@@ -1,0 +1,121 @@
+"""Benchmark: end-to-end TPUJob through the operator on real hardware.
+
+Measures the BASELINE.md north stars in one run:
+- tokens/sec/chip of the flagship Llama trainer (headline metric), and
+- job-startup-to-first-step latency through the full control plane
+  (submit -> gang admission -> pod launch -> first optimizer step).
+
+The reference publishes no numbers (BASELINE.md): vs_baseline is therefore
+reported against the explicit target we set ourselves — 10% MFU on the
+bench model (vs_baseline = achieved_MFU / 0.10); on CPU (no TPU attached)
+it falls back to 1.0.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    t_import = time.time()
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    from kubedl_tpu.api.types import JobConditionType
+    from kubedl_tpu.operator import Operator, OperatorOptions
+    from kubedl_tpu.runtime.executor import ThreadRuntime
+    from tempfile import TemporaryDirectory
+
+    # Bench model: sized for one chip; scaled down for CPU smoke runs.
+    if on_tpu:
+        train_cfg = {
+            "model": "bench-350m",
+            "global_batch": 8,
+            "seq_len": 2048,
+            "steps": 20,
+        }
+    else:
+        train_cfg = {"model": "tiny", "global_batch": 8, "seq_len": 128, "steps": 8}
+
+    summary_holder = {}
+
+    with TemporaryDirectory() as tmp:
+        opts = OperatorOptions(
+            local_addresses=True, artifact_registry_root=os.path.join(tmp, "reg")
+        )
+        with Operator(opts, runtime=ThreadRuntime()) as op:
+            from kubedl_tpu.api.types import ReplicaSpec, ReplicaType, RestartPolicy
+            from kubedl_tpu.core.objects import Container, EnvVar
+            from kubedl_tpu.workloads.tpujob import TPUJob
+
+            job = TPUJob()
+            job.metadata.name = "bench"
+            spec = ReplicaSpec(replicas=1, restart_policy=RestartPolicy.ON_FAILURE_SLICE)
+            spec.template.spec.containers.append(
+                Container(
+                    entrypoint="kubedl_tpu.training.entry:train_main",
+                    env=[EnvVar("KUBEDL_TRAIN_CONFIG", json.dumps(train_cfg))],
+                )
+            )
+            job.spec.replica_specs[ReplicaType.WORKER] = spec
+
+            t_submit = time.time()
+            op.submit(job)
+            got = op.wait_for_phase(
+                "TPUJob", "bench",
+                [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+                timeout=1800,
+            )
+            if got.status.phase != JobConditionType.SUCCEEDED:
+                print(json.dumps({"error": "bench job failed",
+                                  "conditions": [c.message for c in got.status.conditions]}),
+                      file=sys.stderr)
+                return 1
+
+    # ThreadRuntime runs the worker in-process; read its summary back.
+    from kubedl_tpu.training import entry as entry_mod
+
+    summary = entry_mod.LAST_SUMMARY
+    if summary is None:
+        print(json.dumps({"error": "no summary captured"}), file=sys.stderr)
+        return 1
+    summary["_startup_to_first_step"] = max(
+        summary.get("first_step_wall_time", 0.0) - t_submit, 0.0
+    )
+
+    tps_chip = summary["tokens_per_sec_per_chip"]
+    mfu = summary["mfu"]
+    vs_baseline = (mfu / 0.10) if on_tpu and mfu > 0 else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "tokens_per_sec_per_chip",
+                "value": round(tps_chip, 2),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(vs_baseline, 3),
+                "detail": {
+                    "platform": platform,
+                    "mfu": round(mfu, 4),
+                    "first_step_seconds": round(summary["first_step_seconds"], 2),
+                    "startup_to_first_step_seconds": round(
+                        summary.get("_startup_to_first_step", 0.0), 2
+                    ),
+                    "step_time_ms": round(summary["step_time_ms"], 2),
+                    "final_loss": round(summary["final_loss"], 4),
+                },
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,321 @@
+"""Llama-3-family decoder, TPU-first.
+
+Design choices (and why they're TPU-idiomatic, not a torch translation):
+
+- **Functional**: params are a plain pytree; the forward is a pure function
+  under `jit` — no modules, no state.
+- **Scanned layers**: per-layer weights are stacked on a leading axis and the
+  decoder runs as one `lax.scan` over layers. XLA compiles ONE layer body
+  (compile time O(1) in depth) and the weight layout is uniform, which is
+  what makes fsdp/tp shardings trivially specifiable for all layers at once.
+- **Remat**: the scan body is `jax.checkpoint`ed so activations are
+  recomputed in backward — HBM is the bottleneck, MXU flops are cheap.
+- **bf16 params/activations, fp32 softmax + loss** — MXU-native precision.
+- **GQA** (n_kv_heads < n_heads) exactly as Llama-3 uses it.
+- **Sharding by rules**: :func:`param_pspecs` returns a PartitionSpec tree
+  (megatron tensor split + fsdp) consumed by `pjit`/NamedSharding; XLA
+  inserts the collectives.
+
+North-star config (BASELINE.md #4): Llama-3-8B on a gang-scheduled v5e-32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    max_seq: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    #: remat the scan body (trade flops for HBM)
+    remat: bool = True
+    #: tie lm_head to the embedding table (smaller models do)
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def num_params(self) -> int:
+        hd = self.head_dim
+        per_layer = (
+            self.dim * (self.n_heads * hd)  # wq
+            + 2 * self.dim * (self.n_kv_heads * hd)  # wk, wv
+            + (self.n_heads * hd) * self.dim  # wo
+            + 3 * self.dim * self.ffn_dim  # gate, up, down
+            + 2 * self.dim  # norms
+        )
+        embed = self.vocab_size * self.dim
+        head = 0 if self.tie_embeddings else self.dim * self.vocab_size
+        return embed + self.n_layers * per_layer + head + self.dim
+
+    def flops_per_token(self) -> float:
+        """Approximate training FLOPs/token (fwd+bwd ~= 6*N)."""
+        return 6.0 * self.num_params()
+
+
+# ---- presets ---------------------------------------------------------------
+
+LLAMA3_8B = LlamaConfig()
+LLAMA3_1B = LlamaConfig(
+    vocab_size=128256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+    ffn_dim=8192, tie_embeddings=True,
+)
+#: bench-scale model that fits one v5e chip (16 GiB) with room for a real batch
+BENCH_350M = LlamaConfig(
+    vocab_size=32768, dim=1024, n_layers=24, n_heads=16, n_kv_heads=8,
+    ffn_dim=4096, max_seq=2048,
+)
+TINY = LlamaConfig(
+    vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=128,
+    max_seq=128, dtype=jnp.float32, remat=False,
+)
+
+
+def preset(name: str) -> LlamaConfig:
+    table = {
+        "llama3-8b": LLAMA3_8B,
+        "llama3-1b": LLAMA3_1B,
+        "bench-350m": BENCH_350M,
+        "tiny": TINY,
+    }
+    return table[name]
+
+
+# ---- init ------------------------------------------------------------------
+
+def llama_init(key: jax.Array, cfg: LlamaConfig) -> Params:
+    hd = cfg.head_dim
+    k = iter(jax.random.split(key, 12))
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(
+            cfg.dtype
+        )
+
+    L, D, F, V = cfg.n_layers, cfg.dim, cfg.ffn_dim, cfg.vocab_size
+    params: Params = {
+        "embed": dense(next(k), (V, D), D),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), cfg.dtype),
+            "wq": dense(next(k), (L, D, cfg.n_heads * hd), D),
+            "wk": dense(next(k), (L, D, cfg.n_kv_heads * hd), D),
+            "wv": dense(next(k), (L, D, cfg.n_kv_heads * hd), D),
+            "wo": dense(next(k), (L, cfg.n_heads * hd, D), cfg.n_heads * hd),
+            "mlp_norm": jnp.ones((L, D), cfg.dtype),
+            "w_gate": dense(next(k), (L, D, F), D),
+            "w_up": dense(next(k), (L, D, F), D),
+            "w_down": dense(next(k), (L, F, D), F),
+        },
+        "final_norm": jnp.ones((D,), cfg.dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(next(k), (D, V), D)
+    return params
+
+
+def param_pspecs(cfg: LlamaConfig) -> Params:
+    """Megatron tensor split + fsdp, stacked-layer aware.
+
+    Column-parallel (output dim on "tensor"): wq/wk/wv, w_gate/w_up.
+    Row-parallel (input dim on "tensor"): wo, w_down. fsdp shards the other
+    matmul dim. Embedding: vocab on tensor, dim on fsdp.
+    """
+    specs: Params = {
+        "embed": P("tensor", "fsdp"),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, "fsdp", "tensor"),
+            "wk": P(None, "fsdp", "tensor"),
+            "wv": P(None, "fsdp", "tensor"),
+            "wo": P(None, "tensor", "fsdp"),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, "fsdp", "tensor"),
+            "w_up": P(None, "fsdp", "tensor"),
+            "w_down": P(None, "tensor", "fsdp"),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P("fsdp", "tensor")
+    return specs
+
+
+# ---- building blocks -------------------------------------------------------
+
+def rmsnorm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rope_freqs(cfg: LlamaConfig, seq_len: int, offset: int = 0) -> Tuple[jax.Array, jax.Array]:
+    hd = cfg.head_dim
+    inv = 1.0 / (cfg.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    t = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
+    ang = jnp.outer(t, inv)  # [S, hd/2]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [B, S, H, hd]; rotate pairs (even, odd)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    # interleaved convention folded to split-halves (equivalent under a
+    # fixed permutation of head dims; consistent between q and k)
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def attention(
+    q: jax.Array,  # [B, S, H, hd]
+    k: jax.Array,  # [B, S, KV, hd]
+    v: jax.Array,  # [B, S, KV, hd]
+    causal: bool = True,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Reference attention: fp32 softmax, GQA via head grouping. The pallas
+    flash kernel (kubedl_tpu.ops.flash_attention) is the fused drop-in; this
+    is the numerics oracle and CPU fallback."""
+    B, S, H, hd = q.shape
+    KV = k.shape[2]
+    group = H // KV
+    q = q.reshape(B, S, KV, group, hd)
+    scores = jnp.einsum("bskgh,btkh->bkgst", q, k).astype(jnp.float32)
+    scores = scores / math.sqrt(hd)
+    if causal:
+        idx = jnp.arange(S)
+        cmask = idx[:, None] >= idx[None, :]  # [S, T]
+        scores = jnp.where(cmask[None, None, None], scores, -1e30)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgst,btkh->bskgh", probs, v)
+    return out.reshape(B, S, H, hd)
+
+
+def _block(x: jax.Array, lp: Params, cfg: LlamaConfig, cos, sin) -> jax.Array:
+    B, S, D = x.shape
+    hd = cfg.head_dim
+    h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, S, cfg.n_heads, hd)
+    k = (h @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
+    v = (h @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn = attention(q, k, v).reshape(B, S, cfg.n_heads * hd)
+    x = x + attn @ lp["wo"]
+    h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    return x
+
+
+def llama_forward(
+    params: Params, tokens: jax.Array, cfg: LlamaConfig
+) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, V] (fp32)."""
+    B, S = tokens.shape
+    x = params["embed"][tokens].astype(cfg.dtype)
+    cos, sin = rope_freqs(cfg, S)
+
+    def body(carry, lp):
+        return _block(carry, lp, cfg, cos, sin), None
+
+    if cfg.remat:
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    x, _ = lax.scan(body, x, params["layers"])
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return (x @ head).astype(jnp.float32)
+
+
+def llama_loss(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """Next-token cross entropy over tokens[:, 1:]."""
+    logits = llama_forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+# ---- KV-cache decode (serving path) ---------------------------------------
+
+def init_cache(cfg: LlamaConfig, batch: int, max_seq: int) -> Params:
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def decode_step(
+    params: Params, cache: Params, tokens: jax.Array, cfg: LlamaConfig
+) -> Tuple[jax.Array, Params]:
+    """One decode step: tokens [B, 1] -> (logits [B, V], updated cache).
+
+    Static shapes throughout (cache is pre-allocated to max_seq) so the step
+    compiles once and never re-traces — the XLA serving requirement.
+    """
+    B = tokens.shape[0]
+    hd = cfg.head_dim
+    pos = cache["pos"]
+    x = params["embed"][tokens].astype(cfg.dtype)  # [B, 1, D]
+    cos, sin = rope_freqs(cfg, cfg.max_seq)
+    cos_t = lax.dynamic_slice_in_dim(cos, pos, 1)
+    sin_t = lax.dynamic_slice_in_dim(sin, pos, 1)
+    max_s = cache["k"].shape[2]
+    valid = (jnp.arange(max_s) <= pos)[None, None, None, :]  # [1,1,1,T]
+
+    new_k, new_v = [], []
+    for layer in range(cfg.n_layers):
+        lp = jax.tree_util.tree_map(lambda a: a[layer], params["layers"])
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(B, 1, cfg.n_heads, hd)
+        k = (h @ lp["wk"]).reshape(B, 1, cfg.n_kv_heads, hd)
+        v = (h @ lp["wv"]).reshape(B, 1, cfg.n_kv_heads, hd)
+        q = apply_rope(q, cos_t, sin_t)
+        k = apply_rope(k, cos_t, sin_t)
+        ck = lax.dynamic_update_slice_in_dim(cache["k"][layer], k, pos, axis=1)
+        cv = lax.dynamic_update_slice_in_dim(cache["v"][layer], v, pos, axis=1)
+        new_k.append(ck)
+        new_v.append(cv)
+        attn = attention(q, ck, cv, causal=False, mask=valid)
+        x = x + attn.reshape(B, 1, cfg.n_heads * hd) @ lp["wo"]
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+        x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x[:, 0] @ head).astype(jnp.float32)
+    cache = {
+        "k": jnp.stack(new_k),
+        "v": jnp.stack(new_v),
+        "pos": pos + 1,
+    }
+    return logits, cache

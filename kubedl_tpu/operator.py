@@ -1,0 +1,173 @@
+"""Operator: single-binary assembly of the whole control plane.
+
+Reference: main.go:54-118 — flags -> manager (leader election) -> scheme ->
+gang registry -> workload-gated controller setup -> storage backends ->
+persist controllers -> metrics endpoint -> start. Same shape here, minus
+the parts the self-hosted substrate makes moot (scheme registration,
+leader election across replicas).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubedl_tpu.api.interface import JobObject, WorkloadController
+from kubedl_tpu.core.manager import ControllerManager, owner_mapper
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.engine.job_controller import JobEngine
+from kubedl_tpu.gang.slice_scheduler import SliceGangScheduler, SliceInventory
+from kubedl_tpu.lineage.builder import ArtifactRegistry
+from kubedl_tpu.lineage.controller import ModelVersionController
+from kubedl_tpu.observability.metrics import JobMetrics, MetricsRegistry
+from kubedl_tpu.runtime.executor import ContainerRuntime, Kubelet, SubprocessRuntime
+from kubedl_tpu.utils.features import FeatureGates
+from kubedl_tpu.workloads.registry import WORKLOAD_REGISTRY, parse_workload_gate
+
+log = logging.getLogger("kubedl_tpu.operator")
+
+
+@dataclass
+class OperatorOptions:
+    """Startup flags (reference: cmd/options/options.go:24-49 +
+    docs/startup_flags.md)."""
+
+    workloads: str = "*"
+    max_concurrent_reconciles: int = 2
+    feature_gates: str = ""
+    cluster_domain: str = ""
+    artifact_registry_root: str = "/tmp/kubedl-tpu-registry"
+    pod_log_dir: str = ""
+    #: emit loopback addresses instead of svc DNS (local process runtime)
+    local_addresses: bool = False
+    #: workload-controller construction kwargs per kind
+    controller_kwargs: Dict[str, dict] = field(default_factory=dict)
+
+
+class Operator:
+    def __init__(
+        self,
+        options: Optional[OperatorOptions] = None,
+        runtime: Optional[ContainerRuntime] = None,
+        inventory: Optional[SliceInventory] = None,
+    ) -> None:
+        self.options = options or OperatorOptions()
+        self.store = ObjectStore()
+        self.manager = ControllerManager(self.store)
+        self.metrics_registry = MetricsRegistry()
+        self.metrics = JobMetrics(self.metrics_registry)
+        self.features = FeatureGates()
+        if self.options.feature_gates:
+            self.features.set_from_string(self.options.feature_gates)
+        self.inventory = inventory or SliceInventory()
+        self.gang = SliceGangScheduler(self.store, self.inventory)
+        self.engines: Dict[str, JobEngine] = {}
+        self.controllers: Dict[str, WorkloadController] = {}
+
+        # workload-gated controller setup (reference: controllers.go:29-45)
+        enabled = parse_workload_gate(self.options.workloads, list(WORKLOAD_REGISTRY))
+        for kind in enabled:
+            kwargs = dict(self.options.controller_kwargs.get(kind, {}))
+            factory = WORKLOAD_REGISTRY[kind]
+            try:
+                controller = factory(
+                    cluster_domain=self.options.cluster_domain,
+                    local_addresses=self.options.local_addresses,
+                    **kwargs,
+                )
+            except TypeError:
+                controller = factory(**kwargs)
+            engine = JobEngine(
+                store=self.store,
+                controller=controller,
+                recorder=self.manager.recorder,
+                gang_scheduler=self.gang,
+                metrics=self.metrics,
+                features=self.features,
+                cluster_domain=self.options.cluster_domain,
+            )
+            self.engines[kind] = engine
+            self.controllers[kind] = controller
+            self.manager.register(
+                f"{kind.lower()}-controller",
+                engine.reconcile,
+                watch_kinds=[kind, "Pod", "Service", "PodGroup"],
+                mapper=owner_mapper(kind),
+                workers=self.options.max_concurrent_reconciles,
+            )
+            # live running/pending gauges (reference: status_counter.go:22-81)
+            self._register_status_gauges(kind)
+
+        # pod runtime
+        self.kubelet = Kubelet(
+            self.store, runtime or SubprocessRuntime(self.options.pod_log_dir)
+        )
+        self.kubelet.setup(self.manager)
+
+        # model lineage
+        self.artifact_registry = ArtifactRegistry(self.options.artifact_registry_root)
+        self.lineage = ModelVersionController(
+            self.store, self.artifact_registry, self.manager.recorder
+        )
+        self.lineage.setup(self.manager)
+
+    def _register_status_gauges(self, kind: str) -> None:
+        from kubedl_tpu.api.types import JobConditionType
+
+        def count(phase: JobConditionType) -> float:
+            n = 0
+            for obj in self.store.list(kind, namespace=None):
+                if isinstance(obj, JobObject) and obj.status.phase == phase:
+                    n += 1
+            return float(n)
+
+        self.metrics.running.set_function(
+            lambda: count(JobConditionType.RUNNING), kind=kind
+        )
+        self.metrics.pending.set_function(
+            lambda: count(JobConditionType.CREATED)
+            + count(JobConditionType.QUEUED),
+            kind=kind,
+        )
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self.manager.start()
+
+    def stop(self) -> None:
+        self.kubelet.shutdown()
+        self.manager.stop()
+
+    def __enter__(self) -> "Operator":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, job: JobObject) -> JobObject:
+        """Create a job and record the created metric path end-to-end."""
+        return self.store.create(job)  # type: ignore[return-value]
+
+    def wait_for_phase(
+        self, kind: str, name: str, phases, timeout: float = 30.0, namespace: str = "default"
+    ) -> JobObject:
+        if not isinstance(phases, (list, tuple, set)):
+            phases = [phases]
+
+        def check() -> bool:
+            obj = self.store.try_get(kind, name, namespace)
+            return obj is not None and obj.status.phase in phases  # type: ignore[attr-defined]
+
+        self.manager.wait(check, timeout=timeout)
+        obj = self.store.try_get(kind, name, namespace)
+        if obj is None:
+            raise LookupError(f"{kind} {namespace}/{name} vanished")
+        return obj  # type: ignore[return-value]
+
+    def render_metrics(self) -> str:
+        return self.metrics_registry.render()

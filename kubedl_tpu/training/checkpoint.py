@@ -1,0 +1,73 @@
+"""Checkpoint save/restore with a restore-from-latest convention.
+
+The reference has no data-plane checkpointing (SURVEY.md §5) — its analogue
+is the model-output dir convention (`KUBEDL_MODEL_PATH`). The TPU build
+makes checkpointing first-class because slice-granular restart depends on
+it: a gang restart reloads `latest` and loses at most one save interval.
+
+Format: one `step-<N>/` dir per save holding an .npz of all leaves (keyed by
+tree path) + meta.json; `latest` marker file. Restore targets an existing
+abstract state so every leaf lands back on its original NamedSharding.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def _flatten(state) -> Dict[str, Any]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        key = jax.tree_util.keystr(path)
+        flat[key] = np.asarray(jax.device_get(leaf))
+    return flat
+
+
+def save_checkpoint(ckpt_dir: str, state, step: int) -> str:
+    d = Path(ckpt_dir) / f"step-{step:08d}"
+    d.mkdir(parents=True, exist_ok=True)
+    flat = _flatten(state)
+    # atomic-ish: write to tmp then rename
+    fd, tmp = tempfile.mkstemp(dir=str(d), suffix=".tmp.npz")
+    os.close(fd)
+    np.savez(tmp, **flat)
+    os.replace(tmp, d / "state.npz")
+    (d / "meta.json").write_text(json.dumps({"step": step}))
+    (Path(ckpt_dir) / "latest").write_text(d.name)
+    return str(d)
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    marker = Path(ckpt_dir) / "latest"
+    if not marker.exists():
+        return None
+    m = re.match(r"step-(\d+)", marker.read_text().strip())
+    return int(m.group(1)) if m else None
+
+
+def restore_checkpoint(ckpt_dir: str, like, step: Optional[int] = None):
+    """Load into the structure/shardings of `like` (an existing state)."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            return None
+    d = Path(ckpt_dir) / f"step-{step:08d}"
+    data = np.load(d / "state.npz")
+
+    leaves, _ = jax.tree_util.tree_flatten_with_path(like)
+    out = []
+    for path, leaf in leaves:
+        key = jax.tree_util.keystr(path)
+        arr = data[key]
+        if hasattr(leaf, "sharding") and hasattr(leaf, "dtype"):
+            arr = jax.device_put(arr.astype(leaf.dtype), leaf.sharding)
+        out.append(arr)
+    return jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(like), out)

@@ -1,0 +1,80 @@
+"""Worker entrypoint: what a TPUJob pod runs.
+
+Usable two ways (matching the two container runtimes):
+- subprocess: `python -m kubedl_tpu.training.entry`
+- in-process: entrypoint string "kubedl_tpu.training.entry:train_main"
+
+Reads the operator-injected bootstrap env (KUBEDL_*), initializes
+`jax.distributed`, builds the mesh, trains, and writes the final checkpoint
+to KUBEDL_MODEL_PATH (feeding the ModelVersion lineage pipeline). The train
+config rides the env as JSON under KUBEDL_TRAIN_CONFIG.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+#: last run's summary, for in-process harnesses (bench.py) to read back
+LAST_SUMMARY: Optional[dict] = None
+
+
+def train_main(env: Optional[Dict[str, str]] = None) -> int:
+    global LAST_SUMMARY
+    if env:
+        os.environ.update({k: v for k, v in env.items() if isinstance(v, str)})
+    # import jax only after env is set (JAX_PLATFORMS etc.)
+    from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested
+
+    ensure_cpu_if_requested()
+    import jax
+
+    from kubedl_tpu.api import constants
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.parallel.mesh import initialize_from_env, mesh_from_env
+    from kubedl_tpu.training.checkpoint import save_checkpoint
+    from kubedl_tpu.training.data import SyntheticTokens
+    from kubedl_tpu.training.trainer import TrainConfig, Trainer
+
+    initialize_from_env()
+
+    raw = os.environ.get("KUBEDL_TRAIN_CONFIG", "{}")
+    opts = json.loads(raw)
+    model = llama.preset(opts.get("model", "tiny"))
+    cfg = TrainConfig(
+        model=model,
+        global_batch=int(opts.get("global_batch", 8)),
+        seq_len=int(opts.get("seq_len", min(128, model.max_seq))),
+        steps=int(opts.get("steps", 5)),
+        learning_rate=float(opts.get("learning_rate", 3e-4)),
+        grad_accum=int(opts.get("grad_accum", 1)),
+    )
+    mesh = mesh_from_env()
+    trainer = Trainer(cfg, mesh)
+    data = SyntheticTokens(cfg.global_batch, cfg.seq_len, model.vocab_size)
+    first_step_wall = {}
+    cancel = (env or {}).get("_KUBEDL_CANCEL")  # ThreadRuntime cancellation
+
+    def on_step(i, metrics):
+        if i == 0:
+            first_step_wall["t"] = time.time()
+        if cancel is not None and getattr(cancel, "is_set", lambda: False)():
+            raise SystemExit(137)  # retryable: gang restart requested
+
+    state, summary = trainer.fit(iter(data), on_step=on_step)
+    summary["first_step_wall_time"] = first_step_wall.get("t", time.time())
+    LAST_SUMMARY = summary
+    print(json.dumps({"worker_summary": summary}), flush=True)
+
+    out = os.environ.get(constants.ENV_MODEL_PATH, "")
+    proc_id = int(os.environ.get(constants.ENV_PROCESS_ID, "0"))
+    if out and proc_id == 0:
+        save_checkpoint(out, state, int(jax.device_get(state["step"])))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(train_main())

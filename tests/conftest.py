@@ -1,0 +1,22 @@
+"""Test configuration: force CPU JAX with a virtual 8-device mesh so
+multi-chip sharding logic is exercised without TPU hardware (SURVEY.md §4's
+"multi-node-without-cluster" trick, TPU edition)."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: driver env may say otherwise
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("KUBEDL_CI", "true")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Neutralize force-registered accelerator plugins (sitecustomize may have
+# overridden jax_platforms already) so JAX_PLATFORMS=cpu actually holds.
+from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested  # noqa: E402
+
+ensure_cpu_if_requested()

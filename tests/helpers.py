@@ -1,0 +1,76 @@
+"""Shared test builders (reference analogue: pkg/test_util/v1/)."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.api.interface import JobObject
+from kubedl_tpu.api.types import ReplicaSpec, ReplicaType, RestartPolicy
+from kubedl_tpu.core.objects import Container, ContainerStatus, Pod, PodPhase
+from kubedl_tpu.core.store import NotFound, ObjectStore
+from kubedl_tpu.workloads.tpujob import TPUJob
+
+
+def make_tpujob(
+    name: str = "job1",
+    workers: int = 2,
+    command=None,
+    entrypoint: str = "",
+    restart_policy: RestartPolicy = RestartPolicy.ON_FAILURE_SLICE,
+    topology=None,
+) -> TPUJob:
+    job = TPUJob()
+    job.metadata.name = name
+    spec = ReplicaSpec(replicas=workers, restart_policy=restart_policy, topology=topology)
+    spec.template.spec.containers.append(
+        Container(command=command or [], entrypoint=entrypoint)
+    )
+    job.spec.replica_specs[ReplicaType.WORKER] = spec
+    return job
+
+
+class PodDriver:
+    """Drive pod phases by hand (FakeRuntime companion) — the reference's
+    fake-client pattern where tests construct pod states directly."""
+
+    def __init__(self, store: ObjectStore) -> None:
+        self.store = store
+
+    def _set(self, name: str, phase: PodPhase, exit_code: Optional[int] = None,
+             reason: str = "", namespace: str = "default") -> None:
+        def mutate(pod: Pod) -> None:  # type: ignore[type-arg]
+            pod.status.phase = phase
+            pod.status.reason = reason
+            if phase == PodPhase.RUNNING and pod.status.start_time is None:
+                pod.status.start_time = time.time()
+            if exit_code is not None:
+                pod.status.container_statuses = [ContainerStatus(exit_code=exit_code)]
+
+        self.store.update_with_retry("Pod", name, namespace, mutate)
+
+    def run(self, name: str, **kw) -> None:
+        self._set(name, PodPhase.RUNNING, **kw)
+
+    def succeed(self, name: str, **kw) -> None:
+        self._set(name, PodPhase.SUCCEEDED, exit_code=0, **kw)
+
+    def fail(self, name: str, exit_code: int = 1, **kw) -> None:
+        self._set(name, PodPhase.FAILED, exit_code=exit_code, **kw)
+
+    def evict(self, name: str, **kw) -> None:
+        self._set(name, PodPhase.FAILED, exit_code=137, reason="Evicted", **kw)
+
+    def run_all(self, store: ObjectStore, namespace: str = "default") -> None:
+        for pod in store.list("Pod", namespace):
+            if pod.status.phase == PodPhase.PENDING:  # type: ignore[attr-defined]
+                self.run(pod.metadata.name, namespace=namespace)
+
+
+def pod_names(store: ObjectStore, namespace: str = "default"):
+    return sorted(p.metadata.name for p in store.list("Pod", namespace))
+
+
+def env_of(pod: Pod) -> Dict[str, str]:
+    return {e.name: e.value for e in pod.spec.main_container().env}

@@ -1,0 +1,118 @@
+"""Table-driven API type tests (reference analogue:
+apis/training/v1alpha1/*_defaults_test.go)."""
+
+import pytest
+
+from kubedl_tpu.api.topology import MeshSpec, get_slice, validate_mesh_for_slice
+from kubedl_tpu.api.types import (
+    JobCondition,
+    JobConditionType,
+    JobSpec,
+    JobStatus,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    is_retryable_exit_code,
+    job_spec_defaults,
+)
+
+
+def test_exit_code_classification():
+    # reference semantics: 1-127 permanent, 128-255 retryable
+    assert not is_retryable_exit_code(1)
+    assert not is_retryable_exit_code(127)
+    assert is_retryable_exit_code(128)
+    assert is_retryable_exit_code(137)
+    assert is_retryable_exit_code(255)
+
+
+def test_condition_transitions_newest_wins():
+    st = JobStatus()
+    assert st.phase is None
+    assert st.set_condition(JobConditionType.CREATED)
+    assert st.phase == JobConditionType.CREATED
+    assert st.set_condition(JobConditionType.RUNNING)
+    assert st.phase == JobConditionType.RUNNING
+    # same condition again: no transition
+    assert not st.set_condition(JobConditionType.RUNNING, reason="again")
+    assert st.conditions[-1].reason == "again"
+    # restart then run again: RUNNING entry re-appended, only one copy
+    assert st.set_condition(JobConditionType.RESTARTING)
+    assert st.set_condition(JobConditionType.RUNNING)
+    assert sum(1 for c in st.conditions if c.type == JobConditionType.RUNNING) == 1
+    assert st.phase == JobConditionType.RUNNING
+
+
+def test_terminal_helpers():
+    st = JobStatus()
+    st.set_condition(JobConditionType.SUCCEEDED)
+    assert st.is_terminal() and st.is_succeeded() and not st.is_failed()
+
+
+@pytest.mark.parametrize(
+    "replicas,topo,expected",
+    [
+        (0, None, 1),  # defaulted to 1
+        (3, None, 3),
+        (1, "v5e-32", 8),  # clamped to topology host count
+        (99, "v5e-8", 2),
+    ],
+)
+def test_job_spec_defaults(replicas, topo, expected):
+    spec = JobSpec(
+        replica_specs={
+            ReplicaType.WORKER: ReplicaSpec(
+                replicas=replicas, topology=get_slice(topo) if topo else None
+            )
+        }
+    )
+    job_spec_defaults(spec)
+    assert spec.replica_specs[ReplicaType.WORKER].replicas == expected
+    assert spec.replica_specs[ReplicaType.WORKER].template.spec.containers
+
+
+def test_min_available_defaults_to_all():
+    spec = JobSpec(
+        replica_specs={
+            ReplicaType.WORKER: ReplicaSpec(replicas=4),
+            ReplicaType.EVALUATOR: ReplicaSpec(replicas=1),
+        }
+    )
+    assert spec.total_replicas() == 5
+    assert spec.min_available() == 5
+    spec.run_policy.scheduling_policy.min_available = 4
+    assert spec.min_available() == 4
+
+
+class TestTopology:
+    def test_catalog(self):
+        t = get_slice("v5e-32")
+        assert t.chips == 32 and t.hosts == 8 and t.chips_per_host == 4
+        with pytest.raises(KeyError):
+            get_slice("v9x-999")
+
+    def test_host_mesh_and_coordinates(self):
+        t = get_slice("v5e-32")  # physical 4x8, host block 2x2 -> hosts 2x4
+        assert t.host_mesh() == (2, 4)
+        assert t.coordinates(0) == (0, 0)
+        assert t.coordinates(5) == (1, 1)
+
+    def test_mesh_env_roundtrip(self):
+        m = MeshSpec({"data": 4, "tensor": 8})
+        s = m.to_env()
+        assert s == "data=4,tensor=8"
+        assert MeshSpec.from_env(s).axes == m.axes
+
+    def test_mesh_for_slice(self):
+        t = get_slice("v5e-32")
+        m = MeshSpec.for_slice(t, tensor=4)
+        assert m.axes == {"data": 8, "tensor": 4}
+        assert validate_mesh_for_slice(m, t) is None
+        m2 = MeshSpec({"data": 4})
+        assert validate_mesh_for_slice(m2, t) is not None
+
+    def test_mesh_for_multislice(self):
+        t = get_slice("v5e-8")
+        m = MeshSpec.for_slice(t, num_slices=2)
+        assert m.axes == {"replica": 2, "data": 8}
+        assert m.ordered()[0][0] == "replica"  # DCN axis outermost

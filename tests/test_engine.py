@@ -1,0 +1,360 @@
+"""Engine unit tests (reference analogue: pkg/job_controller/*_test.go).
+
+The engine is driven synchronously (no manager threads): reconcile is called
+directly and pod phases are flipped by PodDriver — the fake-client pattern.
+"""
+
+import pytest
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.api.types import (
+    CleanPodPolicy,
+    DAGCondition,
+    JobConditionType,
+    ReplicaPhase,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    SuccessPolicy,
+)
+from kubedl_tpu.core.objects import Container, PodPhase
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.engine.job_controller import JobEngine
+from kubedl_tpu.gang.slice_scheduler import SliceGangScheduler, SliceInventory
+from kubedl_tpu.observability.metrics import JobMetrics, MetricsRegistry
+from kubedl_tpu.workloads.tpujob import TPUJobController
+
+from tests.helpers import PodDriver, env_of, make_tpujob, pod_names
+
+
+def make_engine(store=None, inventory=None, gang=True):
+    store = store or ObjectStore()
+    metrics = JobMetrics(MetricsRegistry())
+    scheduler = None
+    if gang:
+        inventory = inventory or SliceInventory()
+        scheduler = SliceGangScheduler(store, inventory)
+    engine = JobEngine(
+        store=store,
+        controller=TPUJobController(local_addresses=True),
+        gang_scheduler=scheduler,
+        metrics=metrics,
+    )
+    return engine, store, metrics
+
+
+def submit_and_reconcile(engine, store, job, times=1):
+    store.create(job)
+    for _ in range(times):
+        engine.reconcile(job.metadata.namespace, job.metadata.name)
+    return store.get(job.KIND, job.metadata.name)
+
+
+class TestPodCreation:
+    def test_creates_pods_and_services_by_index(self):
+        engine, store, _ = make_engine()
+        job = make_tpujob(workers=3)
+        submit_and_reconcile(engine, store, job)
+        assert pod_names(store) == ["job1-worker-0", "job1-worker-1", "job1-worker-2"]
+        svcs = sorted(s.metadata.name for s in store.list("Service"))
+        assert svcs == ["job1-worker-0", "job1-worker-1", "job1-worker-2"]
+        pod = store.get("Pod", "job1-worker-1")
+        labels = pod.metadata.labels
+        assert labels[constants.LABEL_JOB_NAME] == "job1"
+        assert labels[constants.LABEL_REPLICA_TYPE] == "Worker"
+        assert labels[constants.LABEL_REPLICA_INDEX] == "1"
+
+    def test_bootstrap_env(self):
+        engine, store, _ = make_engine()
+        job = make_tpujob(workers=2)
+        submit_and_reconcile(engine, store, job)
+        pod = store.get("Pod", "job1-worker-1")
+        env = env_of(pod)
+        assert env[constants.ENV_NUM_PROCESSES] == "2"
+        assert env[constants.ENV_PROCESS_ID] == "1"
+        assert env[constants.ENV_TPU_WORKER_ID] == "1"
+        assert env[constants.ENV_COORDINATOR_ADDRESS].startswith("127.0.0.1:")
+        assert "job1-worker-0" in env[constants.ENV_TPU_WORKER_HOSTNAMES]
+
+    def test_idempotent_no_duplicates(self):
+        engine, store, _ = make_engine()
+        job = make_tpujob(workers=2)
+        submit_and_reconcile(engine, store, job, times=3)
+        assert len(pod_names(store)) == 2
+
+    def test_scale_down_deletes_stale_indices(self):
+        engine, store, _ = make_engine()
+        job = make_tpujob(workers=3)
+        submit_and_reconcile(engine, store, job)
+        # shrink to 1 replica
+        def mutate(obj):
+            obj.spec.replica_specs[ReplicaType.WORKER].replicas = 1
+
+        store.update_with_retry("TPUJob", "job1", "default", mutate)
+        engine.reconcile("default", "job1")
+        assert pod_names(store) == ["job1-worker-0"]
+
+
+class TestStatusMachine:
+    def test_running_then_succeeded_worker0(self):
+        engine, store, metrics = make_engine()
+        driver = PodDriver(store)
+        job = make_tpujob(workers=2)
+        submit_and_reconcile(engine, store, job)
+        driver.run("job1-worker-0")
+        driver.run("job1-worker-1")
+        engine.reconcile("default", "job1")
+        assert store.get("TPUJob", "job1").status.phase == JobConditionType.RUNNING
+        driver.succeed("job1-worker-0")
+        engine.reconcile("default", "job1")
+        got = store.get("TPUJob", "job1")
+        assert got.status.phase == JobConditionType.SUCCEEDED
+        assert got.status.completion_time is not None
+        assert metrics.successful.value(kind="TPUJob") == 1
+
+    def test_all_workers_success_policy(self):
+        engine, store, _ = make_engine()
+        driver = PodDriver(store)
+        job = make_tpujob(workers=2)
+        job.spec.success_policy = SuccessPolicy.ALL_WORKERS
+        submit_and_reconcile(engine, store, job)
+        driver.succeed("job1-worker-0")
+        driver.run("job1-worker-1")
+        engine.reconcile("default", "job1")
+        assert store.get("TPUJob", "job1").status.phase != JobConditionType.SUCCEEDED
+        driver.succeed("job1-worker-1")
+        engine.reconcile("default", "job1")
+        assert store.get("TPUJob", "job1").status.phase == JobConditionType.SUCCEEDED
+
+    def test_permanent_failure_fails_job(self):
+        engine, store, metrics = make_engine()
+        driver = PodDriver(store)
+        job = make_tpujob(workers=2, restart_policy=RestartPolicy.EXIT_CODE)
+        submit_and_reconcile(engine, store, job)
+        driver.fail("job1-worker-1", exit_code=1)  # 1-127 = permanent
+        engine.reconcile("default", "job1")
+        got = store.get("TPUJob", "job1")
+        assert got.status.phase == JobConditionType.FAILED
+        assert metrics.failed.value(kind="TPUJob") == 1
+
+    def test_replica_status_counts(self):
+        engine, store, _ = make_engine()
+        driver = PodDriver(store)
+        job = make_tpujob(workers=3, restart_policy=RestartPolicy.NEVER)
+        submit_and_reconcile(engine, store, job)
+        driver.run("job1-worker-0")
+        driver.succeed("job1-worker-1")
+        driver.evict("job1-worker-2")
+        engine.reconcile("default", "job1")
+        rs = store.get("TPUJob", "job1").status.replica_statuses[ReplicaType.WORKER]
+        assert (rs.active, rs.succeeded, rs.failed, rs.evicted) == (1, 1, 1, 1)
+
+
+class TestRestartPolicies:
+    def test_exit_code_retryable_restarts_pod(self):
+        engine, store, _ = make_engine()
+        driver = PodDriver(store)
+        job = make_tpujob(workers=2, restart_policy=RestartPolicy.EXIT_CODE)
+        submit_and_reconcile(engine, store, job)
+        driver.fail("job1-worker-1", exit_code=137)  # retryable
+        engine.reconcile("default", "job1")  # deletes failed pod
+        engine.reconcile("default", "job1")  # recreates it
+        got = store.get("TPUJob", "job1")
+        assert got.status.restart_count == 1
+        pod = store.get("Pod", "job1-worker-1")
+        assert pod.status.phase == PodPhase.PENDING  # fresh replacement
+
+    def test_slice_granular_restart_nukes_gang(self):
+        engine, store, metrics = make_engine()
+        driver = PodDriver(store)
+        job = make_tpujob(workers=3, restart_policy=RestartPolicy.ON_FAILURE_SLICE)
+        submit_and_reconcile(engine, store, job)
+        driver.run("job1-worker-0")
+        driver.run("job1-worker-2")
+        driver.fail("job1-worker-1", exit_code=137)
+        engine.reconcile("default", "job1")
+        # ALL pods of the replica group are gone (whole-slice restart)
+        assert pod_names(store) == []
+        got = store.get("TPUJob", "job1")
+        assert got.status.phase == JobConditionType.RESTARTING
+        assert metrics.restarted.value(kind="TPUJob") == 1
+        engine.reconcile("default", "job1")  # rebuilds the gang
+        assert len(pod_names(store)) == 3
+
+    def test_backoff_limit_fails_job(self):
+        engine, store, _ = make_engine()
+        driver = PodDriver(store)
+        job = make_tpujob(workers=1, restart_policy=RestartPolicy.ON_FAILURE_SLICE)
+        job.spec.run_policy.backoff_limit = 1
+        submit_and_reconcile(engine, store, job)
+        for _ in range(2):
+            driver.fail("job1-worker-0", exit_code=137)
+            engine.reconcile("default", "job1")  # slice restart
+            engine.reconcile("default", "job1")  # recreate
+        got = store.get("TPUJob", "job1")
+        assert got.status.restart_count == 2
+        assert got.status.phase == JobConditionType.FAILED
+        assert "Backoff" in got.status.conditions[-1].reason
+
+    def test_never_leaves_failed_pod(self):
+        engine, store, _ = make_engine()
+        driver = PodDriver(store)
+        job = make_tpujob(workers=1, restart_policy=RestartPolicy.NEVER)
+        submit_and_reconcile(engine, store, job)
+        driver.fail("job1-worker-0", exit_code=137)
+        engine.reconcile("default", "job1")
+        assert store.get("TPUJob", "job1").status.phase == JobConditionType.FAILED
+
+
+class TestCleanPodPolicy:
+    """Reference analogue: TestDeletePodsAndServices CleanPodPolicy matrix
+    (job_test.go:23-130)."""
+
+    @pytest.mark.parametrize(
+        "policy,expect_remaining",
+        [
+            (CleanPodPolicy.ALL, 0),
+            (CleanPodPolicy.RUNNING, 1),  # only the terminal pod stays
+            (CleanPodPolicy.NONE, 2),
+        ],
+    )
+    def test_cleanup_matrix(self, policy, expect_remaining):
+        engine, store, _ = make_engine()
+        driver = PodDriver(store)
+        job = make_tpujob(workers=2)
+        job.spec.run_policy.clean_pod_policy = policy
+        submit_and_reconcile(engine, store, job)
+        driver.succeed("job1-worker-0")  # worker-0 done -> job succeeds
+        driver.run("job1-worker-1")
+        engine.reconcile("default", "job1")
+        assert len(pod_names(store)) == expect_remaining
+        # services always cleaned on terminal
+        assert store.list("Service") == []
+
+    def test_ttl_deletes_job(self):
+        engine, store, _ = make_engine()
+        driver = PodDriver(store)
+        job = make_tpujob(workers=1)
+        job.spec.run_policy.ttl_seconds_after_finished = 0.0
+        submit_and_reconcile(engine, store, job)
+        driver.succeed("job1-worker-0")
+        engine.reconcile("default", "job1")  # terminal + TTL elapsed
+        engine.reconcile("default", "job1")
+        assert store.try_get("TPUJob", "job1") is None
+
+
+class TestDAG:
+    def test_evaluator_waits_for_workers(self):
+        engine, store, _ = make_engine()
+        driver = PodDriver(store)
+        job = make_tpujob(workers=2)
+        ev = ReplicaSpec(
+            replicas=1,
+            restart_policy=RestartPolicy.NEVER,
+            depends_on=[DAGCondition(ReplicaType.WORKER, ReplicaPhase.RUNNING)],
+        )
+        ev.template.spec.containers.append(Container())
+        job.spec.replica_specs[ReplicaType.EVALUATOR] = ev
+        submit_and_reconcile(engine, store, job)
+        assert pod_names(store) == ["job1-worker-0", "job1-worker-1"]
+        driver.run("job1-worker-0")
+        engine.reconcile("default", "job1")
+        assert "job1-evaluator-0" not in pod_names(store)  # not all running yet
+        driver.run("job1-worker-1")
+        engine.reconcile("default", "job1")
+        assert "job1-evaluator-0" in pod_names(store)
+
+
+class TestGang:
+    def test_job_queued_until_slice_free(self):
+        inventory = SliceInventory()
+        inventory.add_slice("s1", "v5e-8")
+        engine, store, _ = make_engine(inventory=inventory)
+        from kubedl_tpu.api.topology import get_slice
+
+        job_a = make_tpujob("job-a", workers=2, topology=get_slice("v5e-8"))
+        job_b = make_tpujob("job-b", workers=2, topology=get_slice("v5e-8"))
+        submit_and_reconcile(engine, store, job_a)
+        assert len(pod_names(store)) == 2  # admitted: pods bound to hosts
+        pod = store.get("Pod", "job-a-worker-0")
+        assert pod.spec.node_name == "s1-host-0"
+        assert pod.spec.slice_assignment == "s1"
+        submit_and_reconcile(engine, store, job_b)
+        got = store.get("TPUJob", "job-b")
+        assert got.status.phase == JobConditionType.QUEUED
+        assert not any("job-b" in n for n in pod_names(store))  # zero partial pods
+        # finish job-a -> slice frees -> job-b admits
+        driver = PodDriver(store)
+        driver.succeed("job-a-worker-0")
+        driver.succeed("job-a-worker-1")
+        engine.reconcile("default", "job-a")
+        engine.reconcile("default", "job-b")
+        assert any("job-b" in n for n in pod_names(store))
+
+    def test_deterministic_binding_across_restart(self):
+        inventory = SliceInventory()
+        inventory.add_slice("s1", "v5e-8")
+        engine, store, _ = make_engine(inventory=inventory)
+        from kubedl_tpu.api.topology import get_slice
+
+        job = make_tpujob("job-a", workers=2, topology=get_slice("v5e-8"))
+        submit_and_reconcile(engine, store, job)
+        before = {
+            p.metadata.name: p.spec.node_name for p in store.list("Pod")
+        }
+        driver = PodDriver(store)
+        driver.fail("job-a-worker-1", exit_code=137)
+        engine.reconcile("default", "job-a")  # slice restart
+        engine.reconcile("default", "job-a")  # recreate
+        after = {p.metadata.name: p.spec.node_name for p in store.list("Pod")}
+        assert before == after  # mesh coordinates stable
+
+
+class TestAnnotationsFeatures:
+    def test_host_network_assigns_port(self):
+        engine, store, _ = make_engine()
+        job = make_tpujob(workers=1)
+        job.metadata.annotations[constants.ANNOTATION_NETWORK_MODE] = "host"
+        submit_and_reconcile(engine, store, job)
+        pod = store.get("Pod", "job1-worker-0")
+        assert pod.spec.host_network
+        hp = pod.spec.main_container().ports[0].host_port
+        assert constants.HOST_PORT_RANGE[0] <= hp < constants.HOST_PORT_RANGE[1]
+
+    def test_git_sync_injection(self):
+        import json
+
+        engine, store, _ = make_engine()
+        job = make_tpujob(workers=1)
+        job.metadata.annotations[constants.ANNOTATION_GIT_SYNC_CONFIG] = json.dumps(
+            {"source": "https://example.com/repo.git", "destPath": "/w/code"}
+        )
+        submit_and_reconcile(engine, store, job)
+        pod = store.get("Pod", "job1-worker-0")
+        assert pod.spec.init_containers
+        assert "clone" in " ".join(pod.spec.init_containers[0].command)
+        assert pod.spec.main_container().working_dir == "/w/code"
+
+
+class TestModelVersionHookup:
+    def test_success_creates_model_version(self, tmp_path):
+        from kubedl_tpu.api.types import ModelVersionSpecRef
+
+        engine, store, _ = make_engine()
+        driver = PodDriver(store)
+        out = tmp_path / "model-out"
+        out.mkdir()
+        (out / "ckpt.bin").write_bytes(b"weights")
+        job = make_tpujob(workers=1)
+        job.spec.model_version = ModelVersionSpecRef(
+            model_name="m1", image_repo="models/m1", storage_root=str(out)
+        )
+        submit_and_reconcile(engine, store, job)
+        pod = store.get("Pod", "job1-worker-0")
+        assert env_of(pod)[constants.ENV_MODEL_PATH] == str(out)
+        driver.succeed("job1-worker-0")
+        engine.reconcile("default", "job1")
+        mvs = store.list("ModelVersion")
+        assert len(mvs) == 1
+        assert mvs[0].model_name == "m1"
+        assert store.get("TPUJob", "job1").status.model_version == mvs[0].metadata.name

@@ -1,0 +1,152 @@
+"""Model + trainer + sharding tests (CPU, 8 virtual devices)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubedl_tpu.api.topology import MeshSpec
+from kubedl_tpu.models import llama
+from kubedl_tpu.parallel.mesh import build_mesh
+from kubedl_tpu.training.checkpoint import (
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from kubedl_tpu.training.data import SyntheticTokens
+from kubedl_tpu.training.trainer import TrainConfig, Trainer
+
+CFG = llama.TINY
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.llama_init(jax.random.PRNGKey(0), CFG)
+
+
+class TestLlamaForward:
+    def test_shapes_and_dtype(self, params):
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        logits = llama.llama_forward(params, tokens, CFG)
+        assert logits.shape == (2, 16, CFG.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_causality(self, params):
+        """Changing a future token must not change past logits."""
+        key = jax.random.PRNGKey(1)
+        t1 = jax.random.randint(key, (1, 16), 0, CFG.vocab_size, jnp.int32)
+        t2 = t1.at[0, 10].set((t1[0, 10] + 1) % CFG.vocab_size)
+        l1 = llama.llama_forward(params, t1, CFG)
+        l2 = llama.llama_forward(params, t2, CFG)
+        np.testing.assert_allclose(l1[0, :10], l2[0, :10], atol=1e-5)
+        assert not np.allclose(l1[0, 10:], l2[0, 10:], atol=1e-5)
+
+    def test_rope_position_dependence(self):
+        """Same vector at different positions -> different rotations, and
+        relative position is preserved (dot product depends only on i-j)."""
+        cos, sin = llama.rope_freqs(CFG, 8)
+        v = jnp.ones((1, 8, 1, CFG.head_dim))
+        r = llama.apply_rope(v, cos, sin)
+        assert not np.allclose(r[0, 0, 0], r[0, 5, 0], atol=1e-4)
+        # relative property: <r_i, r_j> == f(i - j)
+        d01 = jnp.dot(r[0, 1, 0], r[0, 2, 0])
+        d45 = jnp.dot(r[0, 4, 0], r[0, 5, 0])
+        np.testing.assert_allclose(d01, d45, rtol=1e-5)
+
+    def test_param_count_formula(self, params):
+        actual = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        assert actual == CFG.num_params()
+
+    def test_decode_matches_forward(self, params):
+        """KV-cache decode must reproduce teacher-forced logits."""
+        key = jax.random.PRNGKey(2)
+        S = 8
+        tokens = jax.random.randint(key, (1, S), 0, CFG.vocab_size, jnp.int32)
+        full = llama.llama_forward(params, tokens, CFG)  # [1, S, V]
+        cache = llama.init_cache(CFG, 1, S)
+        step = jax.jit(
+            lambda p, c, t: llama.decode_step(p, c, t, CFG)
+        )
+        for i in range(S):
+            logits, cache = step(params, cache, tokens[:, i : i + 1])
+            np.testing.assert_allclose(
+                logits[0], full[0, i], atol=2e-2, rtol=2e-2
+            )
+
+
+class TestTrainer:
+    def test_loss_decreases_on_memorization(self):
+        cfg = TrainConfig(model=CFG, global_batch=4, seq_len=32, steps=30,
+                          learning_rate=1e-2, warmup_steps=2)
+        trainer = Trainer(cfg, build_mesh(MeshSpec({"data": 1}), jax.devices()[:1]))
+        fixed = jax.random.randint(
+            jax.random.PRNGKey(0), (4, 32), 0, CFG.vocab_size, jnp.int32
+        )
+
+        def repeat():
+            while True:
+                yield fixed
+
+        state, summary = trainer.fit(repeat())
+        assert summary["final_loss"] < np.log(CFG.vocab_size) * 0.8
+
+    def test_sharded_training_dp_fsdp_tp(self):
+        """Full train step over an 8-device dp2 x fsdp2 x tensor2 mesh."""
+        assert jax.device_count() >= 8
+        mesh = build_mesh(MeshSpec({"data": 2, "fsdp": 2, "tensor": 2}),
+                          jax.devices()[:8])
+        cfg = TrainConfig(model=CFG, global_batch=8, seq_len=32, steps=3)
+        trainer = Trainer(cfg, mesh)
+        data = SyntheticTokens(8, 32, CFG.vocab_size)
+        state, summary = trainer.fit(iter(data))
+        assert np.isfinite(summary["final_loss"])
+        # params actually sharded: wq leaf must span multiple devices
+        wq = state["params"]["layers"]["wq"]
+        assert len(wq.sharding.device_set) > 1
+
+    def test_grad_accum_matches_tokens(self):
+        mesh = build_mesh(MeshSpec({"data": 2}), jax.devices()[:2])
+        cfg = TrainConfig(model=CFG, global_batch=8, seq_len=16, steps=2,
+                          grad_accum=2)
+        trainer = Trainer(cfg, mesh)
+        data = SyntheticTokens(8, 16, CFG.vocab_size)
+        state, summary = trainer.fit(iter(data))
+        assert np.isfinite(summary["final_loss"])
+        assert int(jax.device_get(state["step"])) == 2
+
+
+class TestCheckpoint:
+    def test_roundtrip_and_latest(self, tmp_path):
+        mesh = build_mesh(MeshSpec({"data": 2}), jax.devices()[:2])
+        cfg = TrainConfig(model=CFG, global_batch=4, seq_len=16, steps=2)
+        trainer = Trainer(cfg, mesh)
+        data = SyntheticTokens(4, 16, CFG.vocab_size)
+        state, _ = trainer.fit(iter(data))
+        save_checkpoint(str(tmp_path), state, 2)
+        assert latest_step(str(tmp_path)) == 2
+        fresh = trainer.init_state()
+        restored = restore_checkpoint(str(tmp_path), fresh)
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(restored["params"]["embed"])),
+            np.asarray(jax.device_get(state["params"]["embed"])),
+        )
+        # restored leaves keep the target shardings
+        assert (
+            restored["params"]["embed"].sharding
+            == fresh["params"]["embed"].sharding
+        )
+
+
+class TestGraftEntry:
+    def test_entry_compiles(self):
+        import __graft_entry__ as ge
+
+        fn, args = ge.entry()
+        out = jax.jit(fn)(*args)
+        jax.block_until_ready(out)
+        assert out.shape[0] == args[1].shape[0]
+
+    def test_dryrun_multichip(self):
+        import __graft_entry__ as ge
+
+        ge.dryrun_multichip(8)

@@ -1,0 +1,121 @@
+"""End-to-end: operator + real pod processes (reference analogue: the kind
+e2e running a distributed TF mnist job, scripts/run_tf_test_job.sh)."""
+
+import sys
+import time
+
+import pytest
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.api.types import JobConditionType, ModelVersionSpecRef, ReplicaType
+from kubedl_tpu.lineage.types import ModelVersionPhase
+from kubedl_tpu.operator import Operator, OperatorOptions
+from kubedl_tpu.runtime.executor import SubprocessRuntime, ThreadRuntime
+
+from tests.helpers import make_tpujob
+
+CHECK_ENV = (
+    "import os,sys;"
+    "req=['KUBEDL_COORDINATOR_ADDRESS','KUBEDL_NUM_PROCESSES','KUBEDL_PROCESS_ID',"
+    "'TPU_WORKER_HOSTNAMES','TPU_WORKER_ID'];"
+    "missing=[k for k in req if k not in os.environ];"
+    "sys.exit(1 if missing else 0)"
+)
+
+
+def test_subprocess_job_lifecycle(tmp_path):
+    opts = OperatorOptions(
+        local_addresses=True,
+        pod_log_dir=str(tmp_path / "logs"),
+        artifact_registry_root=str(tmp_path / "registry"),
+    )
+    with Operator(opts, runtime=SubprocessRuntime(str(tmp_path / "logs"))) as op:
+        job = make_tpujob("e2e", workers=2, command=["python", "-c", CHECK_ENV])
+        op.submit(job)
+        got = op.wait_for_phase(
+            "TPUJob", "e2e", [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+            timeout=30,
+        )
+        assert got.status.phase == JobConditionType.SUCCEEDED, got.status.conditions
+        # launch-delay metrics observed
+        count, _ = op.metrics.first_pod_launch_delay.summary(kind="TPUJob")
+        assert count == 1
+        rendered = op.render_metrics()
+        assert "kubedl_tpu_jobs_successful" in rendered
+
+
+def _train_entry(env):
+    """Thread-runtime entrypoint: writes a fake checkpoint to the model path."""
+    import os
+    import pathlib
+
+    out = env.get(constants.ENV_MODEL_PATH, "")
+    if out:
+        pathlib.Path(out).mkdir(parents=True, exist_ok=True)
+        (pathlib.Path(out) / f"shard-{env['KUBEDL_PROCESS_ID']}.bin").write_bytes(
+            b"\x00" * 128
+        )
+    return 0
+
+
+def test_thread_job_builds_model_version(tmp_path):
+    out_dir = tmp_path / "model-out"
+    opts = OperatorOptions(
+        local_addresses=True, artifact_registry_root=str(tmp_path / "registry")
+    )
+    with Operator(opts, runtime=ThreadRuntime()) as op:
+        job = make_tpujob(
+            "train", workers=2, entrypoint=f"{__name__}:_train_entry"
+        )
+        job.spec.model_version = ModelVersionSpecRef(
+            model_name="flagship", image_repo="models/flagship",
+            storage_root=str(out_dir),
+        )
+        op.submit(job)
+        got = op.wait_for_phase(
+            "TPUJob", "train", [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+            timeout=30,
+        )
+        assert got.status.phase == JobConditionType.SUCCEEDED
+        # lineage: ModelVersion built into the artifact registry
+        assert op.manager.wait(
+            lambda: any(
+                mv.phase == ModelVersionPhase.SUCCEEDED
+                for mv in op.store.list("ModelVersion")
+            ),
+            timeout=10,
+        )
+        mv = op.store.list("ModelVersion")[0]
+        assert op.artifact_registry.exists("models/flagship", mv.image_tag())
+        model = op.store.get("Model", "flagship")
+        assert model.latest_version == mv.metadata.name
+
+
+def test_failed_process_marks_job_failed(tmp_path):
+    opts = OperatorOptions(local_addresses=True,
+                           artifact_registry_root=str(tmp_path / "r"))
+    from kubedl_tpu.api.types import RestartPolicy
+
+    with Operator(opts, runtime=SubprocessRuntime()) as op:
+        job = make_tpujob(
+            "boom", workers=1,
+            command=["python", "-c", "import sys; sys.exit(7)"],
+            restart_policy=RestartPolicy.EXIT_CODE,  # exit 7 = permanent
+        )
+        op.submit(job)
+        got = op.wait_for_phase(
+            "TPUJob", "boom", [JobConditionType.FAILED, JobConditionType.SUCCEEDED],
+            timeout=30,
+        )
+        assert got.status.phase == JobConditionType.FAILED
+        assert op.metrics.failed.value(kind="TPUJob") == 1
+
+
+def test_workload_gate_parsing():
+    from kubedl_tpu.workloads.registry import parse_workload_gate
+
+    known = ["TPUJob", "TorchXLAJob", "MPIJob"]
+    assert parse_workload_gate("*", known) == known
+    assert parse_workload_gate("TPUJob", known) == ["TPUJob"]
+    assert parse_workload_gate("-MPIJob", known) == ["TPUJob", "TorchXLAJob"]
+    assert parse_workload_gate("TPUJob,MPIJob", known) == ["TPUJob", "MPIJob"]
