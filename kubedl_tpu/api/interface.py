@@ -89,6 +89,11 @@ class WorkloadController:
 
     # ---- the process-boundary payload ------------------------------------
 
+    def prepare(self, job: JobObject, ctx: ReconcileContext, store) -> None:
+        """Create kind-owned side objects before pods are built (reference:
+        MPI getOrCreateJobConfig, controllers/mpi/mpi_config.go:48-123 —
+        the hostfile/rsh-agent ConfigMap). Most kinds need nothing."""
+
     def set_mesh_spec(
         self,
         job: JobObject,
@@ -107,6 +112,15 @@ class WorkloadController:
         raise NotImplementedError
 
     # ---- status ----------------------------------------------------------
+
+    def evaluate(self, job: JobObject, pods: List[Pod]):
+        """Compute the job-level condition implied by pod states. Defaults
+        to the shared status machine; kinds with custom success semantics
+        (e.g. XDL's partial-worker success) override or filter the result.
+        Returns (condition|None, reason, message)."""
+        from kubedl_tpu.engine import status as status_machine
+
+        return status_machine.evaluate(job, self, pods)
 
     def update_job_status(
         self, job: JobObject, pods: List[Pod], ctx: ReconcileContext

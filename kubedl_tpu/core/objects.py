@@ -133,6 +133,17 @@ class Volume:
     host_path: str = ""
     empty_dir: bool = False
     mount_path: str = ""
+    #: name of a ConfigMap whose keys are materialized as files at
+    #: ``mount_path`` by the kubelet (reference: MPI mounts the
+    #: hostfile/kubexec ConfigMap into launcher pods, mpi_config.go:48-123)
+    config_map: str = ""
+
+
+def config_mount_path(namespace: str, pod_name: str, volume: str) -> str:
+    """Deterministic materialization dir for ConfigMap volumes, computable
+    at spec-build time (controllers bake it into env) and at launch time
+    (kubelet writes the files there)."""
+    return f"/tmp/kubedl-mounts/{namespace}/{pod_name}/{volume}"
 
 
 @dataclass

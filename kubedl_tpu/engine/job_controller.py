@@ -191,6 +191,9 @@ class JobEngine:
             self._update_status(job)
             return None
 
+        # --- kind-owned side objects (e.g. MPI hostfile ConfigMap) --------
+        self.controller.prepare(job, ctx, self.store)
+
         # --- per-replica-type reconcile in DAG order ----------------------
         restarted = False
         for rtype in self._ordered_types(job):
@@ -212,10 +215,16 @@ class JobEngine:
             )
             self.metrics.restarted.inc(kind=self.controller.KIND)
         else:
-            cond, reason, msg = status_machine.evaluate(job, self.controller, pods)
+            cond, reason, msg = self.controller.evaluate(job, pods)
             if cond is not None and status.set_condition(cond, reason, msg):
                 self._on_transition(job, cond, pods)
+        phase_before_hook = status.phase
         self.controller.update_job_status(job, pods, ctx)
+        if status.phase != phase_before_hook and status.phase is not None:
+            # kind-specific hook transitioned the job (e.g. XDL partial
+            # success) — run the same bookkeeping evaluate-driven
+            # transitions get
+            self._on_transition(job, status.phase, pods)
         self._observe_launch_delays(job, pods)
         if job.status != snapshot or job.metadata.annotations != ann_snapshot:
             status.last_reconcile_time = now

@@ -177,10 +177,25 @@ class Kubelet:
 
     def setup(self, manager: ControllerManager) -> None:
         def mapper(event: str, obj: BaseObject, old):
+            if obj.kind == "ConfigMap":
+                # re-sync mounted ConfigMap volumes of running pods (real
+                # kubelet semantics; e.g. MPI hostfile refresh on scale)
+                keys = []
+                for pod in self.store.list("Pod", obj.metadata.namespace):
+                    if any(
+                        v.config_map == obj.metadata.name
+                        for v in pod.spec.volumes  # type: ignore[union-attr]
+                    ):
+                        keys.append((pod.metadata.namespace, pod.metadata.name))
+                return keys
             return [(obj.metadata.namespace, obj.metadata.name)]
 
         manager.register(
-            self.NAME, self.reconcile, watch_kinds=["Pod"], mapper=mapper, workers=4
+            self.NAME,
+            self.reconcile,
+            watch_kinds=["Pod", "ConfigMap"],
+            mapper=mapper,
+            workers=4,
         )
 
     # ------------------------------------------------------------------
@@ -213,6 +228,11 @@ class Kubelet:
             return None
         with self._lock:
             if key in self._running:
+                # already running: keep mounted ConfigMap volumes fresh
+                try:
+                    self._materialize_config_volumes(pod)
+                except RuntimeError:
+                    pass  # ConfigMap deleted mid-run; keep last snapshot
                 return None
             if pod.status.phase != PodPhase.PENDING:
                 return None
@@ -229,6 +249,7 @@ class Kubelet:
 
     def _launch(self, pod: Pod, key: str) -> None:
         env = self._pod_env(pod)
+        self._materialize_config_volumes(pod)
         # init containers run to completion first (code-sync etc.)
         for init in pod.spec.init_containers:
             if init.command:
@@ -252,6 +273,30 @@ class Kubelet:
             self.reconcile(pod.metadata.namespace, pod.metadata.name)
 
         threading.Thread(target=reap, daemon=True, name=f"reap-{key}").start()
+
+    def _materialize_config_volumes(self, pod: Pod) -> None:
+        """Write ConfigMap-backed volumes to their mount path (the kubelet
+        side of the reference's ConfigMap volume mounts)."""
+        from kubedl_tpu.core.objects import ConfigMap, config_mount_path
+
+        for vol in pod.spec.volumes:
+            if not vol.config_map:
+                continue
+            cm = self.store.try_get(
+                "ConfigMap", vol.config_map, pod.metadata.namespace
+            )
+            if not isinstance(cm, ConfigMap):
+                raise RuntimeError(f"ConfigMap {vol.config_map} not found")
+            root = vol.mount_path or config_mount_path(
+                pod.metadata.namespace, pod.metadata.name, vol.name
+            )
+            os.makedirs(root, exist_ok=True)
+            for fname, content in cm.data.items():
+                path = os.path.join(root, fname)
+                with open(path, "w") as f:
+                    f.write(content)
+                if content.startswith("#!"):
+                    os.chmod(path, 0o755)
 
     class _StalePod(Exception):
         pass

@@ -1,0 +1,149 @@
+"""MarsJob: Scheduler/Worker/WebService graph-execution engine.
+
+Capability parity with the reference's Mars controller (controllers/mars/):
+a `MARS_CLUSTER_DETAIL` env JSON carrying scheduler/web endpoints plus each
+worker's own CPU/memory so workers self-report capacity (mars.go:35-95);
+workers are *excluded* from the cluster endpoint list because the scheduler
+discovers and auto-scales them (mars.go:100-107); a memory-tuning policy
+(plasma store ratio, spill dirs, cache percentage;
+apis/training/v1alpha1/marsjob_types.go:58-79); and WebService addresses
+surfaced on the job (Ingress when `spec.webHost` is set,
+controllers/mars/ingress.go:37-166; status.WebServiceAddresses,
+marsjob_types.go:53-56).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadController
+from kubedl_tpu.api.types import ReplicaType
+from kubedl_tpu.core.objects import Pod
+from kubedl_tpu.workloads.common import add_dag_edge, replica_endpoints
+
+
+@dataclass
+class MemoryTuningPolicy:
+    """Worker memory knobs (reference: marsjob_types.go:58-79)."""
+
+    #: fraction of worker memory given to the plasma shared-memory store
+    plasma_store_ratio: Optional[float] = None
+    #: directories workers spill cold data to
+    spill_dirs: List[str] = field(default_factory=list)
+    #: fraction of memory used as chunk cache
+    cache_ratio: Optional[float] = None
+    #: hard cap on worker memory (bytes); defaults to the container limit
+    worker_cache_size: Optional[int] = None
+
+
+@dataclass
+class MarsJob(JobObject):
+    KIND = "MarsJob"
+    memory_tuning: MemoryTuningPolicy = field(default_factory=MemoryTuningPolicy)
+    #: external host for the web UI; when set, web addresses are published
+    #: as `http://<webHost>/<ns>/<job>` (reference ingress.go:37-166)
+    web_host: str = ""
+
+    #: annotation the observed web endpoints persist under (the engine only
+    #: writes status+annotations back on reconcile)
+    WEB_ADDRESSES_ANNOTATION = "kubedl-tpu.io/web-service-addresses"
+
+    @property
+    def web_service_addresses(self) -> List[str]:
+        """Observed web endpoints (reference: status.WebServiceAddresses,
+        marsjob_types.go:53-56)."""
+        raw = self.metadata.annotations.get(self.WEB_ADDRESSES_ANNOTATION, "")
+        return json.loads(raw) if raw else []
+
+
+class MarsJobController(WorkloadController):
+    KIND = "MarsJob"
+    NAME = "marsjob-controller"
+
+    def __init__(self, cluster_domain: str = "", local_addresses: bool = False) -> None:
+        self.cluster_domain = cluster_domain
+        self.local_addresses = local_addresses
+
+    def object_factory(self) -> MarsJob:
+        return MarsJob()
+
+    def apply_defaults(self, job: JobObject) -> None:
+        """Workers and the web service wait for the scheduler."""
+        super().apply_defaults(job)
+        add_dag_edge(job, ReplicaType.WORKER, ReplicaType.SCHEDULER)
+        add_dag_edge(job, ReplicaType.WEBSERVICE, ReplicaType.SCHEDULER)
+
+    def reconcile_orders(self) -> List[ReplicaType]:
+        return [ReplicaType.SCHEDULER, ReplicaType.WORKER, ReplicaType.WEBSERVICE]
+
+    def is_master_role(self, rtype: ReplicaType) -> bool:
+        return rtype == ReplicaType.SCHEDULER
+
+    # ------------------------------------------------------------------
+
+    def set_mesh_spec(
+        self,
+        job: JobObject,
+        pod: Pod,
+        rtype: ReplicaType,
+        index: int,
+        ctx: ReconcileContext,
+    ) -> None:
+        assert isinstance(job, MarsJob)
+        main = pod.spec.main_container()
+        detail = {
+            "cluster": {
+                # workers deliberately absent: the scheduler discovers them
+                # (reference: mars.go:100-107)
+                "scheduler": replica_endpoints(
+                    job, ReplicaType.SCHEDULER, ctx,
+                    self.cluster_domain, self.local_addresses,
+                ),
+                "web": replica_endpoints(
+                    job, ReplicaType.WEBSERVICE, ctx,
+                    self.cluster_domain, self.local_addresses,
+                ),
+            },
+            "task": {"type": rtype.value.lower(), "index": index},
+        }
+        if rtype == ReplicaType.WORKER:
+            # self-reported capacity (reference: mars.go:35-95)
+            res = main.resources
+            detail["resources"] = {
+                "cpu": res.get("cpu", 1.0),
+                "memory": res.get("memory", 0.0),
+            }
+            mt = job.memory_tuning
+            tuning = {}
+            if mt.plasma_store_ratio is not None:
+                tuning["plasma_store_ratio"] = mt.plasma_store_ratio
+            if mt.cache_ratio is not None:
+                tuning["cache_ratio"] = mt.cache_ratio
+            if mt.spill_dirs:
+                tuning["spill_dirs"] = mt.spill_dirs
+            if mt.worker_cache_size is not None:
+                tuning["worker_cache_size"] = mt.worker_cache_size
+            if tuning:
+                detail["memory_tuning"] = tuning
+        main.set_env("MARS_CLUSTER_DETAIL", json.dumps(detail))
+
+    def update_job_status(
+        self, job: JobObject, pods: List[Pod], ctx: ReconcileContext
+    ) -> None:
+        """Publish web endpoints (reference: status.WebServiceAddresses +
+        ingress host routing)."""
+        assert isinstance(job, MarsJob)
+        addrs = [
+            f"http://{ep}"
+            for ep in replica_endpoints(
+                job, ReplicaType.WEBSERVICE, ctx,
+                self.cluster_domain, self.local_addresses,
+            )
+        ]
+        if job.web_host:
+            addrs.append(
+                f"http://{job.web_host}/{job.metadata.namespace}/{job.metadata.name}"
+            )
+        job.metadata.annotations[job.WEB_ADDRESSES_ANNOTATION] = json.dumps(addrs)

@@ -1,0 +1,105 @@
+"""PyTorchJob: single-master / N-worker DDP.
+
+Capability parity with the reference's PyTorch controller
+(controllers/pytorch/): env MASTER_ADDR / MASTER_PORT / WORLD_SIZE / RANK
+injected per pod, master addressed as `localhost` inside the master pod and
+by its service DNS from workers, worker rank offset +1
+(pytorchjob_controller.go:195-245); a Service is created for the Master only
+(pkg/job_controller/job.go:259-263); master-first reconcile order.
+
+TPU-first: ``backend="xla"`` (the default) additionally emits the torch_xla
+PJRT environment (`PJRT_DEVICE=TPU`) so the same job spec drives
+torch_xla's XLA:TPU DDP instead of NCCL — the reference's NCCL/Gloo init
+maps onto PJRT + XLA collectives (SURVEY.md §2.5 allreduce row).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadController
+from kubedl_tpu.api.types import ReplicaType
+from kubedl_tpu.core.objects import Pod
+from kubedl_tpu.workloads.common import add_dag_edge, replica_dns, replica_port
+
+
+@dataclass
+class PyTorchJob(JobObject):
+    KIND = "PyTorchJob"
+    #: "xla" wires torch_xla/PJRT (TPU); "gloo" leaves device wiring to the
+    #: container (CPU smoke / kind-style CI).
+    backend: str = "xla"
+
+
+class PyTorchJobController(WorkloadController):
+    KIND = "PyTorchJob"
+    NAME = "pytorchjob-controller"
+
+    def __init__(self, cluster_domain: str = "", local_addresses: bool = False) -> None:
+        self.cluster_domain = cluster_domain
+        self.local_addresses = local_addresses
+
+    def object_factory(self) -> PyTorchJob:
+        return PyTorchJob()
+
+    def apply_defaults(self, job: JobObject) -> None:
+        """Workers DAG-wait for the master to be Running — rank-0 must own
+        the rendezvous before ranks 1..N dial in."""
+        super().apply_defaults(job)
+        add_dag_edge(job, ReplicaType.WORKER, ReplicaType.MASTER)
+
+    def reconcile_orders(self) -> List[ReplicaType]:
+        return [ReplicaType.MASTER, ReplicaType.WORKER]
+
+    def is_master_role(self, rtype: ReplicaType) -> bool:
+        return rtype == ReplicaType.MASTER
+
+    def needs_service(self, rtype: ReplicaType) -> bool:
+        return rtype == ReplicaType.MASTER
+
+    # ------------------------------------------------------------------
+
+    def set_mesh_spec(
+        self,
+        job: JobObject,
+        pod: Pod,
+        rtype: ReplicaType,
+        index: int,
+        ctx: ReconcileContext,
+    ) -> None:
+        assert isinstance(job, PyTorchJob)
+        main = pod.spec.main_container()
+        master_spec = job.spec.replica_specs.get(ReplicaType.MASTER)
+        n_workers = (
+            job.spec.replica_specs[ReplicaType.WORKER].replicas
+            if ReplicaType.WORKER in job.spec.replica_specs
+            else 0
+        )
+        world_size = (1 if master_spec else 0) + n_workers
+
+        if rtype == ReplicaType.MASTER:
+            # the master talks to itself over loopback (reference:
+            # pytorchjob_controller.go:195-245)
+            addr = "localhost"
+            rank = 0
+            port = replica_port(master_spec, rtype, index, ctx)
+        else:
+            addr = replica_dns(
+                job, ReplicaType.MASTER, 0, self.cluster_domain, self.local_addresses
+            )
+            rank = index + 1 if master_spec else index
+            port = (
+                replica_port(master_spec, ReplicaType.MASTER, 0, ctx)
+                if master_spec
+                else replica_port(
+                    job.spec.replica_specs[rtype], rtype, index, ctx
+                )
+            )
+
+        main.set_env("MASTER_ADDR", addr)
+        main.set_env("MASTER_PORT", str(port))
+        main.set_env("WORLD_SIZE", str(world_size))
+        main.set_env("RANK", str(rank))
+        if job.backend == "xla":
+            main.set_env("PJRT_DEVICE", "TPU")

@@ -36,9 +36,25 @@ def parse_workload_gate(expr: str, known: List[str]) -> List[str]:
 
 
 def _register_builtin() -> None:
+    """One registration per kind (reference: controllers/add_<kind>.go files
+    populating SetupWithManagerMap)."""
+    from kubedl_tpu.workloads.elasticdljob import ElasticDLJobController
+    from kubedl_tpu.workloads.marsjob import MarsJobController
+    from kubedl_tpu.workloads.mpijob import MPIJobController
+    from kubedl_tpu.workloads.pytorchjob import PyTorchJobController
+    from kubedl_tpu.workloads.tfjob import TFJobController
     from kubedl_tpu.workloads.tpujob import TPUJobController
+    from kubedl_tpu.workloads.xdljob import XDLJobController
+    from kubedl_tpu.workloads.xgboostjob import XGBoostJobController
 
     register_workload("TPUJob", TPUJobController)
+    register_workload("TFJob", TFJobController)
+    register_workload("PyTorchJob", PyTorchJobController)
+    register_workload("XDLJob", XDLJobController)
+    register_workload("XGBoostJob", XGBoostJobController)
+    register_workload("MarsJob", MarsJobController)
+    register_workload("ElasticDLJob", ElasticDLJobController)
+    register_workload("MPIJob", MPIJobController)
 
 
 _register_builtin()

@@ -1,0 +1,420 @@
+"""Workload-controller tests: one class per kind (reference analogue:
+apis/training/v1alpha1/*_test.go defaults tables + per-controller suites
+like controllers/tensorflow/tfjob_controller_test.go).
+
+Pattern: drive the engine synchronously with the kind's controller, flip pod
+phases via PodDriver, assert on generated env/configs — SURVEY.md §4's
+"distributed topology simulated by constructing pod lists" trick.
+"""
+
+import json
+
+import pytest
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.api.types import (
+    JobConditionType,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    SuccessPolicy,
+)
+from kubedl_tpu.core.objects import Container, PodPhase
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.engine.job_controller import JobEngine
+from kubedl_tpu.observability.metrics import JobMetrics, MetricsRegistry
+from kubedl_tpu.workloads.elasticdljob import ElasticDLJob, ElasticDLJobController
+from kubedl_tpu.workloads.marsjob import MarsJob, MarsJobController
+from kubedl_tpu.workloads.mpijob import (
+    HOSTFILE_NAME,
+    INTEL_MPI,
+    MPIJob,
+    MPIJobController,
+    RSH_AGENT_NAME,
+)
+from kubedl_tpu.workloads.pytorchjob import PyTorchJob, PyTorchJobController
+from kubedl_tpu.workloads.registry import WORKLOAD_REGISTRY
+from kubedl_tpu.workloads.tfjob import TFJob, TFJobController
+from kubedl_tpu.workloads.xdljob import XDLJob, XDLJobController
+from kubedl_tpu.workloads.xgboostjob import XGBoostJob, XGBoostJobController
+
+from tests.helpers import PodDriver, env_of, pod_names
+
+
+def make_engine(controller):
+    store = ObjectStore()
+    engine = JobEngine(
+        store=store,
+        controller=controller,
+        gang_scheduler=None,
+        metrics=JobMetrics(MetricsRegistry()),
+    )
+    return engine, store, PodDriver(store)
+
+
+def add_replicas(job, rtype, n, **kw):
+    spec = ReplicaSpec(replicas=n, restart_policy=kw.pop("restart_policy", RestartPolicy.ON_FAILURE))
+    spec.template.spec.containers.append(Container(**kw))
+    job.spec.replica_specs[rtype] = spec
+    return spec
+
+
+def reconcile(engine, job, times=1):
+    for _ in range(times):
+        engine.reconcile(job.metadata.namespace, job.metadata.name)
+
+
+class TestRegistry:
+    def test_all_reference_kinds_registered(self):
+        # the 7 reference kinds (SURVEY.md §2.2) + the flagship TPUJob
+        assert set(WORKLOAD_REGISTRY) >= {
+            "TPUJob", "TFJob", "PyTorchJob", "XDLJob", "XGBoostJob",
+            "MarsJob", "ElasticDLJob", "MPIJob",
+        }
+
+
+class TestTFJob:
+    def make(self, ps=2, workers=2, chief=0):
+        engine, store, driver = make_engine(TFJobController(local_addresses=True))
+        job = TFJob()
+        job.metadata.name = "tf1"
+        add_replicas(job, ReplicaType.PS, ps)
+        add_replicas(job, ReplicaType.WORKER, workers)
+        if chief:
+            add_replicas(job, ReplicaType.CHIEF, chief)
+        store.create(job)
+        return engine, store, driver, job
+
+    def test_tf_config_cluster_and_task(self):
+        engine, store, driver, job = self.make(ps=2, workers=2, chief=1)
+        reconcile(engine, job)
+        # DAG: workers wait for PS Running -> only PS + chief pods first
+        driver.run_all(store)
+        reconcile(engine, job)
+        pod = store.get("Pod", "tf1-worker-1")
+        cfg = json.loads(env_of(pod)["TF_CONFIG"])
+        assert set(cfg["cluster"]) == {"ps", "worker", "chief"}
+        assert len(cfg["cluster"]["ps"]) == 2
+        assert cfg["task"] == {"type": "worker", "index": 1}
+        assert cfg["environment"] == "cloud"
+        # JAX bootstrap rides along for workers only
+        env = env_of(pod)
+        assert env[constants.ENV_NUM_PROCESSES] == "2"
+        ps_env = env_of(store.get("Pod", "tf1-ps-0"))
+        assert constants.ENV_NUM_PROCESSES not in ps_env
+
+    def test_evaluator_excluded_from_cluster_spec(self):
+        engine, store, driver, job = self.make(ps=1, workers=1)
+        add_replicas(job, ReplicaType.EVALUATOR, 1)
+        store.update(job)
+        reconcile(engine, job)
+        driver.run_all(store)
+        reconcile(engine, job)
+        ev = store.get("Pod", "tf1-evaluator-0")
+        cfg = json.loads(env_of(ev)["TF_CONFIG"])
+        assert "evaluator" not in cfg["cluster"]
+        assert cfg["task"]["type"] == "evaluator"
+
+    def test_dag_workers_wait_for_ps(self):
+        engine, store, driver, job = self.make(ps=1, workers=2)
+        reconcile(engine, job)
+        assert pod_names(store) == ["tf1-ps-0"]
+        driver.run("tf1-ps-0")
+        reconcile(engine, job)
+        assert "tf1-worker-0" in pod_names(store)
+
+    def test_success_from_chief(self):
+        engine, store, driver, job = self.make(ps=1, workers=2, chief=1)
+        reconcile(engine, job)
+        driver.run_all(store)
+        reconcile(engine, job)
+        driver.run_all(store)
+        reconcile(engine, job)
+        driver.succeed("tf1-chief-0")
+        reconcile(engine, job)
+        got = store.get("TFJob", "tf1")
+        assert got.status.phase == JobConditionType.SUCCEEDED
+
+    def test_success_worker0_when_masterless(self):
+        engine, store, driver, job = self.make(ps=1, workers=2)
+        reconcile(engine, job)
+        driver.run_all(store)
+        reconcile(engine, job)
+        driver.run_all(store)
+        driver.succeed("tf1-worker-0")
+        reconcile(engine, job)
+        assert store.get("TFJob", "tf1").status.phase == JobConditionType.SUCCEEDED
+
+    def test_all_workers_policy(self):
+        engine, store, driver, job = self.make(ps=1, workers=2)
+        job.spec.success_policy = SuccessPolicy.ALL_WORKERS
+        store.update(job)
+        reconcile(engine, job)
+        driver.run_all(store)
+        reconcile(engine, job)
+        driver.run_all(store)
+        driver.succeed("tf1-worker-0")
+        reconcile(engine, job)
+        assert store.get("TFJob", "tf1").status.phase != JobConditionType.SUCCEEDED
+        driver.succeed("tf1-worker-1")
+        reconcile(engine, job)
+        assert store.get("TFJob", "tf1").status.phase == JobConditionType.SUCCEEDED
+
+
+class TestPyTorchJob:
+    def make(self, workers=2, backend="xla"):
+        engine, store, driver = make_engine(PyTorchJobController(local_addresses=True))
+        job = PyTorchJob()
+        job.metadata.name = "pt1"
+        job.backend = backend
+        add_replicas(job, ReplicaType.MASTER, 1)
+        add_replicas(job, ReplicaType.WORKER, workers)
+        store.create(job)
+        return engine, store, driver, job
+
+    def test_master_env(self):
+        engine, store, driver, job = self.make()
+        reconcile(engine, job)
+        env = env_of(store.get("Pod", "pt1-master-0"))
+        assert env["MASTER_ADDR"] == "localhost"
+        assert env["RANK"] == "0"
+        assert env["WORLD_SIZE"] == "3"
+        assert env["PJRT_DEVICE"] == "TPU"
+
+    def test_worker_rank_offset_and_addr(self):
+        engine, store, driver, job = self.make()
+        reconcile(engine, job)
+        driver.run_all(store)
+        reconcile(engine, job)
+        env = env_of(store.get("Pod", "pt1-worker-1"))
+        assert env["RANK"] == "2"  # offset +1 past the master
+        assert env["MASTER_ADDR"] == "127.0.0.1"
+        assert env["WORLD_SIZE"] == "3"
+
+    def test_service_only_for_master(self):
+        engine, store, driver, job = self.make()
+        reconcile(engine, job)
+        driver.run_all(store)
+        reconcile(engine, job)
+        svcs = [s.metadata.name for s in store.list("Service")]
+        assert svcs == ["pt1-master-0"]
+
+    def test_gloo_backend_skips_pjrt(self):
+        engine, store, driver, job = self.make(backend="gloo")
+        reconcile(engine, job)
+        env = env_of(store.get("Pod", "pt1-master-0"))
+        assert "PJRT_DEVICE" not in env
+
+
+class TestXGBoostJob:
+    def test_rabit_env(self):
+        engine, store, driver = make_engine(XGBoostJobController(local_addresses=True))
+        job = XGBoostJob()
+        job.metadata.name = "xgb1"
+        add_replicas(job, ReplicaType.MASTER, 1)
+        add_replicas(job, ReplicaType.WORKER, 3)
+        store.create(job)
+        reconcile(engine, job)
+        driver.run_all(store)
+        reconcile(engine, job)
+        menv = env_of(store.get("Pod", "xgb1-master-0"))
+        wenv = env_of(store.get("Pod", "xgb1-worker-2"))
+        assert menv["RANK"] == "0"
+        assert wenv["RANK"] == "3"
+        assert wenv["WORLD_SIZE"] == "4"
+        assert wenv["PYTHONUNBUFFERED"] == "1"
+        assert wenv["MASTER_ADDR"] == "127.0.0.1"
+
+
+class TestXDLJob:
+    def make(self, workers=4, **job_kw):
+        engine, store, driver = make_engine(XDLJobController(local_addresses=True))
+        job = XDLJob(**job_kw)
+        job.metadata.name = "xdl1"
+        add_replicas(job, ReplicaType.SCHEDULER, 1)
+        add_replicas(job, ReplicaType.PS, 2)
+        add_replicas(job, ReplicaType.WORKER, workers)
+        store.create(job)
+        return engine, store, driver, job
+
+    def run_all_up(self, engine, store, driver, job):
+        # scheduler -> ps -> workers, DAG-gated round by round
+        for _ in range(3):
+            reconcile(engine, job)
+            driver.run_all(store)
+        reconcile(engine, job)
+
+    def test_cluster_spec_env(self):
+        engine, store, driver, job = self.make()
+        self.run_all_up(engine, store, driver, job)
+        env = env_of(store.get("Pod", "xdl1-worker-0"))
+        cluster = json.loads(env["XDL_CLUSTER_SPEC"])
+        assert set(cluster) == {"scheduler", "ps", "worker"}
+        assert len(cluster["worker"]) == 4
+        assert env["XDL_TASK_NAME"] == "worker"
+        assert env["XDL_TASK_INDEX"] == "0"
+
+    def test_partial_success_num(self):
+        engine, store, driver, job = self.make(workers=4, min_finish_worker_num=2)
+        self.run_all_up(engine, store, driver, job)
+        driver.succeed("xdl1-worker-0")
+        reconcile(engine, job)
+        assert store.get("XDLJob", "xdl1").status.phase != JobConditionType.SUCCEEDED
+        driver.succeed("xdl1-worker-1")
+        reconcile(engine, job)
+        got = store.get("XDLJob", "xdl1")
+        assert got.status.phase == JobConditionType.SUCCEEDED
+        assert got.status.completion_time is not None
+
+    def test_partial_success_percentage(self):
+        engine, store, driver, job = self.make(
+            workers=4, min_finish_worker_percentage=50.0
+        )
+        self.run_all_up(engine, store, driver, job)
+        driver.succeed("xdl1-worker-0")
+        driver.succeed("xdl1-worker-3")
+        reconcile(engine, job)
+        assert store.get("XDLJob", "xdl1").status.phase == JobConditionType.SUCCEEDED
+
+
+class TestMarsJob:
+    def make(self):
+        engine, store, driver = make_engine(MarsJobController(local_addresses=True))
+        job = MarsJob()
+        job.metadata.name = "mars1"
+        add_replicas(job, ReplicaType.SCHEDULER, 1)
+        spec = add_replicas(job, ReplicaType.WORKER, 2)
+        spec.template.spec.main_container().resources.update(
+            {"cpu": 4.0, "memory": 8e9}
+        )
+        add_replicas(job, ReplicaType.WEBSERVICE, 1)
+        job.memory_tuning.plasma_store_ratio = 0.3
+        job.memory_tuning.spill_dirs = ["/spill"]
+        store.create(job)
+        return engine, store, driver, job
+
+    def test_cluster_detail(self):
+        engine, store, driver, job = self.make()
+        reconcile(engine, job)
+        driver.run_all(store)
+        reconcile(engine, job)
+        wenv = env_of(store.get("Pod", "mars1-worker-0"))
+        detail = json.loads(wenv["MARS_CLUSTER_DETAIL"])
+        # workers excluded from the endpoint list (auto-scalable)
+        assert "worker" not in detail["cluster"]
+        assert len(detail["cluster"]["scheduler"]) == 1
+        assert detail["resources"]["cpu"] == 4.0
+        assert detail["memory_tuning"]["plasma_store_ratio"] == 0.3
+        assert detail["memory_tuning"]["spill_dirs"] == ["/spill"]
+        senv = env_of(store.get("Pod", "mars1-scheduler-0"))
+        sdetail = json.loads(senv["MARS_CLUSTER_DETAIL"])
+        assert "resources" not in sdetail
+
+    def test_web_addresses_published(self):
+        engine, store, driver, job = self.make()
+        job.web_host = "mars.example.com"
+        store.update(job)
+        reconcile(engine, job)
+        driver.run_all(store)
+        reconcile(engine, job)
+        got = store.get("MarsJob", "mars1")
+        assert any("mars.example.com" in a for a in got.web_service_addresses)
+        assert any(a.startswith("http://127.0.0.1") for a in got.web_service_addresses)
+
+
+class TestElasticDLJob:
+    def test_master_only_no_services(self):
+        engine, store, driver = make_engine(ElasticDLJobController(local_addresses=True))
+        job = ElasticDLJob()
+        job.metadata.name = "edl1"
+        add_replicas(job, ReplicaType.MASTER, 1)
+        add_replicas(job, ReplicaType.WORKER, 3)  # illegal: dropped by defaults
+        store.create(job)
+        reconcile(engine, job)
+        assert pod_names(store) == ["edl1-master-0"]
+        assert store.list("Service") == []
+        env = env_of(store.get("Pod", "edl1-master-0"))
+        assert env["ELASTICDL_MASTER_POD"] == "elasticdl-edl1-master"
+        driver.run_all(store)
+        reconcile(engine, job)
+        driver.succeed("edl1-master-0")
+        reconcile(engine, job)
+        assert store.get("ElasticDLJob", "edl1").status.phase == JobConditionType.SUCCEEDED
+
+
+class TestMPIJob:
+    def make(self, workers=2, distribution="OpenMPI"):
+        engine, store, driver = make_engine(MPIJobController(local_addresses=True))
+        job = MPIJob()
+        job.metadata.name = "mpi1"
+        job.mpi_distribution = distribution
+        add_replicas(job, ReplicaType.LAUNCHER, 1, command=["mpirun", "true"])
+        add_replicas(job, ReplicaType.WORKER, workers)
+        store.create(job)
+        return engine, store, driver, job
+
+    def test_workers_first_then_launcher(self):
+        engine, store, driver, job = self.make()
+        reconcile(engine, job)
+        assert pod_names(store) == ["mpi1-worker-0", "mpi1-worker-1"]
+        driver.run_all(store)
+        reconcile(engine, job)
+        assert "mpi1-launcher-0" in pod_names(store)
+        # workers get headless services (hostfile DNS); the launcher none
+        svcs = sorted(s.metadata.name for s in store.list("Service"))
+        assert svcs == ["mpi1-worker-0", "mpi1-worker-1"]
+
+    def test_hostfile_configmap(self):
+        engine, store, driver, job = self.make()
+        reconcile(engine, job)
+        cm = store.get("ConfigMap", "mpi1-config")
+        assert "slots=1" in cm.data[HOSTFILE_NAME]
+        assert cm.data[HOSTFILE_NAME].count("\n") == 2
+        assert cm.data[RSH_AGENT_NAME].startswith("#!/bin/sh")
+
+    def test_worker_default_sleep(self):
+        engine, store, driver, job = self.make()
+        reconcile(engine, job)
+        pod = store.get("Pod", "mpi1-worker-0")
+        assert pod.spec.main_container().command == ["sleep", "365d"]
+
+    def test_launcher_env_openmpi(self):
+        engine, store, driver, job = self.make()
+        reconcile(engine, job)
+        driver.run_all(store)
+        reconcile(engine, job)
+        env = env_of(store.get("Pod", "mpi1-launcher-0"))
+        assert env["OMPI_MCA_plm_rsh_agent"].endswith(RSH_AGENT_NAME)
+        assert env["OMPI_MCA_orte_default_hostfile"].endswith(HOSTFILE_NAME)
+        assert env[constants.ENV_NUM_PROCESSES] == "2"
+
+    def test_launcher_env_intelmpi(self):
+        engine, store, driver, job = self.make(distribution=INTEL_MPI)
+        reconcile(engine, job)
+        cm = store.get("ConfigMap", "mpi1-config")
+        assert ":1" in cm.data[HOSTFILE_NAME]  # host:N syntax
+        driver.run_all(store)
+        reconcile(engine, job)
+        env = env_of(store.get("Pod", "mpi1-launcher-0"))
+        assert env["I_MPI_HYDRA_BOOTSTRAP"] == "rsh"
+
+    def test_hostfile_refreshed_on_scale(self):
+        engine, store, driver, job = self.make(workers=2)
+        reconcile(engine, job)
+        job = store.get("MPIJob", "mpi1")
+        job.spec.replica_specs[ReplicaType.WORKER].replicas = 3
+        store.update(job)
+        reconcile(engine, job)
+        cm = store.get("ConfigMap", "mpi1-config")
+        assert cm.data[HOSTFILE_NAME].count("\n") == 3
+
+    def test_launcher_success_finishes_job(self):
+        engine, store, driver, job = self.make()
+        reconcile(engine, job)
+        driver.run_all(store)
+        reconcile(engine, job)
+        driver.run_all(store)
+        reconcile(engine, job)
+        driver.succeed("mpi1-launcher-0")
+        reconcile(engine, job)
+        assert store.get("MPIJob", "mpi1").status.phase == JobConditionType.SUCCEEDED
