@@ -57,6 +57,17 @@ class WorkloadController:
     KIND: str = "Job"
     #: Controller name for logs/metrics.
     NAME: str = "job-controller"
+    #: Replica types this kind accepts; None = no restriction. Unknown
+    #: types are pruned during defaulting (a bad spec must degrade, not
+    #: wedge reconcile with a KeyError).
+    ALLOWED_REPLICA_TYPES: Optional[tuple] = None
+
+    def __init__(self, cluster_domain: str = "", local_addresses: bool = False) -> None:
+        #: local_addresses=True emits 127.0.0.1 instead of service DNS —
+        #: used when pods run as local processes (tests, the single-host
+        #: dev loop, CI's kind-style smoke).
+        self.cluster_domain = cluster_domain
+        self.local_addresses = local_addresses
 
     # ---- identity --------------------------------------------------------
 
@@ -69,6 +80,10 @@ class WorkloadController:
         (e.g. TPUJob.num_slices) override."""
         from kubedl_tpu.api.types import job_spec_defaults
 
+        if self.ALLOWED_REPLICA_TYPES is not None:
+            for rtype in list(job.spec.replica_specs):
+                if rtype not in self.ALLOWED_REPLICA_TYPES:
+                    del job.spec.replica_specs[rtype]
         job_spec_defaults(job.spec)
 
     # ---- topology / ordering --------------------------------------------
@@ -81,10 +96,14 @@ class WorkloadController:
     def is_master_role(self, rtype: ReplicaType) -> bool:
         return rtype in (ReplicaType.MASTER, ReplicaType.CHIEF, ReplicaType.LAUNCHER)
 
-    def needs_service(self, rtype: ReplicaType) -> bool:
+    def needs_service(
+        self, rtype: ReplicaType, job: Optional[JobObject] = None
+    ) -> bool:
         """Whether replicas of this type get a headless service. The
         reference skips services for ElasticDL and MPI entirely and creates
-        master-only services for PyTorch (job.go:253-263)."""
+        master-only services for PyTorch (job.go:253-263). ``job`` lets
+        kinds decide per-spec (e.g. masterless PyTorch needs worker-0
+        addressable)."""
         return True
 
     # ---- the process-boundary payload ------------------------------------

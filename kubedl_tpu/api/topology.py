@@ -161,7 +161,10 @@ class MeshSpec:
 
     axes: Dict[str, int] = field(default_factory=dict)
 
-    AXIS_ORDER = ("replica", "data", "fsdp", "expert", "sequence", "tensor")
+    #: outermost-first; DCN-crossing (replica/data) out, ICI-hungry in.
+    #: "sp" = sequence/context parallel (ring attention), "pipe" = pipeline
+    #: stages, "expert" = MoE expert parallel.
+    AXIS_ORDER = ("replica", "data", "fsdp", "pipe", "expert", "sp", "tensor")
 
     def size(self) -> int:
         n = 1

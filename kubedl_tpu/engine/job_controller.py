@@ -203,7 +203,7 @@ class JobEngine:
             ):
                 continue
             restarted |= self.reconcile_pods(job, ctx, rtype, spec)
-            if self.controller.needs_service(rtype):
+            if self.controller.needs_service(rtype, job):
                 self.reconcile_services(job, ctx, rtype, spec)
 
         # --- status machine ----------------------------------------------

@@ -170,12 +170,17 @@ def rmsnorm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (x * weight.astype(jnp.float32)).astype(dtype)
 
 
-def rope_freqs(cfg: LlamaConfig, seq_len: int, offset: int = 0) -> Tuple[jax.Array, jax.Array]:
-    hd = cfg.head_dim
-    inv = 1.0 / (cfg.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+def rope_table(
+    head_dim: int, theta: float, seq_len: int, offset: int = 0
+) -> Tuple[jax.Array, jax.Array]:
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     t = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
     ang = jnp.outer(t, inv)  # [S, hd/2]
     return jnp.cos(ang), jnp.sin(ang)
+
+
+def rope_freqs(cfg: LlamaConfig, seq_len: int, offset: int = 0) -> Tuple[jax.Array, jax.Array]:
+    return rope_table(cfg.head_dim, cfg.rope_theta, seq_len, offset)
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
@@ -216,7 +221,9 @@ def attention(
     return out.reshape(B, S, H, hd)
 
 
-def _block(x: jax.Array, lp: Params, cfg: LlamaConfig, cos, sin) -> jax.Array:
+def _block(
+    x: jax.Array, lp: Params, cfg: LlamaConfig, cos, sin, attn_fn=None
+) -> jax.Array:
     B, S, D = x.shape
     hd = cfg.head_dim
     h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
@@ -225,7 +232,7 @@ def _block(x: jax.Array, lp: Params, cfg: LlamaConfig, cos, sin) -> jax.Array:
     v = (h @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    attn = attention(q, k, v).reshape(B, S, cfg.n_heads * hd)
+    attn = (attn_fn or attention)(q, k, v).reshape(B, S, cfg.n_heads * hd)
     x = x + attn @ lp["wo"]
     h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
     gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
@@ -234,15 +241,22 @@ def _block(x: jax.Array, lp: Params, cfg: LlamaConfig, cos, sin) -> jax.Array:
 
 
 def llama_forward(
-    params: Params, tokens: jax.Array, cfg: LlamaConfig
+    params: Params, tokens: jax.Array, cfg: LlamaConfig, attn_fn=None
 ) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, V] (fp32)."""
+    """tokens [B, S] int32 -> logits [B, S, V] (fp32).
+
+    ``attn_fn`` swaps the attention implementation: dense oracle (default),
+    pallas flash kernel, or sequence-parallel ring/ulysses attention built
+    by `kubedl_tpu.parallel.ring.make_context_attention` — RoPE is applied
+    here with global positions, so sequence-sharded attention composes
+    without position bookkeeping.
+    """
     B, S = tokens.shape
     x = params["embed"][tokens].astype(cfg.dtype)
     cos, sin = rope_freqs(cfg, S)
 
     def body(carry, lp):
-        return _block(carry, lp, cfg, cos, sin), None
+        return _block(carry, lp, cfg, cos, sin, attn_fn), None
 
     if cfg.remat:
         body = jax.checkpoint(
@@ -254,11 +268,24 @@ def llama_forward(
     return (x @ head).astype(jnp.float32)
 
 
-def llama_loss(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
-    """Next-token cross entropy over tokens[:, 1:]."""
-    logits = llama_forward(params, tokens[:, :-1], cfg)
+def llama_loss(
+    params: Params, tokens: jax.Array, cfg: LlamaConfig, attn_fn=None
+) -> jax.Array:
+    """Next-token cross entropy over tokens[:, 1:].
+
+    The forward runs on the FULL sequence (last position's logits unused)
+    so the seq dim keeps its length — slicing to S-1 before the forward
+    would break even sequence sharding under context parallelism.
+    """
+    logits = llama_forward(params, tokens, cfg, attn_fn)
+    return next_token_nll(logits, tokens)
+
+
+def next_token_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Mean next-token NLL: logits [B, S, V] (full sequence) scored against
+    tokens shifted by one. Shared by every LM family."""
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()
 

@@ -112,6 +112,25 @@ class Operator:
         )
         self.lineage.setup(self.manager)
 
+        # cron workflows over every enabled kind (reference: controllers/apps)
+        from kubedl_tpu.cron.controller import CronController
+
+        self.cron = CronController(
+            self.store, list(self.engines), self.manager.recorder
+        )
+        self.cron.setup(self.manager)
+
+        # inference serving (reference: controllers/serving)
+        from kubedl_tpu.serving.controller import InferenceController
+
+        self.serving = InferenceController(
+            self.store,
+            self.manager.recorder,
+            local_addresses=self.options.local_addresses,
+            cluster_domain=self.options.cluster_domain,
+        )
+        self.serving.setup(self.manager)
+
     def _register_status_gauges(self, kind: str) -> None:
         from kubedl_tpu.api.types import JobConditionType
 

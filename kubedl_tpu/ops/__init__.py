@@ -1,0 +1,8 @@
+"""TPU kernels (pallas) for the hot ops.
+
+The reference has no native compute code at all (SURVEY.md §2: 100% Go
+orchestration); these kernels are the TPU build's data-plane floor:
+- flash_attention: fused attention, O(S) memory, MXU-tiled.
+"""
+
+from kubedl_tpu.ops.flash_attention import flash_attention  # noqa: F401

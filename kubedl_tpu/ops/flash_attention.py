@@ -1,0 +1,238 @@
+"""Flash attention: fused pallas TPU kernel with online softmax.
+
+Single-chip counterpart of `kubedl_tpu.parallel.ring` (which runs the same
+recurrence *across* chips): scores never materialize in HBM — each (q-block,
+k-block) tile streams through VMEM, the MXU does the two matmuls, and a
+running (max, sum, acc) triple in VMEM scratch folds blocks in
+(the flash-attention recurrence). Memory is O(S·hd) instead of O(S²);
+causal blocks above the diagonal are predicated off entirely (half the
+FLOPs at long S).
+
+Grid layout: (batch, q_heads, q_blocks, k_blocks), k innermost so the
+scratch accumulator carries across k-steps of one q-tile — the canonical
+pallas accumulation pattern (pallas_guide.md: grid iterates last dim
+fastest; scratch persists). GQA is free: the K/V BlockSpec index map sends
+q-head h to kv-head h//group, no repeated K/V in memory.
+
+Backward is a custom VJP running the standard flash backward recurrence as
+a blockwise `lax.scan` in plain JAX (saves (q,k,v,out,lse); recomputes
+P per block) — O(S·bk) live memory, XLA fuses the per-block einsums.
+
+On CPU (tests) the kernel runs in pallas interpret mode; numerics match
+the dense oracle `kubedl_tpu.models.llama.attention`.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int, n_k: int,
+):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: skip k-blocks strictly above the diagonal
+    run = (j * block_k <= i * block_q + block_q - 1) if causal else (j <= n_k)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]  # [bq, hd]
+        k = k_ref[0, 0]  # [bk, hd]
+        v = v_ref[0, 0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if causal:
+            rows = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_ref[:, :1]  # [bq, 1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_ref[:, :1] * corr + p.sum(axis=-1, keepdims=True)
+        pv = lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == n_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:, 0] + jnp.log(l[:, 0])
+
+
+def _fwd(
+    q: jax.Array,  # [B, H, Sq, hd]
+    k: jax.Array,  # [B, KV, Sk, hd]
+    v: jax.Array,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Sq, hd = q.shape
+    KV, Sk = k.shape[1], k.shape[2]
+    group = H // KV
+    bq = min(block_q, Sq)
+    bk = min(block_k, Sk)
+    if Sq % bq or Sk % bk:
+        raise ValueError(f"seq lengths ({Sq},{Sk}) must divide blocks ({bq},{bk})")
+    n_q, n_k = Sq // bq, Sk // bk
+    scale = 1.0 / math.sqrt(hd)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=bq, block_k=bk, n_k=n_k,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // group, j, 0)),
+            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // group, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sq, hd), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, hd), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _bwd_blockwise(
+    res, do: jax.Array, causal: bool, block_k: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Flash backward as a lax.scan over k/v blocks (plain JAX; O(S·bk)
+    live memory). GQA handled by grouping q-heads per kv-head."""
+    q, k, v, out, lse = res
+    B, H, Sq, hd = q.shape
+    KV, Sk = k.shape[1], k.shape[2]
+    G = H // KV
+    bk = min(block_k, Sk)
+    n_k = Sk // bk
+    scale = 1.0 / math.sqrt(hd)
+
+    qg = q.reshape(B, KV, G, Sq, hd).astype(jnp.float32)
+    dog = do.reshape(B, KV, G, Sq, hd).astype(jnp.float32)
+    lse_g = lse.reshape(B, KV, G, Sq)
+    # D_i = rowsum(dO * O) — the softmax-normalization term
+    D = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    D_g = D.reshape(B, KV, G, Sq)
+    rows = jnp.arange(Sq)
+
+    k_blocks = k.reshape(B, KV, n_k, bk, hd).transpose(2, 0, 1, 3, 4)
+    v_blocks = v.reshape(B, KV, n_k, bk, hd).transpose(2, 0, 1, 3, 4)
+
+    def step(dq_acc, blk):
+        j, k_j, v_j = blk
+        k_j = k_j.astype(jnp.float32)
+        v_j = v_j.astype(jnp.float32)
+        s = jnp.einsum("bkgqd,bktd->bkgqt", qg, k_j) * scale
+        if causal:
+            cols = j * bk + jnp.arange(bk)
+            s = jnp.where(rows[:, None] >= cols[None, :], s, NEG_INF)
+        p = jnp.exp(s - lse_g[..., None])
+        dv_j = jnp.einsum("bkgqt,bkgqd->bktd", p, dog)
+        dp = jnp.einsum("bkgqd,bktd->bkgqt", dog, v_j)
+        ds = p * (dp - D_g[..., None])
+        dq_acc = dq_acc + jnp.einsum("bkgqt,bktd->bkgqd", ds, k_j) * scale
+        dk_j = jnp.einsum("bkgqt,bkgqd->bktd", ds, qg) * scale
+        return dq_acc, (dk_j, dv_j)
+
+    dq0 = jnp.zeros_like(qg)
+    dq, (dk_blocks, dv_blocks) = lax.scan(
+        step, dq0, (jnp.arange(n_k), k_blocks, v_blocks)
+    )
+    dq = dq.reshape(B, H, Sq, hd).astype(q.dtype)
+    dk = dk_blocks.transpose(1, 2, 0, 3, 4).reshape(B, KV, Sk, hd).astype(k.dtype)
+    dv = dv_blocks.transpose(1, 2, 0, 3, 4).reshape(B, KV, Sk, hd).astype(v.dtype)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, do):
+    return _bwd_blockwise(res, do, causal, block_k)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def flash_attention(
+    q: jax.Array,  # [B, S, H, hd] — llama layout
+    k: jax.Array,  # [B, S, KV, hd]
+    v: jax.Array,
+    causal: bool = True,
+    mask: Optional[jax.Array] = None,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Drop-in for `kubedl_tpu.models.llama.attention` (same signature, so
+    it slots into `llama_forward(..., attn_fn=flash_attention)`). Arbitrary
+    masks fall back to the dense oracle — flash handles the causal/full
+    cases that training uses."""
+    if mask is not None:
+        from kubedl_tpu.models.llama import attention
+
+        return attention(q, k, v, causal=causal, mask=mask)
+    if interpret is None:
+        interpret = _default_interpret()
+    qt = q.transpose(0, 2, 1, 3)  # [B, H, S, hd]
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash(qt, kt, vt, causal, block_q, block_k, interpret)
+    return out.transpose(0, 2, 1, 3)

@@ -22,6 +22,8 @@ from kubedl_tpu.api.topology import MeshSpec
 
 #: Axes a batch dimension is sharded over (all data-parallel-like axes).
 DATA_AXES = ("replica", "data", "fsdp")
+#: The sequence/context-parallel mesh axis (ring attention shards over it).
+SEQUENCE_AXIS = "sp"
 
 
 def build_mesh(
@@ -77,8 +79,11 @@ def batch_axes(mesh: Mesh) -> tuple:
 
 
 def batch_pspec(mesh: Mesh) -> P:
+    """[B, S, ...] batches: B over data-like axes, S over the sequence-
+    parallel axis when the mesh has one (context parallelism)."""
     axes = tuple(a for a in DATA_AXES if a in mesh.axis_names)
-    return P(axes if axes else None)
+    seq = SEQUENCE_AXIS if SEQUENCE_AXIS in mesh.axis_names else None
+    return P(axes if axes else None, seq)
 
 
 def shard_batch(mesh: Mesh, batch):

@@ -174,6 +174,9 @@ class Kubelet:
         self.pod_ip = pod_ip
         self._lock = threading.Lock()
         self._running: Dict[str, ProcHandle] = {}
+        #: (ns, pod, volume) -> (pod uid, ConfigMap resource version) last
+        #: materialized; cleared when the pod is deleted
+        self._materialized: Dict[tuple, tuple] = {}
 
     def setup(self, manager: ControllerManager) -> None:
         def mapper(event: str, obj: BaseObject, old):
@@ -220,6 +223,9 @@ class Kubelet:
         if pod is None:
             with self._lock:
                 handle = self._running.pop(key, None)
+                for sk in [k for k in self._materialized
+                           if (k[0], k[1]) == (namespace, name)]:
+                    del self._materialized[sk]
             if handle is not None:
                 handle.kill()
             return None
@@ -227,17 +233,20 @@ class Kubelet:
         if not self._served(pod) or pod.is_terminal():
             return None
         with self._lock:
-            if key in self._running:
-                # already running: keep mounted ConfigMap volumes fresh
-                try:
-                    self._materialize_config_volumes(pod)
-                except RuntimeError:
-                    pass  # ConfigMap deleted mid-run; keep last snapshot
-                return None
-            if pod.status.phase != PodPhase.PENDING:
-                return None
-            # reserve the slot before leaving the lock
-            self._running[key] = _PlaceholderHandle()
+            already_running = key in self._running
+            if not already_running:
+                if pod.status.phase != PodPhase.PENDING:
+                    return None
+                # reserve the slot before leaving the lock
+                self._running[key] = _PlaceholderHandle()
+        if already_running:
+            # keep mounted ConfigMap volumes fresh (outside self._lock —
+            # materialization takes it internally)
+            try:
+                self._materialize_config_volumes(pod)
+            except RuntimeError:
+                pass  # ConfigMap deleted mid-run; keep last snapshot
+            return None
         try:
             self._launch(pod, key)
         except Exception as e:
@@ -276,7 +285,10 @@ class Kubelet:
 
     def _materialize_config_volumes(self, pod: Pod) -> None:
         """Write ConfigMap-backed volumes to their mount path (the kubelet
-        side of the reference's ConfigMap volume mounts)."""
+        side of the reference's ConfigMap volume mounts). Files are swapped
+        in atomically (write-then-rename, the real kubelet's symlink-swap
+        equivalent) so a running process never reads a torn hostfile, and
+        unchanged ConfigMap versions are skipped."""
         from kubedl_tpu.core.objects import ConfigMap, config_mount_path
 
         for vol in pod.spec.volumes:
@@ -287,16 +299,27 @@ class Kubelet:
             )
             if not isinstance(cm, ConfigMap):
                 raise RuntimeError(f"ConfigMap {vol.config_map} not found")
+            sync_key = (pod.metadata.namespace, pod.metadata.name, vol.name)
+            stamp = (pod.metadata.uid, cm.metadata.resource_version)
+            with self._lock:
+                if self._materialized.get(sync_key) == stamp:
+                    continue
             root = vol.mount_path or config_mount_path(
                 pod.metadata.namespace, pod.metadata.name, vol.name
             )
             os.makedirs(root, exist_ok=True)
             for fname, content in cm.data.items():
                 path = os.path.join(root, fname)
-                with open(path, "w") as f:
+                # per-thread tmp name: concurrent materializers must never
+                # interleave writes into the same tmp file
+                tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+                with open(tmp, "w") as f:
                     f.write(content)
                 if content.startswith("#!"):
-                    os.chmod(path, 0o755)
+                    os.chmod(tmp, 0o755)
+                os.replace(tmp, path)
+            with self._lock:
+                self._materialized[sync_key] = stamp
 
     class _StalePod(Exception):
         pass

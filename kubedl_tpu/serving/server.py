@@ -1,0 +1,183 @@
+"""JAX inference server: the workload a JAX-framework predictor pod runs.
+
+TPU-native serving path (BASELINE.md target 5): loads the checkpoint the
+lineage pipeline published (KUBEDL_MODEL_PATH), jit-compiles the static-
+shape KV-cache decode step ONCE (`llama.decode_step` — pre-allocated cache,
+no retracing), and serves greedy decoding over HTTP:
+
+- GET  /healthz            -> {"status": "ok"}
+- GET  /v1/models          -> model metadata
+- POST /v1/generate        -> {"prompt_ids": [...], "max_tokens": N}
+                              -> {"token_ids": [...], "latency_ms": ...}
+
+Runs under either container runtime: entrypoint
+"kubedl_tpu.serving.server:serve_main" (ThreadRuntime) or
+`python -m kubedl_tpu.serving.server` (SubprocessRuntime).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+log = logging.getLogger("kubedl_tpu.serving.server")
+
+
+class LlamaEngine:
+    """Single-model greedy-decode engine around llama.decode_step."""
+
+    def __init__(self, preset: str = "tiny", ckpt_dir: str = "",
+                 batch: int = 1, max_seq: int = 0) -> None:
+        import jax
+
+        from kubedl_tpu.models import llama
+        from kubedl_tpu.training import checkpoint
+
+        self.cfg = llama.preset(preset)
+        self.max_seq = max_seq or min(self.cfg.max_seq, 512)
+        self.batch = batch
+        params = llama.llama_init(jax.random.PRNGKey(0), self.cfg)
+        if ckpt_dir and checkpoint.latest_step(ckpt_dir) is not None:
+            state = checkpoint.restore_checkpoint(ckpt_dir, {"params": params})
+            if state is not None:
+                params = state["params"]
+                log.info("restored checkpoint from %s", ckpt_dir)
+        self.params = params
+        self._llama = llama
+        self._jax = jax
+        self._decode = jax.jit(
+            lambda p, c, t: llama.decode_step(p, c, t, self.cfg)
+        )
+        self._lock = threading.Lock()  # one sequence at a time per engine
+        # warm the compile cache so first request isn't a compile stall
+        self._warmup()
+
+    def _warmup(self) -> None:
+        import jax.numpy as jnp
+
+        cache = self._llama.init_cache(self.cfg, self.batch, self.max_seq)
+        logits, cache = self._decode(
+            self.params, cache, jnp.zeros((self.batch, 1), jnp.int32)
+        )
+        self._jax.block_until_ready(logits)
+
+    def generate(self, prompt_ids, max_tokens: int = 16) -> Dict:
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        with self._lock:
+            cache = self._llama.init_cache(self.cfg, self.batch, self.max_seq)
+            budget = self.max_seq - 1
+            prompt = list(prompt_ids)[:budget]
+            out_ids = []
+            logits = None
+            # prefill token-by-token through the decode step (static shapes;
+            # a chunked prefill kernel is a later optimization)
+            for tok in prompt:
+                tokens = jnp.full((self.batch, 1), int(tok), jnp.int32)
+                logits, cache = self._decode(self.params, cache, tokens)
+            n_new = max(0, min(max_tokens, budget - len(prompt)))
+            for _ in range(n_new):
+                if logits is None:
+                    break
+                nxt = int(logits[0].argmax())
+                out_ids.append(nxt)
+                tokens = jnp.full((self.batch, 1), nxt, jnp.int32)
+                logits, cache = self._decode(self.params, cache, tokens)
+        ms = (time.perf_counter() - t0) * 1e3
+        return {
+            "token_ids": out_ids,
+            "prompt_len": len(prompt),
+            "latency_ms": round(ms, 2),
+            "tokens_per_sec": round(len(out_ids) / (ms / 1e3), 2) if ms > 0 else 0.0,
+        }
+
+
+def make_handler(engine: LlamaEngine, model_name: str):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet
+            log.debug(fmt, *args)
+
+        def _json(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._json(200, {"status": "ok"})
+            elif self.path == "/v1/models":
+                self._json(200, {
+                    "models": [{
+                        "name": model_name,
+                        "max_seq": engine.max_seq,
+                        "params": engine.cfg.num_params(),
+                    }]
+                })
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/v1/generate":
+                self._json(404, {"error": "not found"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                result = engine.generate(
+                    req.get("prompt_ids", []),
+                    int(req.get("max_tokens", 16)),
+                )
+                self._json(200, result)
+            except Exception as e:  # serving must not die on a bad request
+                self._json(400, {"error": str(e)})
+
+    return Handler
+
+
+def serve_main(env: Optional[Dict[str, str]] = None) -> int:
+    """Container entrypoint (ThreadRuntime-compatible)."""
+    if env:
+        os.environ.update({k: v for k, v in env.items() if isinstance(v, str)})
+    from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested
+
+    ensure_cpu_if_requested()
+
+    cfg = json.loads(os.environ.get("KUBEDL_SERVE_CONFIG", "{}"))
+    ckpt = os.environ.get("KUBEDL_MODEL_PATH", "")
+    port = int(cfg.get("port", 8080))
+    preset = cfg.get("preset", os.environ.get("KUBEDL_SERVE_PRESET", "tiny"))
+    engine = LlamaEngine(preset=preset, ckpt_dir=ckpt)
+    server = ThreadingHTTPServer(
+        ("127.0.0.1", port), make_handler(engine, cfg.get("model_name", preset))
+    )
+    log.info("serving %s on :%d", cfg.get("model_name", preset), port)
+
+    cancel = (env or {}).get("_KUBEDL_CANCEL")
+    if cancel is not None:
+        def watch():
+            cancel.wait()
+            server.shutdown()
+
+        threading.Thread(target=watch, daemon=True).start()
+    try:
+        server.serve_forever(poll_interval=0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(serve_main())

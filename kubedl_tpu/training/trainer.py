@@ -42,6 +42,9 @@ class TrainConfig:
     grad_clip: float = 1.0
     #: microbatches per step (gradient accumulation); 1 = off
     grad_accum: int = 1
+    #: sequence/context parallelism implementation used when the mesh has an
+    #: "sp" axis: "ring" (blockwise ppermute ring) or "ulysses" (all-to-all)
+    context_parallel_impl: str = "ring"
     seed: int = 0
 
 
@@ -96,6 +99,12 @@ class Trainer:
 
     def _build_fns(self) -> None:
         cfg, mcfg = self.cfg, self.cfg.model
+        # sequence-parallel attention when the mesh has an "sp" axis
+        from kubedl_tpu.parallel.ring import make_context_attention
+
+        attn_fn = make_context_attention(
+            self.mesh, impl=cfg.context_parallel_impl
+        )
 
         def constrain_params(params):
             return jax.tree_util.tree_map(
@@ -112,7 +121,7 @@ class Trainer:
                     "step": jnp.zeros((), jnp.int32)}
 
         def loss_fn(params, batch):
-            return llama.llama_loss(params, batch, mcfg)
+            return llama.llama_loss(params, batch, mcfg, attn_fn)
 
         def train_step(state, batch):
             params = constrain_params(state["params"])

@@ -32,21 +32,13 @@ class ElasticDLJob(JobObject):
 class ElasticDLJobController(WorkloadController):
     KIND = "ElasticDLJob"
     NAME = "elasticdljob-controller"
-
-    def __init__(self, cluster_domain: str = "", local_addresses: bool = False) -> None:
-        self.cluster_domain = cluster_domain
-        self.local_addresses = local_addresses
+    ALLOWED_REPLICA_TYPES = (ReplicaType.MASTER,)
 
     def object_factory(self) -> ElasticDLJob:
         return ElasticDLJob()
 
-    def apply_defaults(self, job: JobObject) -> None:
-        """Only the Master replica type is legal (reference:
-        elasticdljob_types.go:62-65)."""
-        super().apply_defaults(job)
-        for rtype in list(job.spec.replica_specs):
-            if rtype != ReplicaType.MASTER:
-                del job.spec.replica_specs[rtype]
+    # ALLOWED_REPLICA_TYPES: only Master is legal (reference:
+    # elasticdljob_types.go:62-65); base defaulting prunes the rest.
 
     def reconcile_orders(self) -> List[ReplicaType]:
         return [ReplicaType.MASTER]
@@ -54,7 +46,7 @@ class ElasticDLJobController(WorkloadController):
     def is_master_role(self, rtype: ReplicaType) -> bool:
         return rtype == ReplicaType.MASTER
 
-    def needs_service(self, rtype: ReplicaType) -> bool:
+    def needs_service(self, rtype: ReplicaType, job=None) -> bool:
         return False  # reference: job.go:253-257 skips ElasticDL services
 
     def set_mesh_spec(

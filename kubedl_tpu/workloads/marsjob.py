@@ -61,10 +61,7 @@ class MarsJob(JobObject):
 class MarsJobController(WorkloadController):
     KIND = "MarsJob"
     NAME = "marsjob-controller"
-
-    def __init__(self, cluster_domain: str = "", local_addresses: bool = False) -> None:
-        self.cluster_domain = cluster_domain
-        self.local_addresses = local_addresses
+    ALLOWED_REPLICA_TYPES = (ReplicaType.SCHEDULER, ReplicaType.WORKER, ReplicaType.WEBSERVICE)
 
     def object_factory(self) -> MarsJob:
         return MarsJob()

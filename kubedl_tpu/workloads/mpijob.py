@@ -34,7 +34,7 @@ from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadContro
 from kubedl_tpu.api.types import ReplicaType
 from kubedl_tpu.core.objects import ConfigMap, Pod, Volume, config_mount_path
 from kubedl_tpu.core.store import AlreadyExists
-from kubedl_tpu.workloads.common import add_dag_edge, replica_dns
+from kubedl_tpu.workloads.common import add_dag_edge, replica_dns, replica_port
 
 OPEN_MPI = "OpenMPI"
 INTEL_MPI = "IntelMPI"
@@ -70,10 +70,7 @@ class MPIJob(JobObject):
 class MPIJobController(WorkloadController):
     KIND = "MPIJob"
     NAME = "mpijob-controller"
-
-    def __init__(self, cluster_domain: str = "", local_addresses: bool = False) -> None:
-        self.cluster_domain = cluster_domain
-        self.local_addresses = local_addresses
+    ALLOWED_REPLICA_TYPES = (ReplicaType.LAUNCHER, ReplicaType.WORKER)
 
     def object_factory(self) -> MPIJob:
         return MPIJob()
@@ -100,7 +97,7 @@ class MPIJobController(WorkloadController):
     def is_master_role(self, rtype: ReplicaType) -> bool:
         return rtype == ReplicaType.LAUNCHER
 
-    def needs_service(self, rtype: ReplicaType) -> bool:
+    def needs_service(self, rtype: ReplicaType, job=None) -> bool:
         """Departure from the reference (job.go:253-257 creates no MPI
         services): its kubectl-exec rsh agent resolves pods through the
         api-server, while ours reaches workers by hostname — the hostfile's
@@ -198,9 +195,8 @@ class MPIJobController(WorkloadController):
             host0 = replica_dns(
                 job, ReplicaType.WORKER, 0, self.cluster_domain, self.local_addresses
             )
-            main.set_env(
-                constants.ENV_COORDINATOR_ADDRESS, f"{host0}:{constants.DEFAULT_PORT}"
-            )
+            port0 = replica_port(worker, ReplicaType.WORKER, 0, ctx)
+            main.set_env(constants.ENV_COORDINATOR_ADDRESS, f"{host0}:{port0}")
             main.set_env(constants.ENV_NUM_PROCESSES, str(n))
 
 

@@ -35,10 +35,7 @@ class PyTorchJob(JobObject):
 class PyTorchJobController(WorkloadController):
     KIND = "PyTorchJob"
     NAME = "pytorchjob-controller"
-
-    def __init__(self, cluster_domain: str = "", local_addresses: bool = False) -> None:
-        self.cluster_domain = cluster_domain
-        self.local_addresses = local_addresses
+    ALLOWED_REPLICA_TYPES = (ReplicaType.MASTER, ReplicaType.WORKER)
 
     def object_factory(self) -> PyTorchJob:
         return PyTorchJob()
@@ -55,8 +52,17 @@ class PyTorchJobController(WorkloadController):
     def is_master_role(self, rtype: ReplicaType) -> bool:
         return rtype == ReplicaType.MASTER
 
-    def needs_service(self, rtype: ReplicaType) -> bool:
-        return rtype == ReplicaType.MASTER
+    def needs_service(self, rtype: ReplicaType, job=None) -> bool:
+        """Master-only services (reference: job.go:259-263) — except for
+        masterless specs, where worker-0 hosts the rendezvous and must be
+        addressable."""
+        if rtype == ReplicaType.MASTER:
+            return True
+        return (
+            job is not None
+            and ReplicaType.MASTER not in job.spec.replica_specs
+            and rtype == ReplicaType.WORKER
+        )
 
     # ------------------------------------------------------------------
 
@@ -84,18 +90,26 @@ class PyTorchJobController(WorkloadController):
             addr = "localhost"
             rank = 0
             port = replica_port(master_spec, rtype, index, ctx)
-        else:
+        elif master_spec is not None:
             addr = replica_dns(
                 job, ReplicaType.MASTER, 0, self.cluster_domain, self.local_addresses
             )
-            rank = index + 1 if master_spec else index
-            port = (
-                replica_port(master_spec, ReplicaType.MASTER, 0, ctx)
-                if master_spec
-                else replica_port(
-                    job.spec.replica_specs[rtype], rtype, index, ctx
+            rank = index + 1
+            port = replica_port(master_spec, ReplicaType.MASTER, 0, ctx)
+        else:
+            # masterless: worker-0 hosts the rendezvous — every rank must
+            # dial the SAME endpoint
+            worker_spec = job.spec.replica_specs[ReplicaType.WORKER]
+            addr = (
+                "localhost"
+                if index == 0
+                else replica_dns(
+                    job, ReplicaType.WORKER, 0,
+                    self.cluster_domain, self.local_addresses,
                 )
             )
+            rank = index
+            port = replica_port(worker_spec, ReplicaType.WORKER, 0, ctx)
 
         main.set_env("MASTER_ADDR", addr)
         main.set_env("MASTER_PORT", str(port))

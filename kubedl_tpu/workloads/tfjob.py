@@ -48,10 +48,7 @@ class TFJob(JobObject):
 class TFJobController(WorkloadController):
     KIND = "TFJob"
     NAME = "tfjob-controller"
-
-    def __init__(self, cluster_domain: str = "", local_addresses: bool = False) -> None:
-        self.cluster_domain = cluster_domain
-        self.local_addresses = local_addresses
+    ALLOWED_REPLICA_TYPES = (ReplicaType.PS, ReplicaType.MASTER, ReplicaType.CHIEF, ReplicaType.WORKER, ReplicaType.EVALUATOR)
 
     def object_factory(self) -> TFJob:
         return TFJob()

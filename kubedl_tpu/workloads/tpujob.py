@@ -44,13 +44,7 @@ class TPUJob(JobObject):
 class TPUJobController(WorkloadController):
     KIND = "TPUJob"
     NAME = "tpujob-controller"
-
-    def __init__(self, cluster_domain: str = "", local_addresses: bool = False) -> None:
-        #: local_addresses=True emits 127.0.0.1 + per-job port instead of
-        #: service DNS — used when pods run as local processes (tests, the
-        #: single-host dev loop, CI's kind-style smoke).
-        self.cluster_domain = cluster_domain
-        self.local_addresses = local_addresses
+    ALLOWED_REPLICA_TYPES = (ReplicaType.WORKER, ReplicaType.EVALUATOR)
 
     def object_factory(self) -> TPUJob:
         return TPUJob()
@@ -70,7 +64,7 @@ class TPUJobController(WorkloadController):
     def is_master_role(self, rtype: ReplicaType) -> bool:
         return False  # SPMD: success comes from worker-0 (status machine)
 
-    def needs_service(self, rtype: ReplicaType) -> bool:
+    def needs_service(self, rtype: ReplicaType, job=None) -> bool:
         return rtype == ReplicaType.WORKER
 
     # ------------------------------------------------------------------

@@ -46,10 +46,7 @@ class XDLJob(JobObject):
 class XDLJobController(WorkloadController):
     KIND = "XDLJob"
     NAME = "xdljob-controller"
-
-    def __init__(self, cluster_domain: str = "", local_addresses: bool = False) -> None:
-        self.cluster_domain = cluster_domain
-        self.local_addresses = local_addresses
+    ALLOWED_REPLICA_TYPES = (ReplicaType.SCHEDULER, ReplicaType.PS, ReplicaType.WORKER)
 
     def object_factory(self) -> XDLJob:
         return XDLJob()

@@ -31,10 +31,7 @@ class XGBoostJob(JobObject):
 class XGBoostJobController(WorkloadController):
     KIND = "XGBoostJob"
     NAME = "xgboostjob-controller"
-
-    def __init__(self, cluster_domain: str = "", local_addresses: bool = False) -> None:
-        self.cluster_domain = cluster_domain
-        self.local_addresses = local_addresses
+    ALLOWED_REPLICA_TYPES = (ReplicaType.MASTER, ReplicaType.WORKER)
 
     def object_factory(self) -> XGBoostJob:
         return XGBoostJob()
@@ -65,15 +62,17 @@ class XGBoostJobController(WorkloadController):
         specs = job.spec.replica_specs
         master_spec = specs.get(ReplicaType.MASTER)
         world_size = sum(rs.replicas for rs in specs.values())
+        # all ranks must dial ONE tracker endpoint: the master, or worker-0
+        # when masterless
+        tracker_rt = ReplicaType.MASTER if master_spec else ReplicaType.WORKER
         master_addr = replica_dns(
-            job, ReplicaType.MASTER, 0, self.cluster_domain, self.local_addresses
+            job, tracker_rt, 0, self.cluster_domain, self.local_addresses
         )
-        master_port = (
-            replica_port(master_spec, ReplicaType.MASTER, 0, ctx)
-            if master_spec
-            else replica_port(specs[rtype], rtype, index, ctx)
-        )
-        rank = 0 if rtype == ReplicaType.MASTER else index + 1
+        master_port = replica_port(specs[tracker_rt], tracker_rt, 0, ctx)
+        if master_spec:
+            rank = 0 if rtype == ReplicaType.MASTER else index + 1
+        else:
+            rank = index
         main.set_env("MASTER_ADDR", master_addr)
         main.set_env("MASTER_PORT", str(master_port))
         main.set_env("WORLD_SIZE", str(world_size))

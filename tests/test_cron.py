@@ -1,0 +1,191 @@
+"""Cron tests: expression parsing (table-driven, reference analogue
+cron_utils tests) + controller semantics with a fake clock."""
+
+import time
+from datetime import datetime
+
+import pytest
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.api.types import JobConditionType, ReplicaSpec, ReplicaType
+from kubedl_tpu.core.objects import Container
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.cron.controller import CronController
+from kubedl_tpu.cron.cronexpr import CronParseError, CronSchedule, missed_run_times
+from kubedl_tpu.cron.types import ConcurrencyPolicy, Cron, CronHistoryEntry
+from kubedl_tpu.workloads.tpujob import TPUJob
+
+
+def ts(*args) -> float:
+    return datetime(*args).timestamp()
+
+
+class TestCronExpr:
+    @pytest.mark.parametrize("expr,frm,want", [
+        ("* * * * *", (2026, 1, 1, 10, 30), (2026, 1, 1, 10, 31)),
+        ("0 * * * *", (2026, 1, 1, 10, 30), (2026, 1, 1, 11, 0)),
+        ("*/15 * * * *", (2026, 1, 1, 10, 16), (2026, 1, 1, 10, 30)),
+        ("30 4 * * *", (2026, 1, 1, 10, 0), (2026, 1, 2, 4, 30)),
+        ("0 0 1 * *", (2026, 1, 15, 0, 0), (2026, 2, 1, 0, 0)),
+        ("0 0 * * 0", (2026, 1, 1, 0, 0), (2026, 1, 4, 0, 0)),  # Thu->Sun
+        ("0 9-17 * * *", (2026, 1, 1, 18, 0), (2026, 1, 2, 9, 0)),
+        ("0 0 29 2 *", (2026, 1, 1, 0, 0), (2028, 2, 29, 0, 0)),  # leap
+        ("@daily", (2026, 1, 1, 5, 0), (2026, 1, 2, 0, 0)),
+        ("0 12 * jan mon", (2026, 1, 3, 0, 0), (2026, 1, 5, 12, 0)),
+    ])
+    def test_next_after(self, expr, frm, want):
+        got = CronSchedule.parse(expr).next_after(ts(*frm))
+        assert datetime.fromtimestamp(got) == datetime(*want)
+
+    def test_vixie_dom_dow_or_rule(self):
+        # both restricted: fires on the 13th OR on Friday
+        s = CronSchedule.parse("0 0 13 * 5")
+        got = datetime.fromtimestamp(s.next_after(ts(2026, 1, 10, 0, 0)))
+        # Jan 10 2026 is a Saturday -> next Friday is Jan 16, but the 13th
+        # (Tuesday) comes first under the OR rule
+        assert got == datetime(2026, 1, 13, 0, 0)
+
+    @pytest.mark.parametrize("bad", [
+        "* * * *", "61 * * * *", "* 25 * * *", "* * 0 * *", "x * * * *",
+        "*/0 * * * *", "5-1 * * * *",
+    ])
+    def test_parse_errors(self, bad):
+        with pytest.raises(CronParseError):
+            CronSchedule.parse(bad)
+
+    def test_missed_runs(self):
+        s = CronSchedule.parse("*/10 * * * *")
+        missed = missed_run_times(s, ts(2026, 1, 1, 10, 0), ts(2026, 1, 1, 10, 35))
+        assert [datetime.fromtimestamp(t).minute for t in missed] == [10, 20, 30]
+
+
+def make_template(name="tpl"):
+    job = TPUJob()
+    spec = ReplicaSpec(replicas=1)
+    spec.template.spec.containers.append(Container(command=["true"]))
+    job.spec.replica_specs[ReplicaType.WORKER] = spec
+    return job
+
+
+class FakeClock:
+    def __init__(self, t: float) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class TestCronController:
+    def setup_cron(self, schedule="*/5 * * * *", policy=ConcurrencyPolicy.ALLOW,
+                   start=(2026, 1, 1, 10, 0)):
+        store = ObjectStore()
+        clock = FakeClock(ts(*start))
+        ctrl = CronController(store, ["TPUJob"], clock=clock)
+        cron = Cron(schedule=schedule, template=make_template(),
+                    concurrency_policy=policy)
+        cron.metadata.name = "nightly"
+        cron.metadata.creation_timestamp = clock.t
+        store.create(cron)
+        return store, ctrl, clock
+
+    def test_fires_on_schedule(self):
+        store, ctrl, clock = self.setup_cron()
+        ctrl.reconcile("default", "nightly")
+        assert store.list("TPUJob") == []  # not due yet
+        clock.t = ts(2026, 1, 1, 10, 5)
+        ctrl.reconcile("default", "nightly")
+        jobs = store.list("TPUJob")
+        assert len(jobs) == 1
+        job = jobs[0]
+        assert job.metadata.labels[constants.LABEL_CRON_NAME] == "nightly"
+        assert job.metadata.name.startswith("nightly-")
+        cron = store.get("Cron", "nightly")
+        assert cron.active == [job.metadata.name]
+        assert cron.last_schedule_time == ts(2026, 1, 1, 10, 5)
+
+    def test_requeue_is_time_to_next_fire(self):
+        store, ctrl, clock = self.setup_cron()
+        requeue = ctrl.reconcile("default", "nightly")
+        assert requeue == pytest.approx(300, abs=1)
+
+    def test_forbid_skips_while_active(self):
+        store, ctrl, clock = self.setup_cron(policy=ConcurrencyPolicy.FORBID)
+        clock.t = ts(2026, 1, 1, 10, 5)
+        ctrl.reconcile("default", "nightly")
+        assert len(store.list("TPUJob")) == 1
+        clock.t = ts(2026, 1, 1, 10, 10)
+        ctrl.reconcile("default", "nightly")
+        assert len(store.list("TPUJob")) == 1  # skipped
+        cron = store.get("Cron", "nightly")
+        assert cron.last_schedule_time == ts(2026, 1, 1, 10, 10)
+
+    def test_replace_deletes_active(self):
+        store, ctrl, clock = self.setup_cron(policy=ConcurrencyPolicy.REPLACE)
+        clock.t = ts(2026, 1, 1, 10, 5)
+        ctrl.reconcile("default", "nightly")
+        first = store.list("TPUJob")[0].metadata.name
+        clock.t = ts(2026, 1, 1, 10, 10)
+        ctrl.reconcile("default", "nightly")
+        names = [j.metadata.name for j in store.list("TPUJob")]
+        assert first not in names and len(names) == 1
+
+    def test_allow_runs_concurrently(self):
+        store, ctrl, clock = self.setup_cron()
+        clock.t = ts(2026, 1, 1, 10, 5)
+        ctrl.reconcile("default", "nightly")
+        clock.t = ts(2026, 1, 1, 10, 10)
+        ctrl.reconcile("default", "nightly")
+        assert len(store.list("TPUJob")) == 2
+
+    def test_suspend(self):
+        store, ctrl, clock = self.setup_cron()
+        cron = store.get("Cron", "nightly")
+        cron.suspend = True
+        store.update(cron)
+        clock.t = ts(2026, 1, 1, 10, 5)
+        ctrl.reconcile("default", "nightly")
+        assert store.list("TPUJob") == []
+
+    def test_starting_deadline_skips_stale_run(self):
+        store, ctrl, clock = self.setup_cron()
+        cron = store.get("Cron", "nightly")
+        cron.starting_deadline_seconds = 60.0
+        store.update(cron)
+        clock.t = ts(2026, 1, 1, 11, 7)  # last fire 11:05 is 120s stale
+        ctrl.reconcile("default", "nightly")
+        assert store.list("TPUJob") == []
+        cron = store.get("Cron", "nightly")
+        assert cron.last_schedule_time == ts(2026, 1, 1, 11, 5)
+
+    def test_history_ring_and_finished_trim(self):
+        store, ctrl, clock = self.setup_cron()
+        cron = store.get("Cron", "nightly")
+        cron.history_limit = 2
+        store.update(cron)
+        for minute in (5, 10, 15):
+            clock.t = ts(2026, 1, 1, 10, minute)
+            ctrl.reconcile("default", "nightly")
+        cron = store.get("Cron", "nightly")
+        assert len(cron.history) == 2  # ring trimmed
+        assert len(store.list("TPUJob")) == 2  # overflow object deleted
+        # finish one job -> drops from active, history shows phase
+        job_name = cron.active[0]
+        def finish(obj):
+            obj.status.set_condition(JobConditionType.SUCCEEDED, "done", "")
+            obj.status.completion_time = clock.t
+        store.update_with_retry("TPUJob", job_name, "default", finish)
+        ctrl.reconcile("default", "nightly")
+        cron = store.get("Cron", "nightly")
+        assert job_name not in cron.active
+        entry = next(e for e in cron.history if e.object_name == job_name)
+        assert entry.status == "Succeeded"
+        assert entry.finished == clock.t
+
+    def test_too_many_missed_runs_warns_and_fires_latest(self):
+        store, ctrl, clock = self.setup_cron(schedule="* * * * *")
+        clock.t = ts(2026, 1, 1, 14, 0)  # 240 missed minutes
+        ctrl.reconcile("default", "nightly")
+        assert len(store.list("TPUJob")) == 1  # only the latest fires
+        events = [e for e in store.list("Event")
+                  if e.reason == "TooManyMissedRuns"]
+        assert events

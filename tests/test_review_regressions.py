@@ -119,3 +119,76 @@ def test_peak_flops_lookup_from_catalog():
     assert peak_flops_for_device_kind("TPU v4") == 275e12
     assert peak_flops_for_device_kind("TPU v6 lite") == 918e12
     assert peak_flops_for_device_kind("Intel Xeon") == 0.0
+
+
+def test_kubelet_configmap_resync_does_not_deadlock():
+    """Reconciling a RUNNING pod that mounts a ConfigMap volume must
+    re-materialize without re-entering the kubelet lock (deadlock found in
+    review: reconcile held self._lock while _materialize_config_volumes
+    acquired it again)."""
+    import threading
+
+    from kubedl_tpu.core.objects import ConfigMap, Pod, Volume
+    from kubedl_tpu.core.store import ObjectStore
+    from kubedl_tpu.runtime.executor import Kubelet, _PlaceholderHandle
+
+    store = ObjectStore()
+    cm = ConfigMap(data={"hostfile": "127.0.0.1 slots=1\n"})
+    cm.metadata.name = "job-config"
+    store.create(cm)
+    pod = Pod()
+    pod.metadata.name = "p1"
+    import tempfile
+
+    mount = tempfile.mkdtemp()
+    pod.spec.volumes.append(Volume(name="cfg", config_map="job-config",
+                                   mount_path=mount))
+    pod.status.phase = PodPhase.RUNNING
+    created = store.create(pod)
+
+    kubelet = Kubelet(store, ThreadRuntime())
+    with kubelet._lock:
+        pass  # sanity: lock is free
+    kubelet._running["default/p1"] = _PlaceholderHandle()
+
+    done = threading.Event()
+
+    def run():
+        kubelet.reconcile("default", "p1")
+        done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert done.wait(5.0), "kubelet reconcile deadlocked"
+    import os
+
+    assert os.path.exists(os.path.join(mount, "hostfile"))
+
+
+def test_cron_long_outage_fires_fresh_run_once():
+    """After an outage far past the missed-run warning threshold, exactly
+    ONE run fires and it carries the MOST RECENT slot time (review bug:
+    capped accounting returned the oldest slot, launching stale runs)."""
+    from datetime import datetime
+
+    from kubedl_tpu.core.store import ObjectStore
+    from kubedl_tpu.cron.controller import CronController
+    from kubedl_tpu.cron.types import Cron
+    from tests.test_cron import FakeClock, make_template, ts
+
+    store = ObjectStore()
+    clock = FakeClock(ts(2026, 1, 1, 10, 0))
+    ctrl = CronController(store, ["TPUJob"], clock=clock)
+    cron = Cron(schedule="* * * * *", template=make_template())
+    cron.metadata.name = "mn"
+    cron.metadata.creation_timestamp = clock.t
+    store.create(cron)
+    clock.t = ts(2026, 1, 3, 10, 0)  # 2 days of missed minutes
+    ctrl.reconcile("default", "mn")
+    jobs = store.list("TPUJob")
+    assert len(jobs) == 1
+    got = store.get("Cron", "mn")
+    assert got.last_schedule_time == ts(2026, 1, 3, 10, 0)  # freshest slot
+    # immediate re-reconcile must NOT fire another stale run
+    ctrl.reconcile("default", "mn")
+    assert len(store.list("TPUJob")) == 1

@@ -1,0 +1,213 @@
+"""Serving tests (reference analogue: controllers/serving suite): predictor
+gating on artifact build, canary weight normalization, framework setters,
+and a real end-to-end generate through the JAX server."""
+
+import json
+import time
+
+import pytest
+
+from kubedl_tpu.core.manager import ControllerManager
+from kubedl_tpu.core.objects import PodPhase
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.lineage.types import Model, ModelVersion, ModelVersionPhase
+from kubedl_tpu.serving.controller import (
+    LABEL_PREDICTOR,
+    HTTP_PORT,
+    InferenceController,
+)
+from kubedl_tpu.serving.types import (
+    Framework,
+    Inference,
+    Predictor,
+    TrafficPolicy,
+)
+
+from tests.helpers import PodDriver, env_of
+
+
+def make_mv(store, name="mv1", model="m1", phase=ModelVersionPhase.SUCCEEDED,
+            storage_root="/tmp/x"):
+    mv = ModelVersion(model_name=model, storage_root=storage_root,
+                      image=f"models/{model}:{name}", phase=phase)
+    mv.metadata.name = name
+    store.create(mv)
+    return mv
+
+
+def make_inference(store, predictors, framework=Framework.JAX, name="inf1"):
+    inf = Inference(framework=framework, predictors=predictors)
+    inf.metadata.name = name
+    store.create(inf)
+    return inf
+
+
+def setup():
+    store = ObjectStore()
+    ctrl = InferenceController(store, local_addresses=True)
+    return store, ctrl
+
+
+class TestPredictorSync:
+    def test_gated_on_artifact_build(self):
+        store, ctrl = setup()
+        make_mv(store, phase=ModelVersionPhase.IMAGE_BUILDING)
+        make_inference(store, [Predictor(name="main", model_version="mv1")])
+        ctrl.reconcile("default", "inf1")
+        assert store.list("Pod") == []  # gated (reference :149-204)
+        inf = store.get("Inference", "inf1")
+        assert "waiting for artifact" in inf.predictor_statuses["main"].message
+        # build completes -> pods appear
+        def done(mv):
+            mv.phase = ModelVersionPhase.SUCCEEDED
+        store.update_with_retry("ModelVersion", "mv1", "default", done)
+        ctrl.reconcile("default", "inf1")
+        pods = store.list("Pod")
+        assert [p.metadata.name for p in pods] == ["inf1-main-0"]
+
+    def test_entry_service_and_scale(self):
+        store, ctrl = setup()
+        make_mv(store)
+        make_inference(store, [Predictor(name="main", model_version="mv1",
+                                         replicas=3)])
+        ctrl.reconcile("default", "inf1")
+        assert store.try_get("Service", "inf1", "default") is not None
+        assert len(store.list("Pod")) == 3
+        # scale down
+        inf = store.get("Inference", "inf1")
+        inf.predictors[0].replicas = 1
+        store.update(inf)
+        ctrl.reconcile("default", "inf1")
+        assert len(store.list("Pod")) == 1
+
+    def test_latest_version_tracking(self):
+        store, ctrl = setup()
+        mv = make_mv(store, name="mv2", model="m1")
+        model = Model(latest_version="mv2")
+        model.metadata.name = "m1"
+        store.create(model)
+        make_inference(store, [Predictor(name="main", model_name="m1")])
+        ctrl.reconcile("default", "inf1")
+        inf = store.get("Inference", "inf1")
+        assert inf.predictor_statuses["main"].image == mv.image
+
+    def test_jax_setter_env(self):
+        store, ctrl = setup()
+        make_mv(store, storage_root="/ckpts/m1")
+        make_inference(store, [Predictor(name="main", model_version="mv1")])
+        ctrl.reconcile("default", "inf1")
+        pod = store.get("Pod", "inf1-main-0")
+        env = env_of(pod)
+        assert env["KUBEDL_MODEL_PATH"] == "/ckpts/m1"
+        cfg = json.loads(env["KUBEDL_SERVE_CONFIG"])
+        assert cfg["port"] == HTTP_PORT
+        assert pod.spec.main_container().entrypoint == (
+            "kubedl_tpu.serving.server:serve_main"
+        )
+
+    def test_tfserving_setter_env(self):
+        store, ctrl = setup()
+        make_mv(store)
+        make_inference(store, [Predictor(name="main", model_version="mv1")],
+                       framework=Framework.TF_SERVING)
+        ctrl.reconcile("default", "inf1")
+        env = env_of(store.get("Pod", "inf1-main-0"))
+        assert env["MODEL_NAME"] == "m1"
+        assert env["MODEL_BASE_PATH"] == "/models/m1"
+
+    def test_removed_predictor_gc(self):
+        store, ctrl = setup()
+        make_mv(store)
+        make_inference(store, [
+            Predictor(name="a", model_version="mv1"),
+            Predictor(name="b", model_version="mv1"),
+        ])
+        ctrl.reconcile("default", "inf1")
+        assert len(store.list("Pod")) == 2
+        inf = store.get("Inference", "inf1")
+        inf.predictors = [p for p in inf.predictors if p.name == "a"]
+        store.update(inf)
+        ctrl.reconcile("default", "inf1")
+        names = [p.metadata.name for p in store.list("Pod")]
+        assert names == ["inf1-a-0"]
+
+
+class TestTraffic:
+    def test_canary_weights_normalized_over_ready(self):
+        store, ctrl = setup()
+        driver = PodDriver(store)
+        make_mv(store)
+        make_inference(store, [
+            Predictor(name="stable", model_version="mv1", traffic_weight=90),
+            Predictor(name="canary", model_version="mv1", traffic_weight=10),
+        ])
+        ctrl.reconcile("default", "inf1")
+        # nothing ready yet -> no routes
+        tp = store.get("TrafficPolicy", "inf1")
+        assert tp.routes == []
+        # only stable ready -> 100% stable (never route to dead canary)
+        driver.run("inf1-stable-0")
+        ctrl.reconcile("default", "inf1")
+        tp = store.get("TrafficPolicy", "inf1")
+        assert {r.predictor: r.weight for r in tp.routes} == {"stable": 100}
+        # both ready -> 90/10
+        driver.run("inf1-canary-0")
+        ctrl.reconcile("default", "inf1")
+        tp = store.get("TrafficPolicy", "inf1")
+        weights = {r.predictor: r.weight for r in tp.routes}
+        assert weights == {"stable": 90, "canary": 10}
+        assert sum(weights.values()) == 100
+
+
+class TestEndToEndServe:
+    def test_generate_through_operator(self, tmp_path):
+        """Train-less serve: publish a ModelVersion, create an Inference,
+        wait for the predictor pod to run the real JAX server, hit HTTP."""
+        import urllib.request
+
+        from kubedl_tpu.operator import Operator, OperatorOptions
+        from kubedl_tpu.runtime.executor import ThreadRuntime
+
+        opts = OperatorOptions(
+            local_addresses=True,
+            artifact_registry_root=str(tmp_path / "reg"),
+        )
+        model_dir = tmp_path / "model"
+        model_dir.mkdir()
+        with Operator(opts, runtime=ThreadRuntime()) as op:
+            mv = ModelVersion(model_name="m1", storage_root=str(model_dir),
+                              phase=ModelVersionPhase.PENDING)
+            mv.metadata.name = "mv1"
+            op.store.create(mv)
+            pred = Predictor(name="main", model_version="mv1")
+            port = 18080
+            pred.template.spec.main_container().set_env(
+                "KUBEDL_SERVE_CONFIG", json.dumps({"port": port, "preset": "tiny"})
+            )
+            inf = Inference(framework=Framework.JAX, predictors=[pred])
+            inf.metadata.name = "inf1"
+            op.store.create(inf)
+
+            # wait for the server pod to come up and answer
+            deadline = time.time() + 60
+            result = None
+            while time.time() < deadline:
+                try:
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{port}/v1/generate",
+                        data=json.dumps(
+                            {"prompt_ids": [1, 2, 3], "max_tokens": 4}
+                        ).encode(),
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with urllib.request.urlopen(req, timeout=5) as resp:
+                        result = json.loads(resp.read())
+                    break
+                except Exception:
+                    time.sleep(0.5)
+            assert result is not None, "server never answered"
+            assert len(result["token_ids"]) == 4
+            assert result["prompt_len"] == 3
+            tp = op.store.get("TrafficPolicy", "inf1")
+            # serving pod is Running -> traffic routed to it
+            assert any(r.predictor == "main" for r in tp.routes)

@@ -199,6 +199,22 @@ class TestPyTorchJob:
         svcs = [s.metadata.name for s in store.list("Service")]
         assert svcs == ["pt1-master-0"]
 
+    def test_masterless_rendezvous_on_worker0(self):
+        engine, store, driver = make_engine(PyTorchJobController(local_addresses=True))
+        job = PyTorchJob()
+        job.metadata.name = "pt2"
+        add_replicas(job, ReplicaType.WORKER, 3)
+        store.create(job)
+        reconcile(engine, job)
+        e0 = env_of(store.get("Pod", "pt2-worker-0"))
+        e2 = env_of(store.get("Pod", "pt2-worker-2"))
+        assert e0["MASTER_ADDR"] == "localhost" and e0["RANK"] == "0"
+        assert e2["MASTER_ADDR"] == "127.0.0.1" and e2["RANK"] == "2"
+        assert e0["MASTER_PORT"] == e2["MASTER_PORT"]  # one endpoint
+        # worker-0 must be addressable: worker services exist when masterless
+        svcs = sorted(s.metadata.name for s in store.list("Service"))
+        assert "pt2-worker-0" in svcs
+
     def test_gloo_backend_skips_pjrt(self):
         engine, store, driver, job = self.make(backend="gloo")
         reconcile(engine, job)
@@ -224,6 +240,18 @@ class TestXGBoostJob:
         assert wenv["WORLD_SIZE"] == "4"
         assert wenv["PYTHONUNBUFFERED"] == "1"
         assert wenv["MASTER_ADDR"] == "127.0.0.1"
+
+
+    def test_masterless_single_tracker_endpoint(self):
+        engine, store, driver = make_engine(XGBoostJobController(local_addresses=True))
+        job = XGBoostJob()
+        job.metadata.name = "xgb2"
+        add_replicas(job, ReplicaType.WORKER, 3)
+        store.create(job)
+        reconcile(engine, job)
+        envs = [env_of(store.get("Pod", f"xgb2-worker-{i}")) for i in range(3)]
+        assert len({e["MASTER_PORT"] for e in envs}) == 1
+        assert [e["RANK"] for e in envs] == ["0", "1", "2"]
 
 
 class TestXDLJob:
