@@ -29,6 +29,7 @@ ANNOTATION_GIT_SYNC_CONFIG = API_GROUP + "/git-sync-config"
 ANNOTATION_TENSORBOARD_CONFIG = API_GROUP + "/tensorboard-config"
 ANNOTATION_NETWORK_MODE = API_GROUP + "/network-mode"
 ANNOTATION_TENANCY = API_GROUP + "/tenancy"
+ANNOTATION_OWNER = API_GROUP + "/owner"  # reference: tenancy.go:25-43 user field
 ANNOTATION_PROFILER_CONFIG = API_GROUP + "/profiler-config"  # TPU addition
 
 NETWORK_MODE_HOST = "host"

@@ -43,6 +43,15 @@ class OperatorOptions:
     local_addresses: bool = False
     #: workload-controller construction kwargs per kind
     controller_kwargs: Dict[str, dict] = field(default_factory=dict)
+    #: durable metadata mirror (reference: --meta-storage flag,
+    #: persist_controller.go:30-34). "" disables; "sqlite" enables.
+    meta_storage: str = ""
+    #: durable event sink (reference: --event-storage flag)
+    event_storage: str = ""
+    #: SQLite database path for the built-in backend (":memory:" or a file)
+    storage_db_path: str = ":memory:"
+    #: region stamped on mirrored rows (reference: REGION env)
+    region: str = ""
 
 
 class Operator:
@@ -120,6 +129,32 @@ class Operator:
         )
         self.cron.setup(self.manager)
 
+        # persistence: storage backends + persist controllers
+        # (reference: main.go:104-107 — RegisterStorageBackends then
+        # persist.SetupWithManager)
+        self.object_backend = None
+        self.event_backend = None
+        if self.options.meta_storage or self.options.event_storage:
+            from kubedl_tpu.persist import PersistControllers, default_registry
+
+            registry = default_registry(self.options.storage_db_path)
+            if self.options.meta_storage:
+                self.object_backend = registry.object_backend(
+                    self.options.meta_storage
+                )
+            if self.options.event_storage:
+                self.event_backend = registry.event_backend(
+                    self.options.event_storage
+                )
+            self.persist = PersistControllers(
+                self.store,
+                kinds=list(self.engines),
+                object_backend=self.object_backend,
+                event_backend=self.event_backend,
+                region=self.options.region,
+            )
+            self.persist.setup(self.manager)
+
         # inference serving (reference: controllers/serving)
         from kubedl_tpu.serving.controller import InferenceController
 
@@ -158,6 +193,9 @@ class Operator:
     def stop(self) -> None:
         self.kubelet.shutdown()
         self.manager.stop()
+        for backend in (self.object_backend, self.event_backend):
+            if backend is not None:
+                backend.close()
 
     def __enter__(self) -> "Operator":
         self.start()

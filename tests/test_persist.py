@@ -1,0 +1,204 @@
+"""Persistence subsystem tests.
+
+Reference analogue: pkg/storage/dmo/converters/{job,pod,event}_test.go
+(pure-function conversion tables) + controllers/persist behavior, exercised
+here through the live operator the way the reference's persist controllers
+ride real informers.
+"""
+
+import json
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.api.types import JobConditionType, ReplicaType
+from kubedl_tpu.core.objects import (
+    ContainerStatus,
+    Event,
+    OwnerRef,
+    Pod,
+    PodPhase,
+)
+from kubedl_tpu.operator import Operator, OperatorOptions
+from kubedl_tpu.persist import Query, SQLiteBackend, default_registry
+from kubedl_tpu.persist.dmo import event_to_dmo, job_to_dmo, pod_to_dmo, to_jsonable
+from kubedl_tpu.runtime.executor import ThreadRuntime
+
+from tests.helpers import make_tpujob
+
+
+# ---- converters (pure functions, table style) -----------------------------
+
+
+def test_job_to_dmo_basic():
+    job = make_tpujob("conv", workers=2)
+    job.metadata.annotations[constants.ANNOTATION_TENANCY] = "team-a"
+    job.metadata.annotations[constants.ANNOTATION_OWNER] = "alice"
+    job.status.set_condition(JobConditionType.RUNNING)
+    job.status.start_time = 123.0
+    row = job_to_dmo(job, region="us-east1")
+    assert row.kind == "TPUJob"
+    assert row.phase == "Running"
+    assert row.tenant == "team-a"
+    assert row.owner == "alice"
+    assert row.region == "us-east1"
+    assert row.started_at == 123.0
+    payload = json.loads(row.payload)
+    assert payload["metadata"]["name"] == "conv"
+    # enum-keyed dicts lower to their values
+    assert "Worker" in payload["spec"]["replica_specs"]
+
+
+def test_pod_to_dmo_labels_and_exit_code():
+    pod = Pod()
+    pod.metadata.name = "conv-worker-1"
+    pod.metadata.labels = {
+        constants.LABEL_JOB_NAME: "conv",
+        constants.LABEL_REPLICA_TYPE: "Worker",
+        constants.LABEL_REPLICA_INDEX: "1",
+    }
+    pod.metadata.owner_refs.append(OwnerRef(kind="TPUJob", name="conv", uid="uid-1"))
+    pod.spec.node_name = "host-3"
+    pod.status.phase = PodPhase.FAILED
+    pod.status.container_statuses = [ContainerStatus(exit_code=137)]
+    row = pod_to_dmo(pod)
+    assert row.job_uid == "uid-1"
+    assert row.job_name == "conv"
+    assert row.replica_type == "Worker"
+    assert row.replica_index == 1
+    assert row.node == "host-3"
+    assert row.exit_code == 137
+    assert row.phase == "Failed"
+
+
+def test_event_to_dmo():
+    ev = Event(
+        involved_kind="TPUJob", involved_name="conv", type="Warning",
+        reason="Failed", message="boom", count=3,
+    )
+    ev.metadata.name = "conv.failed"
+    row = event_to_dmo(ev, region="eu")
+    assert row.involved_kind == "TPUJob"
+    assert row.count == 3
+    assert row.region == "eu"
+
+
+def test_to_jsonable_round_trips_job():
+    job = make_tpujob("json", workers=1)
+    blob = json.dumps(to_jsonable(job))
+    back = json.loads(blob)
+    assert back["spec"]["replica_specs"]["Worker"]["replicas"] == 1
+
+
+# ---- SQLite backend (reference: mysql.go semantics) ----------------------
+
+
+def test_sqlite_job_upsert_and_query():
+    b = SQLiteBackend(":memory:")
+    b.initialize()
+    job = make_tpujob("q1", workers=1)
+    row = job_to_dmo(job)
+    b.save_job(row)
+    row.phase = "Running"
+    b.save_job(row)  # upsert, not duplicate
+    jobs = b.list_jobs(Query())
+    assert len(jobs) == 1 and jobs[0].phase == "Running"
+    assert b.get_job("default", "q1").uid == row.uid
+
+    # filters
+    assert b.list_jobs(Query(kind="TPUJob"))
+    assert not b.list_jobs(Query(kind="TFJob"))
+    assert b.list_jobs(Query(phase="Running"))
+    assert b.list_jobs(Query(name="q"))  # substring match
+    assert not b.list_jobs(Query(namespace="other"))
+
+    # soft delete keeps history
+    b.mark_job_deleted("default", "q1", "TPUJob")
+    got = b.get_job("default", "q1")
+    assert got.deleted and not got.is_in_etcd
+    assert not b.list_jobs(Query(include_deleted=False))
+    b.remove_job_record("default", "q1")
+    assert b.get_job("default", "q1") is None
+    b.close()
+
+
+def test_sqlite_pods_and_events():
+    b = SQLiteBackend(":memory:")
+    b.initialize()
+    pod = Pod()
+    pod.metadata.name = "p0"
+    pod.metadata.owner_refs.append(OwnerRef(kind="TPUJob", name="j", uid="uid-9"))
+    row = pod_to_dmo(pod)
+    b.save_pod(row)
+    row.phase = "Running"
+    b.save_pod(row)
+    pods = b.list_pods("uid-9")
+    assert len(pods) == 1 and pods[0].phase == "Running"
+    b.mark_pod_deleted("default", "p0")
+    assert b.list_pods("uid-9")[0].deleted
+
+    ev = Event(involved_kind="TPUJob", involved_name="j", reason="Created",
+               message="ok")
+    ev.metadata.name = "j.created"
+    b.save_event(event_to_dmo(ev))
+    ev.count = 2
+    b.save_event(event_to_dmo(ev))  # dedup by (ns, name)
+    events = b.list_events("TPUJob", "j")
+    assert len(events) == 1 and events[0].count == 2
+    b.close()
+
+
+def test_registry_unknown_backend():
+    reg = default_registry()
+    try:
+        reg.object_backend("mysql")
+    except KeyError as e:
+        assert "sqlite" in str(e)
+    else:
+        raise AssertionError("expected KeyError")
+
+
+# ---- live mirror through the operator ------------------------------------
+
+
+def test_persist_controllers_mirror_job_lifecycle(tmp_path):
+    opts = OperatorOptions(
+        local_addresses=True,
+        artifact_registry_root=str(tmp_path / "reg"),
+        meta_storage="sqlite",
+        event_storage="sqlite",
+        region="test-region",
+    )
+    with Operator(opts, runtime=ThreadRuntime()) as op:
+        job = make_tpujob("mirror", workers=2, entrypoint="tests.test_persist:_noop")
+        op.submit(job)
+        op.wait_for_phase("TPUJob", "mirror", [JobConditionType.SUCCEEDED], timeout=30)
+
+        backend = op.object_backend
+
+        def mirrored() -> bool:
+            row = backend.get_job("default", "mirror", "TPUJob")
+            return row is not None and row.phase == "Succeeded"
+
+        assert op.manager.wait(mirrored, timeout=10)
+        row = backend.get_job("default", "mirror", "TPUJob")
+        assert row.region == "test-region"
+        assert row.finished_at is not None
+        pods = backend.list_pods(row.uid)
+        assert len(pods) == 2
+        assert {p.replica_index for p in pods} == {0, 1}
+        assert all(p.phase == "Succeeded" for p in pods)
+        # events mirrored too
+        events = op.event_backend.list_events("TPUJob", "mirror")
+        assert events, "expected mirrored events"
+
+        # deleting the live job soft-deletes the mirror row
+        op.store.delete("TPUJob", "mirror")
+
+        def soft_deleted() -> bool:
+            r = backend.get_job("default", "mirror", "TPUJob")
+            return r is not None and r.deleted and not r.is_in_etcd
+
+        assert op.manager.wait(soft_deleted, timeout=10)
+
+
+def _noop(env):
+    return 0
