@@ -32,6 +32,8 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from kubedl_tpu.api import constants
+from kubedl_tpu.observability.tensorboard import TensorBoardReconciler
+from kubedl_tpu.observability.tracing import TRACER
 from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadController
 from kubedl_tpu.api.types import (
     CleanPodPolicy,
@@ -98,6 +100,10 @@ class JobEngine:
         self.features = features or DEFAULT_GATES
         self.cluster_domain = cluster_domain
         self.expectations = ControllerExpectations()
+        # per-job TensorBoard lifecycle (reference: tfjob_controller.go:171-177
+        # calls ReconcileTensorBoard each pass; generic here — any kind may
+        # carry the annotation)
+        self.tensorboard = TensorBoardReconciler(store, cluster_domain)
         self._rng = random.Random(0xC0FFEE)
         # informer-style expectation observers (reference: pod/service event
         # filters feeding expectations, pod.go:55-165, service.go:41-139)
@@ -129,7 +135,10 @@ class JobEngine:
         if not self.expectations.all_satisfied(job_key(job)):
             return None  # watch events will re-trigger once caches settle
         self.controller.apply_defaults(job)
-        return self.reconcile_job(job)
+        with TRACER.span(
+            "reconcile", kind=self.controller.KIND, job=f"{namespace}/{name}"
+        ):
+            return self.reconcile_job(job)
 
     # ----------------------------------------------------------- main loop
 
@@ -226,6 +235,8 @@ class JobEngine:
             # transitions get
             self._on_transition(job, status.phase, pods)
         self._observe_launch_delays(job, pods)
+        if not job.status.is_terminal():  # terminal pass syncs in _finalize
+            self.tensorboard.reconcile(job)
         if job.status != snapshot or job.metadata.annotations != ann_snapshot:
             status.last_reconcile_time = now
             self._update_status(job)
@@ -556,17 +567,21 @@ class JobEngine:
             self.gang.delete_gang(job)
         if job.status.is_succeeded() and job.spec.model_version is not None:
             self._create_model_version(job, ctx)
+        tb_requeue = self.tensorboard.reconcile(job)
         ttl = job.spec.run_policy.ttl_seconds_after_finished
         if ttl is not None and job.status.completion_time is not None:
             remaining = job.status.completion_time + ttl - time.time()
             if remaining <= 0:
                 self.metrics.deleted.inc(kind=self.controller.KIND)
+                self.tensorboard.delete(job)
                 self.store.try_delete(
                     self.controller.KIND, job.metadata.name, job.metadata.namespace
                 )
                 return None
+            if tb_requeue is not None:
+                return min(remaining, tb_requeue)
             return remaining
-        return None
+        return tb_requeue
 
     def _delete_pods(
         self, job: JobObject, pods: List[Pod], policy: CleanPodPolicy

@@ -1,0 +1,139 @@
+"""TensorBoard lifecycle tests (reference analogue: the tensorboard
+reconcile paths exercised via controllers/tensorflow/tfjob_controller.go:171-177
+and pkg/tensorboard/tensorboard.go:59-447)."""
+
+import json
+import time
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.core.objects import Pod, Volume
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.observability.tensorboard import (
+    TB_PORT,
+    TensorBoardReconciler,
+    parse_tensorboard_spec,
+    tb_name,
+)
+
+from tests.helpers import PodDriver, make_tpujob
+from tests.test_engine import make_engine, submit_and_reconcile
+
+
+def annotate_tb(job, **overrides):
+    cfg = {"logDir": "/data/logs", "ttlSecondsAfterJobFinished": 30}
+    cfg.update(overrides)
+    job.metadata.annotations[constants.ANNOTATION_TENSORBOARD_CONFIG] = json.dumps(cfg)
+    return job
+
+
+class TestParse:
+    def test_parse_roundtrip(self):
+        job = annotate_tb(make_tpujob(), image="tb:v1", profile=True)
+        spec = parse_tensorboard_spec(job)
+        assert spec.log_dir == "/data/logs"
+        assert spec.image == "tb:v1"
+        assert spec.ttl_seconds_after_job_finished == 30
+        assert spec.profile is True
+
+    def test_absent_and_garbage(self):
+        job = make_tpujob()
+        assert parse_tensorboard_spec(job) is None
+        job.metadata.annotations[constants.ANNOTATION_TENSORBOARD_CONFIG] = "{nope"
+        assert parse_tensorboard_spec(job) is None
+
+
+class TestReconcile:
+    def test_engine_creates_tb_pod_and_service(self):
+        engine, store, _ = make_engine()
+        job = annotate_tb(make_tpujob("tb1"))
+        submit_and_reconcile(engine, store, job)
+        pod = store.get("Pod", "tb1-tensorboard")
+        svc = store.get("Service", "tb1-tensorboard")
+        assert isinstance(pod, Pod)
+        assert "--logdir=/data/logs" in pod.spec.containers[0].command
+        assert svc.spec.ports[0].port == TB_PORT
+        # owner-ref points at the job so GC cascades
+        assert pod.metadata.controller_ref().name == "tb1"
+
+    def test_mirrors_master_volumes(self):
+        engine, store, _ = make_engine()
+        job = annotate_tb(make_tpujob("tb2"))
+        from kubedl_tpu.api.types import ReplicaType
+
+        job.spec.replica_specs[ReplicaType.WORKER].template.spec.volumes.append(
+            Volume(name="logs", host_path="/mnt/logs", mount_path="/data/logs")
+        )
+        submit_and_reconcile(engine, store, job)
+        pod = store.get("Pod", "tb2-tensorboard")
+        assert [v.name for v in pod.spec.volumes] == ["logs"]
+
+    def test_update_timestamp_recreates_pod(self):
+        store = ObjectStore()
+        rec = TensorBoardReconciler(store)
+        job = annotate_tb(make_tpujob("tb3"), updateTimestamp=1.0)
+        store.create(job)
+        rec.reconcile(job)
+        first_uid = store.get("Pod", tb_name(job)).metadata.uid
+        rec.reconcile(job)  # same config: no churn
+        assert store.get("Pod", tb_name(job)).metadata.uid == first_uid
+        annotate_tb(job, updateTimestamp=2.0, image="tb:v2")
+        rec.reconcile(job)
+        pod = store.get("Pod", tb_name(job))
+        assert pod.metadata.uid != first_uid
+        assert pod.spec.containers[0].image == "tb:v2"
+
+    def test_annotation_removed_tears_down(self):
+        store = ObjectStore()
+        rec = TensorBoardReconciler(store)
+        job = annotate_tb(make_tpujob("tb4"))
+        rec.reconcile(job)
+        assert store.try_get("Pod", tb_name(job)) is not None
+        del job.metadata.annotations[constants.ANNOTATION_TENSORBOARD_CONFIG]
+        rec.reconcile(job)
+        assert store.try_get("Pod", tb_name(job)) is None
+        assert store.try_get("Service", tb_name(job)) is None
+
+
+class TestTTL:
+    def test_kept_until_ttl_then_deleted(self):
+        store = ObjectStore()
+        rec = TensorBoardReconciler(store)
+        job = annotate_tb(make_tpujob("tb5"), ttlSecondsAfterJobFinished=30)
+        from kubedl_tpu.api.types import JobConditionType
+
+        job.status.set_condition(JobConditionType.SUCCEEDED, "ok", "done")
+        job.status.completion_time = time.time()
+        requeue = rec.reconcile(job)
+        assert store.try_get("Pod", tb_name(job)) is not None
+        assert requeue is not None and 0 < requeue <= 30
+        job.status.completion_time = time.time() - 31
+        assert rec.reconcile(job) is None
+        assert store.try_get("Pod", tb_name(job)) is None
+
+    def test_survives_job_completion_through_engine(self):
+        engine, store, _ = make_engine()
+        driver = PodDriver(store)
+        job = annotate_tb(make_tpujob("tb6", workers=1), ttlSecondsAfterJobFinished=60)
+        from kubedl_tpu.api.types import CleanPodPolicy
+
+        job.spec.run_policy.clean_pod_policy = CleanPodPolicy.ALL
+        submit_and_reconcile(engine, store, job)
+        driver.run("tb6-worker-0")
+        engine.reconcile("default", "tb6")
+        driver.succeed("tb6-worker-0")
+        requeue = engine.reconcile("default", "tb6")
+        got = store.get(job.KIND, "tb6")
+        assert got.status.is_succeeded()
+        # worker pod cleaned up, tb pod retained until TTL
+        assert store.try_get("Pod", "tb6-worker-0") is None
+        assert store.try_get("Pod", "tb6-tensorboard") is not None
+        assert requeue is not None and requeue <= 60
+
+    def test_url(self):
+        store = ObjectStore()
+        rec = TensorBoardReconciler(store, cluster_domain="cluster.local")
+        job = make_tpujob("tb7")
+        assert (
+            rec.url(job)
+            == "http://tb7-tensorboard.default.svc.cluster.local:6006"
+        )

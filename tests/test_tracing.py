@@ -1,0 +1,69 @@
+"""Tracing tests (TPU addition per SURVEY.md §5 — no reference analogue)."""
+
+import json
+import threading
+
+from kubedl_tpu.observability.tracing import TRACER, Tracer
+
+from tests.helpers import make_tpujob
+from tests.test_engine import make_engine, submit_and_reconcile
+
+
+class TestTracer:
+    def test_span_records_duration_and_attrs(self):
+        t = Tracer()
+        with t.span("work", key="v") as attrs:
+            attrs["late"] = 1
+        (s,) = t.spans("work")
+        assert s.duration >= 0
+        assert s.attrs == {"key": "v", "late": 1}
+
+    def test_ring_capacity(self):
+        t = Tracer(capacity=8)
+        for i in range(20):
+            with t.span(f"s{i}"):
+                pass
+        assert len(t.spans()) == 8
+        assert t.spans()[0].name == "s12"
+
+    def test_summary_and_chrome_export(self):
+        t = Tracer()
+        for _ in range(3):
+            with t.span("phase"):
+                pass
+        agg = t.summary()["phase"]
+        assert agg["count"] == 3 and agg["total_s"] >= 0
+        trace = json.loads(t.chrome_trace())
+        assert len(trace["traceEvents"]) == 3
+        assert trace["traceEvents"][0]["ph"] == "X"
+
+    def test_disabled_is_free(self):
+        t = Tracer()
+        t.enabled = False
+        with t.span("skipped"):
+            pass
+        assert t.spans() == []
+
+    def test_thread_names_become_tids(self):
+        t = Tracer()
+
+        def work():
+            with t.span("x"):
+                pass
+
+        th = threading.Thread(target=work, name="worker-th")
+        th.start()
+        th.join()
+        with t.span("x"):
+            pass
+        trace = json.loads(t.chrome_trace())
+        assert len({e["tid"] for e in trace["traceEvents"]}) == 2
+
+
+class TestEngineIntegration:
+    def test_reconcile_emits_span(self):
+        TRACER.clear()
+        engine, store, _ = make_engine()
+        submit_and_reconcile(engine, store, make_tpujob("traced"))
+        spans = TRACER.spans("reconcile")
+        assert spans and spans[-1].attrs["job"] == "default/traced"
