@@ -1,0 +1,193 @@
+"""Embedded single-page dashboard.
+
+Reference: console/frontend — a React/UmiJS app (pages: Jobs, JobSubmit,
+JobDetail, ClusterInfo, DataConfig). The TPU build embeds a dependency-free
+vanilla-JS equivalent of those pages served at ``/`` by the console server:
+overview tiles, a filterable job table with stop/delete actions, a job
+detail drawer (replicas + events), and a YAML/JSON submit box.
+"""
+
+INDEX_HTML = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>KubeDL-TPU Console</title>
+<style>
+  :root { --fg:#1a1a2e; --muted:#667; --line:#e3e5ea; --accent:#3451b2; }
+  * { box-sizing:border-box; }
+  body { margin:0; font:14px/1.5 system-ui,sans-serif; color:var(--fg); }
+  header { padding:14px 24px; border-bottom:1px solid var(--line);
+           display:flex; gap:16px; align-items:baseline; }
+  header h1 { font-size:18px; margin:0; }
+  header span { color:var(--muted); font-size:12px; }
+  main { padding:20px 24px; max-width:1100px; margin:0 auto; }
+  .tiles { display:flex; gap:12px; flex-wrap:wrap; margin-bottom:20px; }
+  .tile { border:1px solid var(--line); border-radius:8px; padding:10px 16px;
+          min-width:130px; }
+  .tile b { display:block; font-size:22px; }
+  .tile span { color:var(--muted); font-size:12px; }
+  table { width:100%; border-collapse:collapse; margin-top:8px; }
+  th,td { text-align:left; padding:6px 10px; border-bottom:1px solid var(--line); }
+  th { color:var(--muted); font-weight:600; font-size:12px; }
+  .phase { padding:1px 8px; border-radius:9px; font-size:12px; }
+  .phase.Running { background:#e3f2e8; color:#1c7a3d; }
+  .phase.Succeeded { background:#e5ecfb; color:#2c4ea0; }
+  .phase.Failed { background:#fbe5e5; color:#a02c2c; }
+  .phase.Created,.phase.Queued { background:#f4f4f6; color:#555; }
+  button { border:1px solid var(--line); background:#fff; border-radius:6px;
+           padding:3px 10px; cursor:pointer; }
+  button:hover { border-color:var(--accent); color:var(--accent); }
+  textarea { width:100%; height:160px; font:12px/1.4 ui-monospace,monospace; }
+  input,select { padding:4px 8px; border:1px solid var(--line); border-radius:6px; }
+  .row { display:flex; gap:8px; margin:8px 0; flex-wrap:wrap; }
+  #detail { white-space:pre-wrap; font:12px/1.4 ui-monospace,monospace;
+            background:#f8f8fa; border:1px solid var(--line); border-radius:8px;
+            padding:12px; display:none; margin-top:14px; }
+  h2 { font-size:15px; margin:26px 0 4px; }
+</style>
+</head>
+<body>
+<header><h1>KubeDL-TPU</h1><span>TPU-native workload orchestration console</span></header>
+<main>
+  <div class="tiles" id="tiles"></div>
+
+  <h2>Jobs</h2>
+  <div class="row">
+    <select id="f-kind"><option value="">all kinds</option></select>
+    <input id="f-name" placeholder="name filter">
+    <select id="f-phase">
+      <option value="">all phases</option>
+      <option>Created</option><option>Queued</option><option>Running</option>
+      <option>Succeeded</option><option>Failed</option>
+    </select>
+    <button onclick="loadJobs()">refresh</button>
+  </div>
+  <table><thead><tr>
+    <th>name</th><th>kind</th><th>namespace</th><th>phase</th>
+    <th>created</th><th>owner</th><th></th>
+  </tr></thead><tbody id="jobs"></tbody></table>
+  <div id="detail"></div>
+
+  <h2>Submit</h2>
+  <p style="color:var(--muted)">Paste a job object as YAML or JSON
+     (must include <code>kind</code>).</p>
+  <textarea id="submit-box" placeholder="kind: TPUJob&#10;metadata:&#10;  name: demo"></textarea>
+  <div class="row"><button onclick="submitJob()">submit</button>
+    <span id="submit-msg" style="color:var(--muted)"></span></div>
+</main>
+<div id="login" style="display:none; position:fixed; inset:0; background:#fffd;
+     display:none; align-items:center; justify-content:center;">
+  <div style="border:1px solid var(--line); border-radius:10px; padding:24px;
+       background:#fff; box-shadow:0 8px 30px #0002;">
+    <h2 style="margin-top:0">Sign in</h2>
+    <div class="row"><input id="login-user" placeholder="username"></div>
+    <div class="row"><input id="login-pass" type="password" placeholder="password"></div>
+    <div class="row"><button onclick="doLogin()">login</button>
+      <span id="login-msg" style="color:#a02c2c"></span></div>
+  </div>
+</div>
+<script>
+// All server strings are rendered via esc()/textContent — job names are
+// user-controlled input and must never reach innerHTML unescaped.
+const esc = s => String(s ?? '').replace(/[&<>"']/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+
+async function api(p, opts) {
+  const r = await fetch(p, opts);
+  if (r.status === 401) { showLogin(); throw new Error('unauthorized'); }
+  return r.json();
+}
+const post = (p, b) => api(p, {method:'POST', body: b ? JSON.stringify(b) : null,
+  headers:{'Content-Type':'application/json'}});
+
+function showLogin() { document.getElementById('login').style.display = 'flex'; }
+async function doLogin() {
+  const r = await fetch('/api/v1/login', {method:'POST',
+    headers:{'Content-Type':'application/json'},
+    body: JSON.stringify({username: document.getElementById('login-user').value,
+                          password: document.getElementById('login-pass').value})});
+  if (r.status === 200) {  // session cookie set by the server
+    document.getElementById('login').style.display = 'none';
+    loadOverview(); loadJobs();
+  } else {
+    document.getElementById('login-msg').textContent = 'invalid credentials';
+  }
+}
+
+async function loadOverview() {
+  const o = (await api('/api/v1/data/overview')).data;
+  const t = document.getElementById('tiles');
+  const tiles = [
+    [o.jobTotal, 'jobs'], [o.jobPhases.Running || 0, 'running'],
+    [o.podRunning + '/' + o.podTotal, 'pods running'],
+    [o.sliceFree + '/' + o.sliceTotal, 'slices free'],
+  ];
+  t.innerHTML = tiles.map(([v, l]) =>
+    `<div class=tile><b>${esc(v)}</b><span>${esc(l)}</span></div>`).join('');
+  const sel = document.getElementById('f-kind');
+  if (sel.options.length === 1)
+    for (const k of o.workloadKinds) sel.add(new Option(k, k));
+}
+
+function fmt(ts) { return ts ? new Date(ts * 1000).toLocaleString() : ''; }
+
+const PHASES = ['Created','Queued','Running','Succeeded','Failed'];
+
+async function loadJobs() {
+  const q = new URLSearchParams();
+  for (const [k, id] of [['kind','f-kind'],['name','f-name'],['phase','f-phase']]) {
+    const v = document.getElementById(id).value; if (v) q.set(k, v);
+  }
+  const d = (await api('/api/v1/job/list?' + q)).data;
+  const tbody = document.getElementById('jobs');
+  tbody.innerHTML = d.jobInfos.map((j, i) => {
+    const phase = PHASES.includes(j.phase) ? j.phase : '';
+    return `<tr data-i="${i}">
+    <td><a href="#" data-act="detail">${esc(j.name)}</a></td>
+    <td>${esc(j.kind)}</td><td>${esc(j.namespace)}</td>
+    <td><span class="phase ${phase}">${esc(j.phase)}</span></td>
+    <td>${esc(fmt(j.created_at))}</td><td>${esc(j.owner)}</td>
+    <td><button data-act="stop">stop</button>
+        <button data-act="delete">delete</button></td></tr>`;
+  }).join('');
+  tbody._rows = d.jobInfos;
+}
+
+document.getElementById('jobs').addEventListener('click', async ev => {
+  const act = ev.target.dataset.act;
+  if (!act) return;
+  ev.preventDefault();
+  const tr = ev.target.closest('tr');
+  const j = document.getElementById('jobs')._rows[Number(tr.dataset.i)];
+  const qs = `${encodeURIComponent(j.namespace)}/${encodeURIComponent(j.name)}` +
+             `?kind=${encodeURIComponent(j.kind)}`;
+  if (act === 'detail') {
+    const d = (await api(`/api/v1/job/detail/${qs}`)).data;
+    const el = document.getElementById('detail');
+    el.style.display = 'block';
+    el.textContent = JSON.stringify(d, null, 2);
+  } else if (act === 'stop') {
+    await post(`/api/v1/job/stop/${qs}`); loadJobs();
+  } else if (act === 'delete') {
+    await fetch(`/api/v1/job/delete/${qs}`, {method:'DELETE'}); loadJobs();
+  }
+});
+
+async function submitJob() {
+  const raw = document.getElementById('submit-box').value;
+  let body; try { body = JSON.parse(raw); } catch { body = {yaml: raw}; }
+  const r = await post('/api/v1/job/submit', body);
+  document.getElementById('submit-msg').textContent = JSON.stringify(r.data);
+  loadJobs(); loadOverview();
+}
+
+loadOverview(); loadJobs();
+setInterval(() => {
+  if (document.getElementById('login').style.display !== 'flex') {
+    loadOverview(); loadJobs();
+  }
+}, 5000);
+</script>
+</body>
+</html>
+"""

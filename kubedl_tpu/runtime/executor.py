@@ -67,9 +67,12 @@ class SubprocessRuntime(ContainerRuntime):
         full_env = {**os.environ, **env}
         stdout = None
         if self.log_dir:
-            os.makedirs(self.log_dir, exist_ok=True)
+            # namespaced: same-named pods in different namespaces must not
+            # share (or leak) a log file
+            ns_dir = os.path.join(self.log_dir, pod.metadata.namespace)
+            os.makedirs(ns_dir, exist_ok=True)
             stdout = open(  # noqa: SIM115 - handle outlives this scope
-                os.path.join(self.log_dir, f"{pod.metadata.name}.log"), "ab"
+                os.path.join(ns_dir, f"{pod.metadata.name}.log"), "ab"
             )
         proc = subprocess.Popen(
             argv,

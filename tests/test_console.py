@@ -1,0 +1,345 @@
+"""Console REST API tests (reference analogue: console/backend handler
+tests — job list/submit/stop, logs, overview, sources, auth)."""
+
+import json
+import urllib.request
+import urllib.error
+
+import pytest
+import yaml
+
+from kubedl_tpu.api import codec
+from kubedl_tpu.api.types import JobConditionType, ReplicaSpec, ReplicaType
+from kubedl_tpu.console import ConsoleServer, PersistReadBackend, SessionAuth
+from kubedl_tpu.operator import Operator, OperatorOptions
+from kubedl_tpu.runtime.executor import SubprocessRuntime
+
+from tests.helpers import make_tpujob
+
+
+@pytest.fixture()
+def console(tmp_path):
+    opts = OperatorOptions(
+        local_addresses=True,
+        pod_log_dir=str(tmp_path / "logs"),
+        artifact_registry_root=str(tmp_path / "registry"),
+        meta_storage="sqlite",
+        event_storage="sqlite",
+        storage_db_path=str(tmp_path / "meta.db"),
+    )
+    op = Operator(opts, runtime=SubprocessRuntime(str(tmp_path / "logs")))
+    srv = ConsoleServer(op)
+    op.start()
+    srv.start()
+    try:
+        yield op, srv
+    finally:
+        srv.stop()
+        op.stop()
+
+
+def call(srv, method, path, body=None, token="", raw=False):
+    host, port = srv.address
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}",
+        method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"}
+        | ({"Authorization": f"Bearer {token}"} if token else {}),
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            data = resp.read()
+            return resp.status, data if raw else json.loads(data)
+    except urllib.error.HTTPError as e:
+        data = e.read()
+        return e.code, data if raw else json.loads(data)
+
+
+def submit_and_wait(op, srv, name, phase=JobConditionType.SUCCEEDED, workers=2):
+    job = make_tpujob(name, workers=workers, command=["python", "-c", "pass"])
+    status, resp = call(srv, "POST", "/api/v1/job/submit", codec.encode(job))
+    assert status == 200, resp
+    op.wait_for_phase("TPUJob", name, [phase], timeout=30)
+
+
+def test_job_submit_list_detail_yaml(console):
+    op, srv = console
+    submit_and_wait(op, srv, "c1")
+
+    status, resp = call(srv, "GET", "/api/v1/job/list?kind=TPUJob")
+    assert status == 200
+    rows = resp["data"]["jobInfos"]
+    assert [r["name"] for r in rows] == ["c1"]
+    assert rows[0]["phase"] == "Succeeded"
+
+    status, resp = call(srv, "GET", "/api/v1/job/detail/default/c1?kind=TPUJob")
+    assert status == 200
+    detail = resp["data"]
+    # worker-0 success finishes the job; CleanPodPolicy.RUNNING may reap the
+    # other still-running worker, so 1..2 pods remain.
+    assert 1 <= len(detail["replicas"]) <= 2
+    assert {r["replica_type"] for r in detail["replicas"]} == {"Worker"}
+    assert any(e["reason"] == "JobSucceeded" for e in detail["events"])
+
+    status, resp = call(srv, "GET", "/api/v1/job/yaml/default/c1?kind=TPUJob")
+    assert status == 200
+    doc = yaml.safe_load(resp["data"]["yaml"])
+    assert doc["kind"] == "TPUJob"
+    decoded = codec.decode_object(doc)
+    assert decoded.spec.replica_specs[ReplicaType.WORKER].replicas == 2
+
+
+def test_job_submit_via_yaml_body(console):
+    op, srv = console
+    job = make_tpujob("c-yaml", workers=1, command=["python", "-c", "pass"])
+    body = {"yaml": yaml.safe_dump(codec.encode(job))}
+    status, resp = call(srv, "POST", "/api/v1/job/submit", body)
+    assert status == 200, resp
+    op.wait_for_phase("TPUJob", "c-yaml", [JobConditionType.SUCCEEDED], timeout=30)
+
+
+def test_job_submit_rejects_bad_kind(console):
+    _, srv = console
+    status, resp = call(srv, "POST", "/api/v1/job/submit", {"kind": "Nope"})
+    assert status == 400
+    status, resp = call(srv, "POST", "/api/v1/job/submit", {"no": "kind"})
+    assert status == 400
+
+
+def test_job_submit_rejects_bad_name(console):
+    _, srv = console
+    job = codec.encode(make_tpujob("ok"))
+    job["metadata"]["name"] = "<img src=x onerror=alert(1)>"
+    status, resp = call(srv, "POST", "/api/v1/job/submit", job)
+    assert status == 400 and "invalid job name" in resp["data"]
+
+
+def test_malformed_params_get_400_not_dropped_socket(console):
+    _, srv = console
+    status, resp = call(srv, "GET", "/api/v1/job/list?page_size=abc")
+    assert status == 400
+    status, resp = call(srv, "GET", "/api/v1/job/list?start_time=xyz")
+    assert status == 400
+    status, resp = call(srv, "POST", "/api/v1/job/submit", {"yaml": ":\n:"})
+    assert status == 400
+
+
+def test_pagination_total_is_true_count(console):
+    op, srv = console
+    for i in range(5):
+        job = make_tpujob(f"pg-{i}", workers=1, command=["python", "-c", "pass"])
+        call(srv, "POST", "/api/v1/job/submit", codec.encode(job))
+    for i in range(5):
+        op.wait_for_phase(
+            "TPUJob", f"pg-{i}", [JobConditionType.SUCCEEDED], timeout=30
+        )
+    status, resp = call(srv, "GET", "/api/v1/job/list?page_size=2&page_num=1")
+    assert resp["data"]["total"] == 5
+    assert len(resp["data"]["jobInfos"]) == 2
+    status, resp = call(srv, "GET", "/api/v1/job/list?page_size=2&page_num=3")
+    assert len(resp["data"]["jobInfos"]) == 1
+    # page_num below 1 clamps rather than returning an empty page
+    status, resp = call(srv, "GET", "/api/v1/job/list?page_size=2&page_num=0")
+    assert status == 200 and len(resp["data"]["jobInfos"]) == 2
+
+
+def test_job_stop_and_delete(console):
+    op, srv = console
+    job = make_tpujob(
+        "c-stop", workers=1, command=["python", "-c", "import time; time.sleep(300)"]
+    )
+    call(srv, "POST", "/api/v1/job/submit", codec.encode(job))
+    op.wait_for_phase("TPUJob", "c-stop", [JobConditionType.RUNNING], timeout=30)
+
+    status, _ = call(srv, "POST", "/api/v1/job/stop/default/c-stop?kind=TPUJob")
+    assert status == 200
+    got = op.wait_for_phase("TPUJob", "c-stop", [JobConditionType.FAILED], timeout=30)
+    assert got.status.condition(JobConditionType.FAILED).reason == "JobStopped"
+
+    status, _ = call(srv, "DELETE", "/api/v1/job/delete/default/c-stop?kind=TPUJob")
+    assert status == 200
+    status, _ = call(srv, "GET", "/api/v1/job/detail/default/c-stop?kind=TPUJob")
+    assert status == 404
+
+
+def test_statistics_running_and_overview(console):
+    op, srv = console
+    submit_and_wait(op, srv, "c-stat")
+
+    status, resp = call(srv, "GET", "/api/v1/job/statistics")
+    assert status == 200
+    stats = resp["data"]
+    assert stats["totalJobCount"] == 1
+    assert stats["statistics"]["Succeeded"] == 1
+    assert stats["histogram"]["TPUJob"] == 1
+
+    status, resp = call(srv, "GET", "/api/v1/job/running-jobs")
+    assert resp["data"]["jobInfos"] == []
+
+    status, resp = call(srv, "GET", "/api/v1/data/overview")
+    overview = resp["data"]
+    assert overview["jobTotal"] == 1
+    assert "TPUJob" in overview["workloadKinds"]
+
+
+def test_pod_logs_and_events(console):
+    op, srv = console
+    job = make_tpujob(
+        "c-log", workers=1, command=["python", "-c", "print('hello-from-pod')"]
+    )
+    call(srv, "POST", "/api/v1/job/submit", codec.encode(job))
+    op.wait_for_phase("TPUJob", "c-log", [JobConditionType.SUCCEEDED], timeout=30)
+
+    status, resp = call(srv, "GET", "/api/v1/pod/list/default/c-log")
+    pod_name = resp["data"]["replicas"][0]["name"]
+
+    status, resp = call(srv, "GET", f"/api/v1/log/logs/default/{pod_name}")
+    assert status == 200
+    assert any("hello-from-pod" in line for line in resp["data"]["logs"])
+
+    status, resp = call(srv, "GET", "/api/v1/event/events/default/TPUJob/c-log")
+    assert status == 200
+    assert any(e["reason"] == "JobSucceeded" for e in resp["data"]["events"])
+
+
+def test_job_routes_reject_non_workload_kind(console):
+    _, srv = console
+    # the job API must never reach non-job kinds through ?kind=
+    status, resp = call(
+        srv, "DELETE",
+        "/api/v1/job/delete/kubedl-system/kubedl-console-datasources?kind=ConfigMap",
+    )
+    assert status == 400
+    status, resp = call(srv, "POST", "/api/v1/job/stop/default/x?kind=Pod")
+    assert status == 400
+
+
+def test_codesource_named_datasource_does_not_cross_route(console):
+    _, srv = console
+    call(srv, "POST", "/api/v1/codesource", {"name": "datasource", "git": "g"})
+    status, resp = call(srv, "GET", "/api/v1/datasource")
+    assert resp["data"] == {}
+    status, resp = call(srv, "GET", "/api/v1/codesource")
+    assert list(resp["data"]) == ["datasource"]
+    call(srv, "DELETE", "/api/v1/codesource/datasource")
+    status, resp = call(srv, "GET", "/api/v1/codesource")
+    assert resp["data"] == {}
+
+
+def test_job_list_strips_payload(console):
+    op, srv = console
+    submit_and_wait(op, srv, "c-payload")
+    _, resp = call(srv, "GET", "/api/v1/job/list")
+    assert "payload" not in resp["data"]["jobInfos"][0]
+    _, resp = call(srv, "GET", "/api/v1/job/json/default/c-payload")
+    assert resp["data"]["kind"] == "TPUJob"  # detail still serves the object
+
+
+def test_source_crud(console):
+    _, srv = console
+    body = {"name": "imagenet", "type": "nfs", "path": "/mnt/data"}
+    status, resp = call(srv, "POST", "/api/v1/datasource", body)
+    assert status == 200
+
+    status, resp = call(srv, "GET", "/api/v1/datasource")
+    assert resp["data"]["imagenet"]["path"] == "/mnt/data"
+
+    status, _ = call(srv, "DELETE", "/api/v1/datasource/imagenet")
+    assert status == 200
+    status, resp = call(srv, "GET", "/api/v1/datasource")
+    assert resp["data"] == {}
+
+    # codesource is an independent namespace
+    call(srv, "POST", "/api/v1/codesource", {"name": "repo", "git": "https://x"})
+    status, resp = call(srv, "GET", "/api/v1/codesource")
+    assert list(resp["data"]) == ["repo"]
+
+
+def test_persist_read_backend_survives_store_delete(console):
+    op, srv = console
+    submit_and_wait(op, srv, "c-persist")
+    assert op.manager.wait(
+        lambda: (row := op.object_backend.get_job("default", "c-persist")) is not None
+        and row.phase == "Succeeded"
+        and len(op.object_backend.list_pods(row.uid)) == 2
+    )
+    # replace reader with the persist mirror, then delete from live store
+    srv.reader = PersistReadBackend(op.object_backend, op.event_backend)
+    op.store.delete("TPUJob", "c-persist", "default")
+
+    status, resp = call(srv, "GET", "/api/v1/job/list?kind=TPUJob&name=c-persist")
+    assert status == 200
+    rows = resp["data"]["jobInfos"]
+    assert rows and rows[0]["phase"] == "Succeeded"
+    status, resp = call(srv, "GET", "/api/v1/job/detail/default/c-persist")
+    assert status == 200
+    assert len(resp["data"]["replicas"]) == 2
+
+
+def test_auth_wall(tmp_path):
+    op = Operator(OperatorOptions(local_addresses=True))
+    srv = ConsoleServer(op, auth=SessionAuth({"admin": "s3cret"}))
+    srv.start()
+    try:
+        status, _ = call(srv, "GET", "/api/v1/job/list")
+        assert status == 401
+
+        status, resp = call(
+            srv, "POST", "/api/v1/login",
+            {"username": "admin", "password": "wrong"},
+        )
+        assert status == 401
+
+        status, resp = call(
+            srv, "POST", "/api/v1/login",
+            {"username": "admin", "password": "s3cret"},
+        )
+        assert status == 200
+        token = resp["data"]["token"]
+
+        status, resp = call(srv, "GET", "/api/v1/current-user", token=token)
+        assert resp["data"]["username"] == "admin"
+        status, _ = call(srv, "GET", "/api/v1/job/list", token=token)
+        assert status == 200
+
+        # logout via bearer header revokes the session
+        status, _ = call(srv, "POST", "/api/v1/logout", token=token)
+        assert status == 200
+        status, _ = call(srv, "GET", "/api/v1/job/list", token=token)
+        assert status == 401
+
+        # unauthenticated metrics/health/index stay open
+        status, _ = call(srv, "GET", "/healthz")
+        assert status == 200
+        status, body = call(srv, "GET", "/", raw=True)
+        assert status == 200 and b"KubeDL-TPU" in body
+    finally:
+        srv.stop()
+        op.stop()
+
+
+def test_tensorboard_routes(console):
+    op, srv = console
+    job = make_tpujob(
+        "c-tb", workers=1, command=["python", "-c", "import time; time.sleep(120)"]
+    )
+    call(srv, "POST", "/api/v1/job/submit", codec.encode(job))
+    op.wait_for_phase("TPUJob", "c-tb", [JobConditionType.RUNNING], timeout=30)
+
+    status, resp = call(srv, "GET", "/api/v1/tensorboard/status/default/c-tb")
+    assert resp["data"]["configured"] is False
+
+    status, _ = call(
+        srv, "POST", "/api/v1/tensorboard/apply/default/c-tb",
+        {"log_dir": "/tmp/tb-logs"},
+    )
+    assert status == 200
+    status, resp = call(srv, "GET", "/api/v1/tensorboard/status/default/c-tb")
+    assert resp["data"]["configured"] is True
+
+    status, _ = call(srv, "DELETE", "/api/v1/tensorboard/default/c-tb")
+    assert status == 200
+    status, resp = call(srv, "GET", "/api/v1/tensorboard/status/default/c-tb")
+    assert resp["data"]["configured"] is False
+    call(srv, "POST", "/api/v1/job/stop/default/c-tb?kind=TPUJob")
