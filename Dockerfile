@@ -1,0 +1,24 @@
+# Operator image (reference: Dockerfile — single static binary; here a
+# slim Python image carrying the operator + console + compute path).
+#
+#   docker build -t kubedl-tpu:latest .
+#   docker run kubedl-tpu:latest --workloads '*' --console-port 9090
+#
+# On TPU hosts, base this on a TPU-enabled JAX image instead and the same
+# entrypoint serves both the control plane and in-pod workers.
+
+FROM python:3.12-slim
+
+WORKDIR /app
+
+COPY pyproject.toml README.md bench.py __graft_entry__.py ./
+COPY kubedl_tpu ./kubedl_tpu
+
+# CPU JAX by default; TPU deployments override with jax[tpu]
+RUN pip install --no-cache-dir -e .
+
+# console + metrics
+EXPOSE 9090
+
+ENTRYPOINT ["kubedl-tpu-operator"]
+CMD ["--workloads", "*", "--console-port", "9090", "--console-host", "0.0.0.0"]

@@ -1,0 +1,34 @@
+# Build/test entry points (reference: Makefile:17-19 `make manager/test/...`).
+# Everything runs CPU-only by default; `make bench` uses real hardware.
+
+PY ?= python
+
+.PHONY: test test-fast bench-smoke bench dryrun install lint all
+
+all: test
+
+# unit + integration suite on a virtual 8-device CPU mesh
+test:
+	KUBEDL_CI=true $(PY) -m pytest tests/ -x -q
+
+test-fast:
+	KUBEDL_CI=true $(PY) -m pytest tests/ -x -q -m "not slow"
+
+# CPU smoke of the end-to-end bench (operator -> gang -> pod -> train)
+bench-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py
+
+# real-hardware bench (one JSON line on stdout)
+bench:
+	$(PY) bench.py
+
+# multi-chip sharding dry run on 8 virtual CPU devices
+dryrun:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+	  $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"
+
+install:
+	$(PY) -m pip install -e .
+
+lint:
+	$(PY) -m compileall -q kubedl_tpu bench.py __graft_entry__.py

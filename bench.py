@@ -10,6 +10,12 @@ reported against the explicit target we set ourselves — 10% MFU on the
 bench model (vs_baseline = achieved_MFU / 0.10); on CPU (no TPU attached)
 it falls back to 1.0.
 
+Hard sanity gates (round-1 lesson: the bench printed a physically
+impossible MFU of 538% — VERDICT.md): the run FAILS if MFU > 1, if the
+step time beats the HBM param-read floor, if loss didn't decrease, or if
+the TPU run didn't actually trace the pallas flash kernel into the hot
+path. A failed gate exits nonzero rather than printing a lying number.
+
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 """
@@ -24,6 +30,11 @@ import time
 
 def main() -> int:
     t_import = time.time()
+    # Respect JAX_PLATFORMS=cpu (CPU smoke runs) even where a sitecustomize
+    # force-registers an accelerator plugin; no-op on real TPU runs.
+    from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested
+
+    ensure_cpu_if_requested()
     import jax
 
     platform = jax.devices()[0].platform
@@ -44,8 +55,6 @@ def main() -> int:
         }
     else:
         train_cfg = {"model": "tiny", "global_batch": 8, "seq_len": 128, "steps": 8}
-
-    summary_holder = {}
 
     with TemporaryDirectory() as tmp:
         opts = OperatorOptions(
@@ -91,6 +100,28 @@ def main() -> int:
         summary.get("first_step_wall_time", 0.0) - t_submit, 0.0
     )
 
+    # ---- hard sanity gates --------------------------------------------
+    violations = list(summary.get("sanity_violations") or [])
+    if on_tpu:
+        from kubedl_tpu.ops import flash_attention_module as fa
+
+        if summary.get("attn_impl") != "flash":
+            violations.append(
+                f"TPU bench ran attn_impl={summary.get('attn_impl')!r}, "
+                "expected the pallas flash kernel"
+            )
+        elif fa.TRACE_COUNT == 0:
+            violations.append(
+                "attn_impl claims flash but the pallas kernel was never traced"
+            )
+    if violations:
+        print(
+            json.dumps({"error": "bench sanity gates failed",
+                        "violations": violations, "summary": summary}),
+            file=sys.stderr,
+        )
+        return 1
+
     tps_chip = summary["tokens_per_sec_per_chip"]
     mfu = summary["mfu"]
     vs_baseline = (mfu / 0.10) if on_tpu and mfu > 0 else 1.0
@@ -104,12 +135,16 @@ def main() -> int:
                 "detail": {
                     "platform": platform,
                     "mfu": round(mfu, 4),
+                    "attn_impl": summary.get("attn_impl"),
                     "first_step_seconds": round(summary["first_step_seconds"], 2),
                     "startup_to_first_step_seconds": round(
                         summary.get("_startup_to_first_step", 0.0), 2
                     ),
                     "step_time_ms": round(summary["step_time_ms"], 2),
+                    "hbm_floor_ms": round(summary.get("hbm_floor_ms", 0.0), 2),
+                    "first_loss": round(summary.get("first_loss") or 0.0, 4),
                     "final_loss": round(summary["final_loss"], 4),
+                    "sanity": "all gates passed",
                 },
             }
         )

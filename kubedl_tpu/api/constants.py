@@ -52,6 +52,9 @@ ENV_MESH_AXES = "KUBEDL_MESH_AXES"  # logical mesh hint, e.g. "data=4,model=8"
 # modelversion_types.go:23-33 — KUBEDL_MODEL_PATH + /kubedl-model):
 ENV_MODEL_PATH = "KUBEDL_MODEL_PATH"
 DEFAULT_MODEL_PATH = "/kubedl-model"
+#: Checkpoint root for slice-granular restart-from-checkpoint (SURVEY.md §7
+#: hard-part b). Defaults to <model path>/checkpoints when unset.
+ENV_CKPT_DIR = "KUBEDL_CKPT_DIR"
 
 # Default port every replica's coordinator/service listens on.
 DEFAULT_PORT = 2222

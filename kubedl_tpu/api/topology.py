@@ -36,6 +36,9 @@ class SliceTopology:
     #: Per-chip peak bf16 TFLOP/s — used for MFU accounting, not scheduling.
     peak_bf16_tflops: float = 197.0
     hbm_gib_per_chip: float = 16.0
+    #: Per-chip HBM bandwidth GB/s (spec sheet) — used for bench sanity
+    #: floors (a training step cannot beat one full param read from HBM).
+    hbm_gbps: float = 819.0
 
     @property
     def total_devices(self) -> int:
@@ -91,30 +94,30 @@ def _register(*topos: SliceTopology) -> None:
 
 _register(
     # v5e: 1 host = 4 chips (2x2), 197 bf16 TFLOP/s, 16 GiB HBM
-    SliceTopology("v5e-4", 4, 1, 4, (2, 2), 197.0, 16.0),
-    SliceTopology("v5e-8", 8, 2, 4, (2, 4), 197.0, 16.0),
-    SliceTopology("v5e-16", 16, 4, 4, (4, 4), 197.0, 16.0),
-    SliceTopology("v5e-32", 32, 8, 4, (4, 8), 197.0, 16.0),
-    SliceTopology("v5e-64", 64, 16, 4, (8, 8), 197.0, 16.0),
-    SliceTopology("v5e-128", 128, 32, 4, (8, 16), 197.0, 16.0),
-    SliceTopology("v5e-256", 256, 64, 4, (16, 16), 197.0, 16.0),
+    SliceTopology("v5e-4", 4, 1, 4, (2, 2), 197.0, 16.0, 819.0),
+    SliceTopology("v5e-8", 8, 2, 4, (2, 4), 197.0, 16.0, 819.0),
+    SliceTopology("v5e-16", 16, 4, 4, (4, 4), 197.0, 16.0, 819.0),
+    SliceTopology("v5e-32", 32, 8, 4, (4, 8), 197.0, 16.0, 819.0),
+    SliceTopology("v5e-64", 64, 16, 4, (8, 8), 197.0, 16.0, 819.0),
+    SliceTopology("v5e-128", 128, 32, 4, (8, 16), 197.0, 16.0, 819.0),
+    SliceTopology("v5e-256", 256, 64, 4, (16, 16), 197.0, 16.0, 819.0),
     # v4: 1 host = 4 chips, 3D torus, 275 bf16 TFLOP/s, 32 GiB
-    SliceTopology("v4-8", 8, 1, 4, (2, 2, 1), 275.0, 32.0),
-    SliceTopology("v4-16", 16, 2, 4, (2, 2, 2), 275.0, 32.0),
-    SliceTopology("v4-32", 32, 4, 4, (2, 2, 4), 275.0, 32.0),
-    SliceTopology("v4-64", 64, 8, 4, (2, 4, 4), 275.0, 32.0),
+    SliceTopology("v4-8", 8, 1, 4, (2, 2, 1), 275.0, 32.0, 1228.0),
+    SliceTopology("v4-16", 16, 2, 4, (2, 2, 2), 275.0, 32.0, 1228.0),
+    SliceTopology("v4-32", 32, 4, 4, (2, 2, 4), 275.0, 32.0, 1228.0),
+    SliceTopology("v4-64", 64, 8, 4, (2, 4, 4), 275.0, 32.0, 1228.0),
     # v5p: 1 host = 4 chips, 459 bf16 TFLOP/s, 95 GiB
-    SliceTopology("v5p-8", 8, 2, 4, (2, 2, 1), 459.0, 95.0),
-    SliceTopology("v5p-16", 16, 4, 4, (2, 2, 2), 459.0, 95.0),
-    SliceTopology("v5p-32", 32, 8, 4, (2, 2, 4), 459.0, 95.0),
+    SliceTopology("v5p-8", 8, 2, 4, (2, 2, 1), 459.0, 95.0, 2765.0),
+    SliceTopology("v5p-16", 16, 4, 4, (2, 2, 2), 459.0, 95.0, 2765.0),
+    SliceTopology("v5p-32", 32, 8, 4, (2, 2, 4), 459.0, 95.0, 2765.0),
     # v6e (Trillium): 1 host = 4 chips, ~918 bf16 TFLOP/s, 32 GiB
-    SliceTopology("v6e-4", 4, 1, 4, (2, 2), 918.0, 32.0),
-    SliceTopology("v6e-8", 8, 2, 4, (2, 4), 918.0, 32.0),
-    SliceTopology("v6e-16", 16, 4, 4, (4, 4), 918.0, 32.0),
-    SliceTopology("v6e-32", 32, 8, 4, (4, 8), 918.0, 32.0),
+    SliceTopology("v6e-4", 4, 1, 4, (2, 2), 918.0, 32.0, 1640.0),
+    SliceTopology("v6e-8", 8, 2, 4, (2, 4), 918.0, 32.0, 1640.0),
+    SliceTopology("v6e-16", 16, 4, 4, (4, 4), 918.0, 32.0, 1640.0),
+    SliceTopology("v6e-32", 32, 8, 4, (4, 8), 918.0, 32.0, 1640.0),
     # CPU stand-in used by tests / kind-style local clusters
-    SliceTopology("cpu-1", 1, 1, 1, (1,), 0.5, 8.0),
-    SliceTopology("cpu-8", 8, 8, 1, (8,), 0.5, 8.0),
+    SliceTopology("cpu-1", 1, 1, 1, (1,), 0.5, 8.0, 50.0),
+    SliceTopology("cpu-8", 8, 8, 1, (8,), 0.5, 8.0, 50.0),
 )
 
 
@@ -127,16 +130,26 @@ _DEVICE_KIND_ALIASES = {
 }
 
 
-def peak_flops_for_device_kind(kind: str) -> float:
-    """Per-chip peak bf16 FLOP/s for a PJRT device_kind string, derived
-    from the slice catalog (single source of truth for hardware specs)."""
+def _catalog_lookup(kind: str, getter) -> float:
+    """Resolve a PJRT device_kind string to a per-chip spec value via the
+    slice catalog (single source of truth for hardware numbers). 0.0 for
+    CPU/unknown kinds."""
     kind = kind.lower()
-    gens = {t.name.split("-")[0]: t.peak_bf16_tflops * 1e12
-            for t in SLICE_CATALOG.values()}
+    gens = {t.name.split("-")[0]: getter(t) for t in SLICE_CATALOG.values()}
     for sub, gen in _DEVICE_KIND_ALIASES.items():
         if sub in kind and gen in gens:
             return gens[gen]
     return 0.0
+
+
+def peak_flops_for_device_kind(kind: str) -> float:
+    """Per-chip peak bf16 FLOP/s — used for MFU accounting."""
+    return _catalog_lookup(kind, lambda t: t.peak_bf16_tflops * 1e12)
+
+
+def hbm_bandwidth_for_device_kind(kind: str) -> float:
+    """Per-chip HBM bandwidth bytes/s — used for bench sanity floors."""
+    return _catalog_lookup(kind, lambda t: t.hbm_gbps * 1e9)
 
 
 def get_slice(name: str) -> SliceTopology:

@@ -34,7 +34,7 @@ from kubedl_tpu.api.types import JobConditionType
 from kubedl_tpu.console.auth import SESSION_COOKIE, SessionAuth
 from kubedl_tpu.console.backends import ApiServerReadBackend, ObjectReadBackend
 from kubedl_tpu.console.frontend import INDEX_HTML
-from kubedl_tpu.core.objects import ConfigMap
+from kubedl_tpu.core.objects import ConfigMap, new_uid
 from kubedl_tpu.core.store import AlreadyExists, NotFound
 from kubedl_tpu.persist.backends import Query
 from kubedl_tpu.persist.dmo import row_to_dict, rows_to_dicts
@@ -216,6 +216,11 @@ class ConsoleServer:
 
     def _query_from(self, req: Request, paginate: bool = True) -> Query:
         q = req.query
+        kind = q.get("kind", "")
+        if kind and kind not in self.operator.engines:
+            # same guard as _live_job: job queries must never reach non-job
+            # kinds (Pod, ConfigMap...) whose status lacks job fields
+            raise ApiError(400, f"kind {kind!r} is not an enabled workload kind")
         page_size, offset = self._page_params(req) if paginate else (0, 0)
         return Query(
             name=q.get("name", ""),
@@ -288,6 +293,15 @@ class ConsoleServer:
             raise ApiError(400, f"invalid job name {job.metadata.name!r}")
         if not _NAME_RX.match(job.metadata.namespace):
             raise ApiError(400, f"invalid namespace {job.metadata.namespace!r}")
+        # api-server create semantics (reference: CRD status subresource,
+        # apis/*/+kubebuilder:subresource:status): a submitted object never
+        # carries caller-supplied status or identity — otherwise YAML copied
+        # from the console's own /job/yaml view (which embeds status) would
+        # create a job already in a terminal phase that never runs.
+        job.status = type(job.status)()
+        job.metadata.uid = new_uid()
+        job.metadata.resource_version = 0
+        job.metadata.creation_timestamp = time.time()
         if req.username and req.username != "anonymous":
             # presubmit tenancy injection (reference:
             # handlers/job_presubmit_hooks.go)
@@ -348,8 +362,11 @@ class ConsoleServer:
 
     def _h_job_statistics(self, req: Request):
         """Aggregate counts by phase and kind over a time window
-        (reference: api/job.go statistics + running-jobs)."""
-        return self._job_stats(self.reader.list_jobs(self._query_from(req)))
+        (reference: api/job.go statistics + running-jobs). Unpaginated:
+        aggregates must cover the full filtered set, not one page."""
+        return self._job_stats(
+            self.reader.list_jobs(self._query_from(req, paginate=False))
+        )
 
     def _h_running_jobs(self, req: Request):
         q = self._query_from(req)
@@ -464,7 +481,11 @@ class ConsoleServer:
             cm = ConfigMap()
             cm.metadata.name = name
             cm.metadata.namespace = "kubedl-system"
-            cm = self.operator.store.create(cm)
+            try:
+                cm = self.operator.store.create(cm)
+            except AlreadyExists:
+                # two concurrent first-writes raced; the winner's CM is fine
+                cm = self.operator.store.get("ConfigMap", name, "kubedl-system")
         return cm
 
     def _h_source_list(self, req: Request):

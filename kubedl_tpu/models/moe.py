@@ -58,11 +58,56 @@ class MoEConfig:
     def head_dim(self) -> int:
         return self.dim // self.n_heads
 
+    def num_params(self) -> int:
+        hd = self.head_dim
+        per_layer = (
+            self.dim * (self.n_heads * hd)
+            + 2 * self.dim * (self.n_kv_heads * hd)
+            + (self.n_heads * hd) * self.dim
+            + self.dim * self.n_experts  # router
+            + 2 * self.n_experts * self.dim * self.ffn_dim  # w_in, w_out
+            + 2 * self.dim  # norms
+        )
+        return (
+            self.vocab_size * self.dim  # embed
+            + self.n_layers * per_layer
+            + self.dim  # final norm
+            + self.dim * self.vocab_size  # lm_head
+        )
+
+    def flops_per_token(self) -> float:
+        """Training FLOPs/token ~= 6 * activated params (top-1 routing
+        activates one expert of n_experts per token)."""
+        hd = self.head_dim
+        per_layer_active = (
+            self.dim * (self.n_heads * hd)
+            + 2 * self.dim * (self.n_kv_heads * hd)
+            + (self.n_heads * hd) * self.dim
+            + self.dim * self.n_experts
+            + 2 * self.dim * self.ffn_dim  # one expert's w_in + w_out
+        )
+        active = (
+            self.vocab_size * self.dim
+            + self.n_layers * per_layer_active
+            + self.dim * self.vocab_size
+        )
+        return 6.0 * active
+
 
 TINY_MOE = MoEConfig(
     vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=4, n_experts=4,
     ffn_dim=128, max_seq=128, dtype=jnp.float32, remat=False,
 )
+
+#: bench-scale MoE that fits one v5e chip with a real batch
+BENCH_MOE = MoEConfig(
+    vocab_size=32768, dim=1024, n_layers=12, n_heads=16, n_kv_heads=8,
+    n_experts=8, ffn_dim=2048, max_seq=2048,
+)
+
+
+def preset(name: str) -> MoEConfig:
+    return {"tiny-moe": TINY_MOE, "bench-moe": BENCH_MOE}[name]
 
 
 def moe_init(key: jax.Array, cfg: MoEConfig) -> Params:

@@ -14,9 +14,11 @@ pallas accumulation pattern (pallas_guide.md: grid iterates last dim
 fastest; scratch persists). GQA is free: the K/V BlockSpec index map sends
 q-head h to kv-head h//group, no repeated K/V in memory.
 
-Backward is a custom VJP running the standard flash backward recurrence as
-a blockwise `lax.scan` in plain JAX (saves (q,k,v,out,lse); recomputes
-P per block) — O(S·bk) live memory, XLA fuses the per-block einsums.
+Backward is a custom VJP over two more pallas kernels (the canonical
+flash-2 split): a dQ kernel accumulating over k-blocks and a dK/dV kernel
+accumulating over q-blocks, both recomputing P from the saved lse — same
+O(S·hd) memory profile as the forward, and independently tileable
+(fwd 256x256 / bwd 256x512 are the v5e sweet spots).
 
 On CPU (tests) the kernel runs in pallas interpret mode; numerics match
 the dense oracle `kubedl_tpu.models.llama.attention`.
@@ -85,7 +87,9 @@ def _fwd_kernel(
     def _finalize():
         l = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_ref[:, 0] + jnp.log(l[:, 0])
+        # lse is [B, H, Sq, 1] (trailing singleton keeps the block shape
+        # legal for mosaic's (8, 128) tiling rule); squeezed by _fwd
+        lse_ref[0, 0] = m_ref[:, :1] + jnp.log(l)
 
 
 def _fwd(
@@ -123,11 +127,11 @@ def _fwd(
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Sq, hd), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Sq), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, hd), jnp.float32),
@@ -136,72 +140,190 @@ def _fwd(
         ],
         interpret=interpret,
     )(q, k, v)
-    return out, lse
+    return out, lse[..., 0]
 
 
-def _bwd_blockwise(
-    res, do: jax.Array, causal: bool, block_k: int
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref, acc_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int, n_k: int,
+):
+    """dQ kernel: grid (B, H, n_q, n_k), k innermost — the dq tile for one
+    q-block accumulates across k-blocks in VMEM scratch (same pattern as
+    the forward, with p recomputed from the saved lse)."""
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = (j * block_k <= i * block_q + block_q - 1) if causal else (j <= n_k)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]  # [bq, 1]
+        d = d_ref[0, 0]  # [bq, 1]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - d)
+        acc_ref[:] += lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(j == n_k - 1)
+    def _finalize():
+        dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkdv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, scale: float, causal: bool, block_q: int, block_k: int, n_q: int,
+):
+    """dK/dV kernel: grid (B, H, n_k, n_q), q innermost — each k-block's
+    gradient accumulates across the q-blocks that attend to it."""
+    j = pl.program_id(2)
+    i = pl.program_id(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = (j * block_k <= i * block_q + block_q - 1) if causal else (i <= n_q)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        d = d_ref[0, 0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dv_acc[:] += lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0, 0],
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - d)).astype(q.dtype)
+        dk_acc[:] += lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(i == n_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(
+    res, do: jax.Array, causal: bool, block_q: int, block_k: int,
+    interpret: bool,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Flash backward as a lax.scan over k/v blocks (plain JAX; O(S·bk)
-    live memory). GQA handled by grouping q-heads per kv-head."""
+    """Fused flash backward: dq via one kernel, dk/dv via another, both
+    with the same O(S·hd) memory profile as the forward. GQA: kernels run
+    at q-head granularity against the shared kv-head block (BlockSpec index
+    maps h -> h//group); dk/dv are then summed over the group."""
+    from jax.experimental.pallas import tpu as pltpu
+
     q, k, v, out, lse = res
     B, H, Sq, hd = q.shape
     KV, Sk = k.shape[1], k.shape[2]
-    G = H // KV
+    group = H // KV
+    bq = min(block_q, Sq)
     bk = min(block_k, Sk)
-    n_k = Sk // bk
+    n_q, n_k = Sq // bq, Sk // bk
     scale = 1.0 / math.sqrt(hd)
 
-    qg = q.reshape(B, KV, G, Sq, hd).astype(jnp.float32)
-    dog = do.reshape(B, KV, G, Sq, hd).astype(jnp.float32)
-    lse_g = lse.reshape(B, KV, G, Sq)
-    # D_i = rowsum(dO * O) — the softmax-normalization term
-    D = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
-    D_g = D.reshape(B, KV, G, Sq)
-    rows = jnp.arange(Sq)
+    # D_i = rowsum(dO * O): tiny elementwise pre-pass, XLA fuses it
+    d = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)[..., None]
+    lse4 = lse[..., None]  # [B, H, Sq, 1]
 
-    k_blocks = k.reshape(B, KV, n_k, bk, hd).transpose(2, 0, 1, 3, 4)
-    v_blocks = v.reshape(B, KV, n_k, bk, hd).transpose(2, 0, 1, 3, 4)
+    q_spec = pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // group, j, 0))
+    row_spec = pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0))
 
-    def step(dq_acc, blk):
-        j, k_j, v_j = blk
-        k_j = k_j.astype(jnp.float32)
-        v_j = v_j.astype(jnp.float32)
-        s = jnp.einsum("bkgqd,bktd->bkgqt", qg, k_j) * scale
-        if causal:
-            cols = j * bk + jnp.arange(bk)
-            s = jnp.where(rows[:, None] >= cols[None, :], s, NEG_INF)
-        p = jnp.exp(s - lse_g[..., None])
-        dv_j = jnp.einsum("bkgqt,bkgqd->bktd", p, dog)
-        dp = jnp.einsum("bkgqd,bktd->bkgqt", dog, v_j)
-        ds = p * (dp - D_g[..., None])
-        dq_acc = dq_acc + jnp.einsum("bkgqt,bktd->bkgqd", ds, k_j) * scale
-        dk_j = jnp.einsum("bkgqt,bkgqd->bktd", ds, qg) * scale
-        return dq_acc, (dk_j, dv_j)
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=bq, block_k=bk, n_k=n_k,
+        ),
+        grid=(B, H, n_q, n_k),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((B, H, Sq, hd), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, hd), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse4, d)[0]
 
-    dq0 = jnp.zeros_like(qg)
-    dq, (dk_blocks, dv_blocks) = lax.scan(
-        step, dq0, (jnp.arange(n_k), k_blocks, v_blocks)
-    )
-    dq = dq.reshape(B, H, Sq, hd).astype(q.dtype)
-    dk = dk_blocks.transpose(1, 2, 0, 3, 4).reshape(B, KV, Sk, hd).astype(k.dtype)
-    dv = dv_blocks.transpose(1, 2, 0, 3, 4).reshape(B, KV, Sk, hd).astype(v.dtype)
+    # dk/dv at q-head granularity (grid swaps the two inner axes)
+    q_spec2 = pl.BlockSpec((1, 1, bq, hd), lambda b, h, j, i: (b, h, i, 0))
+    kv_spec2 = pl.BlockSpec((1, 1, bk, hd), lambda b, h, j, i: (b, h // group, j, 0))
+    row_spec2 = pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0))
+    dkv_spec = pl.BlockSpec((1, 1, bk, hd), lambda b, h, j, i: (b, h, j, 0))
+
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(
+            _bwd_dkdv_kernel, scale=scale, causal=causal,
+            block_q=bq, block_k=bk, n_q=n_q,
+        ),
+        grid=(B, H, n_k, n_q),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=[dkv_spec, dkv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sk, hd), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Sk, hd), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, hd), jnp.float32),
+            pltpu.VMEM((bk, hd), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse4, d)
+    dk = dk_h.reshape(B, KV, group, Sk, hd).sum(axis=2).astype(k.dtype)
+    dv = dv_h.reshape(B, KV, group, Sk, hd).sum(axis=2).astype(v.dtype)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, block_q, block_k, bwd_block_q, bwd_block_k, interpret):
     out, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
     return out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, block_q, block_k, bwd_block_q, bwd_block_k, interpret):
     out, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, do):
-    return _bwd_blockwise(res, do, causal, block_k)
+def _flash_bwd(causal, block_q, block_k, bwd_block_q, bwd_block_k, interpret, res, do):
+    return _bwd_pallas(res, do, causal, bwd_block_q, bwd_block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -209,6 +331,12 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def _default_interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+#: Times the pallas kernel was traced into a compiled graph. Incremented at
+#: trace time (once per compile, not per step) — bench.py asserts this is
+#: nonzero to prove the fused kernel is in the hot path, not the oracle.
+TRACE_COUNT = 0
 
 
 def flash_attention(
@@ -219,20 +347,111 @@ def flash_attention(
     mask: Optional[jax.Array] = None,
     block_q: int = 256,
     block_k: int = 256,
+    bwd_block_q: int = 256,
+    bwd_block_k: int = 512,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Drop-in for `kubedl_tpu.models.llama.attention` (same signature, so
     it slots into `llama_forward(..., attn_fn=flash_attention)`). Arbitrary
     masks fall back to the dense oracle — flash handles the causal/full
-    cases that training uses."""
+    cases that training uses. Forward and backward kernels tile
+    independently (v5e sweet spots: fwd 256x256, bwd 256x512)."""
     if mask is not None:
         from kubedl_tpu.models.llama import attention
 
         return attention(q, k, v, causal=causal, mask=mask)
+    global TRACE_COUNT
+    TRACE_COUNT += 1
     if interpret is None:
         interpret = _default_interpret()
     qt = q.transpose(0, 2, 1, 3)  # [B, H, S, hd]
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash(qt, kt, vt, causal, block_q, block_k, interpret)
+    S = qt.shape[2]
+    bwd_q = min(bwd_block_q, S)
+    bwd_k = min(bwd_block_k, S)
+    if S % bwd_q or S % bwd_k:  # fall back to fwd tiling (already checked)
+        bwd_q, bwd_k = block_q, block_k
+    out = _flash(qt, kt, vt, causal, block_q, block_k, bwd_q, bwd_k, interpret)
     return out.transpose(0, 2, 1, 3)
+
+
+def supports(seq_len: int, block_q: int = 256, block_k: int = 256) -> bool:
+    """Whether the kernel's static tiling constraints hold for this shape
+    (seq must divide evenly into blocks after the min() clamp)."""
+    bq = min(block_q, seq_len)
+    bk = min(block_k, seq_len)
+    return seq_len % bq == 0 and seq_len % bk == 0
+
+
+def make_flash_attention(
+    mesh,
+    batch_axes: Tuple[str, ...] = ("replica", "data", "fsdp"),
+    head_axis: str = "tensor",
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: Optional[bool] = None,
+):
+    """Mesh-aware flash attention for the trainer hot path.
+
+    pallas_call can't be auto-partitioned by XLA's SPMD partitioner, so on a
+    multi-device mesh the kernel is wrapped in `shard_map` over the batch
+    (data-like) and head (tensor) axes — attention is embarrassingly
+    parallel over both, so the body needs no collectives. On a trivial mesh
+    the kernel is called directly.
+    """
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    bt = tuple(
+        a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1
+    )
+    ht = (
+        head_axis
+        if head_axis in mesh.axis_names and mesh.shape[head_axis] > 1
+        else None
+    )
+
+    if not bt and ht is None:
+
+        def direct(q, k, v, causal=True, mask=None):
+            return flash_attention(
+                q, k, v, causal=causal, mask=mask,
+                block_q=block_q, block_k=block_k, interpret=interpret,
+            )
+
+        return direct
+
+    def build(head):
+        spec = P(bt if bt else None, None, head, None)  # [B, S, H, hd]
+        inner = shard_map(
+            functools.partial(
+                flash_attention, causal=True,
+                block_q=block_q, block_k=block_k, interpret=interpret,
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return NamedSharding(mesh, spec), inner
+
+    variants = {None: build(None)}
+    if ht is not None:
+        variants[ht] = build(ht)
+
+    def attn_fn(q, k, v, causal=True, mask=None):
+        if mask is not None or not causal:
+            from kubedl_tpu.models.llama import attention
+
+            return attention(q, k, v, causal=causal, mask=mask)
+        # head sharding needs every head count divisible by the axis
+        t = mesh.shape[ht] if ht is not None else 1
+        key = ht if ht is not None and q.shape[2] % t == 0 and k.shape[2] % t == 0 else None
+        sharding, inner = variants[key]
+        q = jax.lax.with_sharding_constraint(q, sharding)
+        k = jax.lax.with_sharding_constraint(k, sharding)
+        v = jax.lax.with_sharding_constraint(v, sharding)
+        return inner(q, k, v)
+
+    return attn_fn

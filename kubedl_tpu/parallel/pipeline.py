@@ -76,17 +76,24 @@ def make_pipeline(
     stage_fn: Callable,
     pipe_axis: str = "pipe",
     params_leading_axis_sharded: bool = True,
+    data_axes: tuple = (),
 ):
-    """Wrap pipeline_apply in shard_map over ``pipe_axis``.
+    """Wrap pipeline_apply in shard_map over ``pipe_axis`` (and, for the
+    activations' microbatch dim, over ``data_axes`` — GPipe composes with
+    data parallelism for free: each dp shard runs its own pipeline over the
+    same stage weights).
 
     Returns ``run(stacked_params, x_mb)`` where ``stacked_params`` leaves
     have a leading [n_stages, ...] axis (sharded across the pipe axis) and
-    ``x_mb`` is [M, mb, ...]. ``stage_fn(params_slice, x)`` sees its own
-    stage's slice with the leading axis collapsed to this stage's share.
+    ``x_mb`` is [M, mb, ...] with mb sharded over ``data_axes``.
+    ``stage_fn(params_slice, x)`` sees its own stage's slice with the
+    leading axis collapsed to this stage's share.
     """
     from jax import shard_map
 
     pspec = P(pipe_axis) if params_leading_axis_sharded else P()
+    dt = tuple(a for a in data_axes if a in mesh.axis_names and mesh.shape[a] > 1)
+    xspec = P(None, dt if dt else None)  # [M, mb, ...rest replicated]
 
     def local(stage_params, x_mb):
         return pipeline_apply(stage_fn, stage_params, x_mb, pipe_axis)
@@ -94,7 +101,7 @@ def make_pipeline(
     return shard_map(
         local,
         mesh=mesh,
-        in_specs=(pspec, P()),
-        out_specs=P(),
+        in_specs=(pspec, xspec),
+        out_specs=xspec,
         check_vma=False,
     )

@@ -1,17 +1,29 @@
-"""Checkpoint save/restore with a restore-from-latest convention.
+"""Sharded checkpoint save/restore with a restore-from-latest convention.
 
 The reference has no data-plane checkpointing (SURVEY.md §5) — its analogue
 is the model-output dir convention (`KUBEDL_MODEL_PATH`). The TPU build
 makes checkpointing first-class because slice-granular restart depends on
-it: a gang restart reloads `latest` and loses at most one save interval.
+it: a gang restart reloads `latest` and loses at most one save interval
+(reference restart machinery: pkg/job_controller/pod.go:305-317).
 
-Format: one `step-<N>/` dir per save holding an .npz of all leaves (keyed by
-tree path) + meta.json; `latest` marker file. Restore targets an existing
-abstract state so every leaf lands back on its original NamedSharding.
+Format (multi-host correct — each process writes only what it can address):
+
+    <ckpt_dir>/step-<N>/
+        meta.json            rank-0 manifest: step + global shape/dtype of
+                             every leaf (keyed by jax tree path)
+        shards-p<pid>.npz    process pid's addressable shards; replicated
+                             leaves saved by rank 0 only, sharded leaves
+                             saved per shard keyed "<path>@<offset,...>"
+    <ckpt_dir>/latest        marker file (rank 0, written last)
+
+Restore targets an existing abstract state so every leaf lands back on its
+original NamedSharding via `jax.make_array_from_callback` — each process
+reads only the shard bytes its devices need.
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import re
@@ -23,25 +35,55 @@ import jax
 import numpy as np
 
 
-def _flatten(state) -> Dict[str, Any]:
-    flat = {}
+def _leaf_items(state):
     for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
-        key = jax.tree_util.keystr(path)
-        flat[key] = np.asarray(jax.device_get(leaf))
-    return flat
+        yield jax.tree_util.keystr(path), leaf
 
 
-def save_checkpoint(ckpt_dir: str, state, step: int) -> str:
+def _shard_key(key: str, index) -> str:
+    offs = ",".join(str(s.start or 0) for s in index)
+    return f"{key}@{offs}"
+
+
+def save_checkpoint(
+    ckpt_dir: str, state, step: int, process_index: Optional[int] = None
+) -> str:
+    """Write this process's shards (+ manifest and marker on rank 0)."""
+    pid = jax.process_index() if process_index is None else process_index
     d = Path(ckpt_dir) / f"step-{step:08d}"
     d.mkdir(parents=True, exist_ok=True)
-    flat = _flatten(state)
+
+    shards: Dict[str, np.ndarray] = {}
+    manifest: Dict[str, Any] = {}
+    for key, leaf in _leaf_items(state):
+        arr = leaf
+        if isinstance(arr, jax.Array):
+            manifest[key] = {"shape": list(arr.shape), "dtype": str(arr.dtype)}
+            if arr.is_fully_replicated:
+                if pid == 0:
+                    shards[key] = np.asarray(jax.device_get(arr))
+            else:
+                for s in arr.addressable_shards:
+                    if s.replica_id == 0:
+                        shards[_shard_key(key, s.index)] = np.asarray(s.data)
+        else:
+            a = np.asarray(arr)
+            manifest[key] = {"shape": list(a.shape), "dtype": str(a.dtype)}
+            if pid == 0:
+                shards[key] = a
+
     # atomic-ish: write to tmp then rename
     fd, tmp = tempfile.mkstemp(dir=str(d), suffix=".tmp.npz")
     os.close(fd)
-    np.savez(tmp, **flat)
-    os.replace(tmp, d / "state.npz")
-    (d / "meta.json").write_text(json.dumps({"step": step}))
-    (Path(ckpt_dir) / "latest").write_text(d.name)
+    np.savez(tmp, **shards)
+    os.replace(tmp, d / f"shards-p{pid}.npz")
+    if pid == 0:
+        (d / "meta.json").write_text(
+            json.dumps(
+                {"step": step, "nprocs": jax.process_count(), "leaves": manifest}
+            )
+        )
+        (Path(ckpt_dir) / "latest").write_text(d.name)
     return str(d)
 
 
@@ -53,21 +95,107 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
     return int(m.group(1)) if m else None
 
 
-def restore_checkpoint(ckpt_dir: str, like, step: Optional[int] = None):
-    """Load into the structure/shardings of `like` (an existing state)."""
-    if step is None:
-        step = latest_step(ckpt_dir)
-        if step is None:
-            return None
-    d = Path(ckpt_dir) / f"step-{step:08d}"
-    data = np.load(d / "state.npz")
+class _ShardStore:
+    """Lazy view over every process's shard files for one step dir."""
 
-    leaves, _ = jax.tree_util.tree_flatten_with_path(like)
+    def __init__(self, d: Path) -> None:
+        self.files = [np.load(f) for f in sorted(glob.glob(str(d / "shards-p*.npz")))]
+        if not self.files:
+            raise FileNotFoundError(f"no shard files under {d}")
+        self.index: Dict[str, tuple] = {}
+        for i, f in enumerate(self.files):
+            for k in f.files:
+                self.index[k] = (i, k)
+
+    def full(self, key: str, shape, dtype) -> np.ndarray:
+        """Assemble the global array for one leaf from whatever shards the
+        files hold (whole-array entry, or offset-keyed pieces). Raises
+        IncompleteCheckpoint unless the pieces cover every element — a
+        torn save must never restore as silently-zeroed parameters."""
+        if key in self.index:
+            i, k = self.index[key]
+            return np.asarray(self.files[i][k], dtype=dtype)
+        out = np.zeros(shape, dtype=dtype)
+        covered = 0
+        prefix = key + "@"
+        for skey, (i, k) in self.index.items():
+            if not skey.startswith(prefix):
+                continue
+            offs = [int(x) for x in skey[len(prefix):].split(",")]
+            piece = self.files[i][k]
+            sl = tuple(
+                slice(o, o + n) for o, n in zip(offs, piece.shape)
+            )
+            out[sl] = piece
+            covered += piece.size
+        if covered != int(np.prod(shape)):
+            # distinct shards never overlap (replica_id==0 dedupe), so
+            # element count is an exact coverage check
+            raise IncompleteCheckpoint(
+                f"leaf {key!r}: shards cover {covered} of {int(np.prod(shape))} elements"
+            )
+        return out
+
+
+class IncompleteCheckpoint(Exception):
+    """A step dir is missing shard data (e.g. preemption mid-save)."""
+
+
+def _available_steps(ckpt_dir: str):
+    steps = []
+    for p in Path(ckpt_dir).glob("step-*"):
+        m = re.match(r"step-(\d+)$", p.name)
+        if m:
+            steps.append(int(m.group(1)))
+    return sorted(steps, reverse=True)
+
+
+def restore_checkpoint(ckpt_dir: str, like, step: Optional[int] = None):
+    """Load into the structure/shardings of `like` (an existing state).
+    Returns None when the dir holds no complete checkpoint. With no
+    explicit ``step``, tries the newest step dir first and falls back to
+    older ones — a save torn by preemption (the exact crash this feature
+    recovers from) must not block resume from the previous good save."""
+    candidates = [step] if step is not None else _available_steps(ckpt_dir)
+    last_err: Optional[Exception] = None
+    for cand in candidates:
+        try:
+            return _restore_step(ckpt_dir, like, cand)
+        except (IncompleteCheckpoint, FileNotFoundError, KeyError) as e:
+            if step is not None:
+                raise
+            last_err = e
+    if last_err is not None:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "no complete checkpoint under %s (last error: %s)", ckpt_dir, last_err
+        )
+    return None
+
+
+def _restore_step(ckpt_dir: str, like, step: int):
+    d = Path(ckpt_dir) / f"step-{step:08d}"
+    meta_file = d / "meta.json"
+    if not meta_file.exists():
+        raise IncompleteCheckpoint(f"{d} has no manifest")
+    meta = json.loads(meta_file.read_text())
+    store = _ShardStore(d)
+    nprocs = int(meta.get("nprocs", 1))
+    if len(store.files) < nprocs:
+        raise IncompleteCheckpoint(
+            f"{d}: {len(store.files)} of {nprocs} process shard files present"
+        )
+
     out = []
-    for path, leaf in leaves:
-        key = jax.tree_util.keystr(path)
-        arr = data[key]
-        if hasattr(leaf, "sharding") and hasattr(leaf, "dtype"):
-            arr = jax.device_put(arr.astype(leaf.dtype), leaf.sharding)
+    for key, leaf in _leaf_items(like):
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
+            full = store.full(key, leaf.shape, leaf.dtype)
+            arr = jax.make_array_from_callback(
+                leaf.shape, leaf.sharding, lambda idx, f=full: f[idx]
+            )
+        else:
+            a = np.asarray(leaf)
+            arr = store.full(key, a.shape, a.dtype)
         out.append(arr)
     return jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(like), out)

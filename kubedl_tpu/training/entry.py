@@ -5,9 +5,12 @@ Usable two ways (matching the two container runtimes):
 - in-process: entrypoint string "kubedl_tpu.training.entry:train_main"
 
 Reads the operator-injected bootstrap env (KUBEDL_*), initializes
-`jax.distributed`, builds the mesh, trains, and writes the final checkpoint
-to KUBEDL_MODEL_PATH (feeding the ModelVersion lineage pipeline). The train
-config rides the env as JSON under KUBEDL_TRAIN_CONFIG.
+`jax.distributed`, builds the mesh, **restores from the latest checkpoint**
+(slice-granular restart-from-checkpoint, SURVEY.md §7 hard-part b: a gang
+restart re-enters here and loses at most one save interval), trains with
+periodic saves, and writes the final state to KUBEDL_MODEL_PATH (feeding
+the ModelVersion lineage pipeline). The train config rides the env as JSON
+under KUBEDL_TRAIN_CONFIG.
 """
 
 from __future__ import annotations
@@ -22,6 +25,14 @@ from typing import Dict, Optional
 LAST_SUMMARY: Optional[dict] = None
 
 
+def _model_preset(name: str):
+    from kubedl_tpu.models import llama, moe
+
+    if "moe" in name:
+        return moe.preset(name)
+    return llama.preset(name)
+
+
 def train_main(env: Optional[Dict[str, str]] = None) -> int:
     global LAST_SUMMARY
     if env:
@@ -33,9 +44,8 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
     import jax
 
     from kubedl_tpu.api import constants
-    from kubedl_tpu.models import llama
     from kubedl_tpu.parallel.mesh import initialize_from_env, mesh_from_env
-    from kubedl_tpu.training.checkpoint import save_checkpoint
+    from kubedl_tpu.training.checkpoint import restore_checkpoint
     from kubedl_tpu.training.data import SyntheticTokens
     from kubedl_tpu.training.trainer import TrainConfig, Trainer
 
@@ -43,7 +53,7 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
 
     raw = os.environ.get("KUBEDL_TRAIN_CONFIG", "{}")
     opts = json.loads(raw)
-    model = llama.preset(opts.get("model", "tiny"))
+    model = _model_preset(opts.get("model", "tiny"))
     cfg = TrainConfig(
         model=model,
         global_batch=int(opts.get("global_batch", 8)),
@@ -51,27 +61,58 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
         steps=int(opts.get("steps", 5)),
         learning_rate=float(opts.get("learning_rate", 3e-4)),
         grad_accum=int(opts.get("grad_accum", 1)),
+        attn_impl=opts.get("attn_impl", "auto"),
+        context_parallel_impl=opts.get("context_parallel_impl", "ring"),
+        microbatches=int(opts.get("microbatches", 0)),
+        ckpt_every=int(opts.get("ckpt_every", 0)),
     )
     mesh = mesh_from_env()
     trainer = Trainer(cfg, mesh)
+
+    out = os.environ.get(constants.ENV_MODEL_PATH, "")
+    ckpt_dir = os.environ.get(constants.ENV_CKPT_DIR, "")
+    if not ckpt_dir and out and cfg.ckpt_every:
+        ckpt_dir = os.path.join(out, "checkpoints")
+
+    # restore-from-latest: a gang restart resumes instead of retraining.
+    # The fresh init doubles as the restore template (shardings/structure)
+    # and is reused as-is on a cold start — init runs exactly once.
+    state = None
+    if ckpt_dir:
+        template = trainer.init_state()
+        state = restore_checkpoint(ckpt_dir, template)
+        if state is not None:
+            step = int(jax.device_get(state["step"]))
+            print(json.dumps({"resumed_from_step": step}), flush=True)
+        else:
+            state = template
+
     data = SyntheticTokens(cfg.global_batch, cfg.seq_len, model.vocab_size)
     first_step_wall = {}
     cancel = (env or {}).get("_KUBEDL_CANCEL")  # ThreadRuntime cancellation
 
     def on_step(i, metrics):
-        if i == 0:
+        if "t" not in first_step_wall:
             first_step_wall["t"] = time.time()
         if cancel is not None and getattr(cancel, "is_set", lambda: False)():
             raise SystemExit(137)  # retryable: gang restart requested
 
-    state, summary = trainer.fit(iter(data), on_step=on_step)
+    state, summary = trainer.fit(
+        iter(data),
+        state=state,
+        on_step=on_step,
+        ckpt_dir=ckpt_dir or None,
+        ckpt_every=cfg.ckpt_every,
+    )
     summary["first_step_wall_time"] = first_step_wall.get("t", time.time())
     LAST_SUMMARY = summary
     print(json.dumps({"worker_summary": summary}), flush=True)
 
-    out = os.environ.get(constants.ENV_MODEL_PATH, "")
-    proc_id = int(os.environ.get(constants.ENV_PROCESS_ID, "0"))
-    if out and proc_id == 0:
+    if out and os.path.abspath(ckpt_dir or "") != os.path.abspath(out):
+        # publish the final state at the model-path root — serving and the
+        # ModelVersion build read `latest` from there, not from checkpoints/
+        from kubedl_tpu.training.checkpoint import save_checkpoint
+
         save_checkpoint(out, state, int(jax.device_get(state["step"])))
     return 0
 

@@ -8,7 +8,22 @@ TPU-first mechanics:
 - Sharding is enforced with `lax.with_sharding_constraint` *inside* the
   step (on params and activations' entry points) so compiler propagation
   handles optimizer state without hand-listing its tree structure.
-- fp32 master-quality loss; optional gradient accumulation via lax.scan.
+- Attention hot path: the pallas flash kernel on TPU (ring/Ulysses context
+  attention when the mesh has an "sp" axis; dense oracle on CPU) — selected
+  once at build time and recorded in ``Trainer.attn_impl``.
+- Model families are pluggable (Llama dense + switch-MoE) via a small
+  adapter so expert parallelism trains through the same optimizer loop.
+- Pipeline parallelism: a "pipe" mesh axis splits the scanned layer stack
+  into GPipe stages (`kubedl_tpu.parallel.pipeline`) with real
+  microbatching.
+
+Timing discipline (the round-1 bench lied — VERDICT.md weak #1): on the
+remote-tunnel TPU platform `block_until_ready` can return without blocking,
+and per-step syncs cost a ~100ms round trip. `fit` therefore dispatches
+steps asynchronously and stops the clock on a `device_get` of the final
+step's scalar loss — a true barrier (the loss depends on the whole donation
+chain) paid once. `sanity_check` enforces physical plausibility (MFU <= 1,
+step time >= HBM param-read floor, loss decreased).
 """
 
 from __future__ import annotations
@@ -17,7 +32,7 @@ import dataclasses
 import time
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -31,8 +46,67 @@ from kubedl_tpu.parallel import mesh as meshlib
 
 
 @dataclass(frozen=True)
+class ModelFamily:
+    """Adapter the trainer uses to stay model-agnostic (dense Llama, MoE,
+    ...): pure init/loss functions + sharding rules + FLOPs accounting."""
+
+    name: str
+    init: Callable[[jax.Array], Any]
+    loss: Callable[..., jax.Array]  # (params, batch, attn_fn=) -> scalar
+    pspecs: Any  # pytree of PartitionSpec
+    num_params: int
+    flops_per_token: float
+    vocab_size: int
+    #: leading (stacked-layer) axis key for pipeline splitting; None = no
+    #: pipeline support for this family
+    layers_key: Optional[str] = "layers"
+
+
+def llama_family(cfg: llama.LlamaConfig) -> ModelFamily:
+    return ModelFamily(
+        name="llama",
+        init=lambda key: llama.llama_init(key, cfg),
+        loss=lambda params, batch, attn_fn=None: llama.llama_loss(
+            params, batch, cfg, attn_fn
+        ),
+        pspecs=llama.param_pspecs(cfg),
+        num_params=cfg.num_params(),
+        flops_per_token=cfg.flops_per_token(),
+        vocab_size=cfg.vocab_size,
+    )
+
+
+def moe_family(cfg) -> ModelFamily:
+    from kubedl_tpu.models import moe
+
+    return ModelFamily(
+        name="moe",
+        init=lambda key: moe.moe_init(key, cfg),
+        loss=lambda params, batch, attn_fn=None: moe.moe_loss(
+            params, batch, cfg, attn_fn
+        ),
+        pspecs=moe.param_pspecs(cfg),
+        num_params=cfg.num_params(),
+        flops_per_token=cfg.flops_per_token(),
+        vocab_size=cfg.vocab_size,
+    )
+
+
+def family_for(model_cfg) -> ModelFamily:
+    from kubedl_tpu.models import moe
+
+    if isinstance(model_cfg, llama.LlamaConfig):
+        return llama_family(model_cfg)
+    if isinstance(model_cfg, moe.MoEConfig):
+        return moe_family(model_cfg)
+    if isinstance(model_cfg, ModelFamily):
+        return model_cfg
+    raise TypeError(f"unknown model config type {type(model_cfg)!r}")
+
+
+@dataclass(frozen=True)
 class TrainConfig:
-    model: llama.LlamaConfig = field(default_factory=lambda: llama.TINY)
+    model: Any = field(default_factory=lambda: llama.TINY)
     global_batch: int = 8
     seq_len: int = 128
     steps: int = 50
@@ -42,9 +116,18 @@ class TrainConfig:
     grad_clip: float = 1.0
     #: microbatches per step (gradient accumulation); 1 = off
     grad_accum: int = 1
+    #: attention implementation: "auto" (flash on TPU / context attention on
+    #: an sp mesh / dense otherwise), "dense", or "flash" (forced; interpret
+    #: mode off-TPU — used by tests)
+    attn_impl: str = "auto"
     #: sequence/context parallelism implementation used when the mesh has an
     #: "sp" axis: "ring" (blockwise ppermute ring) or "ulysses" (all-to-all)
     context_parallel_impl: str = "ring"
+    #: GPipe microbatches when the mesh has a "pipe" axis; 0 = auto (4x the
+    #: pipe axis size, the classic bubble-amortizing choice)
+    microbatches: int = 0
+    #: save a checkpoint every N steps (0 = only via explicit fit args)
+    ckpt_every: int = 0
     seed: int = 0
 
 
@@ -62,13 +145,22 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     )
 
 
+def _fetch_scalar(x) -> float:
+    """True device barrier: transfer a scalar to host. On the axon tunnel
+    platform `block_until_ready` can return early; `device_get` cannot."""
+    return float(jax.device_get(x))
+
+
 class Trainer:
     def __init__(self, cfg: TrainConfig, mesh: Optional[Mesh] = None) -> None:
         self.cfg = cfg
         self.mesh = mesh or meshlib.build_mesh(None)
+        self.family = family_for(cfg.model)
         self.tx = make_optimizer(cfg)
-        mcfg = cfg.model
-        pspecs = llama.param_pspecs(mcfg)
+        self.pipe_size = meshlib.axis_size(self.mesh, "pipe")
+        pspecs = self.family.pspecs
+        if self.pipe_size > 1:
+            pspecs = self._pipe_pspecs(pspecs)
         # drop mesh axes the mesh doesn't have (e.g. CPU tests w/o "tensor")
         self.pspecs = jax.tree_util.tree_map(
             lambda s: self._prune_spec(s), pspecs,
@@ -80,6 +172,7 @@ class Trainer:
             is_leaf=lambda x: isinstance(x, P),
         )
         self.batch_sharding = NamedSharding(self.mesh, meshlib.batch_pspec(self.mesh))
+        self.attn_impl = "dense"
         self._build_fns()
 
     def _prune_spec(self, spec: P) -> P:
@@ -95,16 +188,69 @@ class Trainer:
 
         return P(*(keep(a) for a in spec))
 
+    def _pipe_pspecs(self, pspecs):
+        """Pipeline mode: stacked-layer leaves shard their leading (layer)
+        axis over "pipe"; in-stage weight sharding over fsdp/tensor is not
+        composed with the shard_map pipeline (the stage body is local), so
+        those axes are stripped from layer leaves."""
+        lk = self.family.layers_key
+        if lk is None:
+            raise ValueError(
+                f"model family {self.family.name!r} does not support a pipe axis"
+            )
+        for ax in ("tensor", "sp", "expert"):
+            if meshlib.axis_size(self.mesh, ax) > 1:
+                raise ValueError(
+                    f"pipe axis cannot be combined with a >1 {ax!r} axis "
+                    "(the GPipe shard_map stage body is device-local); use "
+                    "pipe x data/fsdp meshes"
+                )
+        out = dict(pspecs)
+        out[lk] = jax.tree_util.tree_map(
+            lambda s: P("pipe", *([None] * (len(s) - 1))),
+            pspecs[lk],
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        return out
+
     # ------------------------------------------------------------------
 
-    def _build_fns(self) -> None:
-        cfg, mcfg = self.cfg, self.cfg.model
-        # sequence-parallel attention when the mesh has an "sp" axis
+    def _select_attn(self):
+        """Pick the attention hot path once, at build time."""
+        cfg = self.cfg
         from kubedl_tpu.parallel.ring import make_context_attention
 
-        attn_fn = make_context_attention(
-            self.mesh, impl=cfg.context_parallel_impl
-        )
+        ctx = make_context_attention(self.mesh, impl=cfg.context_parallel_impl)
+        if ctx is not None:
+            self.attn_impl = f"context-{cfg.context_parallel_impl}"
+            return ctx
+        if cfg.attn_impl == "dense":
+            self.attn_impl = "dense"
+            return None
+        from kubedl_tpu.ops import flash_attention_module as fa
+
+        on_tpu = jax.default_backend() == "tpu"
+        if cfg.attn_impl == "flash" or (cfg.attn_impl == "auto" and on_tpu):
+            if not fa.supports(cfg.seq_len):
+                if cfg.attn_impl == "flash":
+                    raise ValueError(
+                        f"flash attention cannot tile seq_len={cfg.seq_len}"
+                    )
+                self.attn_impl = "dense"
+                return None
+            self.attn_impl = "flash"
+            if self.pipe_size > 1:
+                # inside the pipeline's shard_map the stage body is local:
+                # call the kernel directly, not mesh-wrapped
+                return partial(fa.flash_attention, interpret=not on_tpu)
+            return fa.make_flash_attention(self.mesh, interpret=not on_tpu)
+        self.attn_impl = "dense"
+        return None
+
+    def _build_fns(self) -> None:
+        cfg = self.cfg
+        family = self.family
+        attn_fn = self._select_attn()
 
         def constrain_params(params):
             return jax.tree_util.tree_map(
@@ -114,14 +260,17 @@ class Trainer:
             )
 
         def init_fn(key):
-            params = llama.llama_init(key, mcfg)
+            params = family.init(key)
             params = constrain_params(params)
             opt_state = self.tx.init(params)
             return {"params": params, "opt_state": opt_state,
                     "step": jnp.zeros((), jnp.int32)}
 
-        def loss_fn(params, batch):
-            return llama.llama_loss(params, batch, mcfg, attn_fn)
+        if self.pipe_size > 1:
+            loss_fn = self._make_pipeline_loss(attn_fn)
+        else:
+            def loss_fn(params, batch):
+                return family.loss(params, batch, attn_fn=attn_fn)
 
         def train_step(state, batch):
             params = constrain_params(state["params"])
@@ -166,6 +315,69 @@ class Trainer:
                 in_shardings=(None, self.batch_sharding),
             )
 
+    def _make_pipeline_loss(self, attn_fn):
+        """GPipe loss: embed (replicated over pipe), microbatched layer
+        stack through the stage ring, head + NLL on the ring's output."""
+        from kubedl_tpu.models import llama as llama_mod
+        from kubedl_tpu.parallel.pipeline import make_pipeline
+
+        cfg = self.cfg
+        mcfg = cfg.model
+        if not isinstance(mcfg, llama_mod.LlamaConfig):
+            raise ValueError("pipeline mode currently drives the Llama family")
+        M = cfg.microbatches or 4 * self.pipe_size
+        if cfg.global_batch % M:
+            raise ValueError(
+                f"global_batch={cfg.global_batch} must divide into "
+                f"microbatches={M}"
+            )
+        data_axes = tuple(
+            a for a in meshlib.DATA_AXES
+            if a in self.mesh.axis_names and self.mesh.shape[a] > 1
+        )
+
+        def stage_fn_factory(cos, sin):
+            def stage_fn(layer_params, x):
+                # this stage's share of the scanned layer stack
+                def body(carry, lp):
+                    return (
+                        llama_mod._block(carry, lp, mcfg, cos, sin, attn_fn),
+                        None,
+                    )
+
+                if mcfg.remat:
+                    body = jax.checkpoint(
+                        body,
+                        policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                    )
+                x, _ = lax.scan(body, x, layer_params)
+                return x
+
+            return stage_fn
+
+        def loss_fn(params, batch):
+            B, S = batch.shape
+            mb = B // M
+            cos, sin = llama_mod.rope_freqs(mcfg, S)
+            x = params["embed"][batch].astype(mcfg.dtype)  # [B, S, D]
+            x_mb = x.reshape(M, mb, S, x.shape[-1])
+            run = make_pipeline(
+                self.mesh,
+                stage_fn_factory(cos, sin),
+                pipe_axis="pipe",
+                data_axes=data_axes,
+            )
+            h = run(params["layers"], x_mb)  # [M, mb, S, D]
+            h = h.reshape(B, S, -1)
+            h = llama_mod.rmsnorm(h, params["final_norm"], mcfg.norm_eps)
+            head = (
+                params["embed"].T if mcfg.tie_embeddings else params["lm_head"]
+            )
+            logits = (h @ head).astype(jnp.float32)
+            return llama_mod.next_token_nll(logits, batch)
+
+        return loss_fn
+
     # ------------------------------------------------------------------
 
     def init_state(self) -> Dict[str, Any]:
@@ -181,40 +393,84 @@ class Trainer:
         state: Optional[Dict[str, Any]] = None,
         steps: Optional[int] = None,
         on_step: Optional[Callable[[int, Dict[str, Any]], None]] = None,
+        ckpt_dir: Optional[str] = None,
+        ckpt_every: Optional[int] = None,
     ) -> Tuple[Dict[str, Any], Dict[str, float]]:
-        """Run the loop; returns (state, summary) where summary carries the
-        north-star metrics (first-step latency, tokens/sec/chip)."""
+        """Run the loop; returns (state, summary) with the north-star
+        metrics (first-step latency, tokens/sec/chip, MFU) measured under
+        the async-dispatch / scalar-fetch-barrier discipline.
+
+        ``steps`` is the TOTAL step budget: a restored ``state`` whose step
+        counter is already k trains only steps-k more (resume semantics).
+        Passing ``ckpt_dir`` saves every ``ckpt_every`` steps (defaults to
+        cfg.ckpt_every) plus once at the end.
+        """
         steps = steps or self.cfg.steps
         state = state or self.init_state()
-        t0 = time.perf_counter()
-        first_step_s = None
+        ckpt_every = self.cfg.ckpt_every if ckpt_every is None else ckpt_every
+        start = int(jax.device_get(state["step"]))
         tokens_per_step = self.cfg.global_batch * self.cfg.seq_len
-        losses = []
+        losses: List[Any] = []
+        t0 = time.perf_counter()
+        first_step_s = 0.0
+        first_loss = None
+        t_run = t0
+        ckpt_overhead = 0.0
         with self.mesh:
-            for i in range(steps):
+            for i in range(start, steps):
                 batch = self.shard_batch(next(data))
                 state, metrics = self.train_step(state, batch)
-                if i == 0:
-                    jax.block_until_ready(metrics["loss"])
+                losses.append(metrics["loss"])
+                if i == start:
+                    # true barrier: scalar fetch (block_until_ready lies on
+                    # the tunnel platform — see module docstring)
+                    first_loss = _fetch_scalar(metrics["loss"])
                     first_step_s = time.perf_counter() - t0
                     t_run = time.perf_counter()
                 if on_step is not None:
                     on_step(i, metrics)
-                losses.append(metrics["loss"])
-            jax.block_until_ready(state["params"])
-        total = time.perf_counter() - t_run if steps > 1 else 0.0
+                if (
+                    ckpt_dir
+                    and ckpt_every
+                    and (i + 1) % ckpt_every == 0
+                    and (i + 1) < steps
+                ):
+                    t_ck = time.perf_counter()
+                    from kubedl_tpu.training.checkpoint import save_checkpoint
+
+                    save_checkpoint(ckpt_dir, state, i + 1)
+                    ckpt_overhead += time.perf_counter() - t_ck
+            # stop the clock on a true barrier: the last loss transitively
+            # depends on every dispatched step via the donated state chain
+            if losses:
+                last_loss = _fetch_scalar(losses[-1])
+            else:  # resume found nothing left to do
+                last_loss = first_loss = float("nan")
+        total = time.perf_counter() - t_run - ckpt_overhead
         n_chips = jax.device_count()
-        steady_steps = steps - 1
-        tps = tokens_per_step * steady_steps / total if total > 0 else 0.0
+        steady_steps = len(losses) - 1
+        tps = tokens_per_step * steady_steps / total if total > 0 and steady_steps > 0 else 0.0
         summary = {
-            "first_step_seconds": first_step_s or 0.0,
-            "steps": steps,
-            "final_loss": float(jax.device_get(losses[-1])),
+            "first_step_seconds": first_step_s,
+            "steps": len(losses),
+            "total_steps": steps,
+            "start_step": start,
+            "first_loss": first_loss,
+            "final_loss": last_loss,
             "tokens_per_sec": tps,
             "tokens_per_sec_per_chip": tps / n_chips,
-            "step_time_ms": (total / steady_steps * 1e3) if steady_steps else 0.0,
+            "step_time_ms": (total / steady_steps * 1e3) if steady_steps > 0 else 0.0,
             "mfu": self._mfu(tps, n_chips),
+            "hbm_floor_ms": self.hbm_floor_ms(),
+            "attn_impl": self.attn_impl,
+            "model_family": self.family.name,
+            "n_params": self.family.num_params,
         }
+        summary["sanity_violations"] = self.sanity_check(summary)
+        if ckpt_dir:
+            from kubedl_tpu.training.checkpoint import save_checkpoint
+
+            save_checkpoint(ckpt_dir, state, steps)
         return state, summary
 
     def _mfu(self, tokens_per_sec: float, n_chips: int) -> float:
@@ -222,8 +478,41 @@ class Trainer:
         peak = _peak_flops_per_chip()
         if peak <= 0 or tokens_per_sec <= 0:
             return 0.0
-        model_flops = self.cfg.model.flops_per_token() * tokens_per_sec
+        model_flops = self.family.flops_per_token * tokens_per_sec
         return model_flops / (peak * n_chips)
+
+    def hbm_floor_ms(self) -> float:
+        """Physical lower bound on step time: one read + one write of the
+        bf16 params through HBM (fwd reads weights, optimizer rewrites
+        them). Any measured step below this is a broken clock, not speed."""
+        from kubedl_tpu.api.topology import hbm_bandwidth_for_device_kind
+
+        bw = hbm_bandwidth_for_device_kind(
+            getattr(jax.devices()[0], "device_kind", "")
+        )
+        if bw <= 0:
+            return 0.0
+        param_bytes = self.family.num_params * 2  # bf16
+        return 2.0 * param_bytes / (bw * jax.device_count()) * 1e3
+
+    def sanity_check(self, summary: Dict[str, Any]) -> List[str]:
+        """Hard plausibility gates (VERDICT.md round-1: the bench printed
+        MFU 538% without question). Returns violations; empty = sane."""
+        v: List[str] = []
+        mfu = summary.get("mfu", 0.0)
+        if mfu > 1.0:
+            v.append(f"mfu {mfu:.3f} > 1.0 is physically impossible")
+        floor = self.hbm_floor_ms()
+        st = summary.get("step_time_ms", 0.0)
+        if floor > 0 and 0 < st < floor:
+            v.append(
+                f"step_time {st:.3f}ms below HBM param-read floor {floor:.3f}ms"
+            )
+        steps = summary.get("steps", 0)
+        fl, ll = summary.get("first_loss"), summary.get("final_loss")
+        if steps >= 8 and fl is not None and ll is not None and not ll < fl:
+            v.append(f"loss did not decrease over {steps} steps ({fl} -> {ll})")
+        return v
 
 
 def _peak_flops_per_chip() -> float:

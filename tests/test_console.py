@@ -343,3 +343,51 @@ def test_tensorboard_routes(console):
     status, resp = call(srv, "GET", "/api/v1/tensorboard/status/default/c-tb")
     assert resp["data"]["configured"] is False
     call(srv, "POST", "/api/v1/job/stop/default/c-tb?kind=TPUJob")
+
+
+def test_submit_strips_caller_status(console):
+    """YAML copied from the console's own /job/yaml view embeds status; a
+    re-submit must create a FRESH job, not one born terminal (ADVICE r1:
+    reference strips this via the CRD status subresource on create)."""
+    op, srv = console
+    job = make_tpujob("c-strip", workers=1, command=["python", "-c", "pass"])
+    body = codec.encode(job)
+    body["status"] = {
+        "conditions": [
+            {"type": "Succeeded", "status": True, "reason": "JobSucceeded",
+             "message": "forged", "last_transition_time": 0.0}
+        ],
+    }
+    body.setdefault("metadata", {})["uid"] = "uid-forged"
+    status, resp = call(srv, "POST", "/api/v1/job/submit", body)
+    assert status == 200, resp
+    stored = op.store.get("TPUJob", "c-strip", "default")
+    assert stored.metadata.uid != "uid-forged"
+    # the job actually runs (a forged-terminal job would never be reconciled)
+    op.wait_for_phase("TPUJob", "c-strip", [JobConditionType.SUCCEEDED], timeout=30)
+
+
+def test_list_and_statistics_reject_non_workload_kind(console):
+    """ADVICE r1: list/statistics/running-jobs must 400 on kinds that are
+    not enabled workloads instead of 500ing on non-job objects."""
+    _, srv = console
+    for path in (
+        "/api/v1/job/list?kind=Pod",
+        "/api/v1/job/statistics?kind=ConfigMap",
+        "/api/v1/job/running-jobs?kind=Service",
+    ):
+        status, resp = call(srv, "GET", path)
+        assert status == 400, (path, resp)
+
+
+def test_statistics_ignore_pagination(console):
+    """ADVICE r1: aggregate counts must cover the full filtered set even
+    when the client passes page_size/page_num."""
+    op, srv = console
+    for i in range(3):
+        submit_and_wait(op, srv, f"c-stat{i}", workers=1)
+    status, resp = call(
+        srv, "GET", "/api/v1/job/statistics?page_size=1&page_num=1"
+    )
+    assert status == 200
+    assert resp["data"]["totalJobCount"] == 3
