@@ -276,6 +276,10 @@ class ModelVersionSpecRef:
     model_name: str = ""
     image_repo: str = ""
     storage_root: str = ""  # host path / NFS root holding the artifact
+    #: storage-union member (reference: modelversion_types.go:72-115):
+    #: "shared" (NFS/EFS-style, default — multi-host jobs need it),
+    #: "local" (node-pinned), or a registered plugin name
+    storage_provider: str = "shared"
 
 
 def job_spec_defaults(spec: JobSpec) -> JobSpec:

@@ -246,9 +246,10 @@ class ConsoleServer:
         return {"jobInfos": dicts, "total": total}
 
     def _get_job_row(self, req: Request):
-        row = self.reader.get_job(
-            req.params["ns"], req.params["name"], req.query.get("kind", "")
-        )
+        kind = req.query.get("kind", "")
+        if kind and kind not in self.operator.engines:
+            raise ApiError(400, f"kind {kind!r} is not an enabled workload kind")
+        row = self.reader.get_job(req.params["ns"], req.params["name"], kind)
         if row is None:
             raise ApiError(404, "job not found")
         return row

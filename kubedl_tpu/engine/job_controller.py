@@ -167,6 +167,34 @@ class JobEngine:
         # --- gang admission (atomic slice acquisition) --------------------
         if self.gang is not None and self.features.enabled(GANG_SCHEDULING):
             gang = self.gang.create_gang(job)
+            # Elastic slice resize (reference analogue: Mars/ElasticDL
+            # worker auto-scaling, mars.go:100-107 — TPU-native semantics
+            # differ: an ICI domain is static, so grow/shrink is a
+            # coordinated whole-gang restart-from-checkpoint at the new
+            # shape; progress is kept by restore-from-latest in the
+            # training entry).
+            demand = self.gang.slice_demand(job)
+            if (
+                demand is not None
+                and gang.phase == "Running"
+                and (gang.slice_type, gang.num_slices) != demand
+            ):
+                job.status.restart_count += 1
+                status.set_condition(
+                    JobConditionType.RESTARTING,
+                    "SliceResize",
+                    f"resizing {gang.num_slices}x{gang.slice_type or 'cpu'} -> "
+                    f"{demand[1]}x{demand[0] or 'cpu'}; gang restarts from checkpoint",
+                )
+                self.recorder.event(
+                    job, "Normal", "SliceResize",
+                    f"slice demand changed {gang.num_slices} -> {demand[1]}",
+                )
+                self._delete_pods(job, ctx.pods, CleanPodPolicy.ALL)
+                ctx.pods = []
+                self.gang.delete_gang(job)
+                self._update_status(job)
+                return 0.1  # next pass admits a fresh gang at the new shape
             if not self.gang.try_admit(gang):
                 if status.set_condition(
                     JobConditionType.QUEUED,
@@ -177,7 +205,10 @@ class JobEngine:
                         job, "Normal", "Queued", "insufficient free slices; queued"
                     )
                     self._update_status(job)
-                return 1.0  # poll admission; slice frees trigger no watch yet
+                # slice frees nudge queued jobs via the PodGroup-deletion
+                # mapper (operator._engine_mapper); this slow poll is only
+                # a safety net against missed events
+                return 5.0
             # Only slice-pinned replica groups get slice placements;
             # topology-less groups (e.g. evaluators) run in the CPU pool.
             for rtype, spec in job.spec.replica_specs.items():
@@ -398,21 +429,87 @@ class JobEngine:
 
     # ------------------------------------------------------------- helpers
 
-    def get_pods_for_job(self, job: JobObject) -> List[Pod]:
-        """Claim pods by base selector (reference: GetPodsForJob with ref
-        manager adoption, e.g. controllers/xgboost/pod.go:39-70)."""
-        selector = {
+    def _job_selector(self, job: JobObject) -> Dict[str, str]:
+        return {
             constants.LABEL_JOB_NAME: job.metadata.name,
             constants.LABEL_JOB_KIND: self.controller.KIND,
         }
-        return self.store.list("Pod", job.metadata.namespace, selector)  # type: ignore[return-value]
+
+    def _claim_objects(self, job: JobObject, kind: str) -> List:
+        """Ref-manager claim semantics (reference:
+        pkg/job_controller/service_ref_manager.go:1-158):
+
+        - objects matching the selector and owned by this job are kept;
+        - matching ORPHANS (no controller owner) are adopted — an owner ref
+          is added so GC and status accounting see them — unless the job is
+          terminal;
+        - objects owned by this job that no longer match the selector are
+          RELEASED (owner ref removed) so a relabeled pod isn't torn down
+          with the job;
+        - objects owned by someone else are never touched.
+        """
+        ns = job.metadata.namespace
+        selector = self._job_selector(job)
+        claimed: List = []
+        for obj in self.store.list(kind, ns, selector):
+            ref = obj.metadata.controller_ref()
+            if ref is not None and ref.uid == job.metadata.uid:
+                claimed.append(obj)
+            elif ref is None and not job.status.is_terminal():
+
+                def adopt(o) -> None:
+                    if o.metadata.controller_ref() is None:
+                        o.metadata.owner_refs.append(self._owner_ref(job))
+
+                try:
+                    updated = self.store.update_with_retry(
+                        kind, obj.metadata.name, ns, adopt
+                    )
+                except NotFound:
+                    continue
+                if (updated.metadata.controller_ref() or OwnerRef("", "", "")).uid == job.metadata.uid:
+                    claimed.append(updated)
+                    self.recorder.event(
+                        job, "Normal", "Adopted",
+                        f"adopted orphan {kind.lower()} {obj.metadata.name}",
+                    )
+            # else: owned by another controller — never touch
+        # release: owned but selector no longer matches (e.g. relabeled).
+        # Only ENGINE-MANAGED replicas are candidates — they always carry
+        # the job-kind label. Auxiliary owned objects (TensorBoard sidecars
+        # deliberately omit job-kind, observability/tensorboard.py:151-159)
+        # must keep their owner ref for GC.
+        for obj in self.store.list(kind, ns):
+            ref = obj.metadata.controller_ref()
+            if ref is None or ref.uid != job.metadata.uid:
+                continue
+            if constants.LABEL_JOB_KIND not in obj.metadata.labels:
+                continue  # aux object, not a claimed replica
+            if all(obj.metadata.labels.get(k) == v for k, v in selector.items()):
+                continue
+
+            def release(o) -> None:
+                o.metadata.owner_refs = [
+                    r for r in o.metadata.owner_refs if r.uid != job.metadata.uid
+                ]
+
+            try:
+                self.store.update_with_retry(kind, obj.metadata.name, ns, release)
+                self.recorder.event(
+                    job, "Normal", "Released",
+                    f"released {kind.lower()} {obj.metadata.name} (selector mismatch)",
+                )
+            except NotFound:
+                pass
+        return claimed
+
+    def get_pods_for_job(self, job: JobObject) -> List[Pod]:
+        """Claim pods with adopt/release (reference: GetPodsForJob with ref
+        manager adoption, e.g. controllers/xgboost/pod.go:39-70)."""
+        return self._claim_objects(job, "Pod")  # type: ignore[return-value]
 
     def get_services_for_job(self, job: JobObject) -> List[Service]:
-        selector = {
-            constants.LABEL_JOB_NAME: job.metadata.name,
-            constants.LABEL_JOB_KIND: self.controller.KIND,
-        }
-        return self.store.list("Service", job.metadata.namespace, selector)  # type: ignore[return-value]
+        return self._claim_objects(job, "Service")  # type: ignore[return-value]
 
     def _ordered_types(self, job: JobObject) -> List[ReplicaType]:
         order = [
@@ -438,6 +535,31 @@ class JobEngine:
 
     def _owner_ref(self, job: JobObject) -> OwnerRef:
         return OwnerRef(kind=job.kind, name=job.metadata.name, uid=job.metadata.uid)
+
+    def _alloc_host_port(self, node: str) -> int:
+        """Random host port avoiding ports already claimed by host-network
+        pods on the same node (the reference draws blind from [30001,65535)
+        and can collide, pod.go:470-486 — here allocation consults live
+        state; "" node = the unpinned pool)."""
+        in_use = set()
+        for p in self.store.list("Pod", None):
+            if not getattr(p.spec, "host_network", False):
+                continue
+            if (p.spec.node_name or "") != node:
+                continue
+            for c in p.spec.containers:
+                for port in c.ports:
+                    if port.host_port:
+                        in_use.add(port.host_port)
+        lo, hi = constants.HOST_PORT_RANGE
+        for _ in range(128):
+            hp = self._rng.randrange(lo, hi)
+            if hp not in in_use:
+                return hp
+        for hp in range(lo, hi):  # dense node: deterministic sweep
+            if hp not in in_use:
+                return hp
+        raise RuntimeError(f"no free host ports on node {node!r}")
 
     def _default_port(self, spec: ReplicaSpec) -> int:
         main = spec.template.spec.main_container()
@@ -470,7 +592,8 @@ class JobEngine:
             == constants.NETWORK_MODE_HOST
         ):
             pod.spec.host_network = True
-            hp = self._rng.randrange(*constants.HOST_PORT_RANGE)
+            node = ctx.placements.get(f"{rtype.value}-{index}", "").partition("@")[0]
+            hp = self._alloc_host_port(node)
             ctx.host_ports[f"{rtype.value}-{index}"] = hp
             main = pod.spec.main_container()
             if not main.ports:
@@ -482,14 +605,16 @@ class JobEngine:
         if git_cfg is not None:
             inject_code_sync(template, git_cfg)
 
-        # model output (reference: job.go:312-339)
+        # model output (reference: job.go:312-339) via the storage union
         if job.spec.model_version is not None:
+            from kubedl_tpu.lineage.storage import get_storage_provider
+
             main = pod.spec.main_container()
             root = job.spec.model_version.storage_root or constants.DEFAULT_MODEL_PATH
+            provider = get_storage_provider(job.spec.model_version.storage_provider)
+            provider.provision(root)
             main.set_env(constants.ENV_MODEL_PATH, root)
-            pod.spec.volumes.append(
-                Volume(name="kubedl-model", host_path=root, mount_path=root)
-            )
+            provider.add_model_volume(pod, root)
 
         # gang binding: placement computed at admission
         placement = ctx.placements.get(f"{rtype.value}-{index}", "")
@@ -610,6 +735,7 @@ class JobEngine:
             model_name=spec_ref.model_name or job.metadata.name,
             image_repo=spec_ref.image_repo,
             storage_root=spec_ref.storage_root or constants.DEFAULT_MODEL_PATH,
+            storage_provider=spec_ref.storage_provider,
             created_by=f"{self.controller.KIND}/{job.metadata.name}",
             node_name=self.controller.get_node_for_model_output(ctx.pods) or "",
         )

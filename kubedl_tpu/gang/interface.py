@@ -43,3 +43,10 @@ class GangScheduler:
     def delete_gang(self, job: JobObject) -> None:
         """Release slices + remove the PodGroup."""
         raise NotImplementedError
+
+    def slice_demand(self, job: JobObject):
+        """(slice_type, num_slices) the job's CURRENT spec demands — the
+        engine compares this against the reserved gang to detect elastic
+        resize (grow/shrink => coordinated restart-from-checkpoint).
+        None = this scheduler doesn't support resize detection."""
+        return None

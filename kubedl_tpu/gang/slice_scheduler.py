@@ -115,6 +115,9 @@ class SliceGangScheduler(GangScheduler):
 
     # -- helpers -----------------------------------------------------------
 
+    def slice_demand(self, job: JobObject) -> tuple:
+        return self._job_slice_demand(job)
+
     @staticmethod
     def _job_slice_demand(job: JobObject) -> tuple[str, int]:
         """(slice_type, num_slices) a job needs. Every replica group pinning

@@ -28,11 +28,15 @@ class ModelVersionController:
         store: ObjectStore,
         registry: ArtifactRegistry,
         recorder: Optional[EventRecorder] = None,
+        local_node: str = "",
     ) -> None:
         self.store = store
         self.registry = registry
         self.builder = LocalBundleBuilder(registry)
         self.recorder = recorder or EventRecorder(store)
+        #: node this builder runs on — node-local artifacts must match
+        #: (the kaniko-pod-on-the-artifact-node analogue)
+        self.local_node = local_node
 
     def setup(self, manager: ControllerManager) -> None:
         manager.register(
@@ -58,8 +62,13 @@ class ModelVersionController:
         tag = mv.image_tag()
         self._set_phase(mv, ModelVersionPhase.IMAGE_BUILDING, "")
         try:
-            manifest = self.builder.build(mv.storage_root, repo, tag)
-        except BuildError as e:
+            from kubedl_tpu.lineage.storage import StorageError, get_storage_provider
+
+            src = get_storage_provider(mv.storage_provider).artifact_dir(
+                mv, local_node=self.local_node
+            )
+            manifest = self.builder.build(src, repo, tag)
+        except (BuildError, StorageError) as e:
             self._set_phase(mv, ModelVersionPhase.FAILED, str(e))
             self.recorder.event(mv, "Warning", "BuildFailed", str(e))
             return None

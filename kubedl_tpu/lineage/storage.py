@@ -1,0 +1,101 @@
+"""Model-output storage providers: the union behind ModelVersion storage.
+
+Reference: `controllers/model/storage/storage_provider.go:1-35` dispatches
+NFS / LocalStorage / AWSEfs providers (modelversion_types.go:72-115), each
+knowing how to (a) provision the PV/PVC for a ModelVersion and (b) mount
+the output dir into training pods (AddModelVolumeToPodSpec,
+pkg/job_controller/job.go:312-339).
+
+TPU-native equivalents over the self-hosted substrate:
+
+- **shared** (NFS/EFS-style): one root every node sees — the only layout
+  that works for multi-host slice jobs, where every host writes its own
+  checkpoint shards (`kubedl_tpu.training.checkpoint`) into the same tree.
+  "nfs" and "efs" are registered aliases so specs written against the
+  reference's union port over directly.
+- **local**: node-pinned output (LocalStorage path+nodeName). The artifact
+  only exists on the node that trained; the MV records `node_name`
+  (pinned to the master/worker-0 node via GetNodeForModelOutput) and the
+  builder validates it runs co-located before reading the path.
+
+Providers are a registry (reference: GetStorageProvider) so a cloud bucket
+provider can be plugged in without touching the engine or the builder.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, Optional
+
+from kubedl_tpu.core.objects import Volume
+
+
+class StorageError(Exception):
+    pass
+
+
+class StorageProvider:
+    """One storage flavor: how jobs write and builders read an artifact."""
+
+    NAME = ""
+    #: whether the artifact is visible from any node (shared filesystem)
+    SHARED = True
+
+    def provision(self, root: str) -> str:
+        """Make the output root exist (the PV/PVC-provisioning analogue,
+        modelversion_controller.go:239-325). Returns the resolved root."""
+        Path(root).mkdir(parents=True, exist_ok=True)
+        return root
+
+    def add_model_volume(self, pod, root: str) -> None:
+        """Mount the output dir into a training pod
+        (AddModelVolumeToPodSpec, job.go:312-339)."""
+        pod.spec.volumes.append(
+            Volume(name="kubedl-model", host_path=root, mount_path=root)
+        )
+
+    def artifact_dir(self, mv, local_node: str = "") -> str:
+        """Where the builder reads this ModelVersion's artifact. Raises
+        StorageError when the artifact isn't reachable from here."""
+        return mv.storage_root
+
+
+class SharedDirProvider(StorageProvider):
+    NAME = "shared"
+    SHARED = True
+
+
+class NodeLocalProvider(StorageProvider):
+    NAME = "local"
+    SHARED = False
+
+    def artifact_dir(self, mv, local_node: str = "") -> str:
+        if mv.node_name and local_node and mv.node_name != local_node:
+            raise StorageError(
+                f"node-local artifact lives on {mv.node_name!r}, "
+                f"builder is on {local_node!r} — use a 'shared' storage "
+                "provider for multi-host jobs"
+            )
+        return mv.storage_root
+
+
+_PROVIDERS: Dict[str, StorageProvider] = {}
+
+
+def register_storage_provider(provider: StorageProvider, *aliases: str) -> None:
+    for name in (provider.NAME, *aliases):
+        _PROVIDERS[name] = provider
+
+
+def get_storage_provider(name: str) -> StorageProvider:
+    """Reference: GetStorageProvider (storage_provider.go:1-35)."""
+    try:
+        return _PROVIDERS[name or "shared"]
+    except KeyError:
+        raise StorageError(
+            f"unknown storage provider {name!r}; known: {sorted(_PROVIDERS)}"
+        ) from None
+
+
+register_storage_provider(SharedDirProvider(), "nfs", "efs")
+register_storage_provider(NodeLocalProvider())

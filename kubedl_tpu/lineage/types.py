@@ -38,7 +38,7 @@ class ModelVersion(BaseObject):
     #: reference's Storage union (NFS/LocalStorage/AWSEfs,
     #: modelversion_types.go:72-115) maps to a storage provider name + root.
     storage_root: str = ""
-    storage_provider: str = "local"
+    storage_provider: str = "shared"
     #: Node that produced the artifact (LocalStorage nodeName pinning,
     #: job.go:341-382).
     node_name: str = ""

@@ -301,6 +301,76 @@ def init_cache(cfg: LlamaConfig, batch: int, max_seq: int) -> Params:
     }
 
 
+def init_batched_cache(cfg: LlamaConfig, batch: int, max_seq: int) -> Params:
+    """Continuous-batching cache: PER-SLOT positions so every batch row can
+    be a different sequence at a different decode depth (the serving
+    engine's slot model). Shapes are static — one compile serves any mix
+    of in-flight requests."""
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+def decode_step_batched(
+    params: Params, cache: Params, tokens: jax.Array, cfg: LlamaConfig
+) -> Tuple[jax.Array, Params]:
+    """One decode step with per-row positions: tokens [B, 1] ->
+    (logits [B, V], updated cache). Each row attends to its own prefix
+    (per-row causal mask) and writes its KV at its own position via a
+    one-hot scatter — static shapes, so the step compiles ONCE and serves
+    any interleaving of requests (continuous batching)."""
+    B = tokens.shape[0]
+    hd = cfg.head_dim
+    pos = cache["pos"]  # [B]
+    max_s = cache["k"].shape[2]
+    x = params["embed"][tokens].astype(cfg.dtype)  # [B, 1, D]
+    cos, sin = rope_freqs(cfg, max_s)
+    cos_t = cos[pos][:, None, None, :]  # [B,1,1,hd/2] per-row rotation
+    sin_t = sin[pos][:, None, None, :]
+    # per-row validity: row b sees positions 0..pos[b]
+    valid = (jnp.arange(max_s)[None, :] <= pos[:, None])  # [B, T]
+    mask = valid[:, None, None, None, :]  # broadcast over (KV, G, S=1)
+    oh = (jnp.arange(max_s)[None, :] == pos[:, None]).astype(cfg.dtype)  # [B, T]
+    oh4 = oh[:, :, None, None]
+
+    def rot(t):  # apply_rope with per-row tables
+        t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate(
+            [t1 * cos_t - t2 * sin_t, t1 * sin_t + t2 * cos_t], axis=-1
+        ).astype(t.dtype)
+
+    new_k, new_v = [], []
+    for layer in range(cfg.n_layers):
+        lp = jax.tree_util.tree_map(lambda a: a[layer], params["layers"])
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(B, 1, cfg.n_heads, hd)
+        k = (h @ lp["wk"]).reshape(B, 1, cfg.n_kv_heads, hd)
+        v = (h @ lp["wv"]).reshape(B, 1, cfg.n_kv_heads, hd)
+        q = rot(q)
+        k = rot(k)
+        ck = cache["k"][layer] * (1.0 - oh4) + k * oh4  # scatter at pos[b]
+        cv = cache["v"][layer] * (1.0 - oh4) + v * oh4
+        new_k.append(ck)
+        new_v.append(cv)
+        attn = attention(q, ck, cv, causal=False, mask=mask)
+        x = x + attn.reshape(B, 1, cfg.n_heads * hd) @ lp["wo"]
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+        x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x[:, 0] @ head).astype(jnp.float32)
+    cache = {
+        "k": jnp.stack(new_k),
+        "v": jnp.stack(new_v),
+        "pos": jnp.minimum(pos + 1, max_s - 1),
+    }
+    return logits, cache
+
+
 def decode_step(
     params: Params, cache: Params, tokens: jax.Array, cfg: LlamaConfig
 ) -> Tuple[jax.Array, Params]:

@@ -150,4 +150,25 @@ def default_registry(db_path: str = ":memory:") -> StorageRegistry:
 
     reg.register_object_backend("sqlite", factory)
     reg.register_event_backend("sqlite", factory)
+
+    # JSONL log-store backend (second real plugin; reference analogue:
+    # the Aliyun SLS log-store event sink, sls_logstore.go). For a file
+    # db_path the log root sits alongside it; for :memory: a temp dir.
+    from kubedl_tpu.persist.jsonl_backend import JSONLBackend
+
+    shared_jsonl: Dict[str, JSONLBackend] = {}
+
+    def jsonl_factory() -> JSONLBackend:
+        if "b" not in shared_jsonl:
+            if db_path and db_path != ":memory:":
+                root = db_path + ".jsonl.d"
+            else:
+                import tempfile
+
+                root = tempfile.mkdtemp(prefix="kubedl-jsonl-")
+            shared_jsonl["b"] = JSONLBackend(root)
+        return shared_jsonl["b"]
+
+    reg.register_object_backend("jsonl", jsonl_factory)
+    reg.register_event_backend("jsonl", jsonl_factory)
     return reg

@@ -28,11 +28,35 @@ from typing import Dict, Optional
 log = logging.getLogger("kubedl_tpu.serving.server")
 
 
+class _Slot:
+    """One in-flight sequence occupying a batch row."""
+
+    def __init__(self, prompt, max_tokens: int, temperature: float) -> None:
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        self.temperature = temperature
+        self.fed = 0  # inputs consumed (prompt + generated)
+        self.out_ids: list = []
+        self.done = threading.Event()
+        self.result: Optional[Dict] = None
+        self.t0 = time.perf_counter()
+
+    def next_input(self) -> int:
+        seq = self.prompt + self.out_ids
+        return int(seq[self.fed])
+
+
 class LlamaEngine:
-    """Single-model greedy-decode engine around llama.decode_step."""
+    """Continuous-batching decode engine (the reference only *models*
+    batching in the API, inference_types.go:96-104 — here it is real):
+    up to ``max_batch`` sequences share one jitted
+    `llama.decode_step_batched` with per-row positions; a scheduler thread
+    admits waiting requests into free rows between steps, so concurrent
+    requests interleave instead of queueing behind a lock. Static shapes:
+    one compile serves every mix of in-flight requests."""
 
     def __init__(self, preset: str = "tiny", ckpt_dir: str = "",
-                 batch: int = 1, max_seq: int = 0) -> None:
+                 batch: int = 0, max_seq: int = 0, max_batch: int = 4) -> None:
         import jax
 
         from kubedl_tpu.models import llama
@@ -40,7 +64,7 @@ class LlamaEngine:
 
         self.cfg = llama.preset(preset)
         self.max_seq = max_seq or min(self.cfg.max_seq, 512)
-        self.batch = batch
+        self.max_batch = batch or max_batch
         params = llama.llama_init(jax.random.PRNGKey(0), self.cfg)
         if ckpt_dir and checkpoint.latest_step(ckpt_dir) is not None:
             state = checkpoint.restore_checkpoint(ckpt_dir, {"params": params})
@@ -51,51 +75,145 @@ class LlamaEngine:
         self._llama = llama
         self._jax = jax
         self._decode = jax.jit(
-            lambda p, c, t: llama.decode_step(p, c, t, self.cfg)
+            lambda p, c, t: llama.decode_step_batched(p, c, t, self.cfg)
         )
-        self._lock = threading.Lock()  # one sequence at a time per engine
-        # warm the compile cache so first request isn't a compile stall
+        self._cache = llama.init_batched_cache(
+            self.cfg, self.max_batch, self.max_seq
+        )
+        self._slots: list = [None] * self.max_batch
+        self._waiting: list = []
+        self._cv = threading.Condition()
+        self._stop = False
+        self._rng = __import__("random").Random(0)
         self._warmup()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="decode-scheduler"
+        )
+        self._thread.start()
 
     def _warmup(self) -> None:
         import jax.numpy as jnp
 
-        cache = self._llama.init_cache(self.cfg, self.batch, self.max_seq)
-        logits, cache = self._decode(
-            self.params, cache, jnp.zeros((self.batch, 1), jnp.int32)
+        logits, _ = self._decode(
+            self.params, self._cache,
+            jnp.zeros((self.max_batch, 1), jnp.int32),
         )
         self._jax.block_until_ready(logits)
 
-    def generate(self, prompt_ids, max_tokens: int = 16) -> Dict:
+    def close(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5)
+
+    # -- request path ------------------------------------------------------
+
+    def generate(self, prompt_ids, max_tokens: int = 16,
+                 temperature: float = 0.0) -> Dict:
+        budget = self.max_seq - 1
+        prompt = [int(t) for t in list(prompt_ids)[:budget]]
+        if not prompt:
+            prompt = [0]
+        max_tokens = max(0, min(int(max_tokens), budget - len(prompt)))
+        slot = _Slot(prompt, max_tokens, float(temperature))
+        with self._cv:
+            self._waiting.append(slot)
+            self._cv.notify_all()
+        slot.done.wait(timeout=600)
+        return slot.result or {"error": "timed out"}
+
+    # -- scheduler loop ----------------------------------------------------
+
+    def _admit_locked(self) -> None:
+        for i in range(self.max_batch):
+            if self._slots[i] is None and self._waiting:
+                slot = self._waiting.pop(0)
+                self._slots[i] = slot
+                # reset this row's position; stale KV is masked by pos
+                self._cache["pos"] = self._cache["pos"].at[i].set(0)
+
+    def _loop(self) -> None:
+        while True:
+            try:
+                if self._loop_once():
+                    return
+            except Exception as e:  # the singleton scheduler must survive:
+                # fail every in-flight request, keep serving new ones
+                log.exception("decode scheduler step failed")
+                with self._cv:
+                    for i, s in enumerate(self._slots):
+                        if s is not None:
+                            s.result = {"error": str(e)}
+                            self._slots[i] = None
+                            s.done.set()
+
+    def _loop_once(self) -> bool:
+        """One scheduler tick; returns True when the engine is stopping."""
+        import numpy as np
         import jax.numpy as jnp
 
-        t0 = time.perf_counter()
-        with self._lock:
-            cache = self._llama.init_cache(self.cfg, self.batch, self.max_seq)
-            budget = self.max_seq - 1
-            prompt = list(prompt_ids)[:budget]
-            out_ids = []
-            logits = None
-            # prefill token-by-token through the decode step (static shapes;
-            # a chunked prefill kernel is a later optimization)
-            for tok in prompt:
-                tokens = jnp.full((self.batch, 1), int(tok), jnp.int32)
-                logits, cache = self._decode(self.params, cache, tokens)
-            n_new = max(0, min(max_tokens, budget - len(prompt)))
-            for _ in range(n_new):
-                if logits is None:
-                    break
-                nxt = int(logits[0].argmax())
-                out_ids.append(nxt)
-                tokens = jnp.full((self.batch, 1), nxt, jnp.int32)
-                logits, cache = self._decode(self.params, cache, tokens)
-        ms = (time.perf_counter() - t0) * 1e3
-        return {
-            "token_ids": out_ids,
-            "prompt_len": len(prompt),
-            "latency_ms": round(ms, 2),
-            "tokens_per_sec": round(len(out_ids) / (ms / 1e3), 2) if ms > 0 else 0.0,
-        }
+        with self._cv:
+            self._admit_locked()
+            while not self._stop and not any(
+                s is not None for s in self._slots
+            ):
+                self._cv.wait(timeout=0.2)
+                self._admit_locked()
+            if self._stop:
+                return True
+            active = list(self._slots)
+        tokens = np.zeros((self.max_batch, 1), np.int32)
+        for i, s in enumerate(active):
+            if s is not None:
+                tokens[i, 0] = s.next_input()
+        logits, self._cache = self._decode(
+            self.params, self._cache, jnp.asarray(tokens)
+        )
+        rows = np.asarray(self._jax.device_get(logits))
+        with self._cv:
+            for i, s in enumerate(active):
+                if s is None:
+                    continue
+                s.fed += 1
+                if s.fed < len(s.prompt):
+                    continue  # still prefilling
+                total = len(s.prompt) + len(s.out_ids)
+                if len(s.out_ids) < s.max_tokens and total < self.max_seq - 1:
+                    s.out_ids.append(self._sample(rows[i], s.temperature))
+                if (
+                    len(s.out_ids) >= s.max_tokens
+                    or len(s.prompt) + len(s.out_ids) >= self.max_seq - 1
+                ):
+                    ms = (time.perf_counter() - s.t0) * 1e3
+                    s.result = {
+                        "token_ids": s.out_ids,
+                        "prompt_len": len(s.prompt),
+                        "latency_ms": round(ms, 2),
+                        "tokens_per_sec": round(
+                            len(s.out_ids) / (ms / 1e3), 2
+                        ) if ms > 0 else 0.0,
+                    }
+                    self._slots[i] = None
+                    s.done.set()
+            self._admit_locked()
+            self._cv.notify_all()
+        return False
+
+    def _sample(self, logits_row, temperature: float) -> int:
+        import numpy as np
+
+        if temperature <= 0.0:
+            return int(np.argmax(logits_row))
+        # clamp: a denormal temperature must degrade to greedy, not NaN out
+        z = logits_row / max(float(temperature), 1e-4)
+        z = z - z.max()
+        p = np.exp(z)
+        total = p.sum()
+        if not np.isfinite(total) or total <= 0.0:
+            return int(np.argmax(logits_row))
+        p = p / total
+        rng = np.random.default_rng(self._rng.randrange(2**31))
+        return int(rng.choice(len(p), p=p))
 
 
 def make_handler(engine: LlamaEngine, model_name: str):
@@ -135,6 +253,7 @@ def make_handler(engine: LlamaEngine, model_name: str):
                 result = engine.generate(
                     req.get("prompt_ids", []),
                     int(req.get("max_tokens", 16)),
+                    float(req.get("temperature", 0.0)),
                 )
                 self._json(200, result)
             except Exception as e:  # serving must not die on a bad request
@@ -155,7 +274,8 @@ def serve_main(env: Optional[Dict[str, str]] = None) -> int:
     ckpt = os.environ.get("KUBEDL_MODEL_PATH", "")
     port = int(cfg.get("port", 8080))
     preset = cfg.get("preset", os.environ.get("KUBEDL_SERVE_PRESET", "tiny"))
-    engine = LlamaEngine(preset=preset, ckpt_dir=ckpt)
+    engine = LlamaEngine(preset=preset, ckpt_dir=ckpt,
+                         max_batch=int(cfg.get("max_batch", 4)))
     server = ThreadingHTTPServer(
         ("127.0.0.1", port), make_handler(engine, cfg.get("model_name", preset))
     )
@@ -174,6 +294,7 @@ def serve_main(env: Optional[Dict[str, str]] = None) -> int:
         pass
     finally:
         server.server_close()
+        engine.close()
     return 0
 
 

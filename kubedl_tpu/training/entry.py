@@ -90,12 +90,26 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
     data = SyntheticTokens(cfg.global_batch, cfg.seq_len, model.vocab_size)
     first_step_wall = {}
     cancel = (env or {}).get("_KUBEDL_CANCEL")  # ThreadRuntime cancellation
+    # fault injection (net-new vs reference, SURVEY.md §5 "No fault
+    # injection anywhere"): die retryably ONCE at a given step — exercises
+    # the slice-granular restart-from-checkpoint path end to end
+    fault_step = int(os.environ.get("KUBEDL_FAULT_ONCE_AT_STEP", "-1"))
+    fault_marker = os.environ.get("KUBEDL_FAULT_MARKER", "")
 
     def on_step(i, metrics):
         if "t" not in first_step_wall:
             first_step_wall["t"] = time.time()
         if cancel is not None and getattr(cancel, "is_set", lambda: False)():
             raise SystemExit(137)  # retryable: gang restart requested
+        if (
+            fault_step >= 0
+            and i == fault_step
+            and fault_marker
+            and not os.path.exists(fault_marker)
+        ):
+            with open(fault_marker, "w") as f:
+                f.write("fired")
+            raise SystemExit(137)
 
     state, summary = trainer.fit(
         iter(data),

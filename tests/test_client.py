@@ -1,0 +1,99 @@
+"""Client SDK tests (reference analogue: generated clientset + fake
+clientset usage in controller suites)."""
+
+import pytest
+
+from kubedl_tpu.api.types import JobConditionType
+from kubedl_tpu.client import ApiException, InProcessClient, KubeDLClient
+from kubedl_tpu.console import ConsoleServer
+from kubedl_tpu.operator import Operator, OperatorOptions
+from kubedl_tpu.runtime.executor import SubprocessRuntime
+
+from tests.helpers import make_tpujob
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    opts = OperatorOptions(
+        local_addresses=True,
+        pod_log_dir=str(tmp_path / "logs"),
+        artifact_registry_root=str(tmp_path / "reg"),
+    )
+    op = Operator(opts, runtime=SubprocessRuntime(str(tmp_path / "logs")))
+    srv = ConsoleServer(op)
+    op.start(); srv.start()
+    try:
+        host, port = srv.address
+        yield op, f"http://{host}:{port}"
+    finally:
+        srv.stop(); op.stop()
+
+
+def _roundtrip(client, op):
+    job = make_tpujob("cl1", workers=1, command=["python", "-c", "print('log-line')"])
+    r = client.tpu_jobs.create(job)
+    assert r["name"] == "cl1"
+    got = client.tpu_jobs.wait("cl1", ["Succeeded", "Failed"], timeout=30)
+    assert got.kind == "TPUJob"
+    assert got.status.phase == JobConditionType.SUCCEEDED
+    # typed list returns decoded objects
+    jobs = client.tpu_jobs.list()
+    assert [j.metadata.name for j in jobs] == ["cl1"]
+    assert jobs[0].spec.replica_specs  # real dataclass, not a dict
+    # stats + overview
+    assert client.statistics()["totalJobCount"] == 1
+    assert "podTotal" in client.overview() or "podRunning" in client.overview()
+    # logs through the client
+    pods = [p for p in op.store.list("Pod")
+            if p.metadata.labels.get("kubedl-tpu.io/job-name") == "cl1"]
+    if pods:
+        logs = "".join(client.job_logs(pods[0].metadata.name))
+        assert "log-line" in logs
+    # unknown kind -> typed error
+    with pytest.raises(ApiException) as ei:
+        client.get_job("Pod", "x")
+    assert ei.value.status == 400
+    with pytest.raises(ApiException) as ei:
+        client.tpu_jobs.get("nope")
+    assert ei.value.status == 404
+    # delete
+    client.tpu_jobs.delete("cl1")
+    with pytest.raises(ApiException):
+        client.tpu_jobs.get("cl1")
+
+
+def test_http_client_roundtrip(stack):
+    op, base = stack
+    _roundtrip(KubeDLClient(base), op)
+
+
+def test_inprocess_client_roundtrip(stack):
+    op, _ = stack
+    _roundtrip(InProcessClient(op), op)
+
+
+def test_stop_via_client(stack):
+    op, base = stack
+    client = KubeDLClient(base)
+    job = make_tpujob("cl-stop", workers=1,
+                      command=["python", "-c", "import time; time.sleep(30)"])
+    client.tpu_jobs.create(job)
+    import time
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        j = op.store.get("TPUJob", "cl-stop")
+        if j.status.phase == JobConditionType.RUNNING:
+            break
+        time.sleep(0.2)
+    client.tpu_jobs.stop("cl-stop")
+    got = client.tpu_jobs.wait("cl-stop", ["Failed"], timeout=30)
+    assert got.status.phase == JobConditionType.FAILED
+
+
+def test_kind_accessors_cover_all_workloads(stack):
+    op, base = stack
+    client = KubeDLClient(base)
+    for attr in ("tpu_jobs", "tf_jobs", "pytorch_jobs", "xdl_jobs",
+                 "xgboost_jobs", "mars_jobs", "elasticdl_jobs", "mpi_jobs"):
+        assert hasattr(client, attr)
+    assert client.kind_client("TFJob").list() == []

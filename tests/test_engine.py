@@ -358,3 +358,200 @@ class TestModelVersionHookup:
         assert len(mvs) == 1
         assert mvs[0].model_name == "m1"
         assert store.get("TPUJob", "job1").status.model_version == mvs[0].metadata.name
+
+
+class TestRefManager:
+    """Adopt/release matrix (reference:
+    pkg/job_controller/service_ref_manager.go:1-158)."""
+
+    def _orphan_pod(self, store, job, name="orphan-0", match=True):
+        from kubedl_tpu.core.objects import Pod
+
+        p = Pod()
+        p.metadata.name = name
+        p.metadata.namespace = "default"
+        if match:
+            p.metadata.labels = {
+                constants.LABEL_JOB_NAME: job.metadata.name,
+                constants.LABEL_JOB_KIND: "TPUJob",
+            }
+        return store.create(p)
+
+    def test_adopts_matching_orphan(self):
+        engine, store, _ = make_engine(gang=False)
+        job = make_tpujob("adopt", workers=1, command=["x"])
+        store.create(job)
+        self._orphan_pod(store, job)
+        engine.reconcile("default", "adopt")
+        p = store.get("Pod", "orphan-0")
+        ref = p.metadata.controller_ref()
+        assert ref is not None and ref.uid == job.metadata.uid
+        assert any(
+            e.reason == "Adopted" for e in store.list("Event")
+        )
+
+    def test_terminal_job_does_not_adopt(self):
+        engine, store, _ = make_engine(gang=False)
+        job = make_tpujob("noadopt", workers=1, command=["x"])
+        job.status.set_condition(JobConditionType.SUCCEEDED, "JobSucceeded", "done")
+        store.create(job)
+        self._orphan_pod(store, job)
+        pods = engine.get_pods_for_job(store.get("TPUJob", "noadopt"))
+        p = store.get("Pod", "orphan-0")
+        assert p.metadata.controller_ref() is None
+        assert pods == []
+
+    def test_releases_on_selector_mismatch(self):
+        engine, store, _ = make_engine(gang=False)
+        job = make_tpujob("rel", workers=1, command=["x"])
+        store.create(job)
+        engine.reconcile("default", "rel")
+        pods = engine.get_pods_for_job(store.get("TPUJob", "rel"))
+        assert len(pods) == 1
+        name = pods[0].metadata.name
+
+        def strip(o):
+            # relabel away from the job but keep the engine's job-kind
+            # marker (a label-less AUX object must NOT be released)
+            o.metadata.labels[constants.LABEL_JOB_NAME] = "someone-else"
+
+        store.update_with_retry("Pod", name, "default", strip)
+        engine.get_pods_for_job(store.get("TPUJob", "rel"))
+        p = store.get("Pod", name)
+        assert p.metadata.controller_ref() is None  # released, not deleted
+
+    def test_never_steals_from_other_owner(self):
+        from kubedl_tpu.core.objects import OwnerRef
+
+        engine, store, _ = make_engine(gang=False)
+        job = make_tpujob("steal", workers=1, command=["x"])
+        store.create(job)
+        p = self._orphan_pod(store, job)
+
+        def own(o):
+            o.metadata.owner_refs.append(
+                OwnerRef(kind="TPUJob", name="other", uid="uid-other")
+            )
+
+        store.update_with_retry("Pod", p.metadata.name, "default", own)
+        pods = engine.get_pods_for_job(store.get("TPUJob", "steal"))
+        assert all(x.metadata.name != p.metadata.name for x in pods)
+        got = store.get("Pod", p.metadata.name)
+        assert got.metadata.controller_ref().uid == "uid-other"
+
+
+class TestElasticSliceResize:
+    """Elastic grow/shrink of a running TPUJob's num_slices: TPU-native
+    semantics are a coordinated whole-gang restart-from-checkpoint at the
+    new shape (SURVEY.md §2.5 'elastic TPU-slice resize')."""
+
+    def _setup(self):
+        from kubedl_tpu.api.topology import get_slice
+
+        inventory = SliceInventory()
+        inventory.add_slice("s1", "v5e-8")
+        inventory.add_slice("s2", "v5e-8")
+        engine, store, _ = make_engine(inventory=inventory)
+        job = make_tpujob("el", workers=2, topology=get_slice("v5e-8"))
+        submit_and_reconcile(engine, store, job)
+        return engine, store
+
+    def test_grow_restarts_gang_at_new_shape(self):
+        engine, store = self._setup()
+        assert len(pod_names(store)) == 2
+        driver = PodDriver(store)
+        driver.run("el-worker-0"); driver.run("el-worker-1")
+        engine.reconcile("default", "el")
+        assert store.get("TPUJob", "el").status.phase == JobConditionType.RUNNING
+
+        def grow(j):
+            j.num_slices = 2
+
+        store.update_with_retry("TPUJob", "el", "default", grow)
+        engine.reconcile("default", "el")  # detects drift: nukes gang+pods
+        got = store.get("TPUJob", "el")
+        assert got.status.phase == JobConditionType.RESTARTING
+        assert got.status.restart_count == 1
+        assert pod_names(store) == []
+        engine.reconcile("default", "el")  # re-admits at 2 slices
+        pods = [store.get("Pod", n) for n in pod_names(store)]
+        assert len(pods) == 4  # 2 hosts/slice x 2 slices
+        envs = env_of(pods[0])
+        assert envs.get("MEGASCALE_NUM_SLICES") == "2"
+        slices = {p.spec.slice_assignment for p in pods}
+        assert slices == {"s1", "s2"}
+        assert any(e.reason == "SliceResize" for e in store.list("Event"))
+
+    def test_shrink_frees_slices_for_others(self):
+        engine, store = self._setup()
+
+        def grow(j):
+            j.num_slices = 2
+
+        store.update_with_retry("TPUJob", "el", "default", grow)
+        engine.reconcile("default", "el")
+        engine.reconcile("default", "el")
+        assert len(pod_names(store)) == 4
+
+        def shrink(j):
+            j.num_slices = 1
+
+        store.update_with_retry("TPUJob", "el", "default", shrink)
+        engine.reconcile("default", "el")
+        engine.reconcile("default", "el")
+        assert len(pod_names(store)) == 2
+        # the freed slice admits another job immediately
+        from kubedl_tpu.api.topology import get_slice
+
+        other = make_tpujob("fill", workers=2, topology=get_slice("v5e-8"))
+        submit_and_reconcile(engine, store, other)
+        assert any("fill-worker" in n for n in pod_names(store))
+
+
+class TestHostPortAllocation:
+    def test_no_collisions_on_same_node(self):
+        """Port allocation consults live pods: even with a seeded RNG forced
+        to collide, every host-network pod gets a unique port."""
+        engine, store, _ = make_engine(gang=False)
+        import random as _random
+
+        class CollidingRng(_random.Random):
+            """Always proposes the same port first."""
+            def randrange(self, *a, **k):
+                return 40000
+
+        engine._rng = CollidingRng()
+        ports = set()
+        for i in range(3):
+            job = make_tpujob(f"hn{i}", workers=1, command=["x"])
+            job.metadata.annotations[constants.ANNOTATION_NETWORK_MODE] = (
+                constants.NETWORK_MODE_HOST
+            )
+            submit_and_reconcile(engine, store, job)
+            pod = store.get("Pod", f"hn{i}-worker-0")
+            hp = pod.spec.main_container().ports[0].host_port
+            assert hp not in ports, f"collision on {hp}"
+            ports.add(hp)
+        # first job got the preferred port; later ones were displaced
+        assert 40000 in ports and len(ports) == 3
+
+
+def test_tensorboard_sidecar_not_released(tmp_path):
+    """Regression (r2 review): the release pass must not strip owner refs
+    from TB sidecar pods/services — they are owned for GC but deliberately
+    unlabeled as replicas."""
+    import json
+
+    engine, store, _ = make_engine(gang=False)
+    job = make_tpujob("tbjob", workers=1, command=["x"])
+    job.metadata.annotations[constants.ANNOTATION_TENSORBOARD_CONFIG] = json.dumps(
+        {"logDir": str(tmp_path)}
+    )
+    submit_and_reconcile(engine, store, job, times=2)
+    tb_pod = store.try_get("Pod", "tbjob-tensorboard")
+    assert tb_pod is not None, [p for p in pod_names(store)]
+    assert tb_pod.metadata.controller_ref() is not None  # still owned
+    engine.get_pods_for_job(store.get("TPUJob", "tbjob"))  # claim pass
+    tb_pod = store.get("Pod", "tbjob-tensorboard")
+    assert tb_pod.metadata.controller_ref() is not None
+    assert not any(e.reason == "Released" for e in store.list("Event"))

@@ -119,3 +119,273 @@ def test_workload_gate_parsing():
     assert parse_workload_gate("TPUJob", known) == ["TPUJob"]
     assert parse_workload_gate("-MPIJob", known) == ["TPUJob", "TorchXLAJob"]
     assert parse_workload_gate("TPUJob,MPIJob", known) == ["TPUJob", "MPIJob"]
+
+
+def test_gang_restart_resumes_from_checkpoint(tmp_path):
+    """VERDICT r1 #3 done-criterion: a worker dies retryably mid-training,
+    the gang restarts, and the job completes having RESUMED (total trained
+    steps < 2x the budget), proving slice-granular restart-from-checkpoint
+    (SURVEY.md §7 hard-part b; reference restart machinery analogue:
+    pkg/job_controller/pod.go:305-317)."""
+    import json
+
+    from kubedl_tpu.core.objects import EnvVar
+    from kubedl_tpu.training import entry as entry_mod
+
+    ckpt_dir = tmp_path / "ckpts"
+    marker = tmp_path / "fault-fired"
+    opts = OperatorOptions(
+        local_addresses=True,
+        pod_log_dir=str(tmp_path / "logs"),
+        artifact_registry_root=str(tmp_path / "registry"),
+    )
+    cfg = {"model": "tiny", "steps": 8, "global_batch": 8, "seq_len": 32,
+           "ckpt_every": 2}
+    with Operator(opts, runtime=ThreadRuntime()) as op:
+        job = make_tpujob(
+            "resume", workers=1,
+            entrypoint="kubedl_tpu.training.entry:train_main",
+        )
+        spec = job.spec.replica_specs[ReplicaType.WORKER]
+        spec.template.spec.containers[0].env = [
+            EnvVar("KUBEDL_TRAIN_CONFIG", json.dumps(cfg)),
+            EnvVar("KUBEDL_CKPT_DIR", str(ckpt_dir)),
+            EnvVar("KUBEDL_FAULT_ONCE_AT_STEP", "5"),
+            EnvVar("KUBEDL_FAULT_MARKER", str(marker)),
+        ]
+        op.submit(job)
+        got = op.wait_for_phase(
+            "TPUJob", "resume",
+            [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+            timeout=120,
+        )
+        assert got.status.phase == JobConditionType.SUCCEEDED, got.status.conditions
+        assert got.status.restart_count >= 1  # the fault actually fired
+        assert marker.exists()
+    summary = entry_mod.LAST_SUMMARY
+    # the restarted attempt resumed from a saved step, not from 0
+    assert summary["start_step"] >= 2, summary
+    # and trained only the remainder: resumed steps + pre-fault steps < 2x
+    assert summary["steps"] <= 8 - summary["start_step"], summary
+
+
+REPO_ROOT = str(__import__("pathlib").Path(__file__).resolve().parents[1])
+
+DIST_PSUM = (
+    "import os, sys\n"
+    f"sys.path.insert(0, {REPO_ROOT!r})\n"
+    "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+    "os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=1'\n"
+    "from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested\n"
+    "ensure_cpu_if_requested()\n"
+    "from kubedl_tpu.parallel.mesh import initialize_from_env\n"
+    "initialize_from_env()\n"
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "assert jax.process_count() == 2, jax.process_count()\n"
+    "assert jax.device_count() == 2, jax.device_count()\n"
+    "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+    "mesh = Mesh(jax.devices(), ('data',))\n"
+    "rank = jax.process_index()\n"
+    "local = jnp.ones((1,), jnp.float32) * (rank + 1)\n"
+    "garr = jax.make_array_from_process_local_data(\n"
+    "    NamedSharding(mesh, P('data')), local, global_shape=(2,))\n"
+    "total = jax.jit(lambda x: x.sum(),\n"
+    "    out_shardings=NamedSharding(mesh, P()))(garr)\n"
+    "assert float(total) == 3.0, float(total)\n"
+    "print('psum-ok rank', rank)\n"
+)
+
+
+def test_two_process_jax_distributed_rendezvous(tmp_path):
+    """VERDICT r1 #7: two real OS processes do a jax.distributed.initialize
+    rendezvous off the operator-injected env (coordinator address, process
+    count/id) and run a cross-process global reduction — the operator's
+    bootstrap wiring proven end to end, not just env-presence-checked
+    (reference e2e bar: scripts/run_tf_test_job.sh)."""
+    script = tmp_path / "dist_psum.py"
+    script.write_text(DIST_PSUM)
+    opts = OperatorOptions(
+        local_addresses=True,
+        pod_log_dir=str(tmp_path / "logs"),
+        artifact_registry_root=str(tmp_path / "registry"),
+    )
+    with Operator(opts, runtime=SubprocessRuntime(str(tmp_path / "logs"))) as op:
+        job = make_tpujob("dist2", workers=2,
+                          command=[sys.executable, str(script)])
+        op.submit(job)
+        got = op.wait_for_phase(
+            "TPUJob", "dist2",
+            [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+            timeout=120,
+        )
+        assert got.status.phase == JobConditionType.SUCCEEDED, [
+            c.message for c in got.status.conditions
+        ]
+    logs = tmp_path / "logs" / "default"
+    merged = "".join(p.read_text() for p in logs.glob("dist2-worker-*.log"))
+    assert "psum-ok rank 0" in merged and "psum-ok rank 1" in merged, merged
+
+
+def test_gang_release_nudges_queued_job(tmp_path):
+    """VERDICT r1 #8: a queued job admits within one reconcile of a slice
+    freeing (PodGroup-deletion nudge), not via the slow fallback poll."""
+    from kubedl_tpu.api.topology import get_slice
+    from kubedl_tpu.gang.slice_scheduler import SliceInventory
+
+    inv = SliceInventory()
+    inv.add_slice("s1", "v5e-8")
+    opts = OperatorOptions(
+        local_addresses=True,
+        pod_log_dir=str(tmp_path / "logs"),
+        artifact_registry_root=str(tmp_path / "registry"),
+    )
+    topo = get_slice("v5e-8")
+    with Operator(opts, runtime=SubprocessRuntime(str(tmp_path / "logs")),
+                  inventory=inv) as op:
+        j1 = make_tpujob("holder", workers=2,
+                         command=[sys.executable, "-c", "import time; time.sleep(4)"],
+                         topology=topo)
+        op.submit(j1)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            pods = [p for p in op.store.list("Pod")
+                    if p.metadata.labels.get(
+                        "kubedl-tpu.io/job-name") == "holder"]
+            if len(pods) == 2:
+                break
+            time.sleep(0.1)
+        assert len(pods) == 2
+        j2 = make_tpujob("waiter", workers=2,
+                         command=[sys.executable, "-c", "print('ok')"],
+                         topology=topo)
+        op.submit(j2)
+        time.sleep(1.0)
+        w = op.store.get("TPUJob", "waiter")
+        assert w.status.phase == JobConditionType.QUEUED
+        got1 = op.wait_for_phase("TPUJob", "holder",
+                                 [JobConditionType.SUCCEEDED], timeout=30)
+        t_free = time.time()
+        # admitted well inside the 5s fallback poll -> the nudge fired
+        deadline = time.time() + 3.0
+        admitted = False
+        while time.time() < deadline:
+            w = op.store.get("TPUJob", "waiter")
+            if w.status.phase != JobConditionType.QUEUED:
+                admitted = True
+                break
+            time.sleep(0.05)
+        assert admitted, f"waiter still QUEUED {time.time() - t_free:.1f}s after slice freed"
+        op.wait_for_phase("TPUJob", "waiter", [JobConditionType.SUCCEEDED],
+                          timeout=30)
+
+
+TRAIN_DIST = (
+    "import os, sys\n"
+    f"sys.path.insert(0, {REPO_ROOT!r})\n"
+    "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+    "os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=1'\n"
+    "from kubedl_tpu.training.entry import train_main\n"
+    "sys.exit(train_main())\n"
+)
+
+
+def test_shared_storage_two_worker_train_build_serve(tmp_path):
+    """VERDICT r1 #6 done-criterion: a 2-worker (2-process jax.distributed)
+    job writes sharded checkpoint output to a SHARED storage root, the
+    ModelVersion build consumes it, and the serving engine loads the
+    restored weights (reference union: storage_provider.go:1-35)."""
+    import json
+
+    import numpy as np
+
+    from kubedl_tpu.core.objects import EnvVar
+    from kubedl_tpu.lineage.types import ModelVersionPhase
+
+    shared_root = tmp_path / "shared" / "out"
+    script = tmp_path / "train_dist.py"
+    script.write_text(TRAIN_DIST)
+    opts = OperatorOptions(
+        local_addresses=True,
+        pod_log_dir=str(tmp_path / "logs"),
+        artifact_registry_root=str(tmp_path / "registry"),
+    )
+    cfg = {"model": "tiny", "steps": 2, "global_batch": 4, "seq_len": 32}
+    with Operator(opts, runtime=SubprocessRuntime(str(tmp_path / "logs"))) as op:
+        job = make_tpujob("shared2", workers=2,
+                          command=[sys.executable, str(script)])
+        spec = job.spec.replica_specs[ReplicaType.WORKER]
+        spec.template.spec.containers[0].env = [
+            EnvVar("KUBEDL_TRAIN_CONFIG", json.dumps(cfg)),
+        ]
+        job.spec.model_version = ModelVersionSpecRef(
+            model_name="shared-model", storage_root=str(shared_root),
+            storage_provider="shared",
+        )
+        op.submit(job)
+        got = op.wait_for_phase(
+            "TPUJob", "shared2",
+            [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+            timeout=120,
+        )
+        assert got.status.phase == JobConditionType.SUCCEEDED, [
+            c.message for c in got.status.conditions
+        ]
+        # both processes wrote their shard files into the shared root
+        import glob as _glob
+
+        shard_files = _glob.glob(str(shared_root / "step-*" / "shards-p*.npz"))
+        pids = {f.rsplit("shards-", 1)[1] for f in shard_files}
+        assert {"p0.npz", "p1.npz"} <= pids, shard_files
+        # MV build consumed the shared artifact
+        mv_name = got.status.model_version
+        deadline = time.time() + 30
+        mv = None
+        while time.time() < deadline:
+            mv = op.store.try_get("ModelVersion", mv_name, "default")
+            if mv is not None and mv.phase in (
+                ModelVersionPhase.SUCCEEDED, ModelVersionPhase.FAILED
+            ):
+                break
+            time.sleep(0.3)
+        assert mv is not None and mv.phase == ModelVersionPhase.SUCCEEDED, (
+            getattr(mv, "message", None)
+        )
+        assert mv.storage_provider == "shared"
+    # serving loads the trained weights from the shared root
+    from kubedl_tpu.serving.server import LlamaEngine
+
+    eng = LlamaEngine(preset="tiny", ckpt_dir=str(shared_root))
+    import jax as _jax
+
+    from kubedl_tpu.models import llama as _llama
+
+    fresh = _llama.llama_init(_jax.random.PRNGKey(0), _llama.TINY)
+    trained = eng.params
+    diff = np.abs(
+        np.asarray(_jax.device_get(trained["embed"]))
+        - np.asarray(_jax.device_get(fresh["embed"]))
+    ).max()
+    assert diff > 0  # engine serves TRAINED weights, not the fresh init
+
+
+def test_node_local_storage_rejects_cross_node_build(tmp_path):
+    """Node-pinned artifacts must fail the build with a clear error when
+    the builder is not co-located (the LocalStorage nodeName contract)."""
+    from kubedl_tpu.lineage.storage import (
+        NodeLocalProvider, SharedDirProvider, StorageError,
+        get_storage_provider,
+    )
+    from kubedl_tpu.lineage.types import ModelVersion
+
+    mv = ModelVersion(storage_root="/data/m", storage_provider="local",
+                      node_name="host-7")
+    with pytest.raises(StorageError):
+        NodeLocalProvider().artifact_dir(mv, local_node="host-1")
+    assert NodeLocalProvider().artifact_dir(mv, local_node="host-7") == "/data/m"
+    # registry + aliases
+    assert isinstance(get_storage_provider("nfs"), SharedDirProvider)
+    assert isinstance(get_storage_provider("efs"), SharedDirProvider)
+    assert isinstance(get_storage_provider(""), SharedDirProvider)
+    with pytest.raises(StorageError):
+        get_storage_provider("bogus")
