@@ -28,6 +28,110 @@ import sys
 import time
 
 
+def bench_control_plane() -> dict:
+    """BASELINE.md targets 1-3: launch-delay latency through the full
+    control plane for the reference's own workload kinds (TFJob 1-worker,
+    PyTorchJob master+3 workers, MPIJob launcher+2 workers), measured by
+    the same first/all-pods histograms the reference instruments
+    (pkg/metrics/job_metrics.go:139-194)."""
+    import tempfile
+
+    from kubedl_tpu.api.types import (
+        JobConditionType, ReplicaSpec, ReplicaType, RestartPolicy,
+    )
+    from kubedl_tpu.core.objects import Container
+    from kubedl_tpu.operator import Operator, OperatorOptions
+    from kubedl_tpu.runtime.executor import SubprocessRuntime
+    from kubedl_tpu.workloads.mpijob import MPIJob
+    from kubedl_tpu.workloads.pytorchjob import PyTorchJob
+    from kubedl_tpu.workloads.tfjob import TFJob
+
+    def add(job, rtype, n, argv):
+        spec = ReplicaSpec(replicas=n, restart_policy=RestartPolicy.ON_FAILURE)
+        spec.template.spec.containers.append(Container(command=argv))
+        job.spec.replica_specs[rtype] = spec
+
+    py = sys.executable
+    out = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        logs = os.path.join(tmp, "logs")
+        opts = OperatorOptions(
+            local_addresses=True, pod_log_dir=logs,
+            artifact_registry_root=os.path.join(tmp, "reg"),
+        )
+        with Operator(opts, runtime=SubprocessRuntime(logs)) as op:
+            tf = TFJob(); tf.metadata.name = "b-tf"
+            add(tf, ReplicaType.WORKER, 1,
+                [py, "-c", "import os; assert 'TF_CONFIG' in os.environ"])
+            pt = PyTorchJob(); pt.metadata.name = "b-pt"
+            add(pt, ReplicaType.MASTER, 1,
+                [py, "-c", "import os; assert os.environ['RANK'] == '0'"])
+            add(pt, ReplicaType.WORKER, 3,
+                [py, "-c", "import os; assert 'MASTER_ADDR' in os.environ"])
+            mpi = MPIJob(); mpi.metadata.name = "b-mpi"
+            add(mpi, ReplicaType.LAUNCHER, 1,
+                ["bash", "-c", 'test -s "$OMPI_MCA_orte_default_hostfile"'])
+            add(mpi, ReplicaType.WORKER, 2, ["sleep", "30"])
+            for job in (tf, pt, mpi):
+                op.submit(job)
+            for job in (tf, pt, mpi):
+                got = op.wait_for_phase(
+                    job.KIND, job.metadata.name,
+                    [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+                    timeout=60,
+                )
+                ok = got.status.phase == JobConditionType.SUCCEEDED
+                n1, s1 = op.metrics.first_pod_launch_delay.summary(kind=job.KIND)
+                na, sa = op.metrics.all_pods_launch_delay.summary(kind=job.KIND)
+                out[job.KIND] = {
+                    "succeeded": ok,
+                    "first_pod_launch_s": round(s1 / n1, 3) if n1 else None,
+                    "all_pods_launch_s": round(sa / na, 3) if na else None,
+                }
+    return out
+
+
+def bench_serving(on_tpu: bool) -> dict:
+    """BASELINE.md target 5: Gemma-2B decode on the chip (tiny on CPU
+    smoke). Measures the jitted continuous-batching decode step under the
+    async-dispatch / scalar-sync discipline — per-token latency at batch 1
+    and throughput at batch 8, plus time-to-first-token for a 64-token
+    prompt."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.models import llama
+
+    preset = "gemma-2b" if on_tpu else "tiny"
+    cfg = llama.preset(preset)
+    max_seq = 512 if on_tpu else 64
+    params = llama.llama_init(jax.random.PRNGKey(0), cfg)
+    decode = jax.jit(lambda p, c, t: llama.decode_step_batched(p, c, t, cfg))
+    out = {"model": preset, "n_params": cfg.num_params()}
+    steps = 32 if on_tpu else 8
+    for B in (1, 8):
+        cache = llama.init_batched_cache(cfg, B, max_seq)
+        toks = jnp.ones((B, 1), jnp.int32)
+        logits, cache = decode(params, cache, toks)  # compile
+        float(jax.device_get(jnp.sum(logits)))  # true barrier
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            logits, cache = decode(params, cache, toks)
+        float(jax.device_get(jnp.sum(logits)))
+        dt = (time.perf_counter() - t0) / steps
+        out[f"decode_ms_per_token_b{B}"] = round(dt * 1e3, 3)
+        out[f"decode_tokens_per_sec_b{B}"] = round(B / dt, 1)
+    # time-to-first-token: 64-token prompt through the same step
+    cache = llama.init_batched_cache(cfg, 1, max_seq)
+    toks = jnp.ones((1, 1), jnp.int32)
+    t0 = time.perf_counter()
+    for _ in range(64):
+        logits, cache = decode(params, cache, toks)
+    float(jax.device_get(jnp.sum(logits)))
+    out["ttft_64_prompt_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    return out
+
+
 def main() -> int:
     t_import = time.time()
     # Respect JAX_PLATFORMS=cpu (CPU smoke runs) even where a sitecustomize
@@ -122,6 +226,17 @@ def main() -> int:
         )
         return 1
 
+    # ---- secondary BASELINE.md targets (never fail the headline) ------
+    targets: dict = {}
+    try:
+        targets["control_plane"] = bench_control_plane()
+    except Exception as e:
+        targets["control_plane"] = {"error": str(e)}
+    try:
+        targets["serving"] = bench_serving(on_tpu)
+    except Exception as e:
+        targets["serving"] = {"error": str(e)}
+
     tps_chip = summary["tokens_per_sec_per_chip"]
     mfu = summary["mfu"]
     vs_baseline = (mfu / 0.10) if on_tpu and mfu > 0 else 1.0
@@ -145,6 +260,7 @@ def main() -> int:
                     "first_loss": round(summary.get("first_loss") or 0.0, 4),
                     "final_loss": round(summary["final_loss"], 4),
                     "sanity": "all gates passed",
+                    "targets": targets,
                 },
             }
         )

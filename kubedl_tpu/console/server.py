@@ -142,6 +142,9 @@ class ConsoleServer:
         r("DELETE", "/api/v1/tensorboard/{ns}/{name}", ConsoleServer._h_tb_delete)
         # cluster overview (reference: routers/api/data.go:24-29)
         r("GET", "/api/v1/data/overview", ConsoleServer._h_overview)
+        # model lineage + slice fleet (console views over live objects)
+        r("GET", "/api/v1/model/list", ConsoleServer._h_model_list)
+        r("GET", "/api/v1/cluster/slices", ConsoleServer._h_cluster_slices)
         # data/code sources, ConfigMap-backed CRUD (reference: console
         # backend datasource/codesource handlers). The source kind is a
         # path capture, never sniffed from the full path (a codesource
@@ -471,6 +474,43 @@ class ConsoleServer:
             "jobPhases": self._job_stats(jobs)["statistics"],
             "workloadKinds": sorted(self.operator.engines),
         }
+
+    def _h_model_list(self, req: Request):
+        """Model lineage view: every Model with its ModelVersions (phase,
+        image, provenance) — the console face of the lineage pipeline."""
+        versions = self.operator.store.list("ModelVersion", namespace=None)
+        # keyed (namespace, model): lineage resolves Models per-namespace
+        by_model: Dict[tuple, List[dict]] = {}
+        for mv in versions:
+            by_model.setdefault(
+                (mv.metadata.namespace, mv.model_name), []
+            ).append({
+                "name": mv.metadata.name,
+                "namespace": mv.metadata.namespace,
+                "phase": getattr(mv.phase, "value", str(mv.phase)),
+                "image": mv.image,
+                "storage_provider": mv.storage_provider,
+                "storage_root": mv.storage_root,
+                "created_by": mv.created_by,
+                "created_at": mv.metadata.creation_timestamp,
+            })
+        models = []
+        for m in self.operator.store.list("Model", namespace=None):
+            models.append({
+                "name": m.metadata.name,
+                "namespace": m.metadata.namespace,
+                "latest_version": m.latest_version,
+                "versions": sorted(
+                    by_model.get((m.metadata.namespace, m.metadata.name), []),
+                    key=lambda v: v["created_at"] or 0, reverse=True,
+                ),
+            })
+        return {"models": models}
+
+    def _h_cluster_slices(self, req: Request):
+        """Slice fleet detail: topology, hosts, holder — the TPU-native
+        analogue of the reference's node/resource ClusterInfo page."""
+        return {"slices": self.operator.inventory.detail()}
 
     def _source_kind(self, req: Request) -> str:
         return req.params["src"]

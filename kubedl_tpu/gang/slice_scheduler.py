@@ -97,6 +97,23 @@ class SliceInventory:
         with self._lock:
             return {s.name: (s.allocated_to or "<free>") for s in self._slices.values()}
 
+    def detail(self) -> List[Dict]:
+        """Full fleet view for the console (name/type/chips/hosts/holder)."""
+        with self._lock:
+            return sorted(
+                (
+                    {
+                        "name": s.name,
+                        "type": s.topology.name,
+                        "chips": s.topology.chips,
+                        "hosts": list(s.hosts),
+                        "allocated_to": s.allocated_to,
+                    }
+                    for s in self._slices.values()
+                ),
+                key=lambda d: d["name"],
+            )
+
 
 def _gang_name(job: JobObject) -> str:
     return f"{job.metadata.name}-gang"

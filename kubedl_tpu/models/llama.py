@@ -51,10 +51,19 @@ class LlamaConfig:
     remat: bool = True
     #: tie lm_head to the embedding table (smaller models do)
     tie_embeddings: bool = False
+    # -- Gemma-family knobs (same decoder skeleton, different details) -----
+    #: MLP activation: "silu" (Llama SwiGLU) or "gelu" (Gemma GeGLU)
+    act: str = "silu"
+    #: RMSNorm uses (1 + weight) (Gemma)
+    norm_plus_one: bool = False
+    #: scale embeddings by sqrt(dim) at input (Gemma)
+    embed_scale: bool = False
+    #: fixed head dim decoupled from dim/n_heads (Gemma: 256); 0 = dim/heads
+    head_dim_fixed: int = 0
 
     @property
     def head_dim(self) -> int:
-        return self.dim // self.n_heads
+        return self.head_dim_fixed or self.dim // self.n_heads
 
     def num_params(self) -> int:
         hd = self.head_dim
@@ -90,6 +99,18 @@ TINY = LlamaConfig(
     vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=128,
     max_seq=128, dtype=jnp.float32, remat=False,
 )
+#: Gemma-2B (BASELINE.md target 5: inference on v5e): MQA, head_dim 256,
+#: GeGLU, (1+w) norms, sqrt(dim)-scaled tied embeddings.
+GEMMA_2B = LlamaConfig(
+    vocab_size=256000, dim=2048, n_layers=18, n_heads=8, n_kv_heads=1,
+    ffn_dim=16384, max_seq=8192, rope_theta=10000.0, tie_embeddings=True,
+    act="gelu", norm_plus_one=True, embed_scale=True, head_dim_fixed=256,
+)
+TINY_GEMMA = LlamaConfig(
+    vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=1, ffn_dim=128,
+    max_seq=128, dtype=jnp.float32, remat=False, tie_embeddings=True,
+    act="gelu", norm_plus_one=True, embed_scale=True, head_dim_fixed=32,
+)
 
 
 def preset(name: str) -> LlamaConfig:
@@ -97,6 +118,8 @@ def preset(name: str) -> LlamaConfig:
         "llama3-8b": LLAMA3_8B,
         "llama3-1b": LLAMA3_1B,
         "bench-350m": BENCH_350M,
+        "gemma-2b": GEMMA_2B,
+        "tiny-gemma": TINY_GEMMA,
         "tiny": TINY,
     }
     return table[name]
@@ -114,20 +137,21 @@ def llama_init(key: jax.Array, cfg: LlamaConfig) -> Params:
         )
 
     L, D, F, V = cfg.n_layers, cfg.dim, cfg.ffn_dim, cfg.vocab_size
+    norm_init = jnp.zeros if cfg.norm_plus_one else jnp.ones
     params: Params = {
         "embed": dense(next(k), (V, D), D),
         "layers": {
-            "attn_norm": jnp.ones((L, D), cfg.dtype),
+            "attn_norm": norm_init((L, D), cfg.dtype),
             "wq": dense(next(k), (L, D, cfg.n_heads * hd), D),
             "wk": dense(next(k), (L, D, cfg.n_kv_heads * hd), D),
             "wv": dense(next(k), (L, D, cfg.n_kv_heads * hd), D),
             "wo": dense(next(k), (L, cfg.n_heads * hd, D), cfg.n_heads * hd),
-            "mlp_norm": jnp.ones((L, D), cfg.dtype),
+            "mlp_norm": norm_init((L, D), cfg.dtype),
             "w_gate": dense(next(k), (L, D, F), D),
             "w_up": dense(next(k), (L, D, F), D),
             "w_down": dense(next(k), (L, F, D), F),
         },
-        "final_norm": jnp.ones((D,), cfg.dtype),
+        "final_norm": norm_init((D,), cfg.dtype),
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = dense(next(k), (D, V), D)
@@ -163,11 +187,22 @@ def param_pspecs(cfg: LlamaConfig) -> Params:
 
 # ---- building blocks -------------------------------------------------------
 
-def rmsnorm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def rmsnorm(
+    x: jax.Array, weight: jax.Array, eps: float, plus_one: bool = False
+) -> jax.Array:
     dtype = x.dtype
     x = x.astype(jnp.float32)
     x = x * lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
-    return (x * weight.astype(jnp.float32)).astype(dtype)
+    w = weight.astype(jnp.float32)
+    if plus_one:  # Gemma convention: weight is a residual around 1
+        w = w + 1.0
+    return (x * w).astype(dtype)
+
+
+def _act(cfg: LlamaConfig):
+    return jax.nn.silu if cfg.act == "silu" else partial(
+        jax.nn.gelu, approximate=True
+    )
 
 
 def rope_table(
@@ -226,7 +261,8 @@ def _block(
 ) -> jax.Array:
     B, S, D = x.shape
     hd = cfg.head_dim
-    h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    po = cfg.norm_plus_one
+    h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps, po)
     q = (h @ lp["wq"]).reshape(B, S, cfg.n_heads, hd)
     k = (h @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
     v = (h @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
@@ -234,8 +270,8 @@ def _block(
     k = apply_rope(k, cos, sin)
     attn = (attn_fn or attention)(q, k, v).reshape(B, S, cfg.n_heads * hd)
     x = x + attn @ lp["wo"]
-    h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps, po)
+    gate = _act(cfg)((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
     x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
     return x
 
@@ -253,6 +289,8 @@ def llama_forward(
     """
     B, S = tokens.shape
     x = params["embed"][tokens].astype(cfg.dtype)
+    if cfg.embed_scale:  # Gemma scales inputs by sqrt(dim)
+        x = x * math.sqrt(cfg.dim)
     cos, sin = rope_freqs(cfg, S)
 
     def body(carry, lp):
@@ -263,7 +301,7 @@ def llama_forward(
             body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         )
     x, _ = lax.scan(body, x, params["layers"])
-    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.norm_plus_one)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     return (x @ head).astype(jnp.float32)
 
@@ -327,6 +365,8 @@ def decode_step_batched(
     pos = cache["pos"]  # [B]
     max_s = cache["k"].shape[2]
     x = params["embed"][tokens].astype(cfg.dtype)  # [B, 1, D]
+    if cfg.embed_scale:
+        x = x * math.sqrt(cfg.dim)
     cos, sin = rope_freqs(cfg, max_s)
     cos_t = cos[pos][:, None, None, :]  # [B,1,1,hd/2] per-row rotation
     sin_t = sin[pos][:, None, None, :]
@@ -345,7 +385,7 @@ def decode_step_batched(
     new_k, new_v = [], []
     for layer in range(cfg.n_layers):
         lp = jax.tree_util.tree_map(lambda a: a[layer], params["layers"])
-        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps, cfg.norm_plus_one)
         q = (h @ lp["wq"]).reshape(B, 1, cfg.n_heads, hd)
         k = (h @ lp["wk"]).reshape(B, 1, cfg.n_kv_heads, hd)
         v = (h @ lp["wv"]).reshape(B, 1, cfg.n_kv_heads, hd)
@@ -357,10 +397,10 @@ def decode_step_batched(
         new_v.append(cv)
         attn = attention(q, ck, cv, causal=False, mask=mask)
         x = x + attn.reshape(B, 1, cfg.n_heads * hd) @ lp["wo"]
-        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps, cfg.norm_plus_one)
+        gate = _act(cfg)((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
         x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
-    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.norm_plus_one)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = (x[:, 0] @ head).astype(jnp.float32)
     cache = {
@@ -383,6 +423,8 @@ def decode_step(
     hd = cfg.head_dim
     pos = cache["pos"]
     x = params["embed"][tokens].astype(cfg.dtype)  # [B, 1, D]
+    if cfg.embed_scale:
+        x = x * math.sqrt(cfg.dim)
     cos, sin = rope_freqs(cfg, cfg.max_seq)
     cos_t = lax.dynamic_slice_in_dim(cos, pos, 1)
     sin_t = lax.dynamic_slice_in_dim(sin, pos, 1)
@@ -392,7 +434,7 @@ def decode_step(
     new_k, new_v = [], []
     for layer in range(cfg.n_layers):
         lp = jax.tree_util.tree_map(lambda a: a[layer], params["layers"])
-        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps, cfg.norm_plus_one)
         q = (h @ lp["wq"]).reshape(B, 1, cfg.n_heads, hd)
         k = (h @ lp["wk"]).reshape(B, 1, cfg.n_kv_heads, hd)
         v = (h @ lp["wv"]).reshape(B, 1, cfg.n_kv_heads, hd)
@@ -404,10 +446,10 @@ def decode_step(
         new_v.append(cv)
         attn = attention(q, ck, cv, causal=False, mask=valid)
         x = x + attn.reshape(B, 1, cfg.n_heads * hd) @ lp["wo"]
-        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps, cfg.norm_plus_one)
+        gate = _act(cfg)((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
         x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
-    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.norm_plus_one)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = (x[:, 0] @ head).astype(jnp.float32)
     cache = {

@@ -17,8 +17,10 @@ q-head h to kv-head h//group, no repeated K/V in memory.
 Backward is a custom VJP over two more pallas kernels (the canonical
 flash-2 split): a dQ kernel accumulating over k-blocks and a dK/dV kernel
 accumulating over q-blocks, both recomputing P from the saved lse — same
-O(S·hd) memory profile as the forward, and independently tileable
-(fwd 256x256 / bwd 256x512 are the v5e sweet spots).
+O(S·hd) memory profile as the forward, and independently tileable.
+1024x1024 tiles are the measured v5e sweet spot (VMEM-bound above that);
+in-model they run 2.6x faster than the stock jax pallas TPU flash kernel
+on the bench model's hd=64 GQA shapes.
 
 On CPU (tests) the kernel runs in pallas interpret mode; numerics match
 the dense oracle `kubedl_tpu.models.llama.attention`.
@@ -345,17 +347,20 @@ def flash_attention(
     v: jax.Array,
     causal: bool = True,
     mask: Optional[jax.Array] = None,
-    block_q: int = 256,
-    block_k: int = 256,
-    bwd_block_q: int = 256,
-    bwd_block_k: int = 512,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    bwd_block_q: int = 1024,
+    bwd_block_k: int = 1024,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Drop-in for `kubedl_tpu.models.llama.attention` (same signature, so
     it slots into `llama_forward(..., attn_fn=flash_attention)`). Arbitrary
     masks fall back to the dense oracle — flash handles the causal/full
     cases that training uses. Forward and backward kernels tile
-    independently (v5e sweet spots: fwd 256x256, bwd 256x512)."""
+    independently. Default 1024x1024 tiles are the measured v5e sweet spot
+    in-model (S=2048, hd=64: 649ms fwd+bwd for the 24-layer bench model vs
+    974ms at 256-tiles, 1673ms for the stock jax pallas TPU kernel; 2048
+    tiles exceed VMEM). Small sequences clamp blocks to S automatically."""
     if mask is not None:
         from kubedl_tpu.models.llama import attention
 
@@ -368,28 +373,45 @@ def flash_attention(
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     S = qt.shape[2]
-    bwd_q = min(bwd_block_q, S)
-    bwd_k = min(bwd_block_k, S)
-    if S % bwd_q or S % bwd_k:  # fall back to fwd tiling (already checked)
-        bwd_q, bwd_k = block_q, block_k
-    out = _flash(qt, kt, vt, causal, block_q, block_k, bwd_q, bwd_k, interpret)
+    # fit every tiling to the actual sequence length (a seq divisible by
+    # 128 but not by the preferred block shrinks the block, not the path)
+    bq = fit_block(S, block_q)
+    bk = fit_block(S, block_k)
+    bwd_q = fit_block(S, bwd_block_q)
+    bwd_k = fit_block(S, bwd_block_k)
+    if not (bq and bk and bwd_q and bwd_k):
+        from kubedl_tpu.models.llama import attention
+
+        return attention(q, k, v, causal=causal)
+    out = _flash(qt, kt, vt, causal, bq, bk, bwd_q, bwd_k, interpret)
     return out.transpose(0, 2, 1, 3)
 
 
-def supports(seq_len: int, block_q: int = 256, block_k: int = 256) -> bool:
-    """Whether the kernel's static tiling constraints hold for this shape
-    (seq must divide evenly into blocks after the min() clamp)."""
-    bq = min(block_q, seq_len)
-    bk = min(block_k, seq_len)
-    return seq_len % bq == 0 and seq_len % bk == 0
+def fit_block(seq_len: int, want: int) -> int:
+    """Largest legal block <= ``want`` for this sequence length: the whole
+    sequence if it fits in one block, else the largest multiple-of-128
+    divisor (mosaic tiling wants 128-lane-aligned score tiles). 0 = no
+    legal block — caller falls back to the dense oracle."""
+    if seq_len <= want:
+        return seq_len
+    for b in range(min(want, seq_len), 127, -128):
+        if b % 128 == 0 and seq_len % b == 0:
+            return b
+    return 0
+
+
+def supports(seq_len: int, block_q: int = 1024, block_k: int = 1024) -> bool:
+    """Whether a legal tiling exists for this shape (a seq divisible by 128
+    always tiles — the block shrinks below the preferred size if needed)."""
+    return fit_block(seq_len, block_q) > 0 and fit_block(seq_len, block_k) > 0
 
 
 def make_flash_attention(
     mesh,
     batch_axes: Tuple[str, ...] = ("replica", "data", "fsdp"),
     head_axis: str = "tensor",
-    block_q: int = 256,
-    block_k: int = 256,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ):
     """Mesh-aware flash attention for the trainer hot path.

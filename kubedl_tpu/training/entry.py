@@ -87,7 +87,18 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
         else:
             state = template
 
-    data = SyntheticTokens(cfg.global_batch, cfg.seq_len, model.vocab_size)
+    data_path = opts.get("data_path", "")
+    if data_path:
+        # real token file through the native prefetch loader (C++ ring,
+        # numpy fallback) — batch assembly off the critical path
+        from kubedl_tpu.data import TokenFileDataset
+
+        data = TokenFileDataset(
+            data_path, cfg.global_batch, cfg.seq_len,
+            seed=cfg.seed, token_bytes=int(opts.get("token_bytes", 4)),
+        )
+    else:
+        data = SyntheticTokens(cfg.global_batch, cfg.seq_len, model.vocab_size)
     first_step_wall = {}
     cancel = (env or {}).get("_KUBEDL_CANCEL")  # ThreadRuntime cancellation
     # fault injection (net-new vs reference, SURVEY.md §5 "No fault

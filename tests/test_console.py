@@ -391,3 +391,37 @@ def test_statistics_ignore_pagination(console):
     )
     assert status == 200
     assert resp["data"]["totalJobCount"] == 3
+
+
+def test_model_list_and_cluster_slices_endpoints(console):
+    op, srv = console
+    # model list: empty then populated via lineage
+    status, resp = call(srv, "GET", "/api/v1/model/list")
+    assert status == 200 and resp["data"]["models"] == []
+    status, resp = call(srv, "GET", "/api/v1/cluster/slices")
+    assert status == 200 and resp["data"]["slices"] == []
+
+    from kubedl_tpu.lineage.types import Model, ModelVersion, ModelVersionPhase
+
+    m = Model()
+    m.metadata.name = "m1"
+    op.store.create(m)
+    mv = ModelVersion(model_name="m1", image="repo:v1",
+                      phase=ModelVersionPhase.SUCCEEDED)
+    mv.metadata.name = "m1-v1"
+    op.store.create(mv)
+    status, resp = call(srv, "GET", "/api/v1/model/list")
+    models = resp["data"]["models"]
+    assert [x["name"] for x in models] == ["m1"]
+    assert models[0]["versions"][0]["image"] == "repo:v1"
+    assert models[0]["versions"][0]["phase"] == "Succeeded"
+
+
+def test_frontend_spa_served(console):
+    _, srv = console
+    status, body = call(srv, "GET", "/", raw=True)
+    assert status == 200
+    html = body.decode()
+    for frag in ("#/jobs", "#/models", "#/submit", "#/sources",
+                 "cluster/slices", "model/list"):
+        assert frag in html, frag

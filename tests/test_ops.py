@@ -78,7 +78,50 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-5, rtol=2e-5)
 
-    def test_indivisible_block_raises(self):
+    def test_untileable_shape_falls_back_to_oracle(self):
+        # S=48 with 32-blocks has no legal tiling; the wrapper degrades to
+        # the dense oracle instead of raising (r2: graceful fit_block path)
+        import numpy as np
+
+        from kubedl_tpu.models.llama import attention
+
         q, k, v = _qkv(jax.random.PRNGKey(5), S=48)
-        with pytest.raises(ValueError, match="divide"):
-            flash_attention(q, k, v, block_q=32, block_k=32)
+        got = flash_attention(q, k, v, block_q=32, block_k=32)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(attention(q, k, v)),
+            rtol=2e-4, atol=2e-4,
+        )
+
+
+class TestBlockFitting:
+    def test_fit_block(self):
+        from kubedl_tpu.ops.flash_attention import fit_block, supports
+
+        assert fit_block(2048, 1024) == 1024
+        assert fit_block(1536, 1024) == 768   # largest 128-multiple divisor
+        assert fit_block(1280, 1024) == 640
+        assert fit_block(64, 1024) == 64      # whole seq in one block
+        assert fit_block(100, 1024) == 100    # whole seq fits one block
+        assert fit_block(100, 64) == 0        # >64, no 128-multiple divisor
+        assert supports(1536) and supports(2048) and supports(32)
+        assert not supports(1000000007)       # prime > block
+
+    def test_odd_seq_len_uses_flash_not_dense(self):
+        """seq 1536 (divisible by 512, not 1024) must still run the fused
+        kernel (regression: r2 review — default-block bump silently
+        narrowed support)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kubedl_tpu.models.llama import attention
+        from kubedl_tpu.ops.flash_attention import flash_attention
+
+        B, S, H, KV, hd = 1, 256, 2, 1, 16  # 256 % 128 == 0, < 1024
+        q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, hd))
+        k = jax.random.normal(jax.random.PRNGKey(1), (B, S, KV, hd))
+        v = jax.random.normal(jax.random.PRNGKey(2), (B, S, KV, hd))
+        got = flash_attention(q, k, v, block_q=1024, block_k=1024)
+        want = attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
