@@ -86,6 +86,37 @@ class WorkloadController:
                     del job.spec.replica_specs[rtype]
         job_spec_defaults(job.spec)
 
+    def validate(self, job: JobObject) -> List[str]:
+        """Admission validation (the reference's validating-webhook
+        analogue, apis/*/zz_generated + webhook configs): returns human
+        errors; non-empty rejects the submit. Runs BEFORE apply_defaults
+        so a disallowed group is rejected, not silently pruned (replicas
+        <= 0 stays legal: defaulting bumps it to 1). Kinds add their own
+        rules on top of the base checks."""
+        errs: List[str] = []
+        if not job.spec.replica_specs:
+            errs.append("spec.replicaSpecs must declare at least one replica type")
+        slice_type = ""
+        for rtype, rs in job.spec.replica_specs.items():
+            if (
+                self.ALLOWED_REPLICA_TYPES is not None
+                and rtype not in self.ALLOWED_REPLICA_TYPES
+            ):
+                errs.append(f"replica type {rtype.value} not allowed for {self.KIND}")
+            if rs.replicas < 0:
+                errs.append(f"{rtype.value}.replicas must not be negative")
+            if rs.topology is not None:
+                if slice_type and rs.topology.name != slice_type:
+                    errs.append("mixed slice types in one job are not supported")
+                slice_type = rs.topology.name
+        bl = job.spec.run_policy.backoff_limit
+        if bl is not None and bl < 0:
+            errs.append("runPolicy.backoffLimit must be >= 0")
+        ttl = job.spec.run_policy.ttl_seconds_after_finished
+        if ttl is not None and ttl < 0:
+            errs.append("runPolicy.ttlSecondsAfterFinished must be >= 0")
+        return errs
+
     # ---- topology / ordering --------------------------------------------
 
     def reconcile_orders(self) -> List[ReplicaType]:

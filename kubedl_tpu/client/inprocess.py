@@ -22,8 +22,10 @@ class InProcessClient(BaseClient):
             raise ApiException(400, f"workload kind {kind} not enabled")
 
     def submit(self, job) -> Dict[str, Any]:
-        self._require_kind(job.kind)
-        created = self.operator.submit(job)
+        try:  # operator.submit's admission covers the kind-enabled check
+            created = self.operator.submit(job)
+        except ValueError as e:  # admission rejection
+            raise ApiException(400, str(e)) from None
         return {"name": created.metadata.name,
                 "namespace": created.metadata.namespace}
 

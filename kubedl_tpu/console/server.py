@@ -314,6 +314,8 @@ class ConsoleServer:
             created = self.operator.submit(job)
         except AlreadyExists as e:
             raise ApiError(409, str(e)) from e
+        except ValueError as e:  # admission rejection (ValidationError)
+            raise ApiError(400, str(e)) from e
         return {"name": created.metadata.name, "namespace": created.metadata.namespace}
 
     def _live_job(self, req: Request):

@@ -39,11 +39,15 @@ class CronController:
         workload_kinds: List[str],
         recorder: Optional[EventRecorder] = None,
         clock=time.time,
+        submitter=None,
     ) -> None:
         self.store = store
         self.workload_kinds = list(workload_kinds)
         self.recorder = recorder or EventRecorder(store)
         self.clock = clock
+        #: admission-checked create (Operator.submit) — cron-materialized
+        #: jobs must pass the same validation as direct submits
+        self.submitter = submitter or store.create
 
     def setup(self, manager: ControllerManager) -> None:
         manager.register(
@@ -146,8 +150,13 @@ class CronController:
         ]
         job.metadata.resource_version = 0
         try:
-            created = self.store.create(job)
+            created = self.submitter(job)
         except AlreadyExists:
+            return
+        except ValueError as e:  # admission rejection: surface, don't churn
+            self.recorder.event(
+                cron, "Warning", "CronTemplateRejected", str(e)
+            )
             return
         cron.active.append(created.metadata.name)
         cron.history.insert(

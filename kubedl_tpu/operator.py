@@ -58,6 +58,14 @@ class OperatorOptions:
     node_name: str = ""
 
 
+class ValidationError(ValueError):
+    """Admission rejection (reference: validating webhook deny)."""
+
+    def __init__(self, kind: str, errors: List[str]) -> None:
+        super().__init__(f"{kind} rejected: " + "; ".join(errors))
+        self.errors = errors
+
+
 class Operator:
     def __init__(
         self,
@@ -130,7 +138,8 @@ class Operator:
         from kubedl_tpu.cron.controller import CronController
 
         self.cron = CronController(
-            self.store, list(self.engines), self.manager.recorder
+            self.store, list(self.engines), self.manager.recorder,
+            submitter=self.submit,
         )
         self.cron.setup(self.manager)
 
@@ -234,7 +243,21 @@ class Operator:
     # ------------------------------------------------------------- submit
 
     def submit(self, job: JobObject) -> JobObject:
-        """Create a job and record the created metric path end-to-end."""
+        """Admission + create (the reference's defaulting/validating
+        webhook chain runs in-process here): defaults are applied, the
+        kind's validation rules run, then the object lands in the store."""
+        engine = self.engines.get(job.kind)
+        if engine is None:
+            raise ValidationError(
+                job.kind, [f"workload kind {job.kind!r} is not enabled"]
+            )
+        # validate BEFORE defaulting: the user must get a 400 for a
+        # disallowed replica group, not have it silently pruned (defaulting
+        # still degrades gracefully on the reconcile path)
+        errs = engine.controller.validate(job)
+        if errs:
+            raise ValidationError(job.kind, errs)
+        engine.controller.apply_defaults(job)
         return self.store.create(job)  # type: ignore[return-value]
 
     def wait_for_phase(

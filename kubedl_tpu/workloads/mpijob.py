@@ -72,6 +72,14 @@ class MPIJobController(WorkloadController):
     NAME = "mpijob-controller"
     ALLOWED_REPLICA_TYPES = (ReplicaType.LAUNCHER, ReplicaType.WORKER)
 
+    def validate(self, job):
+        errs = super().validate(job)
+        if ReplicaType.LAUNCHER not in job.spec.replica_specs:
+            errs.append("MPIJob requires a Launcher replica group")
+        elif job.spec.replica_specs[ReplicaType.LAUNCHER].replicas > 1:
+            errs.append("MPIJob allows exactly one Launcher")
+        return errs
+
     def object_factory(self) -> MPIJob:
         return MPIJob()
 

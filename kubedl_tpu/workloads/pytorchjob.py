@@ -37,6 +37,13 @@ class PyTorchJobController(WorkloadController):
     NAME = "pytorchjob-controller"
     ALLOWED_REPLICA_TYPES = (ReplicaType.MASTER, ReplicaType.WORKER)
 
+    def validate(self, job):
+        errs = super().validate(job)
+        master = job.spec.replica_specs.get(ReplicaType.MASTER)
+        if master is not None and master.replicas > 1:
+            errs.append("PyTorchJob allows at most one Master (rank 0)")
+        return errs
+
     def object_factory(self) -> PyTorchJob:
         return PyTorchJob()
 

@@ -189,3 +189,47 @@ class TestCronController:
         events = [e for e in store.list("Event")
                   if e.reason == "TooManyMissedRuns"]
         assert events
+
+
+def test_cron_template_passes_admission():
+    """r2 review: cron-materialized jobs must go through the same
+    admission as direct submits — an invalid template surfaces as a
+    Warning event instead of churning the store every tick."""
+    from kubedl_tpu.operator import ValidationError
+    from kubedl_tpu.workloads.tpujob import TPUJob, TPUJobController
+
+    store = ObjectStore()
+    clock = FakeClock(ts(2026, 1, 1, 10, 0))
+    controller = TPUJobController(local_addresses=True)
+
+    def submitter(job):
+        errs = controller.validate(job)
+        if errs:
+            raise ValidationError(job.kind, errs)
+        controller.apply_defaults(job)
+        return store.create(job)
+
+    ctrl = CronController(store, ["TPUJob"], clock=clock, submitter=submitter)
+    bad = TPUJob()
+    bad.metadata.name = "tpl"  # no replica specs: invalid
+    cron = Cron(schedule="*/5 * * * *", template=bad)
+    cron.metadata.name = "bad-cron"
+    cron.metadata.creation_timestamp = clock.t
+    store.create(cron)
+    clock.t = ts(2026, 1, 1, 10, 5)
+    ctrl.reconcile("default", "bad-cron")
+    evs = [e for e in store.list("Event")
+           if e.reason == "CronTemplateRejected"]
+    assert evs, "expected a CronTemplateRejected event"
+    assert not store.list("TPUJob")  # invalid job never reached the store
+
+
+def test_cron_through_operator_uses_admission(tmp_path):
+    """The operator wires Operator.submit as the cron submitter."""
+    from kubedl_tpu.operator import Operator, OperatorOptions
+    from kubedl_tpu.runtime.executor import FakeRuntime
+
+    opts = OperatorOptions(local_addresses=True,
+                           artifact_registry_root=str(tmp_path / "r"))
+    op = Operator(opts, runtime=FakeRuntime())
+    assert op.cron.submitter == op.submit

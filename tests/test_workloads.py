@@ -446,3 +446,96 @@ class TestMPIJob:
         driver.succeed("mpi1-launcher-0")
         reconcile(engine, job)
         assert store.get("MPIJob", "mpi1").status.phase == JobConditionType.SUCCEEDED
+
+
+class TestAdmission:
+    """Submit-time validation (the reference's validating-webhook layer)."""
+
+    def _op(self, tmp_path):
+        from kubedl_tpu.operator import Operator, OperatorOptions
+        from kubedl_tpu.runtime.executor import FakeRuntime
+
+        return Operator(
+            OperatorOptions(local_addresses=True,
+                            artifact_registry_root=str(tmp_path / "r")),
+            runtime=FakeRuntime(),
+        )
+
+    def test_rejects_empty_replica_specs(self, tmp_path):
+        from kubedl_tpu.operator import ValidationError
+        from kubedl_tpu.workloads.tpujob import TPUJob
+
+        op = self._op(tmp_path)
+        job = TPUJob()
+        job.metadata.name = "empty"
+        with pytest.raises(ValidationError, match="at least one replica"):
+            op.submit(job)
+
+    def test_rejects_mixed_slice_types(self, tmp_path):
+        from kubedl_tpu.api.topology import get_slice
+        from kubedl_tpu.operator import ValidationError
+        from kubedl_tpu.workloads.tpujob import TPUJob
+
+        op = self._op(tmp_path)
+        job = TPUJob()
+        job.metadata.name = "mixed"
+        for rtype, st in ((ReplicaType.WORKER, "v5e-8"),
+                          (ReplicaType.EVALUATOR, "v5e-16")):
+            spec = ReplicaSpec(replicas=1, topology=get_slice(st))
+            spec.template.spec.containers.append(Container(command=["x"]))
+            job.spec.replica_specs[rtype] = spec
+        with pytest.raises(ValidationError, match="mixed slice types"):
+            op.submit(job)
+
+    def test_mpi_requires_single_launcher(self, tmp_path):
+        from kubedl_tpu.operator import ValidationError
+        from kubedl_tpu.workloads.mpijob import MPIJob
+
+        op = self._op(tmp_path)
+        job = MPIJob()
+        job.metadata.name = "no-launcher"
+        spec = ReplicaSpec(replicas=2)
+        spec.template.spec.containers.append(Container(command=["x"]))
+        job.spec.replica_specs[ReplicaType.WORKER] = spec
+        with pytest.raises(ValidationError, match="Launcher"):
+            op.submit(job)
+
+    def test_pytorch_single_master(self, tmp_path):
+        from kubedl_tpu.operator import ValidationError
+        from kubedl_tpu.workloads.pytorchjob import PyTorchJob
+
+        op = self._op(tmp_path)
+        job = PyTorchJob()
+        job.metadata.name = "two-masters"
+        spec = ReplicaSpec(replicas=2)
+        spec.template.spec.containers.append(Container(command=["x"]))
+        job.spec.replica_specs[ReplicaType.MASTER] = spec
+        with pytest.raises(ValidationError, match="one Master"):
+            op.submit(job)
+
+    def test_disabled_kind_rejected(self, tmp_path):
+        from kubedl_tpu.operator import Operator, OperatorOptions, ValidationError
+        from kubedl_tpu.runtime.executor import FakeRuntime
+        from kubedl_tpu.workloads.marsjob import MarsJob
+
+        op = Operator(
+            OperatorOptions(workloads="TPUJob", local_addresses=True,
+                            artifact_registry_root=str(tmp_path / "r")),
+            runtime=FakeRuntime(),
+        )
+        job = MarsJob()
+        job.metadata.name = "mars"
+        with pytest.raises(ValidationError, match="not enabled"):
+            op.submit(job)
+
+    def test_valid_job_admitted_with_defaults_applied(self, tmp_path):
+        from kubedl_tpu.workloads.tpujob import TPUJob
+
+        op = self._op(tmp_path)
+        job = TPUJob()
+        job.metadata.name = "ok"
+        spec = ReplicaSpec(replicas=0)  # defaulting bumps to 1
+        spec.template.spec.containers.append(Container(command=["x"]))
+        job.spec.replica_specs[ReplicaType.WORKER] = spec
+        created = op.submit(job)
+        assert created.spec.replica_specs[ReplicaType.WORKER].replicas == 1
