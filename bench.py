@@ -132,6 +132,38 @@ def bench_serving(on_tpu: bool) -> dict:
     return out
 
 
+def bench_long_context(on_tpu: bool) -> dict:
+    """Long-context training throughput: the flash kernel's O(S) memory is
+    what makes S=8192 trainable on one 16GB chip at all (dense attention
+    would materialize 8 GiB of scores per layer). Measures tokens/s and
+    step time at long sequence length (CPU smoke uses a tiny shape)."""
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.training.data import SyntheticTokens
+    from kubedl_tpu.training.trainer import TrainConfig, Trainer
+
+    if on_tpu:
+        import dataclasses
+
+        model = dataclasses.replace(llama.BENCH_350M, max_seq=8192)
+        batch, seq, steps = 2, 8192, 6
+    else:
+        model = llama.TINY
+        batch, seq, steps = 2, 128, 3
+    cfg = TrainConfig(model=model, global_batch=batch, seq_len=seq,
+                      steps=steps)
+    trainer = Trainer(cfg)
+    data = SyntheticTokens(batch, seq, model.vocab_size)
+    _, s = trainer.fit(iter(data))
+    return {
+        "seq_len": seq,
+        "global_batch": batch,
+        "attn_impl": s["attn_impl"],
+        "tokens_per_sec_per_chip": round(s["tokens_per_sec_per_chip"], 1),
+        "step_time_ms": round(s["step_time_ms"], 1),
+        "mfu": round(s["mfu"], 4),
+    }
+
+
 def main() -> int:
     t_import = time.time()
     # Respect JAX_PLATFORMS=cpu (CPU smoke runs) even where a sitecustomize
@@ -236,6 +268,10 @@ def main() -> int:
         targets["serving"] = bench_serving(on_tpu)
     except Exception as e:
         targets["serving"] = {"error": str(e)}
+    try:
+        targets["long_context"] = bench_long_context(on_tpu)
+    except Exception as e:
+        targets["long_context"] = {"error": str(e)}
 
     tps_chip = summary["tokens_per_sec_per_chip"]
     mfu = summary["mfu"]

@@ -22,10 +22,12 @@ class InProcessClient(BaseClient):
             raise ApiException(400, f"workload kind {kind} not enabled")
 
     def submit(self, job) -> Dict[str, Any]:
+        from kubedl_tpu.operator import ValidationError
+
         try:  # operator.submit's admission covers the kind-enabled check
             created = self.operator.submit(job)
-        except ValueError as e:  # admission rejection
-            raise ApiException(400, str(e)) from None
+        except ValidationError as e:  # admission rejection
+            raise ApiException(400, str(e)) from e
         return {"name": created.metadata.name,
                 "namespace": created.metadata.namespace}
 

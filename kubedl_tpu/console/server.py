@@ -36,6 +36,7 @@ from kubedl_tpu.console.backends import ApiServerReadBackend, ObjectReadBackend
 from kubedl_tpu.console.frontend import INDEX_HTML
 from kubedl_tpu.core.objects import ConfigMap, new_uid
 from kubedl_tpu.core.store import AlreadyExists, NotFound
+from kubedl_tpu.operator import ValidationError
 from kubedl_tpu.persist.backends import Query
 from kubedl_tpu.persist.dmo import row_to_dict, rows_to_dicts
 
@@ -314,7 +315,7 @@ class ConsoleServer:
             created = self.operator.submit(job)
         except AlreadyExists as e:
             raise ApiError(409, str(e)) from e
-        except ValueError as e:  # admission rejection (ValidationError)
+        except ValidationError as e:  # admission rejection
             raise ApiError(400, str(e)) from e
         return {"name": created.metadata.name, "namespace": created.metadata.namespace}
 

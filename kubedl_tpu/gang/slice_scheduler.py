@@ -119,8 +119,14 @@ def _gang_name(job: JobObject) -> str:
     return f"{job.metadata.name}-gang"
 
 
+def owner_key(namespace: str, name: str) -> str:
+    """Inventory holder key for a job's gang — the single place the
+    "<ns>/<name>-gang" convention lives (invariant checks reuse it)."""
+    return f"{namespace}/{name}-gang"
+
+
 def _owner_key(job: JobObject) -> str:
-    return f"{job.metadata.namespace}/{_gang_name(job)}"
+    return owner_key(job.metadata.namespace, job.metadata.name)
 
 
 class SliceGangScheduler(GangScheduler):

@@ -389,3 +389,66 @@ def test_node_local_storage_rejects_cross_node_build(tmp_path):
     assert isinstance(get_storage_provider(""), SharedDirProvider)
     with pytest.raises(StorageError):
         get_storage_provider("bogus")
+
+
+def test_invariants_hold_through_full_lifecycle(tmp_path):
+    """The consistency checker (control-plane sanitizer the reference
+    lacks, SURVEY.md §5 'no -race') finds nothing after a busy scenario:
+    gang contention + restart + success + deletion."""
+    from kubedl_tpu.api.topology import get_slice
+    from kubedl_tpu.gang.slice_scheduler import SliceInventory
+    from kubedl_tpu.utils.invariants import check_invariants
+
+    inv = SliceInventory()
+    inv.add_slice("s1", "v5e-8")
+    opts = OperatorOptions(
+        local_addresses=True,
+        pod_log_dir=str(tmp_path / "logs"),
+        artifact_registry_root=str(tmp_path / "reg"),
+    )
+    topo = get_slice("v5e-8")
+    with Operator(opts, runtime=SubprocessRuntime(str(tmp_path / "logs")),
+                  inventory=inv) as op:
+        marker = tmp_path / "flaky"
+        j1 = make_tpujob("busy1", workers=2, topology=topo, command=[
+            sys.executable, "-c",
+            f"import os,sys; m={str(marker)!r}; d=os.path.exists(m); "
+            "open(m,'w').write('x'); sys.exit(0 if d else 137)"])
+        j2 = make_tpujob("busy2", workers=2, topology=topo,
+                         command=[sys.executable, "-c", "print('ok')"])
+        op.submit(j1)
+        op.submit(j2)
+        for name in ("busy1", "busy2"):
+            got = op.wait_for_phase("TPUJob", name,
+                                    [JobConditionType.SUCCEEDED,
+                                     JobConditionType.FAILED], timeout=60)
+            assert got.status.phase == JobConditionType.SUCCEEDED
+        op.store.delete("TPUJob", "busy1")
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            violations = check_invariants(op)
+            if not violations:
+                break
+            time.sleep(0.5)  # GC pass may still be collecting
+        assert violations == [], violations
+
+
+def test_invariants_catch_planted_inconsistencies(tmp_path):
+    from kubedl_tpu.core.objects import OwnerRef, Pod
+    from kubedl_tpu.utils.invariants import check_invariants
+
+    opts = OperatorOptions(
+        local_addresses=True,
+        artifact_registry_root=str(tmp_path / "reg"),
+    )
+    from kubedl_tpu.runtime.executor import FakeRuntime
+
+    op = Operator(opts, runtime=FakeRuntime())
+    # plant: pod owned by a job that doesn't exist
+    p = Pod()
+    p.metadata.name = "ghost-pod"
+    p.metadata.owner_refs.append(
+        OwnerRef(kind="TPUJob", name="never-existed", uid="uid-x"))
+    op.store.create(p)
+    violations = check_invariants(op)
+    assert any(v.startswith("I1") for v in violations), violations

@@ -171,7 +171,7 @@ def test_persist_controllers_mirror_job_lifecycle(tmp_path):
     with Operator(opts, runtime=ThreadRuntime()) as op:
         job = make_tpujob("mirror", workers=2, entrypoint="tests.test_persist:_noop")
         op.submit(job)
-        op.wait_for_phase("TPUJob", "mirror", [JobConditionType.SUCCEEDED], timeout=60)
+        op.wait_for_phase("TPUJob", "mirror", [JobConditionType.SUCCEEDED], timeout=120)
 
         backend = op.object_backend
 
@@ -179,7 +179,7 @@ def test_persist_controllers_mirror_job_lifecycle(tmp_path):
             row = backend.get_job("default", "mirror", "TPUJob")
             return row is not None and row.phase == "Succeeded"
 
-        assert op.manager.wait(mirrored, timeout=30)
+        assert op.manager.wait(mirrored, timeout=60)
         row = backend.get_job("default", "mirror", "TPUJob")
         assert row.region == "test-region"
         assert row.finished_at is not None
@@ -198,7 +198,7 @@ def test_persist_controllers_mirror_job_lifecycle(tmp_path):
             r = backend.get_job("default", "mirror", "TPUJob")
             return r is not None and r.deleted and not r.is_in_etcd
 
-        assert op.manager.wait(soft_deleted, timeout=30)
+        assert op.manager.wait(soft_deleted, timeout=60)
 
 
 def _noop(env):
