@@ -56,6 +56,10 @@ class OperatorOptions:
     #: ModelVersion artifacts (storage_provider="local") must be built
     #: co-located with their node_name; "" disables the guard (single-host)
     node_name: str = ""
+    #: QPS probe for serving autoscale: callable(pod) -> float | None
+    #: (e.g. kubedl_tpu.serving.controller.http_qps_probe). None disables
+    #: load-driven scaling (autoscale min/max clamping still applies).
+    serving_qps_probe: Optional[object] = None
 
 
 class ValidationError(ValueError):
@@ -177,6 +181,7 @@ class Operator:
             self.manager.recorder,
             local_addresses=self.options.local_addresses,
             cluster_domain=self.options.cluster_domain,
+            qps_probe=self.options.serving_qps_probe,
         )
         self.serving.setup(self.manager)
 

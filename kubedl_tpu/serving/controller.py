@@ -44,8 +44,27 @@ HTTP_PORT = 8080
 GRPC_PORT = 9000
 
 
+def http_qps_probe(port: int = 8080, timeout: float = 2.0):
+    """Default QPS probe for real deployments: GET the engine's /v1/stats
+    on the pod's IP (falls back to loopback for process pods)."""
+    import json as _json
+    import urllib.request
+
+    def probe(pod) -> Optional[float]:
+        host = getattr(pod.status, "pod_ip", "") or "127.0.0.1"
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/v1/stats", timeout=timeout
+        ) as r:
+            return float(_json.loads(r.read()).get("qps", 0.0))
+
+    return probe
+
+
 class InferenceController:
     NAME = "inference-controller"
+
+    #: seconds between autoscale changes for one predictor (flap damping)
+    AUTOSCALE_COOLDOWN = 30.0
 
     def __init__(
         self,
@@ -53,11 +72,22 @@ class InferenceController:
         recorder: Optional[EventRecorder] = None,
         local_addresses: bool = False,
         cluster_domain: str = "",
+        qps_probe=None,
+        clock=None,
     ) -> None:
         self.store = store
         self.recorder = recorder or EventRecorder(store)
         self.local_addresses = local_addresses
         self.cluster_domain = cluster_domain
+        #: qps_probe(pod) -> Optional[float]: live QPS of one predictor
+        #: replica (the /v1/stats "qps" field). Transport is
+        #: deployment-specific, so it's injected; None disables
+        #: target_qps-driven scaling (min/max clamping still applies).
+        self.qps_probe = qps_probe
+        import time as _time
+
+        self.clock = clock or _time.time
+        self._last_scale: Dict[tuple, float] = {}
 
     def setup(self, manager: ControllerManager) -> None:
         manager.register(
@@ -86,6 +116,9 @@ class InferenceController:
     def reconcile(self, namespace: str, name: str) -> Optional[float]:
         inf = self.store.try_get("Inference", name, namespace)
         if inf is None:
+            for key in [k for k in self._last_scale
+                        if k[0] == namespace and k[1] == name]:
+                self._last_scale.pop(key, None)
             return None
         assert isinstance(inf, Inference)
 
@@ -101,6 +134,11 @@ class InferenceController:
         self._gc_removed_predictors(inf, pods)
         self._sync_traffic(inf, ready_weights)
         self._update_status(inf, statuses)
+        if self.qps_probe is not None and any(
+            p.autoscale is not None and p.autoscale.target_qps
+            for p in inf.predictors
+        ):
+            return 10.0  # autoscale needs a periodic signal sweep
         return None
 
     # ---------------------------------------------------------- services
@@ -161,10 +199,7 @@ class InferenceController:
             )
 
         self._sync_predictor_service(inf, pred)
-        replicas = pred.replicas
-        if pred.autoscale is not None:
-            replicas = min(max(replicas, pred.autoscale.min_replicas),
-                           pred.autoscale.max_replicas)
+        replicas = self._desired_replicas(inf, pred, pods)
         mine = [
             p for p in pods
             if p.metadata.labels.get(LABEL_PREDICTOR) == pred.name
@@ -188,6 +223,71 @@ class InferenceController:
         return PredictorStatus(
             replicas=replicas, ready_replicas=ready, image=mv.image
         )
+
+    def _desired_replicas(self, inf: Inference, pred: Predictor,
+                          pods: List[Pod]) -> int:
+        """Replica target: spec count, clamped to the autoscale window, and
+        — when a QPS probe is wired and target_qps is set — driven by the
+        live load (ceil(total_qps / target_qps)) with a scale-down
+        cooldown. The reference only STUBS autoScale in its API
+        (inference_types.go:96-104); here it closes the loop."""
+        import math
+
+        a = pred.autoscale
+        if a is None:
+            return pred.replicas
+        clamped = min(max(pred.replicas, a.min_replicas), a.max_replicas)
+        if self.qps_probe is None or not a.target_qps:
+            return clamped
+        mine_running = [
+            p for p in pods
+            if p.metadata.labels.get(LABEL_PREDICTOR) == pred.name
+            and p.status.phase == PodPhase.RUNNING
+        ]
+        prev = inf.predictor_statuses.get(pred.name)
+        current = prev.replicas if prev is not None and prev.replicas else clamped
+        if not mine_running:
+            return current
+        # probe all replicas CONCURRENTLY (reconcile shares a worker pool
+        # with every other controller; sequential 2s timeouts would starve
+        # it) and keep failures distinct from zero load
+        from concurrent.futures import ThreadPoolExecutor
+
+        def safe_probe(p):
+            try:
+                v = self.qps_probe(p)
+                return float(v) if v is not None else None
+            except Exception:
+                return None
+
+        with ThreadPoolExecutor(max_workers=min(8, len(mine_running))) as ex:
+            readings = list(ex.map(safe_probe, mine_running))
+        healthy = [v for v in readings if v is not None]
+        if not healthy:
+            return current  # no signal: never act blind
+        qps = sum(healthy)
+        desired = max(1, math.ceil(qps / a.target_qps))
+        desired = min(max(desired, a.min_replicas), a.max_replicas)
+        key = (inf.metadata.namespace, inf.metadata.name, pred.name)
+        now = self.clock()
+        if desired == current:
+            return current
+        if desired < current and len(healthy) < len(readings):
+            # HPA rule: missing metrics never justify a scale-DOWN — an
+            # overloaded replica that can't answer its probe is the worst
+            # moment to delete capacity
+            return current
+        if desired < current and (
+            now - self._last_scale.get(key, 0.0) < self.AUTOSCALE_COOLDOWN
+        ):
+            return current  # damp scale-down flapping
+        self._last_scale[key] = now
+        self.recorder.event(
+            inf, "Normal", "Autoscaled",
+            f"predictor {pred.name}: {current} -> {desired} replicas "
+            f"(qps {qps:.2f}, target {a.target_qps})",
+        )
+        return desired
 
     def _new_predictor_pod(
         self, inf: Inference, pred: Predictor, mv: ModelVersion, index: int
@@ -233,6 +333,10 @@ class InferenceController:
 
     def _gc_removed_predictors(self, inf: Inference, pods: List[Pod]) -> None:
         names = {p.name for p in inf.predictors}
+        for key in [k for k in self._last_scale
+                    if k[0] == inf.metadata.namespace
+                    and k[1] == inf.metadata.name and k[2] not in names]:
+            self._last_scale.pop(key, None)
         for pod in pods:
             pname = pod.metadata.labels.get(LABEL_PREDICTOR, "")
             if pname and pname not in names:

@@ -85,6 +85,14 @@ class LlamaEngine:
         self._cv = threading.Condition()
         self._stop = False
         self._rng = __import__("random").Random(0)
+        self._stats = {"requests": 0, "tokens_out": 0, "tokens_in": 0,
+                       "started_at": time.time()}
+        from collections import deque
+
+        #: completion timestamps for windowed QPS (autoscale signal must
+        #: track LIVE load, not a lifetime average)
+        self._recent: "deque[float]" = deque(maxlen=100_000)
+        self.qps_window_s = 60.0
         self._warmup()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="decode-scheduler"
@@ -120,7 +128,31 @@ class LlamaEngine:
             self._waiting.append(slot)
             self._cv.notify_all()
         slot.done.wait(timeout=600)
-        return slot.result or {"error": "timed out"}
+        result = slot.result or {"error": "timed out"}
+        with self._cv:
+            self._stats["requests"] += 1
+            self._stats["tokens_in"] += len(prompt)
+            self._stats["tokens_out"] += len(result.get("token_ids", []))
+            self._recent.append(time.time())
+        return result
+
+    def stats(self) -> Dict:
+        """Live serving counters (feeds autoscaling signals + /v1/stats)."""
+        with self._cv:
+            out = dict(self._stats)
+        now = time.time()
+        up = max(now - out["started_at"], 1e-9)
+        out["uptime_s"] = round(up, 1)
+        # windowed rate over min(window, uptime): a fresh engine under a
+        # burst reports the burst, a long-idle engine reports ~0
+        with self._cv:
+            recent = sum(1 for t in self._recent if t > now - self.qps_window_s)
+        span = min(self.qps_window_s, up)
+        out["qps"] = round(recent / max(span, 1e-9), 3)
+        out["lifetime_qps"] = round(out["requests"] / up, 3)
+        out["active_slots"] = sum(1 for s in self._slots if s is not None)
+        out["max_batch"] = self.max_batch
+        return out
 
     # -- scheduler loop ----------------------------------------------------
 
@@ -232,6 +264,8 @@ def make_handler(engine: LlamaEngine, model_name: str):
         def do_GET(self):
             if self.path == "/healthz":
                 self._json(200, {"status": "ok"})
+            elif self.path == "/v1/stats":
+                self._json(200, engine.stats())
             elif self.path == "/v1/models":
                 self._json(200, {
                     "models": [{

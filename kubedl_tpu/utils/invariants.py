@@ -54,9 +54,10 @@ def check_invariants(operator) -> List[str]:
                     f"owned by missing {ref.kind} {ref.name}"
                 )
 
-    # I2: unique replica indices per job
+    # I2: unique replica indices per job (one pod snapshot, reused by I5)
+    all_pods = store.list("Pod", None)
     seen = {}
-    for p in store.list("Pod", None):
+    for p in all_pods:
         labels = p.metadata.labels
         if LABEL_JOB_NAME not in labels or LABEL_REPLICA_TYPE not in labels:
             continue
@@ -108,8 +109,9 @@ def check_invariants(operator) -> List[str]:
                 )
         if phase == JobConditionType.QUEUED:
             pods = [
-                p for p in store.list("Pod", ns)
-                if p.metadata.labels.get(LABEL_JOB_NAME) == name
+                p for p in all_pods
+                if p.metadata.namespace == ns
+                and p.metadata.labels.get(LABEL_JOB_NAME) == name
                 and p.metadata.labels.get(LABEL_JOB_KIND) == kind
             ]
             if pods:

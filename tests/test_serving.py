@@ -308,3 +308,160 @@ class TestContinuousBatching:
             assert len(outs) > 1  # hot sampling is actually stochastic
         finally:
             eng.close()
+
+
+def test_stats_endpoint_counts_requests():
+    from kubedl_tpu.serving.server import LlamaEngine
+
+    eng = LlamaEngine(preset="tiny", max_batch=2, max_seq=64)
+    try:
+        eng.generate([1, 2], max_tokens=3)
+        eng.generate([3], max_tokens=2)
+        st = eng.stats()
+        assert st["requests"] == 2
+        assert st["tokens_out"] == 5
+        assert st["tokens_in"] == 3
+        assert st["qps"] > 0 and st["max_batch"] == 2
+    finally:
+        eng.close()
+
+
+class TestAutoscaler:
+    """Closed-loop QPS autoscaling (the reference only stubs autoScale in
+    its API; here the controller drives replicas from live load)."""
+
+    def _setup(self, qps_by_pod, clock):
+        from kubedl_tpu.core.objects import PodPhase
+        from kubedl_tpu.core.store import ObjectStore
+        from kubedl_tpu.lineage.types import ModelVersion, ModelVersionPhase
+        from kubedl_tpu.serving.controller import InferenceController
+        from kubedl_tpu.serving.types import AutoScaleSpec, Inference, Predictor
+
+        store = ObjectStore()
+        mv = ModelVersion(model_name="m", phase=ModelVersionPhase.SUCCEEDED,
+                          image="m:v1")
+        mv.metadata.name = "m-v1"
+        store.create(mv)
+
+        def probe(pod):
+            return qps_by_pod.get(pod.metadata.name, 0.0)
+
+        ctrl = InferenceController(store, local_addresses=True,
+                                   qps_probe=probe, clock=clock)
+        inf = Inference()
+        inf.metadata.name = "svc"
+        inf.predictors.append(Predictor(
+            name="main", model_version="m-v1", replicas=1,
+            autoscale=AutoScaleSpec(min_replicas=1, max_replicas=4,
+                                    target_qps=10.0),
+        ))
+        store.create(inf)
+
+        def run_pods():
+            for p in store.list("Pod"):
+                if p.status.phase != PodPhase.RUNNING:
+                    def mut(o):
+                        o.status.phase = PodPhase.RUNNING
+                    store.update_with_retry("Pod", p.metadata.name,
+                                            "default", mut)
+        return store, ctrl, run_pods
+
+    def test_scales_up_on_load_and_down_after_cooldown(self):
+        t = {"now": 1000.0}
+        qps = {}
+        store, ctrl, run_pods = self._setup(qps, clock=lambda: t["now"])
+        ctrl.reconcile("default", "svc")
+        run_pods()
+        ctrl.reconcile("default", "svc")
+        pods = [p.metadata.name for p in store.list("Pod")]
+        assert pods == ["svc-main-0"]
+        # load arrives: 35 qps against target 10 -> 4 replicas (max-capped)
+        qps["svc-main-0"] = 35.0
+        ctrl.reconcile("default", "svc")
+        assert len(store.list("Pod")) == 4
+        assert any(e.reason == "Autoscaled" for e in store.list("Event"))
+        run_pods()
+        # load drops immediately: cooldown holds the fleet steady...
+        qps["svc-main-0"] = 1.0
+        ctrl.reconcile("default", "svc")
+        assert len(store.list("Pod")) == 4
+        # ...until the cooldown window passes
+        t["now"] += 60.0
+        ctrl.reconcile("default", "svc")
+        assert len(store.list("Pod")) == 1
+
+    def test_no_probe_means_clamp_only(self):
+        store, ctrl, run_pods = self._setup({}, clock=lambda: 0.0)
+        ctrl.qps_probe = None
+        ctrl.reconcile("default", "svc")
+        assert len(store.list("Pod")) == 1  # min_replicas clamp, no scaling
+
+
+def test_windowed_qps_not_lifetime_average():
+    """r2 review: the autoscale signal must track LIVE load — a long-idle
+    engine then hit by a burst must report the burst, not ~0."""
+    import time as _time
+
+    from kubedl_tpu.serving.server import LlamaEngine
+
+    eng = LlamaEngine(preset="tiny", max_batch=2, max_seq=64)
+    try:
+        # simulate a long-idle engine (backdate start + use a small window)
+        eng._stats["started_at"] = _time.time() - 3600
+        eng.qps_window_s = 5.0
+        for _ in range(4):
+            eng.generate([1], max_tokens=1)
+        st = eng.stats()
+        assert st["qps"] >= 0.5, st  # burst visible in the window
+        assert st["lifetime_qps"] < 0.01, st  # the old signal would miss it
+    finally:
+        eng.close()
+
+
+def test_probe_failure_never_scales_down(tmp_path):
+    """r2 review: missing metrics must not justify deleting capacity."""
+    import math
+
+    from kubedl_tpu.core.objects import PodPhase
+    from kubedl_tpu.core.store import ObjectStore
+    from kubedl_tpu.lineage.types import ModelVersion, ModelVersionPhase
+    from kubedl_tpu.serving.controller import InferenceController
+    from kubedl_tpu.serving.types import AutoScaleSpec, Inference, Predictor
+
+    store = ObjectStore()
+    mv = ModelVersion(model_name="m", phase=ModelVersionPhase.SUCCEEDED)
+    mv.metadata.name = "m-v1"
+    store.create(mv)
+    qps = {"value": 40.0, "fail": False}
+
+    def probe(pod):
+        if qps["fail"]:
+            raise TimeoutError("probe timeout")
+        return qps["value"]
+
+    t = {"now": 0.0}
+    ctrl = InferenceController(store, local_addresses=True, qps_probe=probe,
+                               clock=lambda: t["now"])
+    inf = Inference()
+    inf.metadata.name = "svc2"
+    inf.predictors.append(Predictor(
+        name="main", model_version="m-v1", replicas=1,
+        autoscale=AutoScaleSpec(min_replicas=1, max_replicas=4,
+                                target_qps=10.0)))
+    store.create(inf)
+    ctrl.reconcile("default", "svc2")
+    for p in store.list("Pod"):
+        def mut(o):
+            o.status.phase = PodPhase.RUNNING
+        store.update_with_retry("Pod", p.metadata.name, "default", mut)
+    ctrl.reconcile("default", "svc2")  # scales to 4 on load
+    for p in store.list("Pod"):
+        def mut(o):
+            o.status.phase = PodPhase.RUNNING
+        store.update_with_retry("Pod", p.metadata.name, "default", mut)
+    assert len(store.list("Pod")) == 4
+    # probes start failing under overload: fleet must HOLD, not shrink
+    qps["fail"] = True
+    t["now"] += 120.0
+    ctrl.reconcile("default", "svc2")
+    assert len(store.list("Pod")) == 4
