@@ -149,3 +149,73 @@ class TestExamples:
             assert job.kind in op.engines, path
             op.submit(job)  # store-level create must accept it
         assert {"TPUJob", "TFJob", "PyTorchJob", "MPIJob", "XGBoostJob"} <= kinds
+
+
+class TestCodecRoundTripAllKinds:
+    def test_randomized_specs_round_trip_every_kind(self):
+        """Property-style: randomized-but-valid specs for every registered
+        kind survive encode -> YAML -> decode -> encode identically (the
+        codec is the wire format for console, client SDK, cron templates
+        and examples — drift corrupts all four)."""
+        import random
+
+        import yaml as _yaml
+
+        from kubedl_tpu.api import codec
+        from kubedl_tpu.api.types import (
+            CleanPodPolicy, ReplicaSpec, ReplicaType, RestartPolicy,
+            SuccessPolicy,
+        )
+        from kubedl_tpu.core.objects import Container, EnvVar
+        from kubedl_tpu.workloads.registry import WORKLOAD_REGISTRY
+
+        rng = random.Random(7)
+        kind_types = {
+            "TPUJob": [ReplicaType.WORKER],
+            "TFJob": [ReplicaType.PS, ReplicaType.WORKER, ReplicaType.CHIEF],
+            "PyTorchJob": [ReplicaType.MASTER, ReplicaType.WORKER],
+            "XDLJob": [ReplicaType.SCHEDULER, ReplicaType.PS, ReplicaType.WORKER],
+            "XGBoostJob": [ReplicaType.MASTER, ReplicaType.WORKER],
+            "MarsJob": [ReplicaType.SCHEDULER, ReplicaType.WORKER],
+            "ElasticDLJob": [ReplicaType.MASTER],
+            "MPIJob": [ReplicaType.LAUNCHER, ReplicaType.WORKER],
+        }
+        for kind, factory in sorted(WORKLOAD_REGISTRY.items()):
+            for trial in range(5):
+                controller = factory(local_addresses=True)
+                job = controller.object_factory()
+                job.metadata.name = f"rt-{kind.lower()}-{trial}"
+                job.metadata.labels = {"team": f"t{rng.randrange(9)}"}
+                job.metadata.annotations = {
+                    "kubedl-tpu.io/owner": f"u{rng.randrange(9)}"
+                }
+                for rtype in kind_types.get(kind, [ReplicaType.WORKER]):
+                    if rng.random() < 0.3 and rtype != ReplicaType.MASTER:
+                        continue
+                    spec = ReplicaSpec(
+                        replicas=rng.randrange(1, 5),
+                        restart_policy=rng.choice(list(RestartPolicy)),
+                    )
+                    spec.template.spec.containers.append(Container(
+                        command=["python", "-c", f"print({trial})"],
+                        env=[EnvVar(f"K{i}", str(rng.random()))
+                             for i in range(rng.randrange(3))],
+                    ))
+                    job.spec.replica_specs[rtype] = spec
+                if not job.spec.replica_specs:
+                    default_rt = kind_types.get(kind, [ReplicaType.WORKER])[0]
+                    job.spec.replica_specs[default_rt] = ReplicaSpec(replicas=1)
+                job.spec.run_policy.clean_pod_policy = rng.choice(
+                    list(CleanPodPolicy))
+                job.spec.run_policy.backoff_limit = rng.randrange(0, 4)
+                job.spec.success_policy = rng.choice(list(SuccessPolicy))
+
+                doc1 = codec.encode(job)
+                yml = _yaml.safe_dump(doc1)
+                decoded = codec.decode_object(_yaml.safe_load(yml))
+                doc2 = codec.encode(decoded)
+                assert doc1 == doc2, (kind, trial)
+                assert decoded.kind == kind
+                assert decoded.spec.run_policy.backoff_limit == (
+                    job.spec.run_policy.backoff_limit
+                )

@@ -452,3 +452,72 @@ def test_invariants_catch_planted_inconsistencies(tmp_path):
     op.store.create(p)
     violations = check_invariants(op)
     assert any(v.startswith("I1") for v in violations), violations
+
+
+TORCH_DDP = (
+    "import os, sys\n"
+    f"sys.path.insert(0, {REPO_ROOT!r})\n"
+    "import torch\n"
+    "import torch.distributed as dist\n"
+    "rank = int(os.environ['RANK']); world = int(os.environ['WORLD_SIZE'])\n"
+    "dist.init_process_group('gloo', init_method='env://',\n"
+    "                        rank=rank, world_size=world)\n"
+    "model = torch.nn.Linear(4, 1)\n"
+    "for p in model.parameters():\n"
+    "    dist.broadcast(p.data, src=0)\n"
+    "opt = torch.optim.SGD(model.parameters(), lr=0.1)\n"
+    "torch.manual_seed(rank)\n"
+    "for _ in range(3):\n"
+    "    x = torch.randn(8, 4); y = x.sum(dim=1, keepdim=True)\n"
+    "    loss = ((model(x) - y) ** 2).mean()\n"
+    "    opt.zero_grad(); loss.backward()\n"
+    "    for p in model.parameters():\n"
+    "        dist.all_reduce(p.grad); p.grad /= world\n"
+    "    opt.step()\n"
+    "flat = torch.cat([p.data.flatten() for p in model.parameters()])\n"
+    "gathered = [torch.zeros_like(flat) for _ in range(world)]\n"
+    "dist.all_gather(gathered, flat)\n"
+    "assert all(torch.allclose(g, flat) for g in gathered), 'replicas diverged'\n"
+    "print('ddp-ok rank', rank)\n"
+    "dist.destroy_process_group()\n"
+)
+
+
+def test_pytorchjob_runs_real_torch_ddp(tmp_path):
+    """BASELINE.md target 2 wiring proven with REAL torch.distributed:
+    the operator-injected MASTER_ADDR/PORT/RANK/WORLD_SIZE drives a gloo
+    process group across master + workers; allreduce keeps replicas in
+    lockstep (asserted in-job via all_gather)."""
+    from kubedl_tpu.api.types import ReplicaSpec, RestartPolicy
+    from kubedl_tpu.core.objects import Container
+    from kubedl_tpu.workloads.pytorchjob import PyTorchJob
+
+    script = tmp_path / "ddp.py"
+    script.write_text(TORCH_DDP)
+    opts = OperatorOptions(
+        local_addresses=True,
+        pod_log_dir=str(tmp_path / "logs"),
+        artifact_registry_root=str(tmp_path / "reg"),
+    )
+    with Operator(opts, runtime=SubprocessRuntime(str(tmp_path / "logs"))) as op:
+        job = PyTorchJob()
+        job.metadata.name = "ddp"
+        for rtype, n in ((ReplicaType.MASTER, 1), (ReplicaType.WORKER, 2)):
+            spec = ReplicaSpec(replicas=n, restart_policy=RestartPolicy.ON_FAILURE)
+            spec.template.spec.containers.append(
+                Container(command=[sys.executable, str(script)])
+            )
+            job.spec.replica_specs[rtype] = spec
+        op.submit(job)
+        got = op.wait_for_phase(
+            "PyTorchJob", "ddp",
+            [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+            timeout=120,
+        )
+        assert got.status.phase == JobConditionType.SUCCEEDED, [
+            c.message for c in got.status.conditions
+        ]
+    logs = tmp_path / "logs" / "default"
+    merged = "".join(p.read_text() for p in logs.glob("ddp-*.log"))
+    for rank in (0, 1, 2):
+        assert f"ddp-ok rank {rank}" in merged, merged[-2000:]
