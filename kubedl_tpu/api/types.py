@@ -95,6 +95,9 @@ class JobConditionType(str, enum.Enum):
     QUEUED = "Queued"  # TPU addition: gang admitted, waiting for slice
     RUNNING = "Running"
     RESTARTING = "Restarting"
+    #: TPU addition (kueue-style): pods torn down, slices FREED, progress
+    #: kept via checkpoints; unsuspending re-admits and resumes
+    SUSPENDED = "Suspended"
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
 
@@ -145,6 +148,10 @@ class RunPolicy:
     active_deadline_seconds: Optional[float] = None
     backoff_limit: Optional[int] = None
     scheduling_policy: SchedulingPolicy = field(default_factory=SchedulingPolicy)
+    #: Suspend execution (kueue-style, net-new vs reference): pods are torn
+    #: down and the gang's SLICES ARE RELEASED for other jobs; flipping
+    #: back re-admits and training resumes from the latest checkpoint.
+    suspend: bool = False
 
 
 @dataclass

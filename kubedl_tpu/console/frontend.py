@@ -48,7 +48,7 @@ INDEX_HTML = """<!doctype html>
   .phase.Running,.phase.ImageBuilding { background:#e3f2e8; color:#1c7a3d; }
   .phase.Succeeded { background:#e5ecfb; color:#2c4ea0; }
   .phase.Failed { background:#fbe5e5; color:#a02c2c; }
-  .phase.Created,.phase.Queued,.phase.Pending { background:#f4f4f6; color:#555; }
+  .phase.Created,.phase.Queued,.phase.Pending,.phase.Suspended { background:#f4f4f6; color:#555; }
   button { border:1px solid var(--line); background:#fff; border-radius:6px;
            padding:3px 10px; cursor:pointer; }
   button:hover { border-color:var(--accent); color:var(--accent); }
@@ -95,7 +95,7 @@ const esc = s => String(s ?? '').replace(/[&<>"']/g,
 const $ = id => document.getElementById(id);
 const fmt = ts => ts ? new Date(ts * 1000).toLocaleString() : '';
 const PHASES = ['Created','Queued','Running','Succeeded','Failed',
-                'Pending','ImageBuilding'];
+                'Pending','ImageBuilding','Suspended'];
 const phaseTag = p => `<span class="phase ${PHASES.includes(p) ? p : ''}">${esc(p)}</span>`;
 
 async function api(p, opts) {

@@ -164,6 +164,45 @@ class JobEngine:
         if status.is_terminal():
             return self._finalize(job, ctx)
 
+        # --- suspend (kueue-style; net-new vs reference) ------------------
+        # Suspended jobs tear everything down and RELEASE their slices so
+        # other jobs can borrow the capacity; progress survives in
+        # checkpoints and the resume path is the ordinary gang re-admission.
+        if job.spec.run_policy.suspend:
+            changed = False
+            if status.phase != JobConditionType.SUSPENDED:
+                status.set_condition(
+                    JobConditionType.SUSPENDED, "JobSuspended",
+                    "suspended by spec; slices released, resume restores "
+                    "from the latest checkpoint",
+                )
+                # suspended wall-clock must not count against
+                # activeDeadlineSeconds (kueue resets startTime the same
+                # way); RUNNING re-stamps it on resume
+                status.start_time = None
+                status.replica_statuses = {}  # no phantom active replicas
+                self.recorder.event(
+                    job, "Normal", "Suspended", "pods torn down, slices freed"
+                )
+                changed = True
+            if ctx.pods:
+                self._delete_pods(job, ctx.pods, CleanPodPolicy.ALL)
+                ctx.pods = []
+                changed = True
+            if self.gang is not None and self.gang.get_gang(job) is not None:
+                self.gang.delete_gang(job)
+            if changed:  # unguarded writes would hot-loop via MODIFIED events
+                self._update_status(job)
+            return None  # nothing to poll; unsuspend events requeue us
+        if status.phase == JobConditionType.SUSPENDED:
+            # spec flipped back: leave the suspended state and fall through
+            # to ordinary admission (a fresh gang at current spec shape)
+            status.set_condition(
+                JobConditionType.CREATED, "JobResumed",
+                "unsuspended; re-admitting",
+            )
+            self.recorder.event(job, "Normal", "Resumed", "re-admitting gang")
+
         # --- gang admission (atomic slice acquisition) --------------------
         if self.gang is not None and self.features.enabled(GANG_SCHEDULING):
             gang = self.gang.create_gang(job)

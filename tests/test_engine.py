@@ -555,3 +555,90 @@ def test_tensorboard_sidecar_not_released(tmp_path):
     tb_pod = store.get("Pod", "tbjob-tensorboard")
     assert tb_pod.metadata.controller_ref() is not None
     assert not any(e.reason == "Released" for e in store.list("Event"))
+
+
+class TestSuspendResume:
+    """Kueue-style suspend (net-new vs reference): suspending frees the
+    slices for other jobs; resume re-admits with stable binding."""
+
+    def test_suspend_frees_slice_resume_readmits(self):
+        from kubedl_tpu.api.topology import get_slice
+
+        inventory = SliceInventory()
+        inventory.add_slice("s1", "v5e-8")
+        engine, store, _ = make_engine(inventory=inventory)
+        job = make_tpujob("sus", workers=2, topology=get_slice("v5e-8"))
+        submit_and_reconcile(engine, store, job)
+        assert len(pod_names(store)) == 2
+        before = {p.metadata.name: p.spec.node_name for p in store.list("Pod")}
+
+        def suspend(j):
+            j.spec.run_policy.suspend = True
+
+        store.update_with_retry("TPUJob", "sus", "default", suspend)
+        engine.reconcile("default", "sus")
+        got = store.get("TPUJob", "sus")
+        assert got.status.phase == JobConditionType.SUSPENDED
+        assert pod_names(store) == []
+        assert inventory.describe()["s1"] == "<free>"  # capacity released
+
+        # another job borrows the freed slice
+        other = make_tpujob("borrower", workers=2, topology=get_slice("v5e-8"))
+        submit_and_reconcile(engine, store, other)
+        assert any("borrower" in n for n in pod_names(store))
+        driver = PodDriver(store)
+        driver.succeed("borrower-worker-0")
+        driver.succeed("borrower-worker-1")
+        engine.reconcile("default", "borrower")
+
+        # resume: ordinary re-admission, binding identical to before
+        def resume(j):
+            j.spec.run_policy.suspend = False
+
+        store.update_with_retry("TPUJob", "sus", "default", resume)
+        engine.reconcile("default", "sus")
+        engine.reconcile("default", "sus")
+        after = {p.metadata.name: p.spec.node_name
+                 for p in store.list("Pod")
+                 if "sus-" in p.metadata.name}
+        assert after == before  # deterministic host binding survives
+        evs = {e.reason for e in store.list("Event")}
+        assert {"Suspended", "Resumed"} <= evs
+
+    def test_suspended_job_stays_down(self):
+        engine, store, _ = make_engine(gang=False)
+        job = make_tpujob("sus2", workers=1, command=["x"])
+        job.spec.run_policy.suspend = True  # born suspended
+        submit_and_reconcile(engine, store, job, times=2)
+        got = store.get("TPUJob", "sus2")
+        assert got.status.phase == JobConditionType.SUSPENDED
+        assert pod_names(store) == []
+
+
+def test_suspend_is_idempotent_and_clears_status():
+    """r2 review: re-reconciling a suspended job must not rewrite status
+    (MODIFIED-event hot loop), must clear replica counts, and must reset
+    start_time so activeDeadlineSeconds ignores suspended wall-clock."""
+    engine, store, _ = make_engine(gang=False)
+    job = make_tpujob("susq", workers=1, command=["x"])
+    job.spec.run_policy.active_deadline_seconds = 3600
+    submit_and_reconcile(engine, store, job)
+    driver = PodDriver(store)
+    driver.run("susq-worker-0")
+    engine.reconcile("default", "susq")
+    assert store.get("TPUJob", "susq").status.start_time is not None
+
+    def suspend(j):
+        j.spec.run_policy.suspend = True
+
+    store.update_with_retry("TPUJob", "susq", "default", suspend)
+    engine.reconcile("default", "susq")
+    got = store.get("TPUJob", "susq")
+    assert got.status.phase == JobConditionType.SUSPENDED
+    assert got.status.start_time is None  # deadline clock rebased
+    assert got.status.replica_statuses == {}  # no phantom replicas
+    rv = got.metadata.resource_version
+    # steady state: further reconciles write NOTHING
+    for _ in range(3):
+        engine.reconcile("default", "susq")
+    assert store.get("TPUJob", "susq").metadata.resource_version == rv
