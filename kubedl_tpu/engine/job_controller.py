@@ -91,6 +91,7 @@ class JobEngine:
         metrics: Optional[JobMetrics] = None,
         features: Optional[FeatureGates] = None,
         cluster_domain: str = "",
+        compile_cache_dir: str = "",
     ) -> None:
         self.store = store
         self.controller = controller
@@ -99,6 +100,7 @@ class JobEngine:
         self.metrics = metrics or DEFAULT_JOB_METRICS
         self.features = features or DEFAULT_GATES
         self.cluster_domain = cluster_domain
+        self.compile_cache_dir = compile_cache_dir
         self.expectations = ControllerExpectations()
         # per-job TensorBoard lifecycle (reference: tfjob_controller.go:171-177
         # calls ReconcileTensorBoard each pass; generic here — any kind may
@@ -654,6 +656,16 @@ class JobEngine:
             provider.provision(root)
             main.set_env(constants.ENV_MODEL_PATH, root)
             provider.add_model_volume(pod, root)
+
+        # persistent compile cache: restarted/resized/resumed replicas must
+        # deserialize compiled XLA programs, not re-pay first-step compile
+        # (round-2 startup regression). User-set env wins.
+        if self.compile_cache_dir:
+            main = pod.spec.main_container()
+            if main.get_env(constants.ENV_COMPILE_CACHE_DIR) is None:
+                main.set_env(
+                    constants.ENV_COMPILE_CACHE_DIR, self.compile_cache_dir
+                )
 
         # gang binding: placement computed at admission
         placement = ctx.placements.get(f"{rtype.value}-{index}", "")

@@ -10,6 +10,8 @@ leader election across replicas).
 from __future__ import annotations
 
 import logging
+import os
+import tempfile
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -60,6 +62,15 @@ class OperatorOptions:
     #: (e.g. kubedl_tpu.serving.controller.http_qps_probe). None disables
     #: load-driven scaling (autoscale min/max clamping still applies).
     serving_qps_probe: Optional[object] = None
+    #: persistent XLA compilation-cache dir injected into every training/
+    #: serving pod (KUBEDL_COMPILE_CACHE_DIR) so gang restarts, resizes,
+    #: and resumes deserialize compiled programs instead of re-lowering
+    #: them (round-2 startup regression, VERDICT.md). Default is per-user
+    #: (a fixed world-writable path would let another user poison the
+    #: serialized executables). "" disables.
+    compile_cache_dir: str = field(default_factory=lambda: os.path.join(
+        tempfile.gettempdir(), f"kubedl-tpu-compile-cache-{os.getuid()}"
+    ))
 
 
 class ValidationError(ValueError):
@@ -111,6 +122,7 @@ class Operator:
                 metrics=self.metrics,
                 features=self.features,
                 cluster_domain=self.options.cluster_domain,
+                compile_cache_dir=self.options.compile_cache_dir,
             )
             self.engines[kind] = engine
             self.controllers[kind] = controller
@@ -182,6 +194,7 @@ class Operator:
             local_addresses=self.options.local_addresses,
             cluster_domain=self.options.cluster_domain,
             qps_probe=self.options.serving_qps_probe,
+            compile_cache_dir=self.options.compile_cache_dir,
         )
         self.serving.setup(self.manager)
 

@@ -365,8 +365,6 @@ def flash_attention(
         from kubedl_tpu.models.llama import attention
 
         return attention(q, k, v, causal=causal, mask=mask)
-    global TRACE_COUNT
-    TRACE_COUNT += 1
     if interpret is None:
         interpret = _default_interpret()
     qt = q.transpose(0, 2, 1, 3)  # [B, H, S, hd]
@@ -383,6 +381,10 @@ def flash_attention(
         from kubedl_tpu.models.llama import attention
 
         return attention(q, k, v, causal=causal)
+    # counted only on the actual kernel path — a dense-oracle fallback must
+    # not satisfy the bench's "pallas kernel really traced" gate
+    global TRACE_COUNT
+    TRACE_COUNT += 1
     out = _flash(qt, kt, vt, causal, bq, bk, bwd_q, bwd_k, interpret)
     return out.transpose(0, 2, 1, 3)
 

@@ -74,11 +74,15 @@ class InferenceController:
         cluster_domain: str = "",
         qps_probe=None,
         clock=None,
+        compile_cache_dir: str = "",
     ) -> None:
         self.store = store
         self.recorder = recorder or EventRecorder(store)
         self.local_addresses = local_addresses
         self.cluster_domain = cluster_domain
+        #: injected into predictor pods so replica scale-ups / restarts
+        #: deserialize the decode/prefill programs instead of recompiling
+        self.compile_cache_dir = compile_cache_dir
         #: qps_probe(pod) -> Optional[float]: live QPS of one predictor
         #: replica (the /v1/stats "qps" field). Transport is
         #: deployment-specific, so it's injected; None disables
@@ -304,6 +308,12 @@ class InferenceController:
         }
         pod.metadata.owner_refs.append(self._owner(inf))
         apply_setter(inf, pred, pod, mv, HTTP_PORT)
+        if self.compile_cache_dir:
+            main = pod.spec.main_container()
+            if main.get_env(constants.ENV_COMPILE_CACHE_DIR) is None:
+                main.set_env(
+                    constants.ENV_COMPILE_CACHE_DIR, self.compile_cache_dir
+                )
         return pod
 
     def _sync_predictor_service(self, inf: Inference, pred: Predictor) -> None:

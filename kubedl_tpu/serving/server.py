@@ -303,6 +303,9 @@ def serve_main(env: Optional[Dict[str, str]] = None) -> int:
     from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested
 
     ensure_cpu_if_requested()
+    from kubedl_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
 
     cfg = json.loads(os.environ.get("KUBEDL_SERVE_CONFIG", "{}"))
     ckpt = os.environ.get("KUBEDL_MODEL_PATH", "")

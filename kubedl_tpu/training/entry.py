@@ -41,6 +41,11 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
     from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested
 
     ensure_cpu_if_requested()
+    from kubedl_tpu.utils.compile_cache import enable_compilation_cache
+
+    # before the first trace: a gang restart / resize / resume re-enters
+    # here and must deserialize, not recompile, the unchanged train step
+    enable_compilation_cache()
     import jax
 
     from kubedl_tpu.api import constants

@@ -466,11 +466,20 @@ class Trainer:
             "model_family": self.family.name,
             "n_params": self.family.num_params,
         }
+        # cross-process gate data: bench workers may run as subprocesses,
+        # so the "pallas kernel really traced" proof rides the summary
+        from kubedl_tpu.ops import flash_attention_module as _fa
+
+        summary["flash_trace_count"] = _fa.TRACE_COUNT
         summary["sanity_violations"] = self.sanity_check(summary)
-        if ckpt_dir:
+        if ckpt_dir and losses:
+            # label with the state's REAL counter, not the `steps` budget: a
+            # restored state that had nothing left to train must not write a
+            # mislabeled dir that misorders restore-from-newest (and when no
+            # steps ran there is nothing new to save at all)
             from kubedl_tpu.training.checkpoint import save_checkpoint
 
-            save_checkpoint(ckpt_dir, state, steps)
+            save_checkpoint(ckpt_dir, state, int(jax.device_get(state["step"])))
         return state, summary
 
     def _mfu(self, tokens_per_sec: float, n_chips: int) -> float:
