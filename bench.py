@@ -121,12 +121,18 @@ def bench_serving(on_tpu: bool) -> dict:
         dt = (time.perf_counter() - t0) / steps
         out[f"decode_ms_per_token_b{B}"] = round(dt * 1e3, 3)
         out[f"decode_tokens_per_sec_b{B}"] = round(B / dt, 1)
-    # time-to-first-token: 64-token prompt through the same step
+    # time-to-first-token: 64-token prompt via batched prefill (ONE
+    # forward fills the cache and yields the first token's logits —
+    # round 2 paid 64 sequential decode steps here: 633ms on v5e)
+    prefill = jax.jit(lambda p, c, t, l: llama.prefill_batched(p, c, t, l, cfg))
     cache = llama.init_batched_cache(cfg, 1, max_seq)
-    toks = jnp.ones((1, 1), jnp.int32)
+    toks = jnp.ones((1, 64), jnp.int32)
+    lens = jnp.full((1,), 64, jnp.int32)
+    logits, cache = prefill(params, cache, toks, lens)  # compile
+    float(jax.device_get(jnp.sum(logits)))
+    cache = llama.init_batched_cache(cfg, 1, max_seq)
     t0 = time.perf_counter()
-    for _ in range(64):
-        logits, cache = decode(params, cache, toks)
+    logits, cache = prefill(params, cache, toks, lens)
     float(jax.device_get(jnp.sum(logits)))
     out["ttft_64_prompt_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
     return out

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import logging
 import random
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -107,6 +108,8 @@ class JobEngine:
         # carry the annotation)
         self.tensorboard = TensorBoardReconciler(store, cluster_domain)
         self._rng = random.Random(0xC0FFEE)
+        self._port_lock = threading.Lock()
+        self._port_inflight: Dict[Tuple[str, int], float] = {}
         # informer-style expectation observers (reference: pod/service event
         # filters feeding expectations, pod.go:55-165, service.go:41-139)
         store.watch(self._observe_owned, kinds=("Pod", "Service"))
@@ -577,30 +580,57 @@ class JobEngine:
     def _owner_ref(self, job: JobObject) -> OwnerRef:
         return OwnerRef(kind=job.kind, name=job.metadata.name, uid=job.metadata.uid)
 
+    #: in-flight host-port reservations shared by all reconcile workers of
+    #: this engine: (node, port) -> reservation time. Two concurrent
+    #: workers placing pods on one node must not draw the same port in the
+    #: window before the first pod lands in the store (ADVICE r2 #4).
+    _INFLIGHT_TTL = 60.0
+
+    def _port_conflicts(self, node: str, other_node: str) -> bool:
+        """An unpinned ("") pod can land on ANY node, so it conflicts with
+        every allocation — and every allocation conflicts with it."""
+        return node == "" or other_node == "" or node == other_node
+
     def _alloc_host_port(self, node: str) -> int:
         """Random host port avoiding ports already claimed by host-network
-        pods on the same node (the reference draws blind from [30001,65535)
-        and can collide, pod.go:470-486 — here allocation consults live
-        state; "" node = the unpinned pool)."""
-        in_use = set()
-        for p in self.store.list("Pod", None):
-            if not getattr(p.spec, "host_network", False):
-                continue
-            if (p.spec.node_name or "") != node:
-                continue
-            for c in p.spec.containers:
-                for port in c.ports:
-                    if port.host_port:
-                        in_use.add(port.host_port)
-        lo, hi = constants.HOST_PORT_RANGE
-        for _ in range(128):
-            hp = self._rng.randrange(lo, hi)
-            if hp not in in_use:
-                return hp
-        for hp in range(lo, hi):  # dense node: deterministic sweep
-            if hp not in in_use:
-                return hp
-        raise RuntimeError(f"no free host ports on node {node!r}")
+        pods that could share a node (the reference draws blind from
+        [30001,65535) and can collide, pod.go:470-486 — here allocation
+        consults live state + in-flight reservations under a lock)."""
+        with self._port_lock:
+            now = time.time()
+            self._port_inflight = {
+                k: t for k, t in self._port_inflight.items()
+                if now - t < self._INFLIGHT_TTL
+            }
+            in_use = set()
+            for p in self.store.list("Pod", None):
+                if not getattr(p.spec, "host_network", False):
+                    continue
+                if not self._port_conflicts(node, p.spec.node_name or ""):
+                    continue
+                for c in p.spec.containers:
+                    for port in c.ports:
+                        if port.host_port:
+                            in_use.add(port.host_port)
+            for (n, hp), _t in self._port_inflight.items():
+                if self._port_conflicts(node, n):
+                    in_use.add(hp)
+            lo, hi = constants.HOST_PORT_RANGE
+            chosen = None
+            for _ in range(128):
+                hp = self._rng.randrange(lo, hi)
+                if hp not in in_use:
+                    chosen = hp
+                    break
+            if chosen is None:
+                for hp in range(lo, hi):  # dense node: deterministic sweep
+                    if hp not in in_use:
+                        chosen = hp
+                        break
+            if chosen is None:
+                raise RuntimeError(f"no free host ports on node {node!r}")
+            self._port_inflight[(node, chosen)] = now
+            return chosen
 
     def _default_port(self, spec: ReplicaSpec) -> int:
         main = spec.template.spec.main_container()

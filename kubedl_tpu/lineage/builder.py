@@ -62,6 +62,14 @@ class LocalBundleBuilder:
         if not src.is_dir():
             raise BuildError(f"model output dir {source_dir!r} does not exist")
         dest = self.registry.path(repo, tag)
+        # a registry nested inside the model dir would make copytree copy
+        # the tree into its own subtree — unbounded recursion, found by a
+        # drive whose storage_root contained artifact_registry_root
+        if dest.resolve().is_relative_to(src.resolve()):
+            raise BuildError(
+                f"artifact registry {dest} lies inside model dir {src}; "
+                "use a registry root outside the model storage root"
+            )
         payload = dest / "model"
         if payload.exists():
             shutil.rmtree(payload)

@@ -190,28 +190,54 @@ class Trainer:
 
     def _pipe_pspecs(self, pspecs):
         """Pipeline mode: stacked-layer leaves shard their leading (layer)
-        axis over "pipe"; in-stage weight sharding over fsdp/tensor is not
-        composed with the shard_map pipeline (the stage body is local), so
-        those axes are stripped from layer leaves."""
+        axis over "pipe". "tensor" and "expert" axes are KEPT on the inner
+        dims — the stage body issues the megatron/expert collectives itself
+        (llama._block / moe.moe_ffn under shard_map) — while fsdp/sp are
+        stripped (in-stage fsdp all-gathers are not composed with GPipe;
+        sp needs ring attention across the stage boundary)."""
         lk = self.family.layers_key
         if lk is None:
             raise ValueError(
                 f"model family {self.family.name!r} does not support a pipe axis"
             )
-        for ax in ("tensor", "sp", "expert"):
-            if meshlib.axis_size(self.mesh, ax) > 1:
-                raise ValueError(
-                    f"pipe axis cannot be combined with a >1 {ax!r} axis "
-                    "(the GPipe shard_map stage body is device-local); use "
-                    "pipe x data/fsdp meshes"
-                )
+        if meshlib.axis_size(self.mesh, "sp") > 1:
+            raise ValueError(
+                "pipe axis cannot be combined with a >1 'sp' axis (ring "
+                "attention does not cross the GPipe stage boundary); use "
+                "pipe x data/fsdp/tensor/expert meshes"
+            )
+        self._validate_pipe_divisibility()
+
+        def inner(axis):
+            return axis if axis in ("tensor", "expert") else None
+
         out = dict(pspecs)
         out[lk] = jax.tree_util.tree_map(
-            lambda s: P("pipe", *([None] * (len(s) - 1))),
+            lambda s: P("pipe", *(inner(a) for a in list(s)[1:])),
             pspecs[lk],
             is_leaf=lambda x: isinstance(x, P),
         )
         return out
+
+    def _validate_pipe_divisibility(self) -> None:
+        """Fail loudly at build time when the mesh can't split the model:
+        a shape mismatch inside shard_map is far harder to read."""
+        mcfg = self.cfg.model
+        tp = meshlib.axis_size(self.mesh, "tensor")
+        ep = meshlib.axis_size(self.mesh, "expert")
+        pipe = self.pipe_size
+        n_layers = getattr(mcfg, "n_layers", None)
+        if n_layers is not None and n_layers % pipe:
+            raise ValueError(f"n_layers={n_layers} not divisible by pipe={pipe}")
+        if tp > 1:
+            for attr in ("n_heads", "n_kv_heads", "ffn_dim"):
+                val = getattr(mcfg, attr, None)
+                if val is not None and val % tp:
+                    raise ValueError(f"{attr}={val} not divisible by tensor={tp}")
+        if ep > 1:
+            ne = getattr(mcfg, "n_experts", None)
+            if ne is not None and ne % ep:
+                raise ValueError(f"n_experts={ne} not divisible by expert={ep}")
 
     # ------------------------------------------------------------------
 
@@ -317,14 +343,21 @@ class Trainer:
 
     def _make_pipeline_loss(self, attn_fn):
         """GPipe loss: embed (replicated over pipe), microbatched layer
-        stack through the stage ring, head + NLL on the ring's output."""
-        from kubedl_tpu.models import llama as llama_mod
+        stack through the stage ring, head + NLL on the ring's output.
+        Family-agnostic via `PipelineHooks` (llama + MoE); tensor/expert
+        axes compose INSIDE the stage body (collectives issued there)."""
         from kubedl_tpu.parallel.pipeline import make_pipeline
 
         cfg = self.cfg
         mcfg = cfg.model
-        if not isinstance(mcfg, llama_mod.LlamaConfig):
-            raise ValueError("pipeline mode currently drives the Llama family")
+        import importlib
+
+        model_mod = importlib.import_module(type(mcfg).__module__)
+        if not hasattr(model_mod, "pipeline_hooks"):
+            raise ValueError(
+                f"model family {self.family.name!r} has no pipeline_hooks"
+            )
+        hooks = model_mod.pipeline_hooks(mcfg)
         M = cfg.microbatches or 4 * self.pipe_size
         if cfg.global_batch % M:
             raise ValueError(
@@ -335,46 +368,30 @@ class Trainer:
             a for a in meshlib.DATA_AXES
             if a in self.mesh.axis_names and self.mesh.shape[a] > 1
         )
-
-        def stage_fn_factory(cos, sin):
-            def stage_fn(layer_params, x):
-                # this stage's share of the scanned layer stack
-                def body(carry, lp):
-                    return (
-                        llama_mod._block(carry, lp, mcfg, cos, sin, attn_fn),
-                        None,
-                    )
-
-                if mcfg.remat:
-                    body = jax.checkpoint(
-                        body,
-                        policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                    )
-                x, _ = lax.scan(body, x, layer_params)
-                return x
-
-            return stage_fn
+        dp = 1
+        for a in data_axes:
+            dp *= self.mesh.shape[a]
+        tp_axis = "tensor" if meshlib.axis_size(self.mesh, "tensor") > 1 else None
+        ep_axis = "expert" if meshlib.axis_size(self.mesh, "expert") > 1 else None
+        lk = self.family.layers_key
 
         def loss_fn(params, batch):
             B, S = batch.shape
             mb = B // M
-            cos, sin = llama_mod.rope_freqs(mcfg, S)
-            x = params["embed"][batch].astype(mcfg.dtype)  # [B, S, D]
+            cos, sin = hooks.rope(S)
+            x = hooks.embed(params, batch)  # [B, S, D]
             x_mb = x.reshape(M, mb, S, x.shape[-1])
             run = make_pipeline(
                 self.mesh,
-                stage_fn_factory(cos, sin),
+                hooks.make_stage(attn_fn, cos, sin, tp_axis, ep_axis),
                 pipe_axis="pipe",
+                param_specs=self.pspecs[lk],
                 data_axes=data_axes,
             )
-            h = run(params["layers"], x_mb)  # [M, mb, S, D]
+            h, aux_sum = run(params[lk], x_mb)  # [M, mb, S, D], scalar
             h = h.reshape(B, S, -1)
-            h = llama_mod.rmsnorm(h, params["final_norm"], mcfg.norm_eps)
-            head = (
-                params["embed"].T if mcfg.tie_embeddings else params["lm_head"]
-            )
-            logits = (h @ head).astype(jnp.float32)
-            return llama_mod.next_token_nll(logits, batch)
+            aux_mean = aux_sum / (hooks.n_layers * M * dp)
+            return hooks.head_loss(params, h, batch, aux_mean)
 
         return loss_fn
 

@@ -321,6 +321,37 @@ class TestAnnotationsFeatures:
         hp = pod.spec.main_container().ports[0].host_port
         assert constants.HOST_PORT_RANGE[0] <= hp < constants.HOST_PORT_RANGE[1]
 
+    def test_concurrent_port_allocation_never_collides(self):
+        """ADVICE r2 #4: two reconcile workers allocating host ports for
+        the same node in the same window (before either pod lands in the
+        store) must not draw the same port; unpinned allocations conflict
+        with pinned ones too."""
+        import threading
+
+        engine, store, _ = make_engine()
+        got, errs = [], []
+
+        def alloc(node):
+            try:
+                got.append((node, engine._alloc_host_port(node)))
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=alloc, args=(n,))
+                   for n in ["nodeA"] * 8 + [""] * 8]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errs
+        ports = [p for _, p in got]
+        assert len(ports) == len(set(ports)) == 16  # no dupes anywhere
+        # a different pinned node may reuse a nodeA port, but never an
+        # unpinned one
+        hp_b = engine._alloc_host_port("nodeB")
+        unpinned = {p for n, p in got if n == ""}
+        assert hp_b not in unpinned
+
     def test_git_sync_injection(self):
         import json
 

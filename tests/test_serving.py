@@ -465,3 +465,109 @@ def test_probe_failure_never_scales_down(tmp_path):
     t["now"] += 120.0
     ctrl.reconcile("default", "svc2")
     assert len(store.list("Pod")) == 4
+
+
+class TestPrefill:
+    """Batched prefill (round-3 #2): whole prompts in ONE forward, then
+    per-row dynamic-slice cache updates in decode."""
+
+    def test_prefill_matches_stepwise_decode(self):
+        """Prefilling a prompt must leave the cache/logits exactly where
+        feeding it token-by-token through decode_step_batched would."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kubedl_tpu.models import llama
+
+        cfg = llama.TINY
+        params = llama.llama_init(jax.random.PRNGKey(0), cfg)
+        prompt = [5, 9, 13, 2, 7]
+        B, T = 2, 32
+
+        # stepwise oracle: feed each prompt token through the decode step
+        cache_a = llama.init_batched_cache(cfg, B, T)
+        logits_a = None
+        for tok in prompt:
+            toks = jnp.zeros((B, 1), jnp.int32).at[0, 0].set(tok)
+            logits_a, cache_a = llama.decode_step_batched(
+                params, cache_a, toks, cfg
+            )
+
+        # prefill: one forward, row 1 inactive (length 0)
+        cache_b = llama.init_batched_cache(cfg, B, T)
+        toks = jnp.zeros((B, 8), jnp.int32).at[0, : len(prompt)].set(
+            jnp.asarray(prompt)
+        )
+        lens = jnp.asarray([len(prompt), 0], jnp.int32)
+        logits_b, cache_b = llama.prefill_batched(
+            params, cache_b, toks, lens, cfg
+        )
+
+        assert int(cache_b["pos"][0]) == len(prompt)
+        assert int(cache_b["pos"][1]) == 0  # inactive row untouched
+        np.testing.assert_allclose(
+            np.asarray(logits_a[0]), np.asarray(logits_b[0]),
+            rtol=2e-4, atol=2e-4,
+        )
+        # row 0's cached K/V over the prompt span must agree
+        np.testing.assert_allclose(
+            np.asarray(cache_a["k"][:, 0, : len(prompt)]),
+            np.asarray(cache_b["k"][:, 0, : len(prompt)]),
+            rtol=2e-4, atol=2e-4,
+        )
+        # inactive row's cache really untouched (still zeros)
+        assert float(jnp.abs(cache_b["k"][:, 1]).sum()) == 0.0
+
+    def test_prefill_bucket_sizes(self):
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        eng = LlamaEngine(preset="tiny", max_batch=1, max_seq=64)
+        try:
+            assert eng._prefill_bucket(1) == 16
+            assert eng._prefill_bucket(16) == 16
+            assert eng._prefill_bucket(17) == 32
+            assert eng._prefill_bucket(63) == 64
+            assert eng._prefill_bucket(1000) == 64  # clamped to max_seq
+        finally:
+            eng.close()
+
+    def test_long_prompt_single_tick(self):
+        """A prompt near max_seq completes with 1 token without issue
+        (prefill + a single decode step, not 60+ sequential steps)."""
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        eng = LlamaEngine(preset="tiny", max_batch=2, max_seq=64)
+        try:
+            prompt = list(range(1, 50))
+            got = eng.generate(prompt, max_tokens=2)
+            assert len(got["token_ids"]) == 2
+            assert got["prompt_len"] == 49
+        finally:
+            eng.close()
+
+
+def test_generate_timeout_frees_slot():
+    """ADVICE r2 #5: an abandoned (timed-out) request must release its
+    queue entry / batch row instead of occupying it until natural
+    completion."""
+    from kubedl_tpu.serving.server import LlamaEngine, _Slot
+
+    eng = LlamaEngine(preset="tiny", max_batch=1, max_seq=64)
+    try:
+        # freeze the scheduler so the request can never complete
+        with eng._cv:
+            eng._stop = True
+            eng._cv.notify_all()
+        eng._thread.join(timeout=10)
+        out = eng.generate([1, 2], max_tokens=4, timeout_s=0.2)
+        assert out["error"] == "timed out"
+        assert eng._waiting == []  # queue entry released
+        # row-occupying case: simulate a slot stuck mid-decode
+        stuck = _Slot([1], 4, 0.0)
+        eng._slots[0] = stuck
+        out2 = eng.generate([3], max_tokens=1, timeout_s=0.2)
+        assert out2["error"] == "timed out"
+        assert eng._waiting == []
+    finally:
+        eng._thread.join(timeout=1)
