@@ -1,389 +1,58 @@
-"""Embedded multi-view dashboard.
+"""Console frontend assets.
 
 Reference: console/frontend — a React/UmiJS app (pages: Jobs, JobSubmit,
-JobDetail, ClusterInfo, DataConfig/GitConfig, login). The TPU build embeds
-a dependency-free vanilla-JS equivalent served at ``/`` by the console
-server: a hash-routed SPA with the same page set —
+JobDetail, ClusterInfo, DataConfig/GitConfig, login). The TPU build ships
+a dependency-free vanilla-JS equivalent as REAL static assets
+(``console/static/``: index.html + app.js + style.css, served at ``/``
+and ``/static/*`` by the console server) — a hash-routed SPA with the
+same page set:
 
 - **Overview**: live tiles + slice fleet table (ClusterInfo analogue,
   TPU-native: slices instead of nodes).
 - **Jobs**: filterable table, stop/delete, click-through detail page with
   replicas, events and per-pod logs.
+- **Charts**: SVG charts over the backend's metrics registry — launch-
+  delay histograms, per-kind job outcomes, live running/pending timeline,
+  serving QPS table (round-3; the data was always exported at /metrics,
+  now it is visualized).
 - **Models**: lineage view (Model -> ModelVersions with build phase/image).
 - **Submit**: YAML/JSON box with per-kind starter templates.
 - **Sources**: data/code source CRUD (ConfigMap-backed).
 
-No build tooling on purpose: the console is one Python process serving one
-HTML string; everything renders through esc()/textContent so user-named
-objects can't inject markup.
+No build tooling on purpose; everything renders through esc()/textContent
+so user-named objects can't inject markup.
 """
 
-INDEX_HTML = """<!doctype html>
-<html lang="en">
-<head>
-<meta charset="utf-8">
-<title>KubeDL-TPU Console</title>
-<style>
-  :root { --fg:#1a1a2e; --muted:#667; --line:#e3e5ea; --accent:#3451b2;
-          --bg:#f8f8fa; }
-  * { box-sizing:border-box; }
-  body { margin:0; font:14px/1.5 system-ui,sans-serif; color:var(--fg); }
-  header { padding:12px 24px; border-bottom:1px solid var(--line);
-           display:flex; gap:20px; align-items:baseline; }
-  header h1 { font-size:17px; margin:0; }
-  nav a { margin-right:14px; color:var(--muted); text-decoration:none;
-          padding-bottom:10px; }
-  nav a.active { color:var(--accent); border-bottom:2px solid var(--accent); }
-  main { padding:20px 24px; max-width:1150px; margin:0 auto; }
-  .tiles { display:flex; gap:12px; flex-wrap:wrap; margin-bottom:20px; }
-  .tile { border:1px solid var(--line); border-radius:8px; padding:10px 16px;
-          min-width:130px; }
-  .tile b { display:block; font-size:22px; }
-  .tile span { color:var(--muted); font-size:12px; }
-  table { width:100%; border-collapse:collapse; margin-top:8px; }
-  th,td { text-align:left; padding:6px 10px; border-bottom:1px solid var(--line);
-          vertical-align:top; }
-  th { color:var(--muted); font-weight:600; font-size:12px; }
-  .phase { padding:1px 8px; border-radius:9px; font-size:12px; }
-  .phase.Running,.phase.ImageBuilding { background:#e3f2e8; color:#1c7a3d; }
-  .phase.Succeeded { background:#e5ecfb; color:#2c4ea0; }
-  .phase.Failed { background:#fbe5e5; color:#a02c2c; }
-  .phase.Created,.phase.Queued,.phase.Pending,.phase.Suspended { background:#f4f4f6; color:#555; }
-  button { border:1px solid var(--line); background:#fff; border-radius:6px;
-           padding:3px 10px; cursor:pointer; }
-  button:hover { border-color:var(--accent); color:var(--accent); }
-  textarea { width:100%; height:220px; font:12px/1.4 ui-monospace,monospace; }
-  input,select { padding:4px 8px; border:1px solid var(--line); border-radius:6px; }
-  .row { display:flex; gap:8px; margin:8px 0; flex-wrap:wrap; align-items:center; }
-  pre, .mono { white-space:pre-wrap; font:12px/1.4 ui-monospace,monospace;
-        background:var(--bg); border:1px solid var(--line); border-radius:8px;
-        padding:12px; overflow:auto; max-height:420px; }
-  h2 { font-size:15px; margin:22px 0 4px; }
-  .muted { color:var(--muted); }
-  .crumb a { color:var(--accent); text-decoration:none; }
-</style>
-</head>
-<body>
-<header>
-  <h1>KubeDL-TPU</h1>
-  <nav id="nav">
-    <a href="#/overview">Overview</a>
-    <a href="#/jobs">Jobs</a>
-    <a href="#/models">Models</a>
-    <a href="#/submit">Submit</a>
-    <a href="#/sources">Sources</a>
-  </nav>
-  <span class="muted" style="margin-left:auto" id="whoami"></span>
-</header>
-<main id="view"></main>
-<div id="login" style="position:fixed; inset:0; background:#fffd;
-     display:none; align-items:center; justify-content:center;">
-  <div style="border:1px solid var(--line); border-radius:10px; padding:24px;
-       background:#fff; box-shadow:0 8px 30px #0002;">
-    <h2 style="margin-top:0">Sign in</h2>
-    <div class="row"><input id="login-user" placeholder="username"></div>
-    <div class="row"><input id="login-pass" type="password" placeholder="password"></div>
-    <div class="row"><button onclick="doLogin()">login</button>
-      <span id="login-msg" style="color:#a02c2c"></span></div>
-  </div>
-</div>
-<script>
-// All server strings render via esc()/textContent — object names are
-// user-controlled and must never reach innerHTML unescaped.
-const esc = s => String(s ?? '').replace(/[&<>"']/g,
-  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
-const $ = id => document.getElementById(id);
-const fmt = ts => ts ? new Date(ts * 1000).toLocaleString() : '';
-const PHASES = ['Created','Queued','Running','Succeeded','Failed',
-                'Pending','ImageBuilding','Suspended'];
-const phaseTag = p => `<span class="phase ${PHASES.includes(p) ? p : ''}">${esc(p)}</span>`;
+from __future__ import annotations
 
-async function api(p, opts) {
-  const r = await fetch(p, opts);
-  if (r.status === 401) { showLogin(); throw new Error('unauthorized'); }
-  return r.json();
-}
-const post = (p, b) => api(p, {method:'POST', body: b ? JSON.stringify(b) : null,
-  headers:{'Content-Type':'application/json'}});
+from pathlib import Path
+from typing import Optional, Tuple
 
-function showLogin() { $('login').style.display = 'flex'; }
-async function doLogin() {
-  const r = await fetch('/api/v1/login', {method:'POST',
-    headers:{'Content-Type':'application/json'},
-    body: JSON.stringify({username: $('login-user').value,
-                          password: $('login-pass').value})});
-  if (r.status === 200) { $('login').style.display = 'none'; route(); }
-  else $('login-msg').textContent = 'invalid credentials';
+STATIC_DIR = Path(__file__).resolve().parent / "static"
+
+_CONTENT_TYPES = {
+    ".html": "text/html; charset=utf-8",
+    ".js": "application/javascript; charset=utf-8",
+    ".css": "text/css; charset=utf-8",
+    ".svg": "image/svg+xml",
+    ".png": "image/png",
+    ".ico": "image/x-icon",
 }
 
-// ---- hash router ---------------------------------------------------------
 
-const VIEWS = {};
-function route() {
-  $('view').onclick = null;  // views opt in; stale handlers must not leak
-  const hash = location.hash || '#/overview';
-  const [_, name, ...rest] = hash.split('/');
-  for (const a of document.querySelectorAll('#nav a'))
-    a.classList.toggle('active', a.getAttribute('href') === `#/${name}`);
-  (VIEWS[name] || VIEWS.overview)(rest.map(decodeURIComponent));
-}
-window.addEventListener('hashchange', route);
+def static_asset(name: str) -> Optional[Tuple[bytes, str]]:
+    """Return (body, content-type) for one static file, or None.
+    Traversal-safe: only plain file names inside STATIC_DIR resolve."""
+    clean = Path(name).name  # strips any path components
+    if not clean or clean != name:
+        return None
+    target = STATIC_DIR / clean
+    if not target.is_file():
+        return None
+    ctype = _CONTENT_TYPES.get(target.suffix, "application/octet-stream")
+    return target.read_bytes(), ctype
 
-// ---- overview ------------------------------------------------------------
 
-VIEWS.overview = async () => {
-  const o = (await api('/api/v1/data/overview')).data;
-  const sl = (await api('/api/v1/cluster/slices')).data.slices;
-  const tiles = [
-    [o.jobTotal, 'jobs'], [o.jobPhases.Running || 0, 'running'],
-    [o.podRunning + '/' + o.podTotal, 'pods running'],
-    [o.sliceFree + '/' + o.sliceTotal, 'slices free'],
-  ];
-  $('view').innerHTML = `
-    <div class="tiles">${tiles.map(([v, l]) =>
-      `<div class=tile><b>${esc(v)}</b><span>${esc(l)}</span></div>`).join('')}</div>
-    <h2>TPU slice fleet</h2>
-    <table><thead><tr><th>slice</th><th>type</th><th>chips</th>
-      <th>hosts</th><th>held by</th></tr></thead>
-    <tbody>${sl.map(s => `<tr><td>${esc(s.name)}</td><td>${esc(s.type)}</td>
-      <td>${esc(s.chips)}</td><td class=muted>${esc(s.hosts.join(', '))}</td>
-      <td>${s.allocated_to ? esc(s.allocated_to) : '<span class=muted>free</span>'}</td>
-      </tr>`).join('') || '<tr><td colspan=5 class=muted>no slices registered</td></tr>'}
-    </tbody></table>
-    <h2>Jobs by phase</h2>
-    <div class="tiles">${Object.entries(o.jobPhases).map(([p, n]) =>
-      `<div class=tile><b>${esc(n)}</b><span>${esc(p)}</span></div>`).join('')
-      || '<span class=muted>none yet</span>'}</div>`;
-};
+def index_html() -> str:
+    return (STATIC_DIR / "index.html").read_text()
 
-// ---- jobs ----------------------------------------------------------------
-
-VIEWS.jobs = async () => {
-  const o = (await api('/api/v1/data/overview')).data;
-  $('view').innerHTML = `
-    <h2 style="margin-top:0">Jobs</h2>
-    <div class="row">
-      <select id="f-kind"><option value="">all kinds</option>${
-        o.workloadKinds.map(k => `<option>${esc(k)}</option>`).join('')}</select>
-      <input id="f-name" placeholder="name filter">
-      <select id="f-phase"><option value="">all phases</option>
-        <option>Created</option><option>Queued</option><option>Running</option>
-        <option>Succeeded</option><option>Failed</option></select>
-      <button onclick="loadJobs()">refresh</button>
-    </div>
-    <table><thead><tr><th>name</th><th>kind</th><th>namespace</th><th>phase</th>
-      <th>created</th><th>owner</th><th></th></tr></thead>
-      <tbody id="jobs"></tbody></table>`;
-  $('jobs').addEventListener('click', jobAction);
-  await loadJobs();
-};
-
-async function loadJobs() {
-  const q = new URLSearchParams();
-  for (const [k, id] of [['kind','f-kind'],['name','f-name'],['phase','f-phase']]) {
-    const v = $(id)?.value; if (v) q.set(k, v);
-  }
-  const d = (await api('/api/v1/job/list?' + q)).data;
-  const tbody = $('jobs');
-  if (!tbody) return;
-  tbody.innerHTML = d.jobInfos.map((j, i) => `<tr data-i="${i}">
-    <td><a href="#/job/${encodeURIComponent(j.namespace)}/${encodeURIComponent(j.name)}/${encodeURIComponent(j.kind)}">${esc(j.name)}</a></td>
-    <td>${esc(j.kind)}</td><td>${esc(j.namespace)}</td>
-    <td>${phaseTag(j.phase)}</td>
-    <td>${esc(fmt(j.created_at))}</td><td>${esc(j.owner)}</td>
-    <td><button data-act="stop">stop</button>
-        <button data-act="delete">delete</button></td></tr>`).join('')
-    || '<tr><td colspan=7 class=muted>no jobs</td></tr>';
-  tbody._rows = d.jobInfos;
-}
-
-async function jobAction(ev) {
-  const act = ev.target.dataset.act;
-  if (!act) return;
-  ev.preventDefault();
-  const tr = ev.target.closest('tr');
-  const j = $('jobs')._rows[Number(tr.dataset.i)];
-  const qs = `${encodeURIComponent(j.namespace)}/${encodeURIComponent(j.name)}` +
-             `?kind=${encodeURIComponent(j.kind)}`;
-  if (act === 'stop') await post(`/api/v1/job/stop/${qs}`);
-  else if (act === 'delete')
-    await fetch(`/api/v1/job/delete/${qs}`, {method:'DELETE'});
-  loadJobs();
-}
-
-// ---- job detail ----------------------------------------------------------
-
-VIEWS.job = async ([ns, name, kind]) => {
-  const qs = `${encodeURIComponent(ns)}/${encodeURIComponent(name)}?kind=${encodeURIComponent(kind)}`;
-  const d = (await api(`/api/v1/job/detail/${qs}`)).data;
-  const j = d.jobInfo;
-  $('view').innerHTML = `
-    <div class="crumb"><a href="#/jobs">&larr; jobs</a></div>
-    <h2>${esc(kind)} ${esc(ns)}/${esc(name)} ${phaseTag(j.phase)}</h2>
-    <div class="row muted">created ${esc(fmt(j.created_at))}
-      ${j.finished_at ? ' &middot; finished ' + esc(fmt(j.finished_at)) : ''}</div>
-    <div class="row"><button id="yaml-btn">view yaml</button></div>
-    <pre id="yaml" style="display:none"></pre>
-    <h2>Replicas</h2>
-    <table><thead><tr><th>pod</th><th>type</th><th>#</th><th>phase</th>
-      <th>node</th><th>exit</th><th></th></tr></thead>
-    <tbody>${(d.replicas || []).map(r => `<tr>
-      <td>${esc(r.name)}</td><td>${esc(r.replica_type)}</td>
-      <td>${esc(r.replica_index)}</td><td>${phaseTag(r.phase)}</td>
-      <td class=muted>${esc(r.node)}</td><td>${esc(r.exit_code ?? '')}</td>
-      <td><button data-pod="${esc(r.name)}" data-ns="${esc(r.namespace)}">logs</button></td>
-      </tr>`).join('') || '<tr><td colspan=7 class=muted>none</td></tr>'}
-    </tbody></table>
-    <pre id="logs" style="display:none"></pre>
-    <h2>Events</h2>
-    <table><thead><tr><th>type</th><th>reason</th><th>message</th><th>last seen</th>
-      </tr></thead>
-    <tbody>${(d.events || []).map(e => `<tr><td>${esc(e.type)}</td>
-      <td>${esc(e.reason)}</td><td>${esc(e.message)}</td>
-      <td class=muted>${esc(fmt(e.last_timestamp))}</td></tr>`).join('')
-      || '<tr><td colspan=4 class=muted>none</td></tr>'}
-    </tbody></table>`;
-  $('yaml-btn').onclick = async () => {
-    const y = (await api(`/api/v1/job/yaml/${qs}`)).data.yaml;
-    const el = $('yaml');
-    el.style.display = 'block';
-    el.textContent = y;
-  };
-  $('view').onclick = async ev => {
-    const pod = ev.target.dataset.pod;
-    if (!pod) return;
-    const r = await api(`/api/v1/log/logs/${encodeURIComponent(ev.target.dataset.ns)}/${encodeURIComponent(pod)}`);
-    const el = $('logs');
-    el.style.display = 'block';
-    el.textContent = `--- ${pod} ---\\n` + (r.data.logs || []).join('');
-  };
-};
-
-// ---- models ----------------------------------------------------------------
-
-VIEWS.models = async () => {
-  const d = (await api('/api/v1/model/list')).data;
-  $('view').innerHTML = `
-    <h2 style="margin-top:0">Model lineage</h2>
-    ${d.models.map(m => `
-      <h2>${esc(m.namespace)}/${esc(m.name)}
-        <span class="muted" style="font-weight:normal;font-size:12px">
-          latest: ${esc(m.latest_version || '-')}</span></h2>
-      <table><thead><tr><th>version</th><th>phase</th><th>image</th>
-        <th>storage</th><th>built from</th><th>created</th></tr></thead>
-      <tbody>${m.versions.map(v => `<tr>
-        <td>${esc(v.name)}</td><td>${phaseTag(v.phase)}</td>
-        <td class=mono style="background:none;border:none;padding:6px 10px">${esc(v.image || '-')}</td>
-        <td class=muted>${esc(v.storage_provider)}:${esc(v.storage_root)}</td>
-        <td class=muted>${esc(v.created_by)}</td>
-        <td class=muted>${esc(fmt(v.created_at))}</td></tr>`).join('')
-        || '<tr><td colspan=6 class=muted>no versions</td></tr>'}
-      </tbody></table>`).join('')
-      || '<p class=muted>No models yet — jobs with spec.model_version publish here on success.</p>'}`;
-};
-
-// ---- submit ----------------------------------------------------------------
-
-const TEMPLATES = {
-  TPUJob: `kind: TPUJob
-metadata:
-  name: demo
-spec:
-  replicaSpecs:
-    Worker:
-      replicas: 1
-      restartPolicy: OnFailureSlice
-      template:
-        spec:
-          containers:
-          - command: ["python", "-c", "print('hello tpu')"]`,
-  TFJob: `kind: TFJob
-metadata:
-  name: tf-demo
-spec:
-  replicaSpecs:
-    Worker:
-      replicas: 1
-      template:
-        spec:
-          containers:
-          - command: ["python", "-c", "import os; print(os.environ['TF_CONFIG'])"]`,
-};
-
-VIEWS.submit = async () => {
-  const o = (await api('/api/v1/data/overview')).data;
-  $('view').innerHTML = `
-    <h2 style="margin-top:0">Submit a job</h2>
-    <p class="muted">Paste a job object as YAML or JSON (must include
-      <code>kind</code>), or start from a template.</p>
-    <div class="row">
-      <select id="tmpl"><option value="">template...</option>${
-        Object.keys(TEMPLATES).filter(k => o.workloadKinds.includes(k))
-          .map(k => `<option>${esc(k)}</option>`).join('')}</select>
-    </div>
-    <textarea id="submit-box" placeholder="kind: TPUJob&#10;metadata:&#10;  name: demo"></textarea>
-    <div class="row"><button onclick="submitJob()">submit</button>
-      <span id="submit-msg" class="muted"></span></div>`;
-  $('tmpl').onchange = () => {
-    if ($('tmpl').value) $('submit-box').value = TEMPLATES[$('tmpl').value];
-  };
-};
-
-async function submitJob() {
-  const raw = $('submit-box').value;
-  let body; try { body = JSON.parse(raw); } catch { body = {yaml: raw}; }
-  const r = await post('/api/v1/job/submit', body);
-  $('submit-msg').textContent = JSON.stringify(r.data);
-  if (r.code === '200') location.hash = '#/jobs';
-}
-
-// ---- sources ---------------------------------------------------------------
-
-VIEWS.sources = async () => {
-  const kinds = ['datasource', 'codesource'];
-  const data = {};
-  for (const k of kinds) data[k] = (await api(`/api/v1/${k}`)).data;
-  $('view').innerHTML = kinds.map(k => `
-    <h2 ${k === 'datasource' ? 'style="margin-top:0"' : ''}>${esc(k)}s</h2>
-    <table><thead><tr><th>name</th><th>spec</th><th></th></tr></thead>
-    <tbody>${Object.entries(data[k]).map(([n, v]) => `<tr>
-      <td>${esc(n)}</td>
-      <td class=muted>${esc(JSON.stringify(v))}</td>
-      <td><button data-del="${esc(k)}/${esc(n)}">delete</button></td></tr>`).join('')
-      || '<tr><td colspan=3 class=muted>none</td></tr>'}
-    </tbody></table>
-    <div class="row">
-      <input id="new-${esc(k)}-name" placeholder="name">
-      <input id="new-${esc(k)}-spec" placeholder='{"path": "/data"}' size=40>
-      <button data-add="${esc(k)}">add</button>
-    </div>`).join('');
-  $('view').onclick = async ev => {
-    if (ev.target.dataset.del) {
-      await fetch(`/api/v1/${ev.target.dataset.del}`, {method: 'DELETE'});
-      VIEWS.sources();
-    } else if (ev.target.dataset.add) {
-      const k = ev.target.dataset.add;
-      let spec;
-      try { spec = JSON.parse($(`new-${k}-spec`).value || '{}'); }
-      catch (e) { alert('spec is not valid JSON: ' + e.message); return; }
-      spec.name = $(`new-${k}-name`).value;
-      if (!spec.name) return;
-      await post(`/api/v1/${k}`, spec);
-      VIEWS.sources();
-    }
-  };
-};
-
-// ---- boot ------------------------------------------------------------------
-
-route();
-setInterval(() => {
-  if ($('login').style.display === 'flex') return;
-  const h = location.hash || '';
-  if (h === '#/overview' || h === '') route();
-  else if (h === '#/jobs') loadJobs();  // table only: keep filters + focus
-}, 5000);
-</script>
-</body>
-</html>
-"""

@@ -251,6 +251,23 @@ class ConfigMap(BaseObject):
 
 
 @dataclass
+class IngressRoute(BaseObject):
+    """Host/path -> backing-service routing rule (the reference's
+    networking.k8s.io Ingress analogue, controllers/mars/ingress.go:37-166:
+    Mars publishes its web UI at http://<webHost>/<ns>/<job>). A real
+    deployment's edge proxy watches these objects; here they carry the
+    routing intent and are owner-GC'd with the job."""
+
+    KIND = "IngressRoute"
+    host: str = ""
+    #: URL path prefix routed to the backend (e.g. "/default/job1")
+    path: str = ""
+    #: backing Service name + port
+    service: str = ""
+    port: int = 0
+
+
+@dataclass
 class Event(BaseObject):
     KIND = "Event"
     involved_kind: str = ""

@@ -683,7 +683,9 @@ class JobEngine:
             main = pod.spec.main_container()
             root = job.spec.model_version.storage_root or constants.DEFAULT_MODEL_PATH
             provider = get_storage_provider(job.spec.model_version.storage_provider)
-            provider.provision(root)
+            # providers may RESOLVE the root (the http provider maps a
+            # remote blob URL to a local staging dir the pod can write)
+            root = provider.provision(root)
             main.set_env(constants.ENV_MODEL_PATH, root)
             provider.add_model_volume(pod, root)
 

@@ -79,6 +79,69 @@ class NodeLocalProvider(StorageProvider):
         return mv.storage_root
 
 
+class RemoteBlobProvider(StorageProvider):
+    """Network-remote artifact storage over the blob server
+    (`kubedl_tpu.remote`) — the AWS-EFS/object-store analogue
+    (aws_efs_provider.go), and the first provider whose artifacts cross
+    a real network boundary.
+
+    ``storage_root`` is a SELF-DESCRIBING URL: ``http://host:port/blobs/
+    <prefix>``. Training pods write into a local staging dir (returned by
+    :meth:`provision` — the engine mounts and exports THAT as
+    KUBEDL_MODEL_PATH); the builder's :meth:`artifact_dir` uploads fresh
+    local staging to the remote prefix and otherwise downloads the prefix
+    into a local cache — so the blob server is the source of truth and
+    build/serve work from any host."""
+
+    NAME = "http"
+    SHARED = True
+
+    def __init__(self, staging_root: str = "") -> None:
+        import os
+        import tempfile
+
+        self.staging_root = staging_root or os.path.join(
+            tempfile.gettempdir(), f"kubedl-remote-staging-{os.getuid()}"
+        )
+
+    def _staging_dir(self, remote_root: str) -> Path:
+        import hashlib
+
+        digest = hashlib.sha256(remote_root.encode()).hexdigest()[:16]
+        return Path(self.staging_root) / digest
+
+    def provision(self, root: str) -> str:
+        from kubedl_tpu.remote.client import is_remote_root
+
+        if not is_remote_root(root):
+            raise StorageError(
+                f"http storage_root must be http(s)://…/blobs/<prefix>, got {root!r}"
+            )
+        d = self._staging_dir(root)
+        d.mkdir(parents=True, exist_ok=True)
+        return str(d)
+
+    def add_model_volume(self, pod, root: str) -> None:
+        # root here is the resolved local staging dir
+        super().add_model_volume(pod, root)
+
+    def artifact_dir(self, mv, local_node: str = "") -> str:
+        from kubedl_tpu.remote.client import download_tree, upload_tree
+
+        remote_root = mv.storage_root
+        staging = self._staging_dir(remote_root)
+        if staging.is_dir() and any(staging.rglob("*")):
+            # fresh local training output: publish it, then build from it
+            upload_tree(str(staging), remote_root)
+            return str(staging)
+        cache = Path(self.staging_root) / "fetch" / staging.name
+        cache.mkdir(parents=True, exist_ok=True)
+        n = download_tree(remote_root, str(cache))
+        if n == 0:
+            raise StorageError(f"no artifact blobs under {remote_root}")
+        return str(cache)
+
+
 _PROVIDERS: Dict[str, StorageProvider] = {}
 
 
@@ -99,3 +162,4 @@ def get_storage_provider(name: str) -> StorageProvider:
 
 register_storage_provider(SharedDirProvider(), "nfs", "efs")
 register_storage_provider(NodeLocalProvider())
+register_storage_provider(RemoteBlobProvider())

@@ -30,7 +30,27 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
+
+
+def remat_policy_for(name: str):
+    """Map a config string to a jax.checkpoint policy (None = save
+    nothing, i.e. full recompute)."""
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if name == "nothing":
+        return jax.checkpoint_policies.nothing_saveable
+    if name == "attn":
+        return jax.checkpoint_policies.save_only_these_names("attn_out")
+    if name == "dots_attn":
+        # matmul outputs AND the attention output: backward recomputes
+        # neither the dots nor the flash forward kernel
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names("attn_out"),
+        )
+    raise ValueError(f"unknown remat_policy {name!r}")
 
 Params = Dict[str, Any]
 
@@ -49,6 +69,17 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     #: remat the scan body (trade flops for HBM)
     remat: bool = True
+    #: what the remat saves: "dots" (matmul outputs without batch dims —
+    #: the conservative default), "nothing" (full recompute, minimum HBM),
+    #: "attn" (save only each layer's attention output — recompute
+    #: matmuls, keep the flash kernel from running twice in backward)
+    remat_policy: str = "dots"
+    #: compute the LM loss over sequence chunks of this many positions
+    #: (0 = whole sequence at once). The full [B, S, V] fp32 logits are
+    #: the single biggest activation (b8 x s2048 x v32k = 2.1 GB before
+    #: softmax temporaries); chunking + remat caps loss memory at
+    #: [B, chunk, V] and recomputes each chunk's logits in backward.
+    loss_chunk: int = 0
     #: tie lm_head to the embedding table (smaller models do)
     tie_embeddings: bool = False
     # -- Gemma-family knobs (same decoder skeleton, different details) -----
@@ -90,10 +121,12 @@ LLAMA3_1B = LlamaConfig(
     vocab_size=128256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
     ffn_dim=8192, tie_embeddings=True,
 )
-#: bench-scale model that fits one v5e chip (16 GiB) with room for a real batch
+#: bench-scale model that fits one v5e chip (16 GiB) with room for a real
+#: batch. loss_chunk keeps the fp32 logits out of HBM (2.1 GB at b8 s2048
+#: — measured equal-speed and strictly more headroom, docs/performance.md)
 BENCH_350M = LlamaConfig(
     vocab_size=32768, dim=1024, n_layers=24, n_heads=16, n_kv_heads=8,
-    ffn_dim=4096, max_seq=2048,
+    ffn_dim=4096, max_seq=2048, loss_chunk=1024,
 )
 TINY = LlamaConfig(
     vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=128,
@@ -277,6 +310,9 @@ def _block(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     attn = (attn_fn or attention)(q, k, v).reshape(B, S, n_heads * hd)
+    # named for remat_policy="attn": save the attention output so backward
+    # never re-runs the (flash) attention kernel, recompute everything else
+    attn = checkpoint_name(attn, "attn_out")
     attn_out = attn @ lp["wo"]  # row-parallel: partial sums under tp
     if tp_axis:
         attn_out = lax.psum(attn_out, tp_axis)
@@ -287,6 +323,29 @@ def _block(
     if tp_axis:
         mlp = lax.psum(mlp, tp_axis)
     return x + mlp
+
+
+def llama_hidden(
+    params: Params, tokens: jax.Array, cfg: LlamaConfig, attn_fn=None
+) -> jax.Array:
+    """tokens [B, S] int32 -> final-norm hidden states [B, S, D]."""
+    B, S = tokens.shape
+    x = params["embed"][tokens].astype(cfg.dtype)
+    if cfg.embed_scale:  # Gemma scales inputs by sqrt(dim)
+        x = x * math.sqrt(cfg.dim)
+    cos, sin = rope_freqs(cfg, S)
+
+    def body(carry, lp):
+        return _block(carry, lp, cfg, cos, sin, attn_fn), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body, policy=remat_policy_for(cfg.remat_policy))
+    x, _ = lax.scan(body, x, params["layers"])
+    return rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.norm_plus_one)
+
+
+def lm_head_of(params: Params, cfg: LlamaConfig) -> jax.Array:
+    return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
 
 
 def llama_forward(
@@ -300,23 +359,8 @@ def llama_forward(
     here with global positions, so sequence-sharded attention composes
     without position bookkeeping.
     """
-    B, S = tokens.shape
-    x = params["embed"][tokens].astype(cfg.dtype)
-    if cfg.embed_scale:  # Gemma scales inputs by sqrt(dim)
-        x = x * math.sqrt(cfg.dim)
-    cos, sin = rope_freqs(cfg, S)
-
-    def body(carry, lp):
-        return _block(carry, lp, cfg, cos, sin, attn_fn), None
-
-    if cfg.remat:
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-        )
-    x, _ = lax.scan(body, x, params["layers"])
-    x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.norm_plus_one)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return (x @ head).astype(jnp.float32)
+    x = llama_hidden(params, tokens, cfg, attn_fn)
+    return (x @ lm_head_of(params, cfg)).astype(jnp.float32)
 
 
 def llama_loss(
@@ -327,7 +371,15 @@ def llama_loss(
     The forward runs on the FULL sequence (last position's logits unused)
     so the seq dim keeps its length — slicing to S-1 before the forward
     would break even sequence sharding under context parallelism.
+
+    With ``cfg.loss_chunk`` set, the head matmul + softmax run chunk by
+    chunk so the [B, S, V] fp32 logits never materialize.
     """
+    if cfg.loss_chunk:
+        x = llama_hidden(params, tokens, cfg, attn_fn)
+        return chunked_next_token_nll(
+            x, lm_head_of(params, cfg), tokens, cfg.loss_chunk
+        )
     logits = llama_forward(params, tokens, cfg, attn_fn)
     return next_token_nll(logits, tokens)
 
@@ -339,6 +391,42 @@ def next_token_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     targets = tokens[:, 1:]
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()
+
+
+def chunked_next_token_nll(
+    x: jax.Array,  # [B, S, D] final hidden states
+    head: jax.Array,  # [D, V]
+    tokens: jax.Array,  # [B, S]
+    chunk: int,
+) -> jax.Array:
+    """Same mean NLL as :func:`next_token_nll`, computed over sequence
+    chunks so the fp32 [B, S, V] logits (+ softmax temporaries) never
+    exist at once — peak loss memory is [B, chunk, V], and the chunk body
+    is rematerialized so backward recomputes each chunk's logits instead
+    of saving softmax residuals for every chunk (which would be the full
+    array again)."""
+    B, S = tokens.shape
+    n_pos = S - 1  # scored positions
+    n_chunks = -(-n_pos // chunk)
+    pad = n_chunks * chunk - n_pos
+    xs = jnp.pad(x[:, :-1], ((0, 0), (0, pad), (0, 0)))
+    targets = jnp.pad(tokens[:, 1:], ((0, 0), (0, pad)))
+    xs = xs.reshape(B, n_chunks, chunk, -1).transpose(1, 0, 2, 3)
+    targets = targets.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
+    valid = (jnp.arange(n_chunks * chunk) < n_pos).reshape(n_chunks, chunk)
+
+    def body(total, inp):
+        xc, tc, vc = inp  # [B, chunk, D], [B, chunk], [chunk]
+        logits = (xc @ head).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, tc[..., None], axis=-1)[..., 0]
+        return total + (nll * vc[None, :]).sum(), None
+
+    body = jax.checkpoint(
+        body, policy=jax.checkpoint_policies.nothing_saveable
+    )
+    total, _ = lax.scan(body, jnp.zeros((), jnp.float32), (xs, targets, valid))
+    return total / (B * n_pos)
 
 
 # ---- pipeline hooks --------------------------------------------------------
@@ -362,8 +450,7 @@ def pipeline_hooks(cfg: LlamaConfig):
 
             if cfg.remat:
                 body = jax.checkpoint(
-                    body,
-                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                    body, policy=remat_policy_for(cfg.remat_policy)
                 )
             x, _ = lax.scan(body, x, layer_params)
             return x, jnp.zeros((), jnp.float32)
@@ -372,8 +459,7 @@ def pipeline_hooks(cfg: LlamaConfig):
 
     def head_loss(params, h, tokens, aux_mean):
         h = rmsnorm(h, params["final_norm"], cfg.norm_eps, cfg.norm_plus_one)
-        head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-        logits = (h @ head).astype(jnp.float32)
+        logits = (h @ lm_head_of(params, cfg)).astype(jnp.float32)
         return next_token_nll(logits, tokens)
 
     return PipelineHooks(
@@ -469,8 +555,7 @@ def decode_step_batched(
         body, x, (params["layers"], cache["k"], cache["v"])
     )
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.norm_plus_one)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x[:, 0] @ head).astype(jnp.float32)
+    logits = (x[:, 0] @ lm_head_of(params, cfg)).astype(jnp.float32)
     cache = {
         "k": new_k,
         "v": new_v,
@@ -535,8 +620,7 @@ def prefill_batched(
     x_last = jnp.take_along_axis(
         x, idx[:, None, None].astype(jnp.int32), axis=1
     )[:, 0]  # [B, D]
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x_last @ head).astype(jnp.float32)
+    logits = (x_last @ lm_head_of(params, cfg)).astype(jnp.float32)
     pos = jnp.where(active, jnp.minimum(lengths, max_s - 1), cache["pos"])
     return logits, {"k": new_k, "v": new_v, "pos": pos.astype(jnp.int32)}
 
@@ -580,8 +664,7 @@ def decode_step(
         gate = _act(cfg)((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
         x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.norm_plus_one)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x[:, 0] @ head).astype(jnp.float32)
+    logits = (x[:, 0] @ lm_head_of(params, cfg)).astype(jnp.float32)
     cache = {
         "k": jnp.stack(new_k),
         "v": jnp.stack(new_v),

@@ -42,6 +42,13 @@ class Counter:
         with self._lock:
             return self._values.get(_labels(labels), 0.0)
 
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [
+                {"labels": dict(kv), "value": v}
+                for kv, v in sorted(self._values.items())
+            ]
+
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
@@ -74,6 +81,19 @@ class Gauge:
             if kv in self._callbacks:
                 return self._callbacks[kv]()
             return self._values.get(kv, 0.0)
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            items = dict(self._values)
+            callbacks = dict(self._callbacks)
+        for kv, fn in callbacks.items():
+            try:
+                items[kv] = fn()
+            except Exception:
+                continue
+        return [
+            {"labels": dict(kv), "value": v} for kv, v in sorted(items.items())
+        ]
 
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
@@ -117,6 +137,21 @@ class Histogram:
         kv = _labels(labels)
         with self._lock:
             return self._total.get(kv, 0), self._sum.get(kv, 0.0)
+
+    def snapshot(self) -> List[dict]:
+        """Structured view for dashboards: per label-set bucket counts
+        (non-cumulative), sum and total."""
+        with self._lock:
+            return [
+                {
+                    "labels": dict(kv),
+                    "buckets": list(self.buckets),
+                    "counts": list(counts),
+                    "sum": self._sum.get(kv, 0.0),
+                    "total": self._total.get(kv, 0),
+                }
+                for kv, counts in sorted(self._counts.items())
+            ]
 
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]

@@ -71,6 +71,17 @@ class OperatorOptions:
     compile_cache_dir: str = field(default_factory=lambda: os.path.join(
         tempfile.gettempdir(), f"kubedl-tpu-compile-cache-{os.getuid()}"
     ))
+    #: lease-based leader election (reference: main.go:76-84
+    #: "kubedl-election"): with True, this operator campaigns for the
+    #: lease in its store and reconciles ONLY while holding it; losing
+    #: the lease stops the operator (crash-only — restart to re-campaign)
+    leader_elect: bool = False
+    #: candidate identity; defaults to hostname-pid
+    leader_identity: str = ""
+    leader_lease_ttl: float = 5.0
+    #: base URL of a remote store (kubedl_tpu.remote.RemoteStoreServer);
+    #: enables meta_storage/event_storage="http" (network persist mirror)
+    remote_storage_url: str = ""
 
 
 class ValidationError(ValueError):
@@ -87,9 +98,12 @@ class Operator:
         options: Optional[OperatorOptions] = None,
         runtime: Optional[ContainerRuntime] = None,
         inventory: Optional[SliceInventory] = None,
+        store: Optional[ObjectStore] = None,
     ) -> None:
         self.options = options or OperatorOptions()
-        self.store = ObjectStore()
+        #: pass an existing store to run several operators against one
+        #: object world (HA deployments — pair with leader_elect=True)
+        self.store = store or ObjectStore()
         self.manager = ControllerManager(self.store)
         self.metrics_registry = MetricsRegistry()
         self.metrics = JobMetrics(self.metrics_registry)
@@ -167,7 +181,10 @@ class Operator:
         if self.options.meta_storage or self.options.event_storage:
             from kubedl_tpu.persist import PersistControllers, default_registry
 
-            registry = default_registry(self.options.storage_db_path)
+            registry = default_registry(
+                self.options.storage_db_path,
+                remote_url=self.options.remote_storage_url,
+            )
             if self.options.meta_storage:
                 self.object_backend = registry.object_backend(
                     self.options.meta_storage
@@ -242,9 +259,35 @@ class Operator:
     # ------------------------------------------------------------------
 
     def start(self) -> None:
-        self.manager.start()
+        if not self.options.leader_elect:
+            self.manager.start()
+            return
+        # HA mode (reference: main.go:76-84): reconcile only while holding
+        # the lease. The follower builds everything but starts nothing;
+        # on acquisition it resyncs (kick_all) and runs; on LOSS it stops
+        # for good (crash-only — the process restarts to re-campaign).
+        from kubedl_tpu.core.leases import LeaderElector
+
+        self.elector = LeaderElector(
+            self.store,
+            identity=self.options.leader_identity,
+            ttl=self.options.leader_lease_ttl,
+        )
+
+        def on_started() -> None:
+            self.manager.start()
+            self.manager.kick_all()
+
+        self.elector.start(on_started=on_started, on_stopped=self._on_deposed)
+
+    def _on_deposed(self) -> None:
+        self.kubelet.shutdown()
+        self.manager.stop()
 
     def stop(self) -> None:
+        elector = getattr(self, "elector", None)
+        if elector is not None:
+            elector.stop()
         self.kubelet.shutdown()
         self.manager.stop()
         for backend in (self.object_backend, self.event_backend):

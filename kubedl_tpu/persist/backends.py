@@ -132,10 +132,14 @@ class StorageRegistry:
         return backend
 
 
-def default_registry(db_path: str = ":memory:") -> StorageRegistry:
+def default_registry(
+    db_path: str = ":memory:", remote_url: str = ""
+) -> StorageRegistry:
     """Registry with the built-in SQLite backend under both roles
     (the reference registers MySQL for objects+events and SLS for events,
-    registry.go:32-53)."""
+    registry.go:32-53). With ``remote_url`` set, the "http" backend
+    (network-remote store, the MySQL-over-the-wire analogue) registers
+    under both roles too."""
     from kubedl_tpu.persist.sqlite_backend import SQLiteBackend
 
     reg = StorageRegistry()
@@ -171,4 +175,17 @@ def default_registry(db_path: str = ":memory:") -> StorageRegistry:
 
     reg.register_object_backend("jsonl", jsonl_factory)
     reg.register_event_backend("jsonl", jsonl_factory)
+
+    if remote_url:
+        from kubedl_tpu.persist.http_backend import HTTPBackend
+
+        shared_http: Dict[str, HTTPBackend] = {}
+
+        def http_factory() -> HTTPBackend:
+            if "b" not in shared_http:
+                shared_http["b"] = HTTPBackend(remote_url)
+            return shared_http["b"]
+
+        reg.register_object_backend("http", http_factory)
+        reg.register_event_backend("http", http_factory)
     return reg

@@ -1,0 +1,84 @@
+"""Blob client for the remote store (urllib only, no extra deps).
+
+Remote model roots are self-describing URLs: ``http://host:port/blobs/
+<prefix>`` — `is_remote_root` gates the fetch-on-load path in serving and
+the upload path in the `http` storage provider.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from pathlib import Path
+from typing import List
+
+
+class RemoteError(Exception):
+    pass
+
+
+def is_remote_root(root: str) -> bool:
+    return root.startswith(("http://", "https://")) and "/blobs/" in root
+
+
+def _split(root: str) -> tuple:
+    """'http://h:p/blobs/a/b' -> ('http://h:p', 'a/b')."""
+    base, _, prefix = root.partition("/blobs/")
+    return base, prefix.strip("/")
+
+
+def _request(url: str, data: bytes = None, method: str = "GET") -> bytes:
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.read()
+    except urllib.error.HTTPError as e:
+        raise RemoteError(f"{method} {url}: HTTP {e.code}: {e.read()[:200]}") from e
+    except urllib.error.URLError as e:
+        raise RemoteError(f"{method} {url}: {e.reason}") from e
+
+
+def put_blob(base_url: str, key: str, data: bytes) -> None:
+    _request(f"{base_url}/blobs/{key}", data=data, method="PUT")
+
+
+def get_blob(base_url: str, key: str) -> bytes:
+    return _request(f"{base_url}/blobs/{key}")
+
+
+def delete_blob(base_url: str, key: str) -> None:
+    _request(f"{base_url}/blobs/{key}", method="DELETE")
+
+
+def list_blobs(base_url: str, prefix: str = "") -> List[str]:
+    out = json.loads(_request(f"{base_url}/blobs?prefix={prefix}"))
+    return out["keys"]
+
+
+def upload_tree(local_dir: str, remote_root: str) -> int:
+    """Upload every file under ``local_dir`` to the remote prefix.
+    Returns the number of files uploaded."""
+    base, prefix = _split(remote_root)
+    root = Path(local_dir)
+    n = 0
+    for p in sorted(root.rglob("*")):
+        if p.is_file():
+            rel = p.relative_to(root).as_posix()
+            put_blob(base, f"{prefix}/{rel}" if prefix else rel, p.read_bytes())
+            n += 1
+    return n
+
+
+def download_tree(remote_root: str, local_dir: str) -> int:
+    """Mirror the remote prefix into ``local_dir``; returns file count."""
+    base, prefix = _split(remote_root)
+    keys = list_blobs(base, prefix)
+    n = 0
+    for key in keys:
+        rel = key[len(prefix):].lstrip("/") if prefix else key
+        dest = Path(local_dir) / rel
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        dest.write_bytes(get_blob(base, key))
+        n += 1
+    return n

@@ -1,0 +1,200 @@
+"""The remote-store server: blobs + persist RPC over HTTP.
+
+The network-boundary analogue of the reference's external stores (MySQL
+over the wire for job/pod/event rows, mysql.go:413-440; object storage
+for artifacts). One small HTTP server exposes:
+
+- ``PUT/GET/DELETE /blobs/<key>`` and ``GET /blobs?prefix=`` — a flat
+  object store for model artifacts (checkpoint shards, manifests).
+- ``POST /persist/call {"method": ..., "kwargs": ...}`` — RPC onto a
+  server-side persistence backend (the built-in SQLite one), so the full
+  Query/filter semantics live server-side exactly like a SQL store, and
+  the client is a thin typed stub (`kubedl_tpu.persist.http_backend`).
+
+Blobs are files under ``root/``; keys are sanitized relative paths.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger("kubedl_tpu.remote.server")
+
+#: persist methods callable over RPC (both backend roles)
+_PERSIST_METHODS = frozenset({
+    "save_job", "get_job", "list_jobs", "mark_job_deleted",
+    "remove_job_record", "save_pod", "list_pods", "mark_pod_deleted",
+    "save_event", "list_events",
+})
+
+
+def _safe_key(key: str) -> str:
+    key = key.strip("/")
+    parts = [p for p in key.split("/") if p not in ("", ".", "..")]
+    if not parts:
+        raise ValueError("empty blob key")
+    return "/".join(parts)
+
+
+class RemoteStoreServer:
+    """Serve blobs from ``root`` and persist RPC from a SQLite backend."""
+
+    def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0,
+                 db_path: str = ":memory:") -> None:
+        from kubedl_tpu.persist.sqlite_backend import SQLiteBackend
+
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.backend = SQLiteBackend(db_path)
+        self.backend.initialize()
+        self._lock = threading.Lock()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                log.debug(fmt, *args)
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, code: int, payload) -> None:
+                self._send(code, json.dumps(payload).encode())
+
+            def do_PUT(self):
+                parsed = urllib.parse.urlparse(self.path)
+                if not parsed.path.startswith("/blobs/"):
+                    self._json(404, {"error": "not found"})
+                    return
+                try:
+                    key = _safe_key(parsed.path[len("/blobs/"):])
+                    length = int(self.headers.get("Content-Length", "0"))
+                    data = self.rfile.read(length)
+                    dest = server.root / key
+                    dest.parent.mkdir(parents=True, exist_ok=True)
+                    tmp = dest.with_suffix(dest.suffix + ".tmp-upload")
+                    tmp.write_bytes(data)
+                    tmp.replace(dest)
+                    self._json(200, {"key": key, "size": len(data)})
+                except Exception as e:
+                    self._json(400, {"error": str(e)})
+
+            def do_DELETE(self):
+                parsed = urllib.parse.urlparse(self.path)
+                if not parsed.path.startswith("/blobs/"):
+                    self._json(404, {"error": "not found"})
+                    return
+                try:
+                    key = _safe_key(parsed.path[len("/blobs/"):])
+                    target = server.root / key
+                    if target.is_file():
+                        target.unlink()
+                        self._json(200, {"deleted": key})
+                    else:
+                        self._json(404, {"error": f"no blob {key}"})
+                except Exception as e:
+                    self._json(400, {"error": str(e)})
+
+            def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                if parsed.path == "/healthz":
+                    self._json(200, {"status": "ok"})
+                    return
+                if parsed.path == "/blobs":
+                    q = urllib.parse.parse_qs(parsed.query)
+                    prefix = q.get("prefix", [""])[0].strip("/")
+                    base = server.root
+                    keys = sorted(
+                        str(p.relative_to(base))
+                        for p in base.rglob("*")
+                        if p.is_file()
+                        and str(p.relative_to(base)).startswith(prefix)
+                    )
+                    self._json(200, {"keys": keys})
+                    return
+                if parsed.path.startswith("/blobs/"):
+                    try:
+                        key = _safe_key(parsed.path[len("/blobs/"):])
+                    except ValueError as e:
+                        self._json(400, {"error": str(e)})
+                        return
+                    target = server.root / key
+                    if not target.is_file():
+                        self._json(404, {"error": f"no blob {key}"})
+                        return
+                    self._send(200, target.read_bytes(),
+                               "application/octet-stream")
+                    return
+                self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                parsed = urllib.parse.urlparse(self.path)
+                if parsed.path != "/persist/call":
+                    self._json(404, {"error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    method = req.get("method", "")
+                    if method not in _PERSIST_METHODS:
+                        self._json(400, {"error": f"unknown method {method!r}"})
+                        return
+                    result = server._call(method, req.get("kwargs") or {})
+                    self._json(200, {"result": result})
+                except Exception as e:
+                    self._json(500, {"error": str(e)})
+
+        self._http = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._http.server_address[:2]
+        self.base_url = f"http://{self.host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    def _call(self, method: str, kwargs: dict):
+        """Decode typed args, dispatch to the SQLite backend, re-encode."""
+        from kubedl_tpu.api.codec import decode
+        from kubedl_tpu.persist.backends import Query
+        from kubedl_tpu.persist.dmo import EventInfo, JobInfo, ReplicaInfo, to_jsonable
+
+        typed = {
+            "job": JobInfo, "pod": ReplicaInfo, "ev": EventInfo,
+            "query": Query,
+        }
+        call_kwargs = {}
+        for k, v in kwargs.items():
+            cls = typed.get(k)
+            call_kwargs[k] = decode(cls, v) if cls and isinstance(v, dict) else v
+        with self._lock:
+            out = getattr(self.backend, method)(**call_kwargs)
+        return to_jsonable(out)
+
+    def start(self) -> "RemoteStoreServer":
+        self._thread = threading.Thread(
+            target=self._http.serve_forever, kwargs={"poll_interval": 0.2},
+            daemon=True, name="remote-store",
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.backend.close()
+
+    def __enter__(self) -> "RemoteStoreServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

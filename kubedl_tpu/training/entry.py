@@ -59,6 +59,11 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
     raw = os.environ.get("KUBEDL_TRAIN_CONFIG", "{}")
     opts = json.loads(raw)
     model = _model_preset(opts.get("model", "tiny"))
+    import dataclasses
+
+    for knob in ("remat_policy", "loss_chunk"):
+        if knob in opts and hasattr(model, knob):
+            model = dataclasses.replace(model, **{knob: opts[knob]})
     cfg = TrainConfig(
         model=model,
         global_batch=int(opts.get("global_batch", 8)),
@@ -70,6 +75,7 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
         context_parallel_impl=opts.get("context_parallel_impl", "ring"),
         microbatches=int(opts.get("microbatches", 0)),
         ckpt_every=int(opts.get("ckpt_every", 0)),
+        opt_moment_dtype=opts.get("opt_moment_dtype", "float32"),
     )
     mesh = mesh_from_env()
     trainer = Trainer(cfg, mesh)

@@ -60,6 +60,8 @@ class ModelFamily:
     #: leading (stacked-layer) axis key for pipeline splitting; None = no
     #: pipeline support for this family
     layers_key: Optional[str] = "layers"
+    #: () -> PipelineHooks for GPipe mode; None = family can't pipeline
+    pipeline_hooks: Optional[Callable[[], Any]] = None
 
 
 def llama_family(cfg: llama.LlamaConfig) -> ModelFamily:
@@ -73,6 +75,7 @@ def llama_family(cfg: llama.LlamaConfig) -> ModelFamily:
         num_params=cfg.num_params(),
         flops_per_token=cfg.flops_per_token(),
         vocab_size=cfg.vocab_size,
+        pipeline_hooks=lambda: llama.pipeline_hooks(cfg),
     )
 
 
@@ -89,6 +92,7 @@ def moe_family(cfg) -> ModelFamily:
         num_params=cfg.num_params(),
         flops_per_token=cfg.flops_per_token(),
         vocab_size=cfg.vocab_size,
+        pipeline_hooks=lambda: moe.pipeline_hooks(cfg),
     )
 
 
@@ -128,6 +132,10 @@ class TrainConfig:
     microbatches: int = 0
     #: save a checkpoint every N steps (0 = only via explicit fit args)
     ckpt_every: int = 0
+    #: dtype of the adam FIRST moment (mu). "bfloat16" halves mu's HBM —
+    #: mu is a running mean of grads and tolerates bf16; nu (the second
+    #: moment) stays fp32 because rsqrt amplifies its quantization.
+    opt_moment_dtype: str = "float32"
     seed: int = 0
 
 
@@ -141,7 +149,8 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     )
     return optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip),
-        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=cfg.weight_decay),
+        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=cfg.weight_decay,
+                    mu_dtype=jnp.dtype(cfg.opt_moment_dtype)),
     )
 
 
@@ -349,15 +358,11 @@ class Trainer:
         from kubedl_tpu.parallel.pipeline import make_pipeline
 
         cfg = self.cfg
-        mcfg = cfg.model
-        import importlib
-
-        model_mod = importlib.import_module(type(mcfg).__module__)
-        if not hasattr(model_mod, "pipeline_hooks"):
+        if self.family.pipeline_hooks is None:
             raise ValueError(
                 f"model family {self.family.name!r} has no pipeline_hooks"
             )
-        hooks = model_mod.pipeline_hooks(mcfg)
+        hooks = self.family.pipeline_hooks()
         M = cfg.microbatches or 4 * self.pipe_size
         if cfg.global_batch % M:
             raise ValueError(

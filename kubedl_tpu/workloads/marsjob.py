@@ -20,7 +20,8 @@ from typing import List, Optional
 
 from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadController
 from kubedl_tpu.api.types import ReplicaType
-from kubedl_tpu.core.objects import Pod
+from kubedl_tpu.core.objects import IngressRoute, OwnerRef, Pod
+from kubedl_tpu.core.store import AlreadyExists
 from kubedl_tpu.workloads.common import add_dag_edge, replica_endpoints
 
 
@@ -79,6 +80,53 @@ class MarsJobController(WorkloadController):
         return rtype == ReplicaType.SCHEDULER
 
     # ------------------------------------------------------------------
+
+    def prepare(self, job: JobObject, ctx: ReconcileContext, store) -> None:
+        """Create/refresh the web UI routing object when ``web_host`` is
+        set (reference: reconcileIngressForJob, ingress.go:37-166 — the
+        reference creates a real networking/v1 Ingress; here an
+        IngressRoute carries the same host/path->service rule and is
+        owner-GC'd with the job)."""
+        assert isinstance(job, MarsJob)
+        if not job.web_host:
+            return
+        ws = job.spec.replica_specs.get(ReplicaType.WEBSERVICE)
+        if ws is None:
+            return
+        name = f"{job.metadata.name}-web"
+        # route to webservice replica 0's headless service, on its port
+        svc = f"{job.metadata.name}-webservice-0"
+        from kubedl_tpu.api import constants
+
+        main = ws.template.spec.main_container()
+        port = main.ports[0].port if main.ports else constants.DEFAULT_PORT
+        path = f"/{job.metadata.namespace}/{job.metadata.name}"
+        existing = store.try_get("IngressRoute", name, job.metadata.namespace)
+        if existing is None:
+            route = IngressRoute(
+                host=job.web_host, path=path, service=svc, port=port
+            )
+            route.metadata.name = name
+            route.metadata.namespace = job.metadata.namespace
+            route.metadata.owner_refs.append(OwnerRef(
+                kind=job.kind, name=job.metadata.name, uid=job.metadata.uid
+            ))
+            try:
+                store.create(route)
+            except AlreadyExists:
+                pass
+        elif (existing.host, existing.path, existing.service, existing.port) != (
+            job.web_host, path, svc, port
+        ):
+            def mutate(obj: IngressRoute) -> None:  # type: ignore[type-arg]
+                obj.host = job.web_host
+                obj.path = path
+                obj.service = svc
+                obj.port = port
+
+            store.update_with_retry(
+                "IngressRoute", name, job.metadata.namespace, mutate
+            )
 
     def set_mesh_spec(
         self,

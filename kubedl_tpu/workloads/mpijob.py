@@ -27,7 +27,7 @@ to ssh for true multi-host.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List
+from typing import List, Optional
 
 from kubedl_tpu.api import constants
 from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadController
@@ -58,6 +58,30 @@ esac
 
 
 @dataclass
+class MPILegacySpec:
+    """v1alpha1/v1alpha2 MPIJob field spellings (reference:
+    controllers/mpi/legacy.go:1-126): older specs sized the worker fleet by
+    total processing units instead of replica counts. The codec accepts
+    them and :meth:`MPIJobController.apply_defaults` converts into the
+    current schema (replicas + slots_per_worker), never overriding fields
+    the user set explicitly."""
+
+    #: total accelerator units across the job (v1alpha1 `gpus`, deprecated
+    #: spelling of processing_units)
+    gpus: Optional[int] = None
+    gpus_per_node: Optional[int] = None
+    processing_units: Optional[int] = None
+    processing_units_per_node: Optional[int] = None
+    #: direct worker count (used when no unit counts are given)
+    replicas: Optional[int] = None
+    #: container resource key the per-worker units are read from, e.g.
+    #: "tpu" (v1alpha1 `processingResourceType`)
+    processing_resource_type: str = ""
+    #: legacy top-level cleanPodPolicy (moved into runPolicy since)
+    clean_pod_policy: Optional[str] = None
+
+
+@dataclass
 class MPIJob(JobObject):
     KIND = "MPIJob"
     #: OpenMPI (default) | IntelMPI | MPICH — decides hostfile syntax and
@@ -65,6 +89,8 @@ class MPIJob(JobObject):
     mpi_distribution: str = OPEN_MPI
     #: MPI slots per worker; defaults to the worker's TPU chip count or 1
     slots_per_worker: int = 0
+    #: legacy v1alpha1/v1alpha2 spellings, converted at defaulting time
+    legacy_spec: Optional[MPILegacySpec] = None
 
 
 class MPIJobController(WorkloadController):
@@ -86,8 +112,9 @@ class MPIJobController(WorkloadController):
     def apply_defaults(self, job: JobObject) -> None:
         """Launcher DAG-waits for all workers Running; idle workers default
         to `sleep 365d` (reference: mpijob_controller.go:282-287)."""
-        super().apply_defaults(job)
         assert isinstance(job, MPIJob)
+        self._convert_legacy(job)
+        super().apply_defaults(job)
         specs = job.spec.replica_specs
         add_dag_edge(job, ReplicaType.LAUNCHER, ReplicaType.WORKER)
         worker = specs.get(ReplicaType.WORKER)
@@ -98,6 +125,75 @@ class MPIJobController(WorkloadController):
         if job.slots_per_worker <= 0 and worker is not None:
             main = worker.template.spec.main_container()
             job.slots_per_worker = int(main.resources.get("tpu", 0)) or 1
+
+    def _convert_legacy(self, job: MPIJob) -> None:
+        """Fold v1alpha1/v1alpha2 spellings into the current schema
+        (reference: LegacyMPIJobToV1MPIJob, legacy.go:32-79). User-set
+        current-schema fields always win. The unit math follows
+        processingUnitsPerWorker (legacy.go:82-126) with its evident
+        `&`-for-`%` typo corrected: units must be a MULTIPLE of
+        units-per-node, checked with modulo."""
+        legacy = job.legacy_spec
+        if legacy is None:
+            return
+        from kubedl_tpu.api.types import CleanPodPolicy, ReplicaSpec
+
+        if legacy.clean_pod_policy:
+            # the legacy field is explicit user input; it overrides the
+            # run-policy default (reference: legacy.go:39-41)
+            job.spec.run_policy.clean_pod_policy = CleanPodPolicy(
+                legacy.clean_pod_policy
+            )
+        if legacy.gpus is not None and legacy.processing_units is not None:
+            raise ValueError(
+                "legacy spec cannot set both gpus and processing_units"
+            )
+        # mixed spellings across the two generations would silently pick
+        # per_node=1 and mis-size the fleet — reject them loudly
+        if legacy.gpus is not None and legacy.processing_units_per_node is not None:
+            raise ValueError(
+                "legacy spec mixes gpus with processing_units_per_node; "
+                "use gpus_per_node"
+            )
+        if legacy.processing_units is not None and legacy.gpus_per_node is not None:
+            raise ValueError(
+                "legacy spec mixes processing_units with gpus_per_node; "
+                "use processing_units_per_node"
+            )
+        total = legacy.processing_units if legacy.processing_units is not None else legacy.gpus
+        per_node = (
+            legacy.processing_units_per_node
+            if legacy.processing_units is not None
+            else legacy.gpus_per_node
+        ) or 1
+        workers = units_per_worker = 0
+        if total is not None:
+            if total < per_node:
+                workers, units_per_worker = 1, total
+            elif total % per_node == 0:
+                workers, units_per_worker = total // per_node, per_node
+            else:
+                raise ValueError(
+                    f"legacy processing units {total} must be a multiple "
+                    f"of units per node {per_node}"
+                )
+        elif legacy.replicas is not None:
+            workers = legacy.replicas
+            spec = job.spec.replica_specs.get(ReplicaType.WORKER)
+            if spec is not None and legacy.processing_resource_type:
+                main = spec.template.spec.main_container()
+                units_per_worker = int(
+                    main.resources.get(legacy.processing_resource_type, 0)
+                )
+        if job.slots_per_worker <= 0 and units_per_worker > 0:
+            job.slots_per_worker = units_per_worker
+        if workers > 0:
+            spec = job.spec.replica_specs.get(ReplicaType.WORKER)
+            if spec is None:
+                spec = ReplicaSpec(replicas=workers)
+                job.spec.replica_specs[ReplicaType.WORKER] = spec
+            elif spec.replicas <= 0:
+                spec.replicas = workers
 
     def reconcile_orders(self) -> List[ReplicaType]:
         return [ReplicaType.WORKER, ReplicaType.LAUNCHER]

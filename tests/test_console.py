@@ -422,6 +422,53 @@ def test_frontend_spa_served(console):
     status, body = call(srv, "GET", "/", raw=True)
     assert status == 200
     html = body.decode()
-    for frag in ("#/jobs", "#/models", "#/submit", "#/sources",
-                 "cluster/slices", "model/list"):
+    for frag in ("#/jobs", "#/models", "#/submit", "#/sources", "#/charts"):
         assert frag in html, frag
+    # routes moved into the static app bundle with the round-3 split
+    status, js = call(srv, "GET", "/static/app.js", raw=True)
+    assert status == 200
+    text = js.decode()
+    for frag in ("cluster/slices", "model/list", "data/charts"):
+        assert frag in text, frag
+
+
+def test_static_assets_and_index(console):
+    """Round-3 console split: the SPA is served from real static files
+    (index + app.js + style.css), no longer one embedded string."""
+    op, srv = console
+    status, body = call(srv, "GET", "/", raw=True)
+    assert status == 200
+    html = body.decode()
+    assert '<script src="/static/app.js">' in html
+    assert '<link rel="stylesheet" href="/static/style.css">' in html
+    status, js = call(srv, "GET", "/static/app.js", raw=True)
+    assert status == 200
+    text = js.decode()
+    assert "VIEWS.charts" in text and "VIEWS.overview" in text
+    status, css = call(srv, "GET", "/static/style.css", raw=True)
+    assert status == 200 and b".tile" in css
+    # traversal-safe
+    status, _ = call(srv, "GET", "/static/..%2Ffrontend.py", raw=True)
+    assert status == 404
+    status, _ = call(srv, "GET", "/static/nope.js", raw=True)
+    assert status == 404
+
+
+def test_charts_endpoint_serves_metric_snapshots(console):
+    op, srv = console
+    submit_and_wait(op, srv, "chart1")
+    status, resp = call(srv, "GET", "/api/v1/data/charts")
+    assert status == 200
+    d = resp["data"]
+    first = d["launch_delay"]["first_pod"]
+    assert first and first[0]["labels"].get("kind") == "TPUJob"
+    assert first[0]["total"] >= 1
+    assert len(first[0]["buckets"]) == len(first[0]["counts"])
+    assert sum(first[0]["counts"]) >= 1  # the launch landed in a bucket
+    created = {r["labels"].get("kind"): r["value"] for r in d["counters"]["created"]}
+    assert created.get("TPUJob", 0) >= 1
+    succ = {r["labels"].get("kind"): r["value"] for r in d["counters"]["successful"]}
+    assert succ.get("TPUJob", 0) >= 1
+    gauges = d["gauges"]
+    assert any(r["labels"].get("kind") == "TPUJob" for r in gauges["running"])
+    assert d["serving"] == []  # no inference objects in this fixture

@@ -1,0 +1,123 @@
+"""Operator HA: lease-based leader election (VERDICT r2 #7; reference:
+main.go:76-84 "kubedl-election"). Two operators share one object world;
+only the lease holder reconciles, and when the holder dies the follower
+takes over within the lease TTL."""
+
+import time
+
+import pytest
+
+from kubedl_tpu.core.leases import Lease, LeaderElector
+from kubedl_tpu.core.store import ObjectStore
+
+
+class TestLeaderElector:
+    def test_first_candidate_wins_second_waits(self):
+        store = ObjectStore()
+        t = {"now": 100.0}
+        a = LeaderElector(store, identity="a", ttl=5.0, clock=lambda: t["now"])
+        b = LeaderElector(store, identity="b", ttl=5.0, clock=lambda: t["now"])
+        assert a._try_acquire()
+        assert not b._try_acquire()
+        lease = store.get("Lease", a.name, a.namespace)
+        assert lease.holder == "a" and lease.transitions == 0
+
+    def test_takeover_only_after_expiry_and_fencing_bump(self):
+        store = ObjectStore()
+        t = {"now": 100.0}
+        a = LeaderElector(store, identity="a", ttl=5.0, clock=lambda: t["now"])
+        b = LeaderElector(store, identity="b", ttl=5.0, clock=lambda: t["now"])
+        assert a._try_acquire()
+        t["now"] += 4.0
+        assert not b._try_acquire()  # not expired yet
+        t["now"] += 2.0  # 6s since renew > ttl
+        assert b._try_acquire()
+        lease = store.get("Lease", b.name, b.namespace)
+        assert lease.holder == "b"
+        assert lease.transitions == 1  # fencing token bumped
+        # deposed holder cannot renew
+        assert not a._renew()
+
+    def test_release_allows_immediate_takeover(self):
+        store = ObjectStore()
+        t = {"now": 100.0}
+        a = LeaderElector(store, identity="a", ttl=60.0, clock=lambda: t["now"])
+        b = LeaderElector(store, identity="b", ttl=60.0, clock=lambda: t["now"])
+        assert a._try_acquire()
+        a.release()
+        assert b._try_acquire()  # no TTL wait after clean release
+
+
+class TestOperatorHA:
+    def test_only_holder_reconciles_and_failover(self, tmp_path):
+        """The VERDICT done-criterion: two operators, one store; only the
+        holder reconciles; kill it and the follower takes over within the
+        lease TTL (and actually completes work)."""
+        from tests.helpers import make_tpujob
+
+        from kubedl_tpu.api.types import JobConditionType
+        from kubedl_tpu.operator import Operator, OperatorOptions
+        from kubedl_tpu.runtime.executor import SubprocessRuntime
+
+        store = ObjectStore()
+        logs = str(tmp_path / "logs")
+
+        def opts(ident):
+            return OperatorOptions(
+                local_addresses=True, pod_log_dir=logs,
+                artifact_registry_root=str(tmp_path / f"reg-{ident}"),
+                leader_elect=True, leader_identity=ident,
+                leader_lease_ttl=0.6,
+            )
+
+        op1 = Operator(opts("op1"), runtime=SubprocessRuntime(logs), store=store)
+        op2 = Operator(opts("op2"), runtime=SubprocessRuntime(logs), store=store)
+        op1.start()
+        # op1 campaigns alone first so leadership is deterministic
+        deadline = time.time() + 5
+        while time.time() < deadline and not op1.elector.is_leader:
+            time.sleep(0.02)
+        assert op1.elector.is_leader
+        op2.start()
+        time.sleep(1.0)  # give op2 time to (NOT) steal
+        assert not op2.elector.is_leader
+        assert op1.manager._running and not op2.manager._running
+
+        try:
+            # work completes under the leader
+            job = make_tpujob("ha1", workers=1, command=["true"])
+            op1.submit(job)
+            got = op1.wait_for_phase(
+                "TPUJob", "ha1",
+                [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+                timeout=60,
+            )
+            assert got.status.phase == JobConditionType.SUCCEEDED
+
+            # kill the holder WITHOUT a clean release (simulated crash:
+            # stop its campaign thread and its manager, keep the lease)
+            op1.elector._stop.set()
+            op1.elector._thread.join(timeout=2)
+            op1._on_deposed()
+
+            # follower takes over within ~TTL
+            deadline = time.time() + 10
+            while time.time() < deadline and not op2.elector.is_leader:
+                time.sleep(0.05)
+            assert op2.elector.is_leader
+            assert op2.manager._running
+            lease = store.get("Lease", "kubedl-election", "kubedl-system")
+            assert lease.holder == "op2" and lease.transitions == 1
+
+            # and actually reconciles new work
+            job2 = make_tpujob("ha2", workers=1, command=["true"])
+            op2.submit(job2)
+            got2 = op2.wait_for_phase(
+                "TPUJob", "ha2",
+                [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+                timeout=60,
+            )
+            assert got2.status.phase == JobConditionType.SUCCEEDED
+        finally:
+            op1.stop()
+            op2.stop()

@@ -425,3 +425,34 @@ def test_torn_save_fails_uniformly_not_just_on_affected_region(tmp_path):
     # and restore_checkpoint refuses the step entirely (falls back to None)
     template = {"w": jax.device_put(jnp.zeros((8, 8)), sharding)}
     assert ck.restore_checkpoint(str(tmp_path), template) is None
+
+
+def test_chunked_loss_matches_full(tmp_path):
+    """cfg.loss_chunk must not change the loss value or its gradient —
+    only the peak memory (the [B,S,V] logits never materialize)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubedl_tpu.models import llama
+
+    cfg = llama.TINY
+    params = llama.llama_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 37), 0,
+                                cfg.vocab_size)  # odd S: exercises padding
+    cfg_chunked = dataclasses.replace(cfg, loss_chunk=16)
+    full = jax.jit(lambda p, t: llama.llama_loss(p, t, cfg))
+    chunked = jax.jit(lambda p, t: llama.llama_loss(p, t, cfg_chunked))
+    np.testing.assert_allclose(float(full(params, tokens)),
+                               float(chunked(params, tokens)),
+                               rtol=1e-5, atol=1e-5)
+    g_full = jax.jit(jax.grad(lambda p: llama.llama_loss(p, tokens, cfg)))(params)
+    g_chunk = jax.jit(jax.grad(
+        lambda p: llama.llama_loss(p, tokens, cfg_chunked)
+    ))(params)
+    for kf, kc in zip(jax.tree_util.tree_leaves(g_full),
+                      jax.tree_util.tree_leaves(g_chunk)):
+        np.testing.assert_allclose(np.asarray(kf), np.asarray(kc),
+                                   rtol=2e-4, atol=2e-4)

@@ -1,0 +1,214 @@
+"""Network-remote storage (VERDICT r2 #8): the HTTP blob + persist store.
+
+Every prior backend/provider was local-disk; these tests prove both
+registries across a REAL network boundary (a localhost HTTP server):
+unit coverage for blobs and the persist RPC, then the full e2e — train
+-> staging -> MV build uploads blobs -> serving fetches blobs over HTTP
+and serves the TRAINED weights, while the persist mirror writes job/pod/
+event rows through the same server.
+"""
+
+import json
+import time
+
+import pytest
+
+from kubedl_tpu.persist.backends import Query
+from kubedl_tpu.persist.dmo import EventInfo, JobInfo, ReplicaInfo
+from kubedl_tpu.persist.http_backend import HTTPBackend
+from kubedl_tpu.remote import (
+    RemoteStoreServer,
+    download_tree,
+    get_blob,
+    is_remote_root,
+    list_blobs,
+    put_blob,
+    upload_tree,
+)
+
+
+@pytest.fixture()
+def server(tmp_path):
+    with RemoteStoreServer(str(tmp_path / "remote-root")) as srv:
+        yield srv
+
+
+class TestBlobs:
+    def test_put_get_list_roundtrip(self, server):
+        put_blob(server.base_url, "a/b.bin", b"hello")
+        put_blob(server.base_url, "a/c.bin", b"world")
+        put_blob(server.base_url, "z.bin", b"!")
+        assert get_blob(server.base_url, "a/b.bin") == b"hello"
+        assert list_blobs(server.base_url, "a") == ["a/b.bin", "a/c.bin"]
+        assert len(list_blobs(server.base_url)) == 3
+
+    def test_traversal_rejected(self, server):
+        from kubedl_tpu.remote.client import RemoteError
+
+        with pytest.raises(RemoteError):
+            get_blob(server.base_url, "../../etc/passwd")
+
+    def test_tree_roundtrip(self, server, tmp_path):
+        src = tmp_path / "src"
+        (src / "sub").mkdir(parents=True)
+        (src / "x.txt").write_bytes(b"x")
+        (src / "sub" / "y.txt").write_bytes(b"y")
+        root = f"{server.base_url}/blobs/model/v1"
+        assert is_remote_root(root)
+        assert upload_tree(str(src), root) == 2
+        dest = tmp_path / "dest"
+        assert download_tree(root, str(dest)) == 2
+        assert (dest / "sub" / "y.txt").read_bytes() == b"y"
+
+
+class TestHTTPPersist:
+    def test_job_rows_over_the_wire(self, server):
+        b = HTTPBackend(server.base_url)
+        b.initialize()
+        b.save_job(JobInfo(uid="u1", name="j1", kind="TPUJob",
+                           phase="Running", created_at=1.0))
+        got = b.get_job("default", "j1", "TPUJob")
+        assert got is not None and got.uid == "u1" and got.phase == "Running"
+        b.save_job(JobInfo(uid="u1", name="j1", kind="TPUJob",
+                           phase="Succeeded", created_at=1.0))
+        rows = b.list_jobs(Query(kind="TPUJob"))
+        assert [r.phase for r in rows] == ["Succeeded"]
+        b.mark_job_deleted("default", "j1", "TPUJob")
+        rows = b.list_jobs(Query(kind="TPUJob", include_deleted=True))
+        assert rows and not rows[0].is_in_etcd
+
+    def test_pods_and_events(self, server):
+        b = HTTPBackend(server.base_url)
+        b.save_pod(ReplicaInfo(uid="p1", name="j1-worker-0", job_uid="u1",
+                               replica_type="Worker", phase="Running"))
+        pods = b.list_pods("u1")
+        assert [p.name for p in pods] == ["j1-worker-0"]
+        b.save_event(EventInfo(name="e1", involved_kind="TPUJob",
+                               involved_name="j1", reason="Created",
+                               last_timestamp=2.0))
+        evs = b.list_events("TPUJob", "j1")
+        assert [e.reason for e in evs] == ["Created"]
+
+
+class TestRemoteE2E:
+    def test_train_build_serve_and_persist_through_http(self, tmp_path):
+        """The VERDICT done-criterion: persist mirror + MV build + serving
+        load round-trip through the network store."""
+        import urllib.request
+
+        from kubedl_tpu.api.types import (
+            JobConditionType, ModelVersionSpecRef, ReplicaSpec, ReplicaType,
+            RestartPolicy,
+        )
+        from kubedl_tpu.core.objects import Container, EnvVar
+        from kubedl_tpu.lineage.storage import RemoteBlobProvider, register_storage_provider
+        from kubedl_tpu.lineage.types import ModelVersionPhase
+        from kubedl_tpu.operator import Operator, OperatorOptions
+        from kubedl_tpu.runtime.executor import ThreadRuntime
+        from kubedl_tpu.serving.types import Framework, Inference, Predictor
+        from kubedl_tpu.workloads.tpujob import TPUJob
+
+        with RemoteStoreServer(str(tmp_path / "remote-root")) as srv:
+            # isolate this test's staging from other runs
+            register_storage_provider(
+                RemoteBlobProvider(staging_root=str(tmp_path / "staging"))
+            )
+            remote_root = f"{srv.base_url}/blobs/models/m1"
+            opts = OperatorOptions(
+                local_addresses=True,
+                pod_log_dir=str(tmp_path / "logs"),
+                artifact_registry_root=str(tmp_path / "reg"),
+                meta_storage="http", event_storage="http",
+                remote_storage_url=srv.base_url,
+            )
+            with Operator(opts, runtime=ThreadRuntime()) as op:
+                job = TPUJob()
+                job.metadata.name = "rtrain"
+                spec = ReplicaSpec(
+                    replicas=1, restart_policy=RestartPolicy.ON_FAILURE_SLICE
+                )
+                spec.template.spec.containers.append(Container(
+                    entrypoint="kubedl_tpu.training.entry:train_main",
+                    env=[EnvVar("KUBEDL_TRAIN_CONFIG", json.dumps(
+                        {"model": "tiny", "steps": 4, "global_batch": 8,
+                         "seq_len": 32}
+                    ))],
+                ))
+                job.spec.replica_specs[ReplicaType.WORKER] = spec
+                job.spec.model_version = ModelVersionSpecRef(
+                    model_name="m1", storage_root=remote_root,
+                    storage_provider="http",
+                )
+                op.submit(job)
+                got = op.wait_for_phase(
+                    "TPUJob", "rtrain",
+                    [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+                    timeout=120,
+                )
+                assert got.status.phase == JobConditionType.SUCCEEDED
+
+                # MV builds; artifact_dir publishes staging -> remote blobs
+                deadline = time.time() + 60
+                mv = None
+                while time.time() < deadline:
+                    mvs = op.store.list("ModelVersion", "default")
+                    if mvs and mvs[0].phase in (
+                        ModelVersionPhase.SUCCEEDED, ModelVersionPhase.FAILED
+                    ):
+                        mv = mvs[0]
+                        break
+                    time.sleep(0.2)
+                assert mv is not None and mv.phase == ModelVersionPhase.SUCCEEDED, (
+                    mv and mv.message
+                )
+                blobs = list_blobs(srv.base_url, "models/m1")
+                assert any("shards-p0" in b for b in blobs), blobs
+                assert any(b.endswith("latest") for b in blobs), blobs
+
+                # serving fetches the blobs over HTTP and serves trained
+                # weights (compare against a direct local engine)
+                port = 18095
+                pred = Predictor(name="main", model_version=mv.metadata.name)
+                pred.template.spec.main_container().set_env(
+                    "KUBEDL_SERVE_CONFIG",
+                    json.dumps({"port": port, "preset": "tiny"}),
+                )
+                inf = Inference(framework=Framework.JAX, predictors=[pred])
+                inf.metadata.name = "rserve"
+                op.store.create(inf)
+
+                result = None
+                deadline = time.time() + 90
+                while time.time() < deadline and result is None:
+                    try:
+                        req = urllib.request.Request(
+                            f"http://127.0.0.1:{port}/v1/generate",
+                            data=json.dumps({"prompt_ids": [3, 7],
+                                             "max_tokens": 5}).encode(),
+                            headers={"Content-Type": "application/json"},
+                        )
+                        with urllib.request.urlopen(req, timeout=5) as resp:
+                            result = json.loads(resp.read())
+                    except Exception:
+                        time.sleep(0.5)
+                assert result is not None, "remote-backed server never answered"
+
+                from kubedl_tpu.serving.server import LlamaEngine
+
+                local_dir = tmp_path / "local-copy"
+                download_tree(remote_root, str(local_dir))
+                eng = LlamaEngine(preset="tiny", ckpt_dir=str(local_dir))
+                try:
+                    want = eng.generate([3, 7], max_tokens=5)["token_ids"]
+                finally:
+                    eng.close()
+                assert result["token_ids"] == want
+
+                # persist mirror wrote THROUGH the network store
+                rows = srv.backend.list_jobs(Query(kind="TPUJob"))
+                assert [r.name for r in rows] == ["rtrain"]
+                assert rows[0].phase == "Succeeded"
+                pods = srv.backend.list_pods(rows[0].uid)
+                assert pods and pods[0].name == "rtrain-worker-0"
+                evs = srv.backend.list_events("TPUJob", "rtrain")
+                assert any(e.reason for e in evs)
