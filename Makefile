@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast bench-smoke bench dryrun install lint all
+.PHONY: test test-fast bench-smoke bench dryrun install lint all render-deploy
 
 all: test
 
@@ -26,6 +26,11 @@ bench:
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	  $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"
+
+# parameterized deploy surface: manifests from deploy/values.yaml +
+# CRD-equivalent JSON Schemas for every kind (reference: helm + config/crd)
+render-deploy:
+	$(PY) deploy/render.py
 
 install:
 	$(PY) -m pip install -e .

@@ -1,0 +1,428 @@
+"""Benchmark: end-to-end TPUJob through the operator on real hardware.
+
+Measures the BASELINE.md north stars in one run:
+- tokens/sec/chip of the flagship Llama trainer (headline metric), and
+- job-startup-to-first-step latency through the full control plane
+  (submit -> gang admission -> pod launch -> first optimizer step).
+
+The reference publishes no numbers (BASELINE.md): vs_baseline is therefore
+reported against the explicit target we set ourselves — 10% MFU on the
+bench model (vs_baseline = achieved_MFU / 0.10); on CPU (no TPU attached)
+it falls back to 1.0.
+
+Hard sanity gates (round-1 lesson: the bench printed a physically
+impossible MFU of 538% — VERDICT.md): the run FAILS if MFU > 1, if the
+step time beats the HBM param-read floor, if loss didn't decrease, or if
+the TPU run didn't actually trace the pallas flash kernel into the hot
+path. A failed gate exits nonzero rather than printing a lying number.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def bench_control_plane() -> dict:
+    """BASELINE.md targets 1-3: launch-delay latency through the full
+    control plane for the reference's own workload kinds (TFJob 1-worker,
+    PyTorchJob master+3 workers, MPIJob launcher+2 workers), measured by
+    the same first/all-pods histograms the reference instruments
+    (pkg/metrics/job_metrics.go:139-194)."""
+    import tempfile
+
+    from kubedl_tpu.api.types import (
+        JobConditionType, ReplicaSpec, ReplicaType, RestartPolicy,
+    )
+    from kubedl_tpu.core.objects import Container
+    from kubedl_tpu.operator import Operator, OperatorOptions
+    from kubedl_tpu.runtime.executor import SubprocessRuntime
+    from kubedl_tpu.workloads.mpijob import MPIJob
+    from kubedl_tpu.workloads.pytorchjob import PyTorchJob
+    from kubedl_tpu.workloads.tfjob import TFJob
+
+    def add(job, rtype, n, argv):
+        spec = ReplicaSpec(replicas=n, restart_policy=RestartPolicy.ON_FAILURE)
+        spec.template.spec.containers.append(Container(command=argv))
+        job.spec.replica_specs[rtype] = spec
+
+    py = sys.executable
+    out = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        logs = os.path.join(tmp, "logs")
+        opts = OperatorOptions(
+            local_addresses=True, pod_log_dir=logs,
+            artifact_registry_root=os.path.join(tmp, "reg"),
+        )
+        with Operator(opts, runtime=SubprocessRuntime(logs)) as op:
+            tf = TFJob(); tf.metadata.name = "b-tf"
+            add(tf, ReplicaType.WORKER, 1,
+                [py, "-c", "import os; assert 'TF_CONFIG' in os.environ"])
+            pt = PyTorchJob(); pt.metadata.name = "b-pt"
+            add(pt, ReplicaType.MASTER, 1,
+                [py, "-c", "import os; assert os.environ['RANK'] == '0'"])
+            add(pt, ReplicaType.WORKER, 3,
+                [py, "-c", "import os; assert 'MASTER_ADDR' in os.environ"])
+            mpi = MPIJob(); mpi.metadata.name = "b-mpi"
+            add(mpi, ReplicaType.LAUNCHER, 1,
+                ["bash", "-c", 'test -s "$OMPI_MCA_orte_default_hostfile"'])
+            add(mpi, ReplicaType.WORKER, 2, ["sleep", "30"])
+            for job in (tf, pt, mpi):
+                op.submit(job)
+            for job in (tf, pt, mpi):
+                got = op.wait_for_phase(
+                    job.KIND, job.metadata.name,
+                    [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+                    timeout=60,
+                )
+                ok = got.status.phase == JobConditionType.SUCCEEDED
+                n1, s1 = op.metrics.first_pod_launch_delay.summary(kind=job.KIND)
+                na, sa = op.metrics.all_pods_launch_delay.summary(kind=job.KIND)
+                out[job.KIND] = {
+                    "succeeded": ok,
+                    "first_pod_launch_s": round(s1 / n1, 3) if n1 else None,
+                    "all_pods_launch_s": round(sa / na, 3) if na else None,
+                }
+    return out
+
+
+def bench_serving(on_tpu: bool) -> dict:
+    """BASELINE.md target 5: Gemma-2B decode on the chip (tiny on CPU
+    smoke). Measures the jitted continuous-batching decode step under the
+    async-dispatch / scalar-sync discipline — per-token latency at batch 1
+    and throughput at batch 8, plus time-to-first-token for a 64-token
+    prompt."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.models import llama
+
+    preset = "gemma-2b" if on_tpu else "tiny"
+    cfg = llama.preset(preset)
+    max_seq = 512 if on_tpu else 64
+    params = llama.llama_init(jax.random.PRNGKey(0), cfg)
+    decode = jax.jit(lambda p, c, t: llama.decode_step_batched(p, c, t, cfg))
+    out = {"model": preset, "n_params": cfg.num_params()}
+    steps = 32 if on_tpu else 8
+    for B in (1, 8):
+        cache = llama.init_batched_cache(cfg, B, max_seq)
+        toks = jnp.ones((B, 1), jnp.int32)
+        logits, cache = decode(params, cache, toks)  # compile
+        float(jax.device_get(jnp.sum(logits)))  # true barrier
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            logits, cache = decode(params, cache, toks)
+        float(jax.device_get(jnp.sum(logits)))
+        dt = (time.perf_counter() - t0) / steps
+        out[f"decode_ms_per_token_b{B}"] = round(dt * 1e3, 3)
+        out[f"decode_tokens_per_sec_b{B}"] = round(B / dt, 1)
+    # time-to-first-token: 64-token prompt via batched prefill (ONE
+    # forward fills the cache and yields the first token's logits —
+    # round 2 paid 64 sequential decode steps here: 633ms on v5e)
+    prefill = jax.jit(lambda p, c, t, l: llama.prefill_batched(p, c, t, l, cfg))
+    cache = llama.init_batched_cache(cfg, 1, max_seq)
+    toks = jnp.ones((1, 64), jnp.int32)
+    lens = jnp.full((1,), 64, jnp.int32)
+    logits, cache = prefill(params, cache, toks, lens)  # compile
+    float(jax.device_get(jnp.sum(logits)))
+    cache = llama.init_batched_cache(cfg, 1, max_seq)
+    t0 = time.perf_counter()
+    logits, cache = prefill(params, cache, toks, lens)
+    float(jax.device_get(jnp.sum(logits)))
+    out["ttft_64_prompt_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    return out
+
+
+def bench_long_context(on_tpu: bool) -> dict:
+    """Long-context training throughput: the flash kernel's O(S) memory is
+    what makes S=8192 trainable on one 16GB chip at all (dense attention
+    would materialize 8 GiB of scores per layer). Measures tokens/s and
+    step time at long sequence length (CPU smoke uses a tiny shape)."""
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.training.data import SyntheticTokens
+    from kubedl_tpu.training.trainer import TrainConfig, Trainer
+
+    if on_tpu:
+        import dataclasses
+
+        model = dataclasses.replace(llama.BENCH_350M, max_seq=8192)
+        batch, seq, steps = 2, 8192, 6
+    else:
+        model = llama.TINY
+        batch, seq, steps = 2, 128, 3
+    cfg = TrainConfig(model=model, global_batch=batch, seq_len=seq,
+                      steps=steps)
+    trainer = Trainer(cfg)
+    data = SyntheticTokens(batch, seq, model.vocab_size)
+    _, s = trainer.fit(iter(data))
+    return {
+        "seq_len": seq,
+        "global_batch": batch,
+        "attn_impl": s["attn_impl"],
+        "tokens_per_sec_per_chip": round(s["tokens_per_sec_per_chip"], 1),
+        "step_time_ms": round(s["step_time_ms"], 1),
+        "mfu": round(s["mfu"], 4),
+    }
+
+
+def _probe_platform() -> str:
+    """Detect the platform in a THROWAWAY subprocess so this parent process
+    does not initialize (and hold) the TPU before the headline subprocess
+    workers need it."""
+    import subprocess
+
+    code = (
+        "from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested;"
+        "ensure_cpu_if_requested();"
+        "import jax; print(jax.devices()[0].platform)"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=300,
+        )
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip().splitlines()[-1]
+        # fall back loudly: a broken probe on a TPU host must not silently
+        # reclassify the whole bench as a CPU smoke run
+        print(json.dumps({"platform_probe_failed": out.stderr[-500:]}),
+              file=sys.stderr)
+        return "cpu"
+    except Exception as e:
+        print(json.dumps({"platform_probe_failed": str(e)}), file=sys.stderr)
+        return "cpu"
+
+
+def _parse_worker_summary(log_path: str) -> dict:
+    """Pull the last `worker_summary` JSON line from a pod log."""
+    summary = None
+    with open(log_path) as f:
+        for line in f:
+            if '"worker_summary"' in line:
+                try:
+                    summary = json.loads(line)["worker_summary"]
+                except json.JSONDecodeError:
+                    continue
+    if summary is None:
+        raise RuntimeError(f"no worker_summary in {log_path}")
+    return summary
+
+
+def _submit_and_wait(op, name: str, container, get_summary) -> dict:
+    """Shared headline scaffolding: submit a single-worker TPUJob built
+    around ``container``, wait for a terminal phase, and return the worker
+    summary (via ``get_summary``) stamped with startup-to-first-step."""
+    from kubedl_tpu.api.types import (
+        JobConditionType, ReplicaSpec, ReplicaType, RestartPolicy,
+    )
+    from kubedl_tpu.workloads.tpujob import TPUJob
+
+    job = TPUJob()
+    job.metadata.name = name
+    spec = ReplicaSpec(replicas=1, restart_policy=RestartPolicy.ON_FAILURE_SLICE)
+    spec.template.spec.containers.append(container)
+    job.spec.replica_specs[ReplicaType.WORKER] = spec
+    t_submit = time.time()
+    op.submit(job)
+    got = op.wait_for_phase(
+        "TPUJob", name,
+        [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+        timeout=1800,
+    )
+    if got.status.phase != JobConditionType.SUCCEEDED:
+        raise RuntimeError(
+            f"bench job {name} failed: "
+            + "; ".join(c.message for c in got.status.conditions)
+        )
+    summary = get_summary()
+    summary["_startup_to_first_step"] = max(
+        summary.get("first_step_wall_time", 0.0) - t_submit, 0.0
+    )
+    return summary
+
+
+def _run_headline(op, name: str, train_cfg: dict, log_dir: str) -> dict:
+    """Headline via a SUBPROCESS worker (a fresh process = exactly what a
+    gang restart / resize / resume launches); summary parsed from the pod
+    log."""
+    from kubedl_tpu.core.objects import Container, EnvVar
+
+    container = Container(
+        command=[sys.executable, "-m", "kubedl_tpu.training.entry"],
+        env=[EnvVar("KUBEDL_TRAIN_CONFIG", json.dumps(train_cfg))],
+    )
+    return _submit_and_wait(op, name, container, lambda: _parse_worker_summary(
+        os.path.join(log_dir, "default", f"{name}-worker-0.log")
+    ))
+
+
+def _run_headline_inprocess(op, train_cfg: dict) -> dict:
+    """Fallback headline (round-2 shape): the worker runs in-process via
+    ThreadRuntime. Used only if the subprocess path can't produce a
+    summary (e.g. an environment where a child process can't open the
+    TPU); reports cold numbers only."""
+    from kubedl_tpu.core.objects import Container, EnvVar
+    from kubedl_tpu.training import entry as entry_mod
+
+    container = Container(
+        entrypoint="kubedl_tpu.training.entry:train_main",
+        env=[EnvVar("KUBEDL_TRAIN_CONFIG", json.dumps(train_cfg))],
+    )
+
+    def get_summary():
+        if entry_mod.LAST_SUMMARY is None:
+            raise RuntimeError("no summary captured")
+        return entry_mod.LAST_SUMMARY
+
+    return _submit_and_wait(op, "bench-inproc", container, get_summary)
+
+
+def main() -> int:
+    platform = _probe_platform()
+    on_tpu = platform == "tpu"
+
+    from kubedl_tpu.operator import Operator, OperatorOptions
+    from kubedl_tpu.runtime.executor import SubprocessRuntime, ThreadRuntime
+    from tempfile import TemporaryDirectory
+
+    # Bench model: sized for one chip; scaled down for CPU smoke runs.
+    if on_tpu:
+        train_cfg = {
+            "model": "bench-350m",
+            "global_batch": 8,
+            "seq_len": 2048,
+            "steps": 20,
+        }
+    else:
+        train_cfg = {"model": "tiny", "global_batch": 8, "seq_len": 128, "steps": 8}
+
+    summary_warm = None
+    warm_error = ""  # why warm is missing: gate-relevant on the subprocess path
+    with TemporaryDirectory() as tmp:
+        logs = os.path.join(tmp, "logs")
+        # cold AND warm startup measured against the SAME fresh compile
+        # cache: job 1 populates it, job 2 (a brand-new process, the gang-
+        # restart shape) must deserialize instead of recompile
+        opts = OperatorOptions(
+            local_addresses=True,
+            artifact_registry_root=os.path.join(tmp, "reg"),
+            pod_log_dir=logs,
+            compile_cache_dir=os.path.join(tmp, "compile-cache"),
+        )
+        try:
+            with Operator(opts, runtime=SubprocessRuntime(logs)) as op:
+                summary = _run_headline(op, "bench-cold", train_cfg, logs)
+                try:
+                    summary_warm = _run_headline(
+                        op, "bench-warm", train_cfg, logs
+                    )
+                except Exception as e:
+                    warm_error = str(e)
+                    print(json.dumps({"warm_run_error": warm_error}),
+                          file=sys.stderr)
+        except Exception as e:
+            print(json.dumps({"subprocess_headline_fallback": str(e)}),
+                  file=sys.stderr)
+            summary_warm = None  # never pair in-process cold w/ stale warm
+            warm_error = f"in-process fallback (warm N/A): {e}"
+            with Operator(opts, runtime=ThreadRuntime()) as op:
+                summary = _run_headline_inprocess(op, train_cfg)
+
+    # the headline subprocesses guard themselves; this parent's own jax
+    # (serving/long-context benches below) needs the same CPU guard
+    from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested
+
+    ensure_cpu_if_requested()
+
+    # ---- hard sanity gates --------------------------------------------
+    violations = list(summary.get("sanity_violations") or [])
+    if on_tpu:
+        if summary.get("attn_impl") != "flash":
+            violations.append(
+                f"TPU bench ran attn_impl={summary.get('attn_impl')!r}, "
+                "expected the pallas flash kernel"
+            )
+        elif not summary.get("flash_trace_count"):
+            violations.append(
+                "attn_impl claims flash but the pallas kernel was never traced"
+            )
+        if summary_warm is not None:
+            cold_s = summary.get("_startup_to_first_step", 0.0)
+            warm_s = summary_warm.get("_startup_to_first_step", 0.0)
+            if warm_s >= cold_s:
+                violations.append(
+                    f"warm startup {warm_s:.1f}s not better than cold "
+                    f"{cold_s:.1f}s — compile cache not hitting"
+                )
+        elif not warm_error.startswith("in-process fallback"):
+            # the subprocess path worked for cold but warm produced no
+            # summary: the feature this gate validates is silently broken
+            violations.append(f"warm run missing: {warm_error or 'unknown'}")
+    if violations:
+        print(
+            json.dumps({"error": "bench sanity gates failed",
+                        "violations": violations, "summary": summary}),
+            file=sys.stderr,
+        )
+        return 1
+
+    # ---- secondary BASELINE.md targets (never fail the headline) ------
+    targets: dict = {}
+    try:
+        targets["control_plane"] = bench_control_plane()
+    except Exception as e:
+        targets["control_plane"] = {"error": str(e)}
+    try:
+        targets["serving"] = bench_serving(on_tpu)
+    except Exception as e:
+        targets["serving"] = {"error": str(e)}
+    try:
+        targets["long_context"] = bench_long_context(on_tpu)
+    except Exception as e:
+        targets["long_context"] = {"error": str(e)}
+
+    tps_chip = summary["tokens_per_sec_per_chip"]
+    mfu = summary["mfu"]
+    vs_baseline = (mfu / 0.10) if on_tpu and mfu > 0 else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "tokens_per_sec_per_chip",
+                "value": round(tps_chip, 2),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(vs_baseline, 3),
+                "detail": {
+                    "platform": platform,
+                    "mfu": round(mfu, 4),
+                    "attn_impl": summary.get("attn_impl"),
+                    "first_step_seconds": round(summary["first_step_seconds"], 2),
+                    "startup_to_first_step_seconds": round(
+                        summary.get("_startup_to_first_step", 0.0), 2
+                    ),
+                    "first_step_seconds_warm": round(
+                        summary_warm["first_step_seconds"], 2
+                    ) if summary_warm else None,
+                    "startup_to_first_step_warm_seconds": round(
+                        summary_warm.get("_startup_to_first_step", 0.0), 2
+                    ) if summary_warm else None,
+                    "warm_unavailable": warm_error or None,
+                    "step_time_ms": round(summary["step_time_ms"], 2),
+                    "hbm_floor_ms": round(summary.get("hbm_floor_ms", 0.0), 2),
+                    "first_loss": round(summary.get("first_loss") or 0.0, 4),
+                    "final_loss": round(summary["final_loss"], 4),
+                    "sanity": "all gates passed",
+                    "targets": targets,
+                },
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,32 @@
+"""kubedl-tpu: a TPU-native distributed-training orchestration framework.
+
+A ground-up re-imagining of KubeDL (reference: /root/reference, a Kubernetes
+controller manager in Go) for TPU fleets:
+
+- A shared job-controller **engine** reconciles every workload kind
+  (`kubedl_tpu.engine`), exactly one generic loop handling pod diffing,
+  restart/backoff/TTL policies, DAG-ordered replica startup and status
+  conditions (reference: pkg/job_controller/job.go:68-308).
+- **Workload controllers** (`kubedl_tpu.workloads`) plug into the engine via a
+  small contract (reference: pkg/job_controller/api/v1/interface.go:12-70) and
+  only contribute what is framework-specific: the cluster-bootstrap payload
+  (TPU_WORKER_HOSTNAMES / coordinator address for `jax.distributed` instead of
+  TF_CONFIG / MASTER_ADDR), reconcile order, and success semantics.
+- **Gang scheduling** (`kubedl_tpu.gang`) is a hard dependency, not an option:
+  TPU jobs acquire whole slices atomically (reference analogue:
+  pkg/gang_schedule/batch_scheduler/scheduler.go:58-119).
+- The **compute path** (`kubedl_tpu.models` / `ops` / `parallel`) is pure
+  JAX/XLA: SPMD over `jax.sharding.Mesh`, pallas kernels for hot ops — the
+  in-container frameworks the reference merely wires up are first-class here.
+- Aux subsystems mirror the reference's: model lineage (`lineage`), inference
+  serving (`serving`), cron workflows (`cron`), metadata persistence
+  (`persist`), metrics/events (`observability`), console REST API (`console`),
+  code-sync and TensorBoard/profiler injection.
+
+The control plane is self-hosted: an in-process object store with watch
+semantics (`kubedl_tpu.core`) substitutes for etcd/api-server, and executors
+(`kubedl_tpu.runtime`) realize pods as real local processes (one per TPU host)
+or in-process fakes for tests.
+"""
+
+__version__ = "0.1.0"

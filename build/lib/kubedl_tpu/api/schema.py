@@ -1,0 +1,132 @@
+"""JSON Schema generation from the API dataclasses.
+
+The reference ships generated CRD OpenAPI schemas for every kind
+(config/crd/bases/*.yaml, produced by controller-gen from the Go types).
+The TPU build's types are dataclasses, so the schemas are derived by
+reflection instead of codegen: :func:`json_schema` walks a dataclass's
+type hints (enums, Optional, List/Dict/Tuple, nested dataclasses) into
+draft-07 JSON Schema, and :func:`workload_schemas` emits one per
+registered kind — the deploy surface's CRD-equivalent artifacts
+(rendered into ``deploy/schemas/`` by ``deploy/render.py``).
+
+Validation semantics match the codec: unknown fields are rejected
+(`additionalProperties: false`), exactly as `kubedl_tpu.api.codec.decode`
+raises on unknown keys.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import typing
+from typing import Any, Dict, Optional, Union
+
+
+def _field_schema(tp: Any, defs: Dict[str, Any]) -> Dict[str, Any]:
+    origin = typing.get_origin(tp)
+
+    if tp is Any or tp is None or tp is type(None):
+        return {}
+    if origin is Union:
+        args = list(typing.get_args(tp))
+        nullable = type(None) in args
+        args = [a for a in args if a is not type(None)]
+        inner = (
+            _field_schema(args[0], defs)
+            if len(args) == 1
+            else {"anyOf": [_field_schema(a, defs) for a in args]}
+        )
+        if nullable:
+            return {"anyOf": [inner, {"type": "null"}]} if inner else {}
+        return inner
+    if origin in (list, tuple):
+        args = typing.get_args(tp)
+        elem = args[0] if args and args[0] is not Ellipsis else Any
+        return {"type": "array", "items": _field_schema(elem, defs)}
+    if origin is dict:
+        args = typing.get_args(tp)
+        vt = args[1] if len(args) == 2 else Any
+        return {
+            "type": "object",
+            "additionalProperties": _field_schema(vt, defs) or True,
+        }
+    if isinstance(tp, type) and issubclass(tp, enum.Enum):
+        name = tp.__name__
+        if name not in defs:
+            defs[name] = {"enum": [m.value for m in tp]}
+        return {"$ref": f"#/definitions/{name}"}
+    if dataclasses.is_dataclass(tp):
+        name = tp.__name__
+        if name not in defs:
+            defs[name] = {"type": "object"}  # placeholder breaks cycles
+            defs[name] = _dataclass_schema(tp, defs)
+        return {"$ref": f"#/definitions/{name}"}
+    if tp is bool:
+        return {"type": "boolean"}
+    if tp is int:
+        return {"type": "integer"}
+    if tp is float:
+        return {"type": "number"}
+    if tp is str:
+        return {"type": "string"}
+    return {}  # unknown/opaque types: unconstrained
+
+
+def _dataclass_schema(cls: type, defs: Dict[str, Any]) -> Dict[str, Any]:
+    try:
+        hints = typing.get_type_hints(cls)
+    except Exception:
+        hints = {f.name: f.type for f in dataclasses.fields(cls)}
+    props: Dict[str, Any] = {}
+    required = []
+    for f in dataclasses.fields(cls):
+        if not f.init:
+            continue
+        props[f.name] = _field_schema(hints.get(f.name, Any), defs)
+        no_default = (
+            f.default is dataclasses.MISSING
+            and f.default_factory is dataclasses.MISSING
+        )
+        if no_default:
+            required.append(f.name)
+    out: Dict[str, Any] = {
+        "type": "object",
+        "properties": props,
+        "additionalProperties": False,
+    }
+    if required:
+        out["required"] = required
+    return out
+
+
+def json_schema(cls: type, kind: Optional[str] = None) -> Dict[str, Any]:
+    """Draft-07 JSON Schema for one API dataclass."""
+    defs: Dict[str, Any] = {}
+    body = _dataclass_schema(cls, defs)
+    # stored objects carry the kind discriminator the codec dispatches on
+    if kind:
+        body["properties"] = {
+            "kind": {"const": kind},
+            **body["properties"],
+        }
+    out = {
+        "$schema": "http://json-schema.org/draft-07/schema#",
+        "title": kind or cls.__name__,
+        **body,
+    }
+    if defs:
+        out["definitions"] = defs
+    return out
+
+
+def workload_schemas() -> Dict[str, Dict[str, Any]]:
+    """One schema per registered workload kind plus the lineage/serving/
+    cron kinds — the CRD-equivalent artifact set."""
+    from kubedl_tpu.api.codec import known_kinds
+
+    skip = {"Pod", "Service", "ConfigMap", "Event", "TrafficPolicy"}
+    return {
+        kind: json_schema(cls, kind=kind)
+        for kind, cls in sorted(known_kinds().items())
+        if kind not in skip
+    }

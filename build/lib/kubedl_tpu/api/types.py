@@ -1,0 +1,304 @@
+"""Vendor-neutral job/replica model shared by every workload kind.
+
+Capability parity with the reference's common job API
+(pkg/job_controller/api/v1/types.go:26-224): ReplicaSpec, JobStatus with
+typed conditions, RunPolicy {clean-pod policy, TTL, active deadline, backoff
+limit, gang min-available}, RestartPolicy incl. exit-code classification
+(1-127 permanent / 128-255 retryable, types.go:169-182), SuccessPolicy, and
+DAG startup conditions (types.go:219-224).
+
+TPU-first departures:
+
+- Replicas may pin a :class:`~kubedl_tpu.api.topology.SliceTopology`; the gang
+  scheduler treats a slice as atomic (a partially placed ICI job wedges the
+  whole slice), so ``SchedulingPolicy.min_available`` defaults to *all* pods.
+- Restart semantics are slice-granular by default
+  (:attr:`RestartPolicy.ON_FAILURE_SLICE`): one failed worker restarts the
+  gang from the latest checkpoint, since ICI collectives cannot survive a
+  single lost participant.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubedl_tpu.api.topology import MeshSpec, SliceTopology
+from kubedl_tpu.core.objects import PodTemplateSpec
+
+
+class ReplicaType(str, enum.Enum):
+    """Union of replica roles across all workload kinds.
+
+    Reference analogues: TF PS/Worker/Chief/Master/Evaluator
+    (apis/training/v1alpha1/tfjob_types.go:79-98), PyTorch Master/Worker, MPI
+    Launcher/Worker, XGBoost Master/Worker, Mars Scheduler/Worker/WebService,
+    XDL PS/Worker/Scheduler.
+    """
+
+    MASTER = "Master"
+    CHIEF = "Chief"
+    WORKER = "Worker"
+    PS = "PS"
+    EVALUATOR = "Evaluator"
+    SCHEDULER = "Scheduler"
+    LAUNCHER = "Launcher"
+    WEBSERVICE = "WebService"
+
+
+class RestartPolicy(str, enum.Enum):
+    """Per-replica restart policy (reference: types.go:169-182)."""
+
+    ALWAYS = "Always"
+    ON_FAILURE = "OnFailure"
+    NEVER = "Never"
+    #: Restart only on retryable exit codes 128-255 (reference: ExitCode
+    #: policy, pkg/job_controller/pod.go:305-317, pkg/util/train/train_util.go).
+    EXIT_CODE = "ExitCode"
+    #: TPU addition: any worker failure restarts the whole gang from the
+    #: latest checkpoint (ICI jobs die whole-slice; SURVEY.md §7 hard part b).
+    ON_FAILURE_SLICE = "OnFailureSlice"
+
+
+#: Exit codes in [1, 127] are permanent failures; [128, 255] retryable
+#: (reference: pkg/util/train/train_util.go).
+RETRYABLE_EXIT_CODE_MIN = 128
+
+
+def is_retryable_exit_code(code: int) -> bool:
+    return code >= RETRYABLE_EXIT_CODE_MIN
+
+
+class CleanPodPolicy(str, enum.Enum):
+    """What to delete when a job terminates (reference: types.go:188-199)."""
+
+    RUNNING = "Running"  # delete only still-running pods
+    ALL = "All"
+    NONE = "None"
+
+
+class SuccessPolicy(str, enum.Enum):
+    """When a job counts as succeeded (reference: types.go:146-153)."""
+
+    #: Chief/master completion, or worker-0 for master-less jobs
+    #: (reference: controllers/tensorflow/status.go:56-215).
+    DEFAULT = "Default"
+    ALL_WORKERS = "AllWorkers"
+
+
+class JobConditionType(str, enum.Enum):
+    """Job lifecycle conditions (reference: types.go:117-143)."""
+
+    CREATED = "Created"
+    QUEUED = "Queued"  # TPU addition: gang admitted, waiting for slice
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    #: TPU addition (kueue-style): pods torn down, slices FREED, progress
+    #: kept via checkpoints; unsuspending re-admits and resumes
+    SUSPENDED = "Suspended"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+TERMINAL_CONDITIONS = (JobConditionType.SUCCEEDED, JobConditionType.FAILED)
+
+
+class ReplicaPhase(str, enum.Enum):
+    """Aggregate phase a DAG condition can gate on (reference:
+    dag_sched.go:92-106 phase comparator)."""
+
+    CREATED = "Created"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+
+    def rank(self) -> int:
+        return {"Created": 0, "Running": 1, "Succeeded": 2}[self.value]
+
+
+@dataclass
+class DAGCondition:
+    """Startup-ordering edge: this replica type waits until ``upstream``
+    reaches ``on_phase`` (reference: types.go:219-224, dag_sched.go:29-68)."""
+
+    upstream: ReplicaType
+    on_phase: ReplicaPhase = ReplicaPhase.RUNNING
+
+
+@dataclass
+class SchedulingPolicy:
+    """Gang scheduling knobs (reference: types.go:206-217).
+
+    ``min_available=None`` means *all* replicas — the TPU default, since
+    partial placement wedges a slice.
+    """
+
+    min_available: Optional[int] = None
+    queue: str = "default"
+    priority: int = 0
+
+
+@dataclass
+class RunPolicy:
+    """Job-level execution policy (reference: types.go:188-217)."""
+
+    clean_pod_policy: CleanPodPolicy = CleanPodPolicy.RUNNING
+    ttl_seconds_after_finished: Optional[float] = None
+    active_deadline_seconds: Optional[float] = None
+    backoff_limit: Optional[int] = None
+    scheduling_policy: SchedulingPolicy = field(default_factory=SchedulingPolicy)
+    #: Suspend execution (kueue-style, net-new vs reference): pods are torn
+    #: down and the gang's SLICES ARE RELEASED for other jobs; flipping
+    #: back re-admits and training resumes from the latest checkpoint.
+    suspend: bool = False
+
+
+@dataclass
+class ReplicaSpec:
+    """Desired state for one replica type (reference: types.go:75-95)."""
+
+    replicas: int = 1
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    restart_policy: RestartPolicy = RestartPolicy.ON_FAILURE_SLICE
+    #: TPU: the slice this replica group collectively occupies. One pod per
+    #: TPU host; replicas must equal topology.hosts when set.
+    topology: Optional[SliceTopology] = None
+    #: Logical mesh hint passed to the workload (data/fsdp/tensor/... axes).
+    mesh: Optional[MeshSpec] = None
+    #: DAG-ordered startup: wait for these upstreams first.
+    depends_on: List[DAGCondition] = field(default_factory=list)
+
+
+@dataclass
+class ReplicaStatus:
+    """Observed counts per replica type (reference: types.go:53-73)."""
+
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    evicted: int = 0  # counted separately (reference: types.go:68-70)
+
+
+@dataclass
+class JobCondition:
+    """One observed lifecycle condition (reference: types.go:98-115)."""
+
+    type: JobConditionType
+    status: bool = True
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = field(default_factory=time.time)
+
+
+@dataclass
+class JobStatus:
+    """Observed job state (reference: types.go:26-51)."""
+
+    conditions: List[JobCondition] = field(default_factory=list)
+    replica_statuses: Dict[ReplicaType, ReplicaStatus] = field(default_factory=dict)
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+    last_reconcile_time: Optional[float] = None
+    #: Cumulative restart count, compared against RunPolicy.backoff_limit
+    #: (reference: job.go:141-159, :396-435).
+    restart_count: int = 0
+    #: Name of the ModelVersion created on success, if any.
+    model_version: str = ""
+
+    # ---- condition helpers (reference: pkg/util/status.go) ----------------
+
+    def condition(self, ctype: JobConditionType) -> Optional[JobCondition]:
+        for c in self.conditions:
+            if c.type == ctype and c.status:
+                return c
+        return None
+
+    @property
+    def phase(self) -> Optional[JobConditionType]:
+        """Latest true condition, i.e. the current phase."""
+        return self.conditions[-1].type if self.conditions else None
+
+    def is_terminal(self) -> bool:
+        return self.phase in TERMINAL_CONDITIONS
+
+    def is_succeeded(self) -> bool:
+        return self.phase == JobConditionType.SUCCEEDED
+
+    def is_failed(self) -> bool:
+        return self.phase == JobConditionType.FAILED
+
+    def set_condition(
+        self, ctype: JobConditionType, reason: str = "", message: str = ""
+    ) -> bool:
+        """Append/refresh a condition; newest-true-wins phase semantics.
+
+        Returns True if the phase actually changed (callers use this to emit
+        events/metrics exactly once per transition).
+        """
+        if self.phase == ctype:
+            cur = self.conditions[-1]
+            cur.reason, cur.message = reason or cur.reason, message or cur.message
+            return False
+        # Flip previous same-type stale entries off, then append.
+        for c in self.conditions:
+            if c.type == ctype:
+                self.conditions.remove(c)
+                break
+        self.conditions.append(
+            JobCondition(type=ctype, status=True, reason=reason, message=message)
+        )
+        return True
+
+
+@dataclass
+class JobSpec:
+    """The common portion of every workload kind's spec.
+
+    Workload kinds (TPUJob, TorchXLAJob, ...) embed this and add their own
+    knobs, the way the reference's TFJobSpec/PyTorchJobSpec embed
+    ReplicaSpecs + RunPolicy (apis/training/v1alpha1/tfjob_types.go:30-77).
+    """
+
+    replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    success_policy: SuccessPolicy = SuccessPolicy.DEFAULT
+    #: Build a ModelVersion from the job's model output on success
+    #: (reference: apis/training/v1alpha1/tfjob_types.go:51-53).
+    model_version: Optional["ModelVersionSpecRef"] = None
+
+    def total_replicas(self) -> int:
+        return sum(rs.replicas for rs in self.replica_specs.values())
+
+    def min_available(self) -> int:
+        ma = self.run_policy.scheduling_policy.min_available
+        return self.total_replicas() if ma is None else ma
+
+
+@dataclass
+class ModelVersionSpecRef:
+    """Inline request to publish the job's output as a ModelVersion
+    (mirrors apis/model/v1alpha1/modelversion_types.go:35-70)."""
+
+    model_name: str = ""
+    image_repo: str = ""
+    storage_root: str = ""  # host path / NFS root holding the artifact
+    #: storage-union member (reference: modelversion_types.go:72-115):
+    #: "shared" (NFS/EFS-style, default — multi-host jobs need it),
+    #: "local" (node-pinned), or a registered plugin name
+    storage_provider: str = "shared"
+
+
+def job_spec_defaults(spec: JobSpec) -> JobSpec:
+    """Apply defaulting the way the reference's scheme.Default does
+    (apis/training/v1alpha1/*_defaults.go): fill replica counts, port, and
+    clamp replicas to slice topology when one is pinned."""
+    for rs in spec.replica_specs.values():
+        if rs.replicas <= 0:
+            rs.replicas = 1
+        if rs.topology is not None:
+            rs.replicas = rs.topology.hosts
+        rs.template.apply_defaults()
+    return spec
+
+

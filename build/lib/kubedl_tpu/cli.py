@@ -1,0 +1,117 @@
+"""Single-binary CLI: the operator process (reference: main.go:54-118 —
+one controller-manager binary whose flags select workloads, storage
+backends, and the console).
+
+    kubedl-tpu-operator --workloads '*' --console-port 9090
+
+Runs the whole control plane in-process: object store, workload-gated
+controllers, gang scheduler, lineage, serving, cron, persist mirrors, and
+(optionally) the console REST server. Ctrl-C / SIGTERM shuts down cleanly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kubedl-tpu-operator",
+        description="TPU-native KubeDL: unified training/serving operator",
+    )
+    # flag names mirror the reference's startup flags (docs/startup_flags.md)
+    p.add_argument("--workloads", default="*",
+                   help="enabled workload kinds: '*', 'TPUJob,TFJob', '*,-MarsJob'")
+    p.add_argument("--max-reconciles", type=int, default=2,
+                   help="concurrent reconciles per controller")
+    p.add_argument("--feature-gates", default="",
+                   help="comma list, e.g. 'DAGScheduling=true,GangScheduling=false'")
+    p.add_argument("--cluster-domain", default="",
+                   help="cluster DNS domain suffix for service addresses")
+    p.add_argument("--model-registry", default="/tmp/kubedl-tpu-registry",
+                   help="artifact registry root for ModelVersion builds")
+    p.add_argument("--pod-log-dir", default="",
+                   help="directory for per-pod log capture")
+    p.add_argument("--meta-storage", default="",
+                   help="object metadata mirror backend ('' disables; 'sqlite', 'jsonl')")
+    p.add_argument("--event-storage", default="",
+                   help="event sink backend ('' disables; 'sqlite', 'jsonl')")
+    p.add_argument("--storage-db-path", default=":memory:",
+                   help="db path for the sqlite/jsonl backends")
+    p.add_argument("--region", default="", help="region stamp for mirrored rows")
+    p.add_argument("--console-port", type=int, default=-1,
+                   help="console REST port (-1 disables, 0 = ephemeral)")
+    p.add_argument("--console-host", default="127.0.0.1")
+    p.add_argument("--local-addresses", action="store_true",
+                   help="emit loopback addresses (process runtime on one host)")
+    p.add_argument("--log-level", default="info",
+                   choices=["debug", "info", "warning", "error"])
+    p.add_argument("--version", action="store_true", help="print version and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.version:
+        from kubedl_tpu import __version__
+
+        print(__version__)
+        return 0
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper()),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+
+    from kubedl_tpu.operator import Operator, OperatorOptions
+
+    opts = OperatorOptions(
+        workloads=args.workloads,
+        max_concurrent_reconciles=args.max_reconciles,
+        feature_gates=args.feature_gates,
+        cluster_domain=args.cluster_domain,
+        artifact_registry_root=args.model_registry,
+        pod_log_dir=args.pod_log_dir,
+        local_addresses=args.local_addresses,
+        meta_storage=args.meta_storage,
+        event_storage=args.event_storage,
+        storage_db_path=args.storage_db_path,
+        region=args.region,
+    )
+    op = Operator(opts)
+    op.start()
+    console = None
+    if args.console_port >= 0:
+        from kubedl_tpu.console import ConsoleServer
+
+        console = ConsoleServer(op, host=args.console_host, port=args.console_port)
+        console.start()
+        host, port = console.address
+        logging.getLogger("kubedl_tpu.cli").info(
+            "console listening on http://%s:%d", host, port
+        )
+
+    stop = threading.Event()
+
+    def _sig(_num, _frm):
+        stop.set()
+
+    try:
+        signal.signal(signal.SIGINT, _sig)
+        signal.signal(signal.SIGTERM, _sig)
+    except ValueError:
+        pass  # not the main thread (embedded use): rely on caller to stop
+    try:
+        stop.wait()
+    finally:
+        if console is not None:
+            console.stop()
+        op.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+"""Typed client SDK (the L7 layer the reference generates with
+code-generator: client/clientset/versioned + a fake for tests).
+
+Two interchangeable implementations of one surface:
+
+- :class:`~kubedl_tpu.client.http.KubeDLClient` — talks to a running
+  ConsoleServer over HTTP (external programs).
+- :class:`~kubedl_tpu.client.inprocess.InProcessClient` — wraps an
+  Operator directly; doubles as the fake clientset for tests (reference:
+  client/clientset/versioned/fake).
+
+Both decode console payloads back into real API dataclasses via
+`kubedl_tpu.api.codec`, so a consumer works with `TPUJob`/`TFJob`/...
+objects, not dicts. Per-kind accessors mirror the generated clientset's
+`clientset.TrainingV1alpha1().TFJobs(ns)` shape:
+
+    client = KubeDLClient("http://127.0.0.1:9090")
+    job = client.tpu_jobs.get("my-job")
+    client.tpu_jobs.create(job2)
+    client.tpu_jobs.wait("my-job", ["Succeeded"])
+"""
+
+from kubedl_tpu.client.base import ApiException, KindClient
+from kubedl_tpu.client.http import KubeDLClient
+from kubedl_tpu.client.inprocess import InProcessClient
+
+__all__ = ["ApiException", "KindClient", "KubeDLClient", "InProcessClient"]

@@ -1,0 +1,119 @@
+"""Shared client surface: transport-agnostic typed operations.
+
+The reference's generated clientset exposes one typed accessor per kind
+(client/clientset/versioned/typed/training/v1alpha1/*.go); here a single
+:class:`KindClient` parameterized by kind provides the same CRUD+watch
+verbs, and :class:`BaseClient` wires one per registered workload kind
+(tpu_jobs, tf_jobs, pytorch_jobs, ...).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class ApiException(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f"{status}: {message}")
+        self.status = status
+        self.message = message
+
+
+#: kind -> snake_case accessor name
+KIND_ACCESSORS = {
+    "TPUJob": "tpu_jobs",
+    "TFJob": "tf_jobs",
+    "PyTorchJob": "pytorch_jobs",
+    "XDLJob": "xdl_jobs",
+    "XGBoostJob": "xgboost_jobs",
+    "MarsJob": "mars_jobs",
+    "ElasticDLJob": "elasticdl_jobs",
+    "MPIJob": "mpi_jobs",
+}
+
+
+class KindClient:
+    """Typed verbs for one workload kind (clientset TFJobs(ns) analogue)."""
+
+    def __init__(self, api: "BaseClient", kind: str) -> None:
+        self._api = api
+        self.kind = kind
+
+    def create(self, job) -> Dict[str, Any]:
+        assert job.kind == self.kind, (job.kind, self.kind)
+        return self._api.submit(job)
+
+    def get(self, name: str, namespace: str = "default"):
+        return self._api.get_job(self.kind, name, namespace)
+
+    def list(self, namespace: str = "default") -> List:
+        return self._api.list_jobs(kind=self.kind, namespace=namespace)
+
+    def stop(self, name: str, namespace: str = "default") -> None:
+        self._api.stop_job(self.kind, name, namespace)
+
+    def delete(self, name: str, namespace: str = "default") -> None:
+        self._api.delete_job(self.kind, name, namespace)
+
+    def wait(
+        self,
+        name: str,
+        phases: Sequence[str],
+        namespace: str = "default",
+        timeout: float = 300.0,
+        poll: float = 0.5,
+    ):
+        """Block until the job reaches one of ``phases`` (strings like
+        "Succeeded"); returns the decoded job."""
+        deadline = time.time() + timeout
+        while True:
+            job = self.get(name, namespace)
+            phase = job.status.phase
+            if phase is not None and str(phase.value) in phases:
+                return job
+            if time.time() >= deadline:
+                raise TimeoutError(
+                    f"{self.kind} {namespace}/{name} still {phase} after {timeout}s"
+                )
+            time.sleep(poll)
+
+
+class BaseClient:
+    """Transport-agnostic operations; subclasses implement the raw verbs."""
+
+    def __init__(self) -> None:
+        for kind, attr in KIND_ACCESSORS.items():
+            setattr(self, attr, KindClient(self, kind))
+
+    def kind_client(self, kind: str) -> KindClient:
+        return KindClient(self, kind)
+
+    # -- to implement ------------------------------------------------------
+
+    def submit(self, job) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def get_job(self, kind: str, name: str, namespace: str = "default"):
+        raise NotImplementedError
+
+    def list_jobs(self, kind: str = "", namespace: str = "default") -> List:
+        raise NotImplementedError
+
+    def stop_job(self, kind: str, name: str, namespace: str = "default") -> None:
+        raise NotImplementedError
+
+    def delete_job(self, kind: str, name: str, namespace: str = "default") -> None:
+        raise NotImplementedError
+
+    def job_logs(self, pod: str, namespace: str = "default") -> List[str]:
+        raise NotImplementedError
+
+    def job_events(self, kind: str, name: str, namespace: str = "default") -> List[dict]:
+        raise NotImplementedError
+
+    def overview(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def statistics(self) -> Dict[str, Any]:
+        raise NotImplementedError

@@ -1,0 +1,101 @@
+"""HTTP client against a ConsoleServer (reference: external Go consumers
+of the generated clientset; here the console REST API is the wire
+protocol, console/backend/pkg/routers/api/job.go:29-43)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from kubedl_tpu.api import codec
+from kubedl_tpu.client.base import ApiException, BaseClient
+
+
+class KubeDLClient(BaseClient):
+    def __init__(self, base_url: str, token: str = "", timeout: float = 30.0) -> None:
+        super().__init__()
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+
+    # -- transport ---------------------------------------------------------
+
+    def _call(self, method: str, path: str, body: Optional[dict] = None) -> Any:
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        req = urllib.request.Request(
+            self.base_url + path,
+            method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers=headers,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read())
+                msg = payload.get("data", str(payload))
+            except Exception:
+                msg = str(e)
+            raise ApiException(e.code, str(msg)) from None
+        return payload.get("data", payload)
+
+    def login(self, username: str, password: str) -> str:
+        """Session login; stores and returns the bearer token."""
+        data = self._call(
+            "POST", "/api/v1/login",
+            {"username": username, "password": password},
+        )
+        self.token = data.get("token", "")
+        return self.token
+
+    # -- BaseClient verbs --------------------------------------------------
+
+    def submit(self, job) -> Dict[str, Any]:
+        return self._call("POST", "/api/v1/job/submit", codec.encode(job))
+
+    def get_job(self, kind: str, name: str, namespace: str = "default"):
+        data = self._call(
+            "GET", f"/api/v1/job/json/{namespace}/{name}?kind={kind}"
+        )
+        return codec.decode_object(data)
+
+    def list_jobs(self, kind: str = "", namespace: str = "default") -> List:
+        q = urllib.parse.urlencode(
+            {k: v for k, v in (("kind", kind), ("namespace", namespace)) if v}
+        )
+        data = self._call("GET", f"/api/v1/job/list?{q}")
+        out = []
+        for row in data.get("jobInfos", []):
+            try:
+                out.append(
+                    self.get_job(row["kind"], row["name"], row["namespace"])
+                )
+            except ApiException:
+                pass  # raced a deletion between list and get
+        return out
+
+    def stop_job(self, kind: str, name: str, namespace: str = "default") -> None:
+        self._call("POST", f"/api/v1/job/stop/{namespace}/{name}?kind={kind}")
+
+    def delete_job(self, kind: str, name: str, namespace: str = "default") -> None:
+        self._call("DELETE", f"/api/v1/job/delete/{namespace}/{name}?kind={kind}")
+
+    def job_logs(self, pod: str, namespace: str = "default") -> List[str]:
+        data = self._call("GET", f"/api/v1/log/logs/{namespace}/{pod}")
+        return data.get("logs", [])
+
+    def job_events(self, kind: str, name: str, namespace: str = "default") -> List[dict]:
+        data = self._call("GET", f"/api/v1/event/events/{namespace}/{kind}/{name}")
+        return data.get("events", data) if isinstance(data, dict) else data
+
+    def overview(self) -> Dict[str, Any]:
+        return self._call("GET", "/api/v1/data/overview")
+
+    def statistics(self) -> Dict[str, Any]:
+        return self._call("GET", "/api/v1/job/statistics")

@@ -1,0 +1,98 @@
+"""In-process client over an Operator — same typed surface as the HTTP
+client, no sockets. Doubles as the fake clientset for tests (reference:
+client/clientset/versioned/fake), and is what embedded consumers (cron
+materializers, notebooks in the operator process) use."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubedl_tpu.api.types import JobConditionType
+from kubedl_tpu.client.base import ApiException, BaseClient
+from kubedl_tpu.core.store import NotFound
+
+
+class InProcessClient(BaseClient):
+    def __init__(self, operator) -> None:
+        super().__init__()
+        self.operator = operator
+
+    def _require_kind(self, kind: str) -> None:
+        if kind not in self.operator.engines:
+            raise ApiException(400, f"workload kind {kind} not enabled")
+
+    def submit(self, job) -> Dict[str, Any]:
+        from kubedl_tpu.operator import ValidationError
+
+        try:  # operator.submit's admission covers the kind-enabled check
+            created = self.operator.submit(job)
+        except ValidationError as e:  # admission rejection
+            raise ApiException(400, str(e)) from e
+        return {"name": created.metadata.name,
+                "namespace": created.metadata.namespace}
+
+    def get_job(self, kind: str, name: str, namespace: str = "default"):
+        self._require_kind(kind)
+        obj = self.operator.store.try_get(kind, name, namespace)
+        if obj is None:
+            raise ApiException(404, f"{kind} {namespace}/{name} not found")
+        return obj
+
+    def list_jobs(self, kind: str = "", namespace: str = "default") -> List:
+        kinds = [kind] if kind else list(self.operator.engines)
+        out: List = []
+        for k in kinds:
+            self._require_kind(k)
+            out.extend(self.operator.store.list(k, namespace))
+        return out
+
+    def stop_job(self, kind: str, name: str, namespace: str = "default") -> None:
+        self.get_job(kind, name, namespace)
+
+        def mutate(obj) -> None:
+            if not obj.status.is_terminal():
+                obj.status.set_condition(
+                    JobConditionType.FAILED, "JobStopped", "stopped via client"
+                )
+
+        self.operator.store.update_with_retry(kind, name, namespace, mutate)
+        self.operator.manager.kick_all()
+
+    def delete_job(self, kind: str, name: str, namespace: str = "default") -> None:
+        try:
+            self.operator.store.delete(kind, name, namespace)
+        except NotFound:
+            raise ApiException(404, f"{kind} {namespace}/{name} not found") from None
+
+    def job_logs(self, pod: str, namespace: str = "default") -> List[str]:
+        import os
+
+        log_dir = getattr(self.operator.options, "pod_log_dir", "")
+        path = os.path.join(log_dir, namespace, f"{pod}.log")
+        if not log_dir or not os.path.exists(path):
+            return []
+        with open(path) as f:
+            return f.readlines()
+
+    def job_events(self, kind: str, name: str, namespace: str = "default") -> List[dict]:
+        out = []
+        for e in self.operator.store.list("Event", namespace):
+            if e.involved_kind == kind and e.involved_name == name:
+                out.append({"reason": e.reason, "message": e.message,
+                            "type": e.type})
+        return out
+
+    def overview(self) -> Dict[str, Any]:
+        pods = self.operator.store.list("Pod", None)
+        return {
+            "podRunning": sum(1 for p in pods if str(p.phase) == "PodPhase.RUNNING"),
+            "podTotal": len(pods),
+        }
+
+    def statistics(self) -> Dict[str, Any]:
+        jobs = self.list_jobs()
+        by_phase: Dict[str, int] = {}
+        for j in jobs:
+            p = j.status.phase.value if j.status.phase else "Pending"
+            by_phase[p] = by_phase.get(p, 0) + 1
+        return {"totalJobCount": len(jobs), "statistics": by_phase}

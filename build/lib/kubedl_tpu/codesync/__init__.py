@@ -1,0 +1,3 @@
+"""Git code-sync injection (reference: pkg/code_sync/)."""
+
+from kubedl_tpu.codesync.sync import inject_code_sync  # noqa: F401

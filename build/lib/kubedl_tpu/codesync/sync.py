@@ -1,0 +1,78 @@
+"""Inject a git-sync init step + shared volume into every replica.
+
+Reference: pkg/code_sync/sync_handler.go:34-73 + git_sync_handler.go:38-152 —
+the annotation `kubedl.io/git-sync-config` carries JSON
+{source, branch, revision, destPath}; the engine injects a git-sync init
+container and mounts the checked-out tree at the main container's working
+dir. Invoked from inside ReconcileJobs (job.go:108-112).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Optional
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.core.objects import Container, PodTemplateSpec, Volume
+
+CODE_VOLUME = "kubedl-code-sync"
+DEFAULT_DEST = "/workspace/code"
+
+
+@dataclass
+class GitSyncConfig:
+    source: str = ""
+    branch: str = ""
+    revision: str = ""
+    dest_path: str = DEFAULT_DEST
+
+    @classmethod
+    def from_annotation(cls, raw: str) -> "GitSyncConfig":
+        data = json.loads(raw)
+        return cls(
+            source=data.get("source", ""),
+            branch=data.get("branch", ""),
+            revision=data.get("revision", ""),
+            dest_path=data.get("destPath", data.get("dest_path", DEFAULT_DEST)),
+        )
+
+
+def parse_git_sync(annotations: dict) -> Optional[GitSyncConfig]:
+    raw = annotations.get(constants.ANNOTATION_GIT_SYNC_CONFIG)
+    if not raw:
+        return None
+    cfg = GitSyncConfig.from_annotation(raw)
+    if not cfg.source:
+        raise ValueError("git-sync-config requires a `source` repo URL")
+    return cfg
+
+
+def inject_code_sync(template: PodTemplateSpec, cfg: GitSyncConfig) -> None:
+    """Idempotently add the git-sync init container + shared volume."""
+    for c in template.spec.init_containers:
+        if c.name == CODE_VOLUME:
+            return
+    # argv only — annotation values must never reach a shell
+    clone = ["git", "clone"]
+    if cfg.revision:
+        clone += [cfg.source, cfg.dest_path]  # full clone; checkout follows
+    else:
+        clone += ["--depth", "1"]
+        if cfg.branch:
+            clone += ["--branch", cfg.branch]
+        clone += [cfg.source, cfg.dest_path]
+    template.spec.init_containers.append(Container(name=CODE_VOLUME, command=clone))
+    if cfg.revision:
+        template.spec.init_containers.append(
+            Container(
+                name=CODE_VOLUME + "-checkout",
+                command=["git", "-C", cfg.dest_path, "checkout", cfg.revision],
+            )
+        )
+    template.spec.volumes.append(
+        Volume(name=CODE_VOLUME, empty_dir=True, mount_path=cfg.dest_path)
+    )
+    main = template.spec.main_container()
+    if not main.working_dir:
+        main.working_dir = cfg.dest_path

@@ -1,0 +1,23 @@
+"""Console: REST API server + dashboard (reference: console/backend, L6).
+
+The reference ships a gin HTTP backend (console/backend/pkg/routers/
+router.go:97-127) and a React frontend. The TPU build's console is a
+dependency-free stdlib HTTP server over the operator's live object store or
+its persist mirror, plus an embedded single-page dashboard.
+"""
+
+from kubedl_tpu.console.auth import SessionAuth
+from kubedl_tpu.console.backends import (
+    ApiServerReadBackend,
+    ObjectReadBackend,
+    PersistReadBackend,
+)
+from kubedl_tpu.console.server import ConsoleServer
+
+__all__ = [
+    "ApiServerReadBackend",
+    "ConsoleServer",
+    "ObjectReadBackend",
+    "PersistReadBackend",
+    "SessionAuth",
+]

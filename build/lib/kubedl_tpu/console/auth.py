@@ -1,0 +1,85 @@
+"""Session auth for the console.
+
+Reference: console/backend/pkg/auth (oauth/session login wired at
+routers/api/auth.go:21-27). The TPU build keeps the same shape without an
+external IdP: a user table (name -> salted SHA-256), bearer-token sessions
+issued at login, validated per request, expired on TTL or logout.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+SESSION_COOKIE = "kubedl-session"
+
+
+def _hash(password: str, salt: str) -> str:
+    return hashlib.sha256(f"{salt}:{password}".encode()).hexdigest()
+
+
+@dataclass
+class Session:
+    token: str
+    username: str
+    created_at: float
+    expires_at: float
+
+
+class SessionAuth:
+    """None-auth when ``users`` is empty: every request is ``anonymous``
+    (the reference console also runs open unless auth is configured)."""
+
+    def __init__(
+        self, users: Optional[Dict[str, str]] = None, session_ttl: float = 12 * 3600.0
+    ) -> None:
+        self._lock = threading.Lock()
+        self._salt = secrets.token_hex(8)
+        self._users = {
+            name: _hash(password, self._salt)
+            for name, password in (users or {}).items()
+        }
+        self._sessions: Dict[str, Session] = {}
+        self.session_ttl = session_ttl
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._users)
+
+    def login(self, username: str, password: str) -> Optional[Session]:
+        with self._lock:
+            want = self._users.get(username)
+            if want is None or not hmac.compare_digest(
+                want, _hash(password, self._salt)
+            ):
+                return None
+            now = time.time()
+            sess = Session(
+                token=secrets.token_urlsafe(32),
+                username=username,
+                created_at=now,
+                expires_at=now + self.session_ttl,
+            )
+            self._sessions[sess.token] = sess
+            return sess
+
+    def logout(self, token: str) -> None:
+        with self._lock:
+            self._sessions.pop(token, None)
+
+    def validate(self, token: str) -> Optional[Session]:
+        if not self.enabled:
+            return Session(token="", username="anonymous", created_at=0, expires_at=0)
+        with self._lock:
+            sess = self._sessions.get(token)
+            if sess is None:
+                return None
+            if time.time() > sess.expires_at:
+                del self._sessions[token]
+                return None
+            return sess

@@ -1,0 +1,151 @@
+"""Console object-read backends.
+
+Reference: console/backend/pkg/storage/objects/{apiserver,proxy} — the
+console reads job/pod/event state either live from the api-server or from
+the persist DB mirror, selected by a backend flag. Same split here: the
+"apiserver" backend reads the operator's :class:`ObjectStore`, the
+"persist" backend reads an :class:`ObjectStorageBackend` mirror (useful
+once jobs have been TTL-reaped from the store). Both speak DMO rows so the
+route handlers are backend-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.core.objects import Event, Pod
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.persist.backends import (
+    EventStorageBackend,
+    ObjectStorageBackend,
+    Query,
+)
+from kubedl_tpu.persist.dmo import (
+    EventInfo,
+    JobInfo,
+    ReplicaInfo,
+    event_to_dmo,
+    job_to_dmo,
+    pod_to_dmo,
+)
+
+
+class ObjectReadBackend:
+    """What the console needs to render: jobs, replicas, events."""
+
+    def name(self) -> str:
+        raise NotImplementedError
+
+    def list_jobs(self, query: Query) -> List[JobInfo]:
+        raise NotImplementedError
+
+    def get_job(self, namespace: str, name: str, kind: str = "") -> Optional[JobInfo]:
+        raise NotImplementedError
+
+    def list_replicas(self, namespace: str, job_name: str) -> List[ReplicaInfo]:
+        raise NotImplementedError
+
+    def list_events(
+        self, involved_kind: str, involved_name: str, namespace: str = ""
+    ) -> List[EventInfo]:
+        raise NotImplementedError
+
+
+class ApiServerReadBackend(ObjectReadBackend):
+    """Live reads from the in-process store (reference: objects/apiserver)."""
+
+    def __init__(self, store: ObjectStore, kinds: List[str]) -> None:
+        self.store = store
+        self.kinds = list(kinds)
+
+    def name(self) -> str:
+        return "apiserver"
+
+    def _iter_jobs(self, kind: str = "", namespace: Optional[str] = None):
+        for k in [kind] if kind else self.kinds:
+            for obj in self.store.list(k, namespace=namespace):
+                yield obj
+
+    def list_jobs(self, query: Query) -> List[JobInfo]:
+        rows: List[JobInfo] = []
+        ns = query.namespace or None
+        for job in self._iter_jobs(query.kind, ns):
+            row = job_to_dmo(job)
+            if query.name and query.name not in row.name:
+                continue
+            if query.phase and row.phase != query.phase:
+                continue
+            if query.start_time is not None and row.created_at < query.start_time:
+                continue
+            if query.end_time is not None and row.created_at > query.end_time:
+                continue
+            rows.append(row)
+        rows.sort(key=lambda r: r.created_at, reverse=True)
+        if query.limit:
+            rows = rows[query.offset : query.offset + query.limit]
+        return rows
+
+    def get_job(self, namespace: str, name: str, kind: str = "") -> Optional[JobInfo]:
+        for k in [kind] if kind else self.kinds:
+            obj = self.store.try_get(k, name, namespace)
+            if obj is not None:
+                return job_to_dmo(obj)
+        return None
+
+    def list_replicas(self, namespace: str, job_name: str) -> List[ReplicaInfo]:
+        sel = {constants.LABEL_JOB_NAME: job_name}
+        pods: List[Pod] = self.store.list("Pod", namespace=namespace, selector=sel)  # type: ignore[assignment]
+        rows = [pod_to_dmo(p) for p in pods]
+        rows.sort(key=lambda r: (r.replica_type, r.replica_index))
+        return rows
+
+    def list_events(
+        self, involved_kind: str, involved_name: str, namespace: str = ""
+    ) -> List[EventInfo]:
+        evs: List[Event] = self.store.list("Event", namespace=namespace or None)  # type: ignore[assignment]
+        rows = [
+            event_to_dmo(e)
+            for e in evs
+            if (not involved_kind or e.involved_kind == involved_kind)
+            and (not involved_name or e.involved_name == involved_name)
+        ]
+        rows.sort(key=lambda r: r.last_timestamp)
+        return rows
+
+
+class PersistReadBackend(ObjectReadBackend):
+    """Reads from the durable mirror (reference: objects/proxy over the
+    persist DB) — survives TTL cleanup of live objects."""
+
+    def __init__(
+        self,
+        object_backend: ObjectStorageBackend,
+        event_backend: Optional[EventStorageBackend] = None,
+    ) -> None:
+        self.objects = object_backend
+        self.events = event_backend
+
+    def name(self) -> str:
+        return "persist"
+
+    def list_jobs(self, query: Query) -> List[JobInfo]:
+        return self.objects.list_jobs(query)
+
+    def get_job(self, namespace: str, name: str, kind: str = "") -> Optional[JobInfo]:
+        return self.objects.get_job(namespace, name, kind)
+
+    def list_replicas(self, namespace: str, job_name: str) -> List[ReplicaInfo]:
+        job = self.objects.get_job(namespace, job_name)
+        if job is None:
+            return []
+        rows = self.objects.list_pods(job.uid)
+        rows.sort(key=lambda r: (r.replica_type, r.replica_index))
+        return rows
+
+    def list_events(
+        self, involved_kind: str, involved_name: str, namespace: str = ""
+    ) -> List[EventInfo]:
+        if self.events is None:
+            return []
+        return self.events.list_events(involved_kind, involved_name, namespace)

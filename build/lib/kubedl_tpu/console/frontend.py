@@ -1,0 +1,58 @@
+"""Console frontend assets.
+
+Reference: console/frontend — a React/UmiJS app (pages: Jobs, JobSubmit,
+JobDetail, ClusterInfo, DataConfig/GitConfig, login). The TPU build ships
+a dependency-free vanilla-JS equivalent as REAL static assets
+(``console/static/``: index.html + app.js + style.css, served at ``/``
+and ``/static/*`` by the console server) — a hash-routed SPA with the
+same page set:
+
+- **Overview**: live tiles + slice fleet table (ClusterInfo analogue,
+  TPU-native: slices instead of nodes).
+- **Jobs**: filterable table, stop/delete, click-through detail page with
+  replicas, events and per-pod logs.
+- **Charts**: SVG charts over the backend's metrics registry — launch-
+  delay histograms, per-kind job outcomes, live running/pending timeline,
+  serving QPS table (round-3; the data was always exported at /metrics,
+  now it is visualized).
+- **Models**: lineage view (Model -> ModelVersions with build phase/image).
+- **Submit**: YAML/JSON box with per-kind starter templates.
+- **Sources**: data/code source CRUD (ConfigMap-backed).
+
+No build tooling on purpose; everything renders through esc()/textContent
+so user-named objects can't inject markup.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Tuple
+
+STATIC_DIR = Path(__file__).resolve().parent / "static"
+
+_CONTENT_TYPES = {
+    ".html": "text/html; charset=utf-8",
+    ".js": "application/javascript; charset=utf-8",
+    ".css": "text/css; charset=utf-8",
+    ".svg": "image/svg+xml",
+    ".png": "image/png",
+    ".ico": "image/x-icon",
+}
+
+
+def static_asset(name: str) -> Optional[Tuple[bytes, str]]:
+    """Return (body, content-type) for one static file, or None.
+    Traversal-safe: only plain file names inside STATIC_DIR resolve."""
+    clean = Path(name).name  # strips any path components
+    if not clean or clean != name:
+        return None
+    target = STATIC_DIR / clean
+    if not target.is_file():
+        return None
+    ctype = _CONTENT_TYPES.get(target.suffix, "application/octet-stream")
+    return target.read_bytes(), ctype
+
+
+def index_html() -> str:
+    return (STATIC_DIR / "index.html").read_text()
+

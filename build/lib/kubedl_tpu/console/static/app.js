@@ -1,0 +1,408 @@
+// All server strings render via esc()/textContent — object names are
+// user-controlled and must never reach innerHTML unescaped.
+const esc = s => String(s ?? '').replace(/[&<>"']/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+const $ = id => document.getElementById(id);
+const fmt = ts => ts ? new Date(ts * 1000).toLocaleString() : '';
+const PHASES = ['Created','Queued','Running','Succeeded','Failed',
+                'Pending','ImageBuilding','Suspended'];
+const phaseTag = p => `<span class="phase ${PHASES.includes(p) ? p : ''}">${esc(p)}</span>`;
+
+async function api(p, opts) {
+  const r = await fetch(p, opts);
+  if (r.status === 401) { showLogin(); throw new Error('unauthorized'); }
+  return r.json();
+}
+const post = (p, b) => api(p, {method:'POST', body: b ? JSON.stringify(b) : null,
+  headers:{'Content-Type':'application/json'}});
+
+function showLogin() { $('login').style.display = 'flex'; }
+async function doLogin() {
+  const r = await fetch('/api/v1/login', {method:'POST',
+    headers:{'Content-Type':'application/json'},
+    body: JSON.stringify({username: $('login-user').value,
+                          password: $('login-pass').value})});
+  if (r.status === 200) { $('login').style.display = 'none'; route(); }
+  else $('login-msg').textContent = 'invalid credentials';
+}
+
+// ---- hash router ---------------------------------------------------------
+
+const VIEWS = {};
+function route() {
+  $('view').onclick = null;  // views opt in; stale handlers must not leak
+  const hash = location.hash || '#/overview';
+  const [_, name, ...rest] = hash.split('/');
+  for (const a of document.querySelectorAll('#nav a'))
+    a.classList.toggle('active', a.getAttribute('href') === `#/${name}`);
+  (VIEWS[name] || VIEWS.overview)(rest.map(decodeURIComponent));
+}
+window.addEventListener('hashchange', route);
+
+// ---- overview ------------------------------------------------------------
+
+VIEWS.overview = async () => {
+  const o = (await api('/api/v1/data/overview')).data;
+  const sl = (await api('/api/v1/cluster/slices')).data.slices;
+  const tiles = [
+    [o.jobTotal, 'jobs'], [o.jobPhases.Running || 0, 'running'],
+    [o.podRunning + '/' + o.podTotal, 'pods running'],
+    [o.sliceFree + '/' + o.sliceTotal, 'slices free'],
+  ];
+  $('view').innerHTML = `
+    <div class="tiles">${tiles.map(([v, l]) =>
+      `<div class=tile><b>${esc(v)}</b><span>${esc(l)}</span></div>`).join('')}</div>
+    <h2>TPU slice fleet</h2>
+    <table><thead><tr><th>slice</th><th>type</th><th>chips</th>
+      <th>hosts</th><th>held by</th></tr></thead>
+    <tbody>${sl.map(s => `<tr><td>${esc(s.name)}</td><td>${esc(s.type)}</td>
+      <td>${esc(s.chips)}</td><td class=muted>${esc(s.hosts.join(', '))}</td>
+      <td>${s.allocated_to ? esc(s.allocated_to) : '<span class=muted>free</span>'}</td>
+      </tr>`).join('') || '<tr><td colspan=5 class=muted>no slices registered</td></tr>'}
+    </tbody></table>
+    <h2>Jobs by phase</h2>
+    <div class="tiles">${Object.entries(o.jobPhases).map(([p, n]) =>
+      `<div class=tile><b>${esc(n)}</b><span>${esc(p)}</span></div>`).join('')
+      || '<span class=muted>none yet</span>'}</div>`;
+};
+
+// ---- jobs ----------------------------------------------------------------
+
+VIEWS.jobs = async () => {
+  const o = (await api('/api/v1/data/overview')).data;
+  $('view').innerHTML = `
+    <h2 style="margin-top:0">Jobs</h2>
+    <div class="row">
+      <select id="f-kind"><option value="">all kinds</option>${
+        o.workloadKinds.map(k => `<option>${esc(k)}</option>`).join('')}</select>
+      <input id="f-name" placeholder="name filter">
+      <select id="f-phase"><option value="">all phases</option>
+        <option>Created</option><option>Queued</option><option>Running</option>
+        <option>Succeeded</option><option>Failed</option></select>
+      <button onclick="loadJobs()">refresh</button>
+    </div>
+    <table><thead><tr><th>name</th><th>kind</th><th>namespace</th><th>phase</th>
+      <th>created</th><th>owner</th><th></th></tr></thead>
+      <tbody id="jobs"></tbody></table>`;
+  $('jobs').addEventListener('click', jobAction);
+  await loadJobs();
+};
+
+async function loadJobs() {
+  const q = new URLSearchParams();
+  for (const [k, id] of [['kind','f-kind'],['name','f-name'],['phase','f-phase']]) {
+    const v = $(id)?.value; if (v) q.set(k, v);
+  }
+  const d = (await api('/api/v1/job/list?' + q)).data;
+  const tbody = $('jobs');
+  if (!tbody) return;
+  tbody.innerHTML = d.jobInfos.map((j, i) => `<tr data-i="${i}">
+    <td><a href="#/job/${encodeURIComponent(j.namespace)}/${encodeURIComponent(j.name)}/${encodeURIComponent(j.kind)}">${esc(j.name)}</a></td>
+    <td>${esc(j.kind)}</td><td>${esc(j.namespace)}</td>
+    <td>${phaseTag(j.phase)}</td>
+    <td>${esc(fmt(j.created_at))}</td><td>${esc(j.owner)}</td>
+    <td><button data-act="stop">stop</button>
+        <button data-act="delete">delete</button></td></tr>`).join('')
+    || '<tr><td colspan=7 class=muted>no jobs</td></tr>';
+  tbody._rows = d.jobInfos;
+}
+
+async function jobAction(ev) {
+  const act = ev.target.dataset.act;
+  if (!act) return;
+  ev.preventDefault();
+  const tr = ev.target.closest('tr');
+  const j = $('jobs')._rows[Number(tr.dataset.i)];
+  const qs = `${encodeURIComponent(j.namespace)}/${encodeURIComponent(j.name)}` +
+             `?kind=${encodeURIComponent(j.kind)}`;
+  if (act === 'stop') await post(`/api/v1/job/stop/${qs}`);
+  else if (act === 'delete')
+    await fetch(`/api/v1/job/delete/${qs}`, {method:'DELETE'});
+  loadJobs();
+}
+
+// ---- job detail ----------------------------------------------------------
+
+VIEWS.job = async ([ns, name, kind]) => {
+  const qs = `${encodeURIComponent(ns)}/${encodeURIComponent(name)}?kind=${encodeURIComponent(kind)}`;
+  const d = (await api(`/api/v1/job/detail/${qs}`)).data;
+  const j = d.jobInfo;
+  $('view').innerHTML = `
+    <div class="crumb"><a href="#/jobs">&larr; jobs</a></div>
+    <h2>${esc(kind)} ${esc(ns)}/${esc(name)} ${phaseTag(j.phase)}</h2>
+    <div class="row muted">created ${esc(fmt(j.created_at))}
+      ${j.finished_at ? ' &middot; finished ' + esc(fmt(j.finished_at)) : ''}</div>
+    <div class="row"><button id="yaml-btn">view yaml</button></div>
+    <pre id="yaml" style="display:none"></pre>
+    <h2>Replicas</h2>
+    <table><thead><tr><th>pod</th><th>type</th><th>#</th><th>phase</th>
+      <th>node</th><th>exit</th><th></th></tr></thead>
+    <tbody>${(d.replicas || []).map(r => `<tr>
+      <td>${esc(r.name)}</td><td>${esc(r.replica_type)}</td>
+      <td>${esc(r.replica_index)}</td><td>${phaseTag(r.phase)}</td>
+      <td class=muted>${esc(r.node)}</td><td>${esc(r.exit_code ?? '')}</td>
+      <td><button data-pod="${esc(r.name)}" data-ns="${esc(r.namespace)}">logs</button></td>
+      </tr>`).join('') || '<tr><td colspan=7 class=muted>none</td></tr>'}
+    </tbody></table>
+    <pre id="logs" style="display:none"></pre>
+    <h2>Events</h2>
+    <table><thead><tr><th>type</th><th>reason</th><th>message</th><th>last seen</th>
+      </tr></thead>
+    <tbody>${(d.events || []).map(e => `<tr><td>${esc(e.type)}</td>
+      <td>${esc(e.reason)}</td><td>${esc(e.message)}</td>
+      <td class=muted>${esc(fmt(e.last_timestamp))}</td></tr>`).join('')
+      || '<tr><td colspan=4 class=muted>none</td></tr>'}
+    </tbody></table>`;
+  $('yaml-btn').onclick = async () => {
+    const y = (await api(`/api/v1/job/yaml/${qs}`)).data.yaml;
+    const el = $('yaml');
+    el.style.display = 'block';
+    el.textContent = y;
+  };
+  $('view').onclick = async ev => {
+    const pod = ev.target.dataset.pod;
+    if (!pod) return;
+    const r = await api(`/api/v1/log/logs/${encodeURIComponent(ev.target.dataset.ns)}/${encodeURIComponent(pod)}`);
+    const el = $('logs');
+    el.style.display = 'block';
+    el.textContent = `--- ${pod} ---\n` + (r.data.logs || []).join('');
+  };
+};
+
+// ---- models ----------------------------------------------------------------
+
+VIEWS.models = async () => {
+  const d = (await api('/api/v1/model/list')).data;
+  $('view').innerHTML = `
+    <h2 style="margin-top:0">Model lineage</h2>
+    ${d.models.map(m => `
+      <h2>${esc(m.namespace)}/${esc(m.name)}
+        <span class="muted" style="font-weight:normal;font-size:12px">
+          latest: ${esc(m.latest_version || '-')}</span></h2>
+      <table><thead><tr><th>version</th><th>phase</th><th>image</th>
+        <th>storage</th><th>built from</th><th>created</th></tr></thead>
+      <tbody>${m.versions.map(v => `<tr>
+        <td>${esc(v.name)}</td><td>${phaseTag(v.phase)}</td>
+        <td class=mono style="background:none;border:none;padding:6px 10px">${esc(v.image || '-')}</td>
+        <td class=muted>${esc(v.storage_provider)}:${esc(v.storage_root)}</td>
+        <td class=muted>${esc(v.created_by)}</td>
+        <td class=muted>${esc(fmt(v.created_at))}</td></tr>`).join('')
+        || '<tr><td colspan=6 class=muted>no versions</td></tr>'}
+      </tbody></table>`).join('')
+      || '<p class=muted>No models yet — jobs with spec.model_version publish here on success.</p>'}`;
+};
+
+// ---- submit ----------------------------------------------------------------
+
+const TEMPLATES = {
+  TPUJob: `kind: TPUJob
+metadata:
+  name: demo
+spec:
+  replicaSpecs:
+    Worker:
+      replicas: 1
+      restartPolicy: OnFailureSlice
+      template:
+        spec:
+          containers:
+          - command: ["python", "-c", "print('hello tpu')"]`,
+  TFJob: `kind: TFJob
+metadata:
+  name: tf-demo
+spec:
+  replicaSpecs:
+    Worker:
+      replicas: 1
+      template:
+        spec:
+          containers:
+          - command: ["python", "-c", "import os; print(os.environ['TF_CONFIG'])"]`,
+};
+
+VIEWS.submit = async () => {
+  const o = (await api('/api/v1/data/overview')).data;
+  $('view').innerHTML = `
+    <h2 style="margin-top:0">Submit a job</h2>
+    <p class="muted">Paste a job object as YAML or JSON (must include
+      <code>kind</code>), or start from a template.</p>
+    <div class="row">
+      <select id="tmpl"><option value="">template...</option>${
+        Object.keys(TEMPLATES).filter(k => o.workloadKinds.includes(k))
+          .map(k => `<option>${esc(k)}</option>`).join('')}</select>
+    </div>
+    <textarea id="submit-box" placeholder="kind: TPUJob&#10;metadata:&#10;  name: demo"></textarea>
+    <div class="row"><button onclick="submitJob()">submit</button>
+      <span id="submit-msg" class="muted"></span></div>`;
+  $('tmpl').onchange = () => {
+    if ($('tmpl').value) $('submit-box').value = TEMPLATES[$('tmpl').value];
+  };
+};
+
+async function submitJob() {
+  const raw = $('submit-box').value;
+  let body; try { body = JSON.parse(raw); } catch { body = {yaml: raw}; }
+  const r = await post('/api/v1/job/submit', body);
+  $('submit-msg').textContent = JSON.stringify(r.data);
+  if (r.code === '200') location.hash = '#/jobs';
+}
+
+// ---- sources ---------------------------------------------------------------
+
+VIEWS.sources = async () => {
+  const kinds = ['datasource', 'codesource'];
+  const data = {};
+  for (const k of kinds) data[k] = (await api(`/api/v1/${k}`)).data;
+  $('view').innerHTML = kinds.map(k => `
+    <h2 ${k === 'datasource' ? 'style="margin-top:0"' : ''}>${esc(k)}s</h2>
+    <table><thead><tr><th>name</th><th>spec</th><th></th></tr></thead>
+    <tbody>${Object.entries(data[k]).map(([n, v]) => `<tr>
+      <td>${esc(n)}</td>
+      <td class=muted>${esc(JSON.stringify(v))}</td>
+      <td><button data-del="${esc(k)}/${esc(n)}">delete</button></td></tr>`).join('')
+      || '<tr><td colspan=3 class=muted>none</td></tr>'}
+    </tbody></table>
+    <div class="row">
+      <input id="new-${esc(k)}-name" placeholder="name">
+      <input id="new-${esc(k)}-spec" placeholder='{"path": "/data"}' size=40>
+      <button data-add="${esc(k)}">add</button>
+    </div>`).join('');
+  $('view').onclick = async ev => {
+    if (ev.target.dataset.del) {
+      await fetch(`/api/v1/${ev.target.dataset.del}`, {method: 'DELETE'});
+      VIEWS.sources();
+    } else if (ev.target.dataset.add) {
+      const k = ev.target.dataset.add;
+      let spec;
+      try { spec = JSON.parse($(`new-${k}-spec`).value || '{}'); }
+      catch (e) { alert('spec is not valid JSON: ' + e.message); return; }
+      spec.name = $(`new-${k}-name`).value;
+      if (!spec.name) return;
+      await post(`/api/v1/${k}`, spec);
+      VIEWS.sources();
+    }
+  };
+};
+
+
+// ---- charts ----------------------------------------------------------------
+// Dependency-free SVG charts over the metrics the backend already exports
+// (/api/v1/data/charts wraps the prometheus registry's structured
+// snapshot): launch-delay histograms, per-kind job outcomes, live
+// running/pending sampled client-side while the view is open.
+
+const SAMPLES = [];  // [{t, running, pending}] gauge timeline (this tab)
+let chartsTimer = null;
+
+function barChart(items, {width = 520, height = 150, color = '#3451b2'} = {}) {
+  // items: [[label, value], ...]
+  const max = Math.max(1, ...items.map(([, v]) => v));
+  const bw = Math.max(8, Math.floor((width - 40) / Math.max(items.length, 1)) - 6);
+  const bars = items.map(([l, v], i) => {
+    const h = Math.round((height - 35) * v / max);
+    const x = 30 + i * (bw + 6);
+    const y = height - 20 - h;
+    return `<rect x="${x}" y="${y}" width="${bw}" height="${h}" fill="${color}" rx="2">
+        <title>${esc(l)}: ${esc(v)}</title></rect>
+      <text x="${x + bw / 2}" y="${height - 6}" font-size="9" text-anchor="middle"
+        fill="#667">${esc(String(l).slice(0, 8))}</text>
+      ${v ? `<text x="${x + bw / 2}" y="${y - 3}" font-size="9" text-anchor="middle"
+        fill="#1a1a2e">${esc(v)}</text>` : ''}`;
+  }).join('');
+  return `<svg viewBox="0 0 ${width} ${height}" width="${width}" height="${height}"
+    role="img">${bars}</svg>`;
+}
+
+function lineChart(series, {width = 520, height = 120} = {}) {
+  // series: [{name, color, points: [v, ...]}] sharing an x axis
+  const n = Math.max(2, ...series.map(s => s.points.length));
+  const max = Math.max(1, ...series.flatMap(s => s.points));
+  const path = s => s.points.map((v, i) =>
+    `${i ? 'L' : 'M'}${10 + i * (width - 20) / (n - 1)},${height - 15 - (height - 25) * v / max}`
+  ).join('');
+  return `<svg viewBox="0 0 ${width} ${height}" width="${width}" height="${height}">
+    ${series.map(s => `<path d="${path(s)}" fill="none" stroke="${s.color}"
+      stroke-width="2"><title>${esc(s.name)}</title></path>`).join('')}
+    <text x="10" y="12" font-size="10" fill="#667">max ${esc(max)}</text>
+    ${series.map((s, i) => `<text x="${70 + i * 90}" y="12" font-size="10"
+      fill="${s.color}">${esc(s.name)}</text>`).join('')}</svg>`;
+}
+
+function histChart(snap, {width = 520, height = 150} = {}) {
+  // one histogram label-set: bucket counts with le labels
+  const items = snap.buckets.map((b, i) => [b >= 1 ? b + 's' : b * 1000 + 'ms',
+                                            snap.counts[i]]);
+  return barChart(items, {width, height, color: '#5a7bd8'});
+}
+
+VIEWS.charts = async () => {
+  const d = (await api('/api/v1/data/charts')).data;
+  if (!chartsTimer) {
+    chartsTimer = setInterval(async () => {
+      if ((location.hash || '') !== '#/charts') {
+        clearInterval(chartsTimer); chartsTimer = null; return;
+      }
+      try {
+        const g = (await api('/api/v1/data/charts')).data.gauges;
+        SAMPLES.push({
+          t: Date.now(),
+          running: g.running.reduce((a, r) => a + r.value, 0),
+          pending: g.pending.reduce((a, r) => a + r.value, 0),
+        });
+        if (SAMPLES.length > 120) SAMPLES.shift();
+        const el = $('gauge-line');
+        if (el) el.innerHTML = lineChart([
+          {name: 'running', color: '#1c7a3d', points: SAMPLES.map(s => s.running)},
+          {name: 'pending', color: '#a07a2c', points: SAMPLES.map(s => s.pending)},
+        ]);
+      } catch (e) { /* sampling best-effort */ }
+    }, 3000);
+  }
+  const kinds = [...new Set([
+    ...d.counters.created.map(r => r.labels.kind),
+    ...d.counters.successful.map(r => r.labels.kind),
+  ])].filter(Boolean);
+  const outcome = name => kinds.map(k => [k,
+    (d.counters[name].find(r => r.labels.kind === k) || {value: 0}).value]);
+  const launch = d.launch_delay.first_pod;
+  const launchAll = d.launch_delay.all_pods;
+  $('view').innerHTML = `
+    <h2 style="margin-top:0">Jobs running / pending (live, sampled while open)</h2>
+    <div id="gauge-line" class="muted">sampling&hellip;</div>
+    <h2>Job outcomes by kind</h2>
+    <div class="row">
+      <div><div class="muted">created</div>${barChart(outcome('created'))}</div>
+    </div>
+    <div class="row">
+      <div><div class="muted">succeeded</div>${barChart(outcome('successful'), {color: '#1c7a3d'})}</div>
+      <div><div class="muted">failed</div>${barChart(outcome('failed'), {color: '#a02c2c'})}</div>
+    </div>
+    <h2>Launch delay: submit &rarr; first pod running</h2>
+    ${launch.length ? launch.map(s => `<div class="muted">kind
+      ${esc(s.labels.kind || 'all')} &middot; n=${esc(s.total)} &middot;
+      mean ${esc((s.total ? s.sum / s.total : 0).toFixed(3))}s</div>
+      ${histChart(s)}`).join('') : '<p class="muted">no launches yet</p>'}
+    <h2>Launch delay: submit &rarr; ALL pods running</h2>
+    ${launchAll.length ? launchAll.map(s => `<div class="muted">kind
+      ${esc(s.labels.kind || 'all')} &middot; n=${esc(s.total)}</div>
+      ${histChart(s)}`).join('') : '<p class="muted">no launches yet</p>'}
+    <h2>Serving</h2>
+    ${d.serving.length ? `<table><thead><tr><th>inference</th><th>predictor</th>
+      <th>replicas</th><th>ready</th><th>traffic %</th><th>qps</th></tr></thead>
+      <tbody>${d.serving.map(s => `<tr><td>${esc(s.inference)}</td>
+        <td>${esc(s.predictor)}</td><td>${esc(s.replicas)}</td>
+        <td>${esc(s.ready)}</td><td>${esc(s.weight ?? '-')}</td>
+        <td>${s.qps == null ? '<span class=muted>n/a</span>' : esc(s.qps)}</td>
+        </tr>`).join('')}</tbody></table>`
+      : '<p class="muted">no inference services</p>'}`;
+};
+
+// ---- boot ------------------------------------------------------------------
+
+route();
+setInterval(() => {
+  if ($('login').style.display === 'flex') return;
+  const h = location.hash || '';
+  if (h === '#/overview' || h === '') route();
+  else if (h === '#/jobs') loadJobs();  // table only: keep filters + focus
+}, 5000);

@@ -1,0 +1,5 @@
+"""Self-hosted control-plane substrate: object store, watches, workqueues."""
+
+from kubedl_tpu.core.manager import ControllerManager, EventRecorder, owner_mapper  # noqa: F401
+from kubedl_tpu.core.store import AlreadyExists, Conflict, NotFound, ObjectStore  # noqa: F401
+from kubedl_tpu.core.workqueue import WorkQueue  # noqa: F401

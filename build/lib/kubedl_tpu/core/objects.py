@@ -1,0 +1,297 @@
+"""Core object model: the Pod/Service/ConfigMap/Event analogues.
+
+The reference delegates these to Kubernetes (L0 in SURVEY.md §1). The TPU
+build is self-hosted: these are plain dataclasses living in an in-process
+:class:`~kubedl_tpu.core.store.ObjectStore`, and "pods" are realized by an
+executor (`kubedl_tpu.runtime`) as local processes on TPU hosts. The fields
+kept are exactly the ones the reference's engine manipulates: labels for
+claiming (pod.go:343-357), owner refs for GC, restart-relevant exit codes
+(pod.go:305-317), host-network ports (hostnetwork.go:29-100), and headless
+service DNS (service.go:260-307).
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import ClassVar, Dict, List, Optional, Tuple
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid() -> str:
+    return f"uid-{next(_uid_counter):08d}"
+
+
+@dataclass
+class OwnerRef:
+    kind: str
+    name: str
+    uid: str
+    controller: bool = True
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=new_uid)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    owner_refs: List[OwnerRef] = field(default_factory=list)
+    creation_timestamp: float = field(default_factory=time.time)
+    deletion_timestamp: Optional[float] = None
+    resource_version: int = 0
+
+    def controller_ref(self) -> Optional[OwnerRef]:
+        for r in self.owner_refs:
+            if r.controller:
+                return r
+        return None
+
+
+@dataclass
+class BaseObject:
+    """Everything stored in the ObjectStore derives from this."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+
+    KIND: ClassVar[str] = "Object"
+
+    @property
+    def kind(self) -> str:
+        return self.KIND
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.metadata.namespace, self.metadata.name)
+
+
+class PodPhase(str, enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+@dataclass
+class EnvVar:
+    name: str
+    value: str
+
+
+@dataclass
+class Port:
+    name: str
+    port: int
+    host_port: Optional[int] = None
+
+
+@dataclass
+class Container:
+    """One process image. ``command`` is an argv; ``entrypoint`` may instead
+    name a Python callable ("pkg.mod:fn") the executor runs in-process — the
+    TPU-native fast path that skips container pull entirely."""
+
+    name: str = "main"
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    entrypoint: str = ""
+    env: List[EnvVar] = field(default_factory=list)
+    ports: List[Port] = field(default_factory=list)
+    working_dir: str = ""
+    resources: Dict[str, float] = field(default_factory=dict)
+
+    def set_env(self, name: str, value: str) -> None:
+        for e in self.env:
+            if e.name == name:
+                e.value = value
+                return
+        self.env.append(EnvVar(name, value))
+
+    def get_env(self, name: str) -> Optional[str]:
+        for e in self.env:
+            if e.name == name:
+                return e.value
+        return None
+
+
+@dataclass
+class Volume:
+    name: str
+    host_path: str = ""
+    empty_dir: bool = False
+    mount_path: str = ""
+    #: name of a ConfigMap whose keys are materialized as files at
+    #: ``mount_path`` by the kubelet (reference: MPI mounts the
+    #: hostfile/kubexec ConfigMap into launcher pods, mpi_config.go:48-123)
+    config_map: str = ""
+
+
+def config_mount_path(namespace: str, pod_name: str, volume: str) -> str:
+    """Deterministic materialization dir for ConfigMap volumes, computable
+    at spec-build time (controllers bake it into env) and at launch time
+    (kubelet writes the files there)."""
+    return f"/tmp/kubedl-mounts/{namespace}/{pod_name}/{volume}"
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    volumes: List[Volume] = field(default_factory=list)
+    node_name: str = ""
+    scheduler_name: str = "default"
+    host_network: bool = False
+    restart_policy: str = "Never"
+    #: TPU: name of the slice this pod's gang occupies; filled by the gang
+    #: scheduler at bind time.
+    slice_assignment: str = ""
+
+    def main_container(self, name: str = "") -> Container:
+        if not self.containers:
+            self.containers.append(Container())
+        if name:
+            for c in self.containers:
+                if c.name == name:
+                    return c
+        return self.containers[0]
+
+
+@dataclass
+class ContainerStatus:
+    name: str = "main"
+    exit_code: Optional[int] = None
+    reason: str = ""
+
+
+@dataclass
+class PodStatus:
+    phase: PodPhase = PodPhase.PENDING
+    pod_ip: str = ""
+    host_ip: str = ""
+    start_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    reason: str = ""
+    container_statuses: List[ContainerStatus] = field(default_factory=list)
+
+    def exit_code(self) -> Optional[int]:
+        for cs in self.container_statuses:
+            if cs.exit_code is not None:
+                return cs.exit_code
+        return None
+
+
+@dataclass
+class PodTemplateSpec:
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+    def apply_defaults(self) -> None:
+        if not self.spec.containers:
+            self.spec.containers.append(Container())
+
+    def deep_copy(self) -> "PodTemplateSpec":
+        import copy
+
+        return copy.deepcopy(self)
+
+
+@dataclass
+class Pod(BaseObject):
+    KIND = "Pod"
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def phase(self) -> PodPhase:
+        return self.status.phase
+
+    def is_terminal(self) -> bool:
+        return self.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+
+    def is_evicted(self) -> bool:
+        return self.status.phase == PodPhase.FAILED and self.status.reason == "Evicted"
+
+
+@dataclass
+class ServiceSpec:
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[Port] = field(default_factory=list)
+    cluster_ip: str = "None"  # headless by default (reference: service.go:260-307)
+
+
+@dataclass
+class Service(BaseObject):
+    KIND = "Service"
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+
+    def dns_name(self, cluster_domain: str = "") -> str:
+        """`name.ns.svc[.domain]` — reference: tensorflow.go:124-146."""
+        base = f"{self.metadata.name}.{self.metadata.namespace}.svc"
+        return f"{base}.{cluster_domain}" if cluster_domain else base
+
+
+@dataclass
+class ConfigMap(BaseObject):
+    KIND = "ConfigMap"
+    data: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class IngressRoute(BaseObject):
+    """Host/path -> backing-service routing rule (the reference's
+    networking.k8s.io Ingress analogue, controllers/mars/ingress.go:37-166:
+    Mars publishes its web UI at http://<webHost>/<ns>/<job>). A real
+    deployment's edge proxy watches these objects; here they carry the
+    routing intent and are owner-GC'd with the job."""
+
+    KIND = "IngressRoute"
+    host: str = ""
+    #: URL path prefix routed to the backend (e.g. "/default/job1")
+    path: str = ""
+    #: backing Service name + port
+    service: str = ""
+    port: int = 0
+
+
+@dataclass
+class Event(BaseObject):
+    KIND = "Event"
+    involved_kind: str = ""
+    involved_name: str = ""
+    involved_namespace: str = "default"
+    type: str = "Normal"
+    reason: str = ""
+    message: str = ""
+    count: int = 1
+    timestamp: float = field(default_factory=time.time)
+
+
+@dataclass
+class PodGroup(BaseObject):
+    """Gang-scheduling unit (reference: kube-batch PodGroup,
+    batch_scheduler/scheduler.go:58-119)."""
+
+    KIND = "PodGroup"
+    min_member: int = 1
+    slice_type: str = ""  # e.g. "v5e-32"; empty = host-count gang only
+    num_slices: int = 1
+    phase: str = "Pending"  # Pending -> Running -> Finished
+    assigned_slices: List[str] = field(default_factory=list)
+
+
+def match_labels(labels: Dict[str, str], selector: Dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())

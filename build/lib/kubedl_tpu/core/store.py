@@ -1,0 +1,203 @@
+"""In-process object store with watch semantics — the etcd/api-server analogue.
+
+The reference rides controller-runtime's informer cache + client (SURVEY.md
+L0). Here a single thread-safe store holds every object, hands out deep
+copies (so controllers can't mutate shared state accidentally — the same
+reason the reference reads via a cache and writes via the client), and fans
+out Added/Modified/Deleted events to registered watchers. Controllers never
+poll: watch events feed their workqueues
+(:mod:`kubedl_tpu.core.workqueue`), exactly like informer event handlers.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from kubedl_tpu.core.objects import BaseObject, match_labels
+
+WatchCallback = Callable[[str, BaseObject, Optional[BaseObject]], None]
+# signature: (event_type, new_obj, old_obj) with event_type in
+# {"ADDED", "MODIFIED", "DELETED"}
+
+
+class Conflict(Exception):
+    """Optimistic-concurrency failure (stale resource_version on update)."""
+
+
+class NotFound(Exception):
+    pass
+
+
+class AlreadyExists(Exception):
+    pass
+
+
+@dataclass
+class _Watcher:
+    kinds: Optional[Tuple[str, ...]]
+    callback: WatchCallback
+
+
+class ObjectStore:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._objects: Dict[str, Dict[Tuple[str, str], BaseObject]] = {}
+        self._rv = 0
+        self._watchers: List[_Watcher] = []
+
+    # ---- CRUD ------------------------------------------------------------
+
+    def create(self, obj: BaseObject) -> BaseObject:
+        with self._lock:
+            bucket = self._objects.setdefault(obj.kind, {})
+            if obj.key in bucket:
+                raise AlreadyExists(f"{obj.kind} {obj.key} already exists")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            stored = copy.deepcopy(obj)
+            bucket[obj.key] = stored
+            snapshot = copy.deepcopy(stored)
+        self._notify("ADDED", snapshot, None)
+        return snapshot
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> BaseObject:
+        with self._lock:
+            bucket = self._objects.get(kind, {})
+            obj = bucket.get((namespace, name))
+            if obj is None or obj.metadata.deletion_timestamp is not None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def try_get(
+        self, kind: str, name: str, namespace: str = "default"
+    ) -> Optional[BaseObject]:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def update(self, obj: BaseObject) -> BaseObject:
+        """Optimistic update: fails with Conflict on stale resource_version
+        (the reference requeues on conflict, job.go:298-306)."""
+        with self._lock:
+            bucket = self._objects.get(obj.kind, {})
+            cur = bucket.get(obj.key)
+            if cur is None:
+                raise NotFound(f"{obj.kind} {obj.key} not found")
+            if obj.metadata.resource_version != cur.metadata.resource_version:
+                raise Conflict(
+                    f"{obj.kind} {obj.key}: stale rv "
+                    f"{obj.metadata.resource_version} != {cur.metadata.resource_version}"
+                )
+            old = copy.deepcopy(cur)
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            stored = copy.deepcopy(obj)
+            bucket[obj.key] = stored
+            snapshot = copy.deepcopy(stored)
+        self._notify("MODIFIED", snapshot, old)
+        return snapshot
+
+    def update_with_retry(
+        self, kind: str, name: str, namespace: str, mutate: Callable[[BaseObject], None],
+        attempts: int = 5,
+    ) -> BaseObject:
+        """Read-modify-write loop, the client-go `retry.RetryOnConflict` idiom."""
+        last: Optional[Exception] = None
+        for _ in range(attempts):
+            obj = self.get(kind, name, namespace)
+            mutate(obj)
+            try:
+                return self.update(obj)
+            except Conflict as e:  # refetch and retry
+                last = e
+        raise last  # type: ignore[misc]
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        with self._lock:
+            bucket = self._objects.get(kind, {})
+            obj = bucket.pop((namespace, name), None)
+        if obj is None:
+            raise NotFound(f"{kind} {namespace}/{name} not found")
+        self._notify("DELETED", copy.deepcopy(obj), copy.deepcopy(obj))
+
+    def try_delete(self, kind: str, name: str, namespace: str = "default") -> bool:
+        try:
+            self.delete(kind, name, namespace)
+            return True
+        except NotFound:
+            return False
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = "default",
+        selector: Optional[Dict[str, str]] = None,
+    ) -> List[BaseObject]:
+        with self._lock:
+            bucket = self._objects.get(kind, {})
+            out = []
+            for (ns, _), obj in bucket.items():
+                if namespace is not None and ns != namespace:
+                    continue
+                if selector and not match_labels(obj.metadata.labels, selector):
+                    continue
+                out.append(copy.deepcopy(obj))
+        out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+        return out
+
+    def kinds(self) -> Iterable[str]:
+        with self._lock:
+            return list(self._objects)
+
+    # ---- watches ---------------------------------------------------------
+
+    def watch(
+        self, callback: WatchCallback, kinds: Optional[Iterable[str]] = None
+    ) -> Callable[[], None]:
+        """Register a watcher; returns an unsubscribe function. Watchers run
+        inline on the mutating thread (informer-style handlers must be quick
+        — typically just a workqueue enqueue)."""
+        w = _Watcher(tuple(kinds) if kinds else None, callback)
+        with self._lock:
+            self._watchers.append(w)
+
+        def cancel() -> None:
+            with self._lock:
+                if w in self._watchers:
+                    self._watchers.remove(w)
+
+        return cancel
+
+    def _notify(
+        self, event: str, obj: BaseObject, old: Optional[BaseObject]
+    ) -> None:
+        with self._lock:
+            watchers = list(self._watchers)
+        for w in watchers:
+            if w.kinds is None or obj.kind in w.kinds:
+                w.callback(event, obj, old)
+
+    # ---- garbage collection ---------------------------------------------
+
+    def collect_orphans(self) -> int:
+        """Delete objects whose controller owner is gone (the kube GC
+        analogue; the reference leans on ownerReferences for cascade)."""
+        doomed: List[BaseObject] = []
+        with self._lock:
+            uids = {
+                o.metadata.uid
+                for bucket in self._objects.values()
+                for o in bucket.values()
+            }
+            for bucket in self._objects.values():
+                for obj in bucket.values():
+                    ref = obj.metadata.controller_ref()
+                    if ref is not None and ref.uid not in uids:
+                        doomed.append(obj)
+        for obj in doomed:
+            self.try_delete(obj.kind, obj.metadata.name, obj.metadata.namespace)
+        return len(doomed)

@@ -1,0 +1,118 @@
+"""Rate-limited work queue with deduplication and exponential backoff.
+
+The controller-runtime workqueue analogue the reference's engine relies on
+(BackoffStatesQueue, pkg/job_controller/job_controller.go:71 and requeue
+semantics in job.go:87-97). Guarantees: an item queued multiple times before
+being processed is handed out once; an item re-added while being processed is
+re-queued afterwards; failures back off exponentially per item.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, Generic, Hashable, List, Optional, Tuple, TypeVar
+
+T = TypeVar("T", bound=Hashable)
+
+
+class WorkQueue(Generic[T]):
+    def __init__(
+        self, base_delay: float = 0.005, max_delay: float = 30.0
+    ) -> None:
+        self._cond = threading.Condition()
+        self._queue: List[T] = []
+        self._dirty: set = set()
+        self._processing: set = set()
+        self._delayed: List[Tuple[float, int, T]] = []  # heap by ready-time
+        self._seq = 0
+        self._failures: Dict[T, int] = {}
+        self._base_delay = base_delay
+        self._max_delay = max_delay
+        self._shutdown = False
+
+    def add(self, item: T) -> None:
+        with self._cond:
+            if self._shutdown or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item not in self._processing:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def add_after(self, item: T, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._cond:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed, (time.time() + delay, self._seq, item))
+            self._cond.notify()
+
+    def add_rate_limited(self, item: T) -> None:
+        """Re-queue with per-item exponential backoff (failure path)."""
+        with self._cond:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        self.add_after(item, min(self._base_delay * (2**n), self._max_delay))
+
+    def forget(self, item: T) -> None:
+        """Reset the item's backoff counter (success path)."""
+        with self._cond:
+            self._failures.pop(item, None)
+
+    def num_requeues(self, item: T) -> int:
+        with self._cond:
+            return self._failures.get(item, 0)
+
+    def _drain_delayed_locked(self) -> Optional[float]:
+        """Move due delayed items into the active queue; return seconds until
+        the next one is due (None if no delayed items)."""
+        now = time.time()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, item = heapq.heappop(self._delayed)
+            if item not in self._dirty:
+                self._dirty.add(item)
+                if item not in self._processing:
+                    self._queue.append(item)
+        return (self._delayed[0][0] - now) if self._delayed else None
+
+    def get(self, timeout: Optional[float] = None) -> Optional[T]:
+        """Block until an item is available; None on shutdown/timeout."""
+        deadline = None if timeout is None else time.time() + timeout
+        with self._cond:
+            while True:
+                next_due = self._drain_delayed_locked()
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._dirty.discard(item)
+                    self._processing.add(item)
+                    return item
+                if self._shutdown:
+                    return None
+                wait: Optional[float] = next_due
+                if deadline is not None:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._cond.wait(wait)
+
+    def done(self, item: T) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue) + len(self._delayed)

@@ -1,0 +1,10 @@
+"""Cron workflows: scheduled launches of any workload kind.
+
+Reference: apis/apps/v1alpha1/cron_types.go + controllers/apps/ (SURVEY.md
+§2.3 Cron row): Cron{schedule, template, concurrencyPolicy, suspend,
+deadline, historyLimit} with missed-run accounting and a history ring.
+"""
+
+from kubedl_tpu.cron.controller import CronController  # noqa: F401
+from kubedl_tpu.cron.cronexpr import CronSchedule  # noqa: F401
+from kubedl_tpu.cron.types import ConcurrencyPolicy, Cron  # noqa: F401

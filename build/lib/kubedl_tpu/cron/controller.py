@@ -1,0 +1,234 @@
+"""Cron controller: fire workloads on schedule.
+
+Reference: controllers/apps/cron_controller.go — reconcile flow: list
+active workloads (:405-441), refresh the history ring (:259-294), trim
+finished runs from active (:348-403), suspend/deadline checks (:154-166),
+then scheduleNextIfPossible (:176-257): missed-run accounting with a >100
+warning (cron_utils.go:54-121), concurrency policy Forbid -> skip /
+Replace -> delete actives, materialize the template with the cron-name
+label (:296-346), and RequeueAfter(next fire).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import time
+from typing import List, Optional
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.api.interface import JobObject
+from kubedl_tpu.core.manager import ControllerManager, EventRecorder
+from kubedl_tpu.core.objects import BaseObject, OwnerRef
+from kubedl_tpu.core.store import AlreadyExists, NotFound, ObjectStore
+from kubedl_tpu.cron.cronexpr import CronSchedule, missed_run_info
+from kubedl_tpu.cron.types import ConcurrencyPolicy, Cron, CronHistoryEntry
+
+log = logging.getLogger("kubedl_tpu.cron")
+
+#: reference warns when missed-run accounting passes 100 (cron_utils.go:80-98)
+MISSED_RUN_WARNING = 100
+
+
+class CronController:
+    NAME = "cron-controller"
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        workload_kinds: List[str],
+        recorder: Optional[EventRecorder] = None,
+        clock=time.time,
+        submitter=None,
+    ) -> None:
+        self.store = store
+        self.workload_kinds = list(workload_kinds)
+        self.recorder = recorder or EventRecorder(store)
+        self.clock = clock
+        #: admission-checked create (Operator.submit) — cron-materialized
+        #: jobs must pass the same validation as direct submits
+        self.submitter = submitter or store.create
+
+    def setup(self, manager: ControllerManager) -> None:
+        manager.register(
+            self.NAME,
+            self.reconcile,
+            watch_kinds=["Cron"] + self.workload_kinds,
+            mapper=self._mapper,
+        )
+
+    def _mapper(self, event: str, obj: BaseObject, old):
+        if obj.kind == "Cron":
+            return [(obj.metadata.namespace, obj.metadata.name)]
+        cron_name = obj.metadata.labels.get(constants.LABEL_CRON_NAME)
+        return [(obj.metadata.namespace, cron_name)] if cron_name else []
+
+    # ------------------------------------------------------------------
+
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        cron = self.store.try_get("Cron", name, namespace)
+        if cron is None:
+            return None
+        assert isinstance(cron, Cron)
+        now = self.clock()
+
+        owned = self._owned_workloads(cron)
+        self._refresh_history(cron, owned)
+
+        if cron.suspend or cron.template is None or not cron.schedule:
+            self._write_status(cron)  # persist history/active refresh
+            return None
+        try:
+            schedule = CronSchedule.parse(cron.schedule)
+        except ValueError as e:
+            self.recorder.event(cron, "Warning", "BadSchedule", str(e))
+            self._write_status(cron)
+            return None
+
+        fired = self._schedule_next_if_possible(cron, schedule, now)
+        self._write_status(cron)
+        nxt = schedule.next_after(self.clock())
+        return max(nxt - self.clock(), 0.5) if not fired else 0.0
+
+    # ------------------------------------------------------- scheduling
+
+    def _schedule_next_if_possible(
+        self, cron: Cron, schedule: CronSchedule, now: float
+    ) -> bool:
+        """Returns True if a workload was launched (requeue immediately to
+        recompute state)."""
+        earliest = cron.last_schedule_time or cron.metadata.creation_timestamp
+        fire_time, n_missed = missed_run_info(schedule, earliest, now)
+        if fire_time is None:
+            return False
+        if n_missed > MISSED_RUN_WARNING:
+            self.recorder.event(
+                cron, "Warning", "TooManyMissedRuns",
+                f"{n_missed} missed runs; check clock skew or a "
+                "long controller outage",
+            )
+        # only the most recent missed run launches
+        deadline = cron.starting_deadline_seconds
+        if deadline is not None and now - fire_time > deadline:
+            self.recorder.event(
+                cron, "Warning", "MissedDeadline",
+                f"run for {fire_time} skipped: past startingDeadlineSeconds",
+            )
+            cron.last_schedule_time = fire_time
+            return False
+
+        if cron.active:
+            if cron.concurrency_policy == ConcurrencyPolicy.FORBID:
+                self.recorder.event(
+                    cron, "Normal", "ConcurrencySkip",
+                    f"{len(cron.active)} run(s) still active; Forbid skips",
+                )
+                cron.last_schedule_time = fire_time
+                return False
+            if cron.concurrency_policy == ConcurrencyPolicy.REPLACE:
+                for obj_name in cron.active:
+                    self.store.try_delete(
+                        cron.template.kind, obj_name, cron.metadata.namespace
+                    )
+                cron.active = []
+
+        self._launch(cron, fire_time)
+        cron.last_schedule_time = fire_time
+        return True
+
+    def _launch(self, cron: Cron, fire_time: float) -> None:
+        """Materialize the template (reference: newWorkloadFromTemplate,
+        cron_controller.go:296-346)."""
+        job = copy.deepcopy(cron.template)
+        assert isinstance(job, JobObject)
+        stamp = time.strftime("%Y%m%d%H%M", time.localtime(fire_time))
+        job.metadata.name = f"{cron.metadata.name}-{stamp}"
+        job.metadata.namespace = cron.metadata.namespace
+        job.metadata.labels[constants.LABEL_CRON_NAME] = cron.metadata.name
+        job.metadata.owner_refs = [
+            OwnerRef(kind=cron.kind, name=cron.metadata.name, uid=cron.metadata.uid)
+        ]
+        job.metadata.resource_version = 0
+        try:
+            created = self.submitter(job)
+        except AlreadyExists:
+            return
+        except ValueError as e:  # admission rejection: surface, don't churn
+            self.recorder.event(
+                cron, "Warning", "CronTemplateRejected", str(e)
+            )
+            return
+        cron.active.append(created.metadata.name)
+        cron.history.insert(
+            0,
+            CronHistoryEntry(
+                object_name=created.metadata.name,
+                kind=created.kind,
+                status="Created",
+                created=fire_time,
+            ),
+        )
+        self._trim_history_ring(cron)
+        self.recorder.event(
+            cron, "Normal", "CronFired", f"launched {created.kind}/{created.metadata.name}"
+        )
+
+    # ---------------------------------------------------------- history
+
+    def _owned_workloads(self, cron: Cron) -> List[JobObject]:
+        if cron.template is None:
+            return []
+        return [
+            obj
+            for obj in self.store.list(
+                cron.template.kind,
+                cron.metadata.namespace,
+                {constants.LABEL_CRON_NAME: cron.metadata.name},
+            )
+            if isinstance(obj, JobObject)
+        ]
+
+    def _refresh_history(self, cron: Cron, owned: List[JobObject]) -> None:
+        """Sync entry statuses, trim finished runs from active, apply the
+        history ring limit (reference :259-294, :348-403)."""
+        by_name = {o.metadata.name: o for o in owned}
+        for entry in cron.history:
+            obj = by_name.get(entry.object_name)
+            if obj is None:
+                if entry.status not in ("Succeeded", "Failed", "Deleted"):
+                    entry.status = "Deleted"
+                continue
+            phase = obj.status.phase
+            entry.status = phase.value if phase else "Created"
+            if obj.status.completion_time and entry.finished is None:
+                entry.finished = obj.status.completion_time
+        cron.active = [
+            n
+            for n in cron.active
+            if n in by_name and not by_name[n].status.is_terminal()
+        ]
+        self._trim_history_ring(cron)
+
+    def _trim_history_ring(self, cron: Cron) -> None:
+        """Keep historyLimit entries; delete workloads that fall off the
+        end (reference keeps historyLimit objects, deletes overflow)."""
+        overflow = cron.history[max(cron.history_limit, 0):]
+        cron.history = cron.history[: max(cron.history_limit, 0)]
+        for entry in overflow:
+            self.store.try_delete(
+                entry.kind, entry.object_name, cron.metadata.namespace
+            )
+            cron.active = [n for n in cron.active if n != entry.object_name]
+
+    def _write_status(self, cron: Cron) -> None:
+        def mutate(obj: Cron) -> None:  # type: ignore[type-arg]
+            obj.active = cron.active
+            obj.last_schedule_time = cron.last_schedule_time
+            obj.history = cron.history
+
+        try:
+            self.store.update_with_retry(
+                "Cron", cron.metadata.name, cron.metadata.namespace, mutate
+            )
+        except NotFound:
+            pass

@@ -1,0 +1,202 @@
+"""Standard 5-field cron expression parsing and next-fire computation.
+
+Replaces the reference's robfig/cron dependency (controllers/apps/
+cron_utils.go) with an in-tree implementation: fields
+`minute hour day-of-month month day-of-week`, supporting `*`, values,
+ranges `a-b`, steps `*/n` and `a-b/n`, lists `a,b,c`, and the standard
+vixie-cron day rule: when BOTH day-of-month and day-of-week are
+restricted, a time matches if EITHER does.
+"""
+
+from __future__ import annotations
+
+import calendar
+from dataclasses import dataclass
+from datetime import datetime, timedelta
+from typing import FrozenSet, Optional, Tuple
+
+_FIELDS: Tuple[Tuple[str, int, int], ...] = (
+    ("minute", 0, 59),
+    ("hour", 0, 23),
+    ("day_of_month", 1, 31),
+    ("month", 1, 12),
+    ("day_of_week", 0, 6),  # 0 = Sunday (7 accepted as alias)
+)
+
+_ALIASES = {
+    "@hourly": "0 * * * *",
+    "@daily": "0 0 * * *",
+    "@midnight": "0 0 * * *",
+    "@weekly": "0 0 * * 0",
+    "@monthly": "0 0 1 * *",
+    "@yearly": "0 0 1 1 *",
+    "@annually": "0 0 1 1 *",
+}
+
+_MONTH_NAMES = {name.lower(): i for i, name in enumerate(calendar.month_abbr) if name}
+_DOW_NAMES = {"sun": 0, "mon": 1, "tue": 2, "wed": 3, "thu": 4, "fri": 5, "sat": 6}
+
+
+class CronParseError(ValueError):
+    pass
+
+
+def _to_int(token: str, field: str) -> int:
+    token = token.lower()
+    if field == "month" and token in _MONTH_NAMES:
+        return _MONTH_NAMES[token]
+    if field == "day_of_week" and token in _DOW_NAMES:
+        return _DOW_NAMES[token]
+    try:
+        return int(token)
+    except ValueError:
+        raise CronParseError(f"bad {field} value {token!r}") from None
+
+
+def _parse_field(spec: str, field: str, lo: int, hi: int) -> Tuple[FrozenSet[int], bool]:
+    """Returns (allowed values, is_wildcard)."""
+    values = set()
+    wildcard = spec == "*"
+    for part in spec.split(","):
+        step = 1
+        if "/" in part:
+            part, _, step_s = part.partition("/")
+            step = _to_int(step_s, field)
+            if step <= 0:
+                raise CronParseError(f"bad step in {field}: {step}")
+        if part == "*":
+            start, end = lo, hi
+        elif "-" in part:
+            a, _, b = part.partition("-")
+            start, end = _to_int(a, field), _to_int(b, field)
+        else:
+            start = end = _to_int(part, field)
+            if field == "day_of_week" and start == 7:
+                start = end = 0
+        if start < lo or end > hi or start > end:
+            raise CronParseError(
+                f"{field} value out of range [{lo},{hi}]: {part!r}"
+            )
+        values.update(range(start, end + 1, step))
+    return frozenset(values), wildcard
+
+
+@dataclass(frozen=True)
+class CronSchedule:
+    minutes: FrozenSet[int]
+    hours: FrozenSet[int]
+    days: FrozenSet[int]
+    months: FrozenSet[int]
+    dows: FrozenSet[int]
+    dom_wild: bool
+    dow_wild: bool
+    expr: str = ""
+
+    @classmethod
+    def parse(cls, expr: str) -> "CronSchedule":
+        expr = expr.strip()
+        expr = _ALIASES.get(expr, expr)
+        parts = expr.split()
+        if len(parts) != 5:
+            raise CronParseError(
+                f"expected 5 fields, got {len(parts)} in {expr!r}"
+            )
+        parsed = []
+        wilds = {}
+        for spec, (name, lo, hi) in zip(parts, _FIELDS):
+            vals, wild = _parse_field(spec, name, lo, hi)
+            parsed.append(vals)
+            wilds[name] = wild
+        return cls(
+            minutes=parsed[0], hours=parsed[1], days=parsed[2],
+            months=parsed[3], dows=parsed[4],
+            dom_wild=wilds["day_of_month"], dow_wild=wilds["day_of_week"],
+            expr=expr,
+        )
+
+    # ------------------------------------------------------------------
+
+    def _day_matches(self, dt: datetime) -> bool:
+        dom_ok = dt.day in self.days
+        # Python weekday(): Mon=0; cron: Sun=0
+        dow_ok = ((dt.weekday() + 1) % 7) in self.dows
+        if not self.dom_wild and not self.dow_wild:
+            return dom_ok or dow_ok  # vixie OR rule
+        return (self.dom_wild or dom_ok) and (self.dow_wild or dow_ok)
+
+    def next_after(self, ts: float) -> float:
+        """Earliest fire time strictly after unix time ``ts`` (local time,
+        matching the reference's in-cluster clock semantics)."""
+        dt = datetime.fromtimestamp(ts).replace(second=0, microsecond=0)
+        dt += timedelta(minutes=1)
+        # bound the search at ~5 years (worst case: Feb 29 schedules)
+        limit = dt + timedelta(days=366 * 5)
+        while dt < limit:
+            if dt.month not in self.months:
+                # jump to the 1st of the next month
+                if dt.month == 12:
+                    dt = dt.replace(year=dt.year + 1, month=1, day=1,
+                                    hour=0, minute=0)
+                else:
+                    dt = dt.replace(month=dt.month + 1, day=1, hour=0, minute=0)
+                continue
+            if not self._day_matches(dt):
+                dt = (dt + timedelta(days=1)).replace(hour=0, minute=0)
+                continue
+            if dt.hour not in self.hours:
+                nxt = [h for h in sorted(self.hours) if h > dt.hour]
+                if not nxt:
+                    dt = (dt + timedelta(days=1)).replace(hour=0, minute=0)
+                    continue
+                dt = dt.replace(hour=nxt[0], minute=0)
+            nxt_min = [m for m in sorted(self.minutes) if m >= dt.minute]
+            if not nxt_min:
+                dt = (dt + timedelta(hours=1)).replace(minute=0)
+                continue
+            return dt.replace(minute=nxt_min[0]).timestamp()
+        raise CronParseError(f"no fire time within 5 years for {self.expr!r}")
+
+
+def missed_run_times(
+    schedule: CronSchedule, earliest: float, now: float, limit: int = 500
+) -> list:
+    """All fire times in (earliest, now], capped at ``limit`` (the
+    reference warns past 100 missed runs, cron_utils.go:54-121)."""
+    out = []
+    t = earliest
+    while len(out) < limit:
+        t = schedule.next_after(t)
+        if t > now:
+            break
+        out.append(t)
+    return out
+
+
+def missed_run_info(
+    schedule: CronSchedule, earliest: float, now: float,
+    max_scan: int = 100_000,
+) -> Tuple[Optional[float], int]:
+    """(latest fire time in (earliest, now] or None, total missed count).
+
+    Scans to the TRUE latest run — a controller resuming after a long
+    outage must fire the most recent slot, never a stale one. ``max_scan``
+    only bounds pathological cases (years of minutely fires); when hit,
+    accounting re-anchors near ``now`` so the returned latest is still
+    fresh, with the count saturated."""
+    count = 0
+    latest: Optional[float] = None
+    t = earliest
+    while count < max_scan:
+        t = schedule.next_after(t)
+        if t > now:
+            return latest, count
+        latest = t
+        count += 1
+    # saturated: re-anchor one day back so 'latest' is genuinely recent
+    t = now - 86400.0
+    while True:
+        nxt = schedule.next_after(t)
+        if nxt > now:
+            return latest, count
+        latest = nxt
+        t = nxt

@@ -1,0 +1,52 @@
+"""Cron CRD types.
+
+Reference: apis/apps/v1alpha1/cron_types.go:26-107 — CronSpec {schedule,
+template (RawExtension workload), concurrencyPolicy Allow/Forbid/Replace,
+suspend, startingDeadlineSeconds, historyLimit}; CronStatus {active[],
+lastScheduleTime, history[]}.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from kubedl_tpu.api.interface import JobObject
+from kubedl_tpu.core.objects import BaseObject
+
+
+class ConcurrencyPolicy(str, enum.Enum):
+    ALLOW = "Allow"
+    FORBID = "Forbid"  # skip a run while one is active
+    REPLACE = "Replace"  # kill the active run, start fresh
+
+
+@dataclass
+class CronHistoryEntry:
+    """One launched run (reference: history ring, cron_controller.go:259-294)."""
+
+    object_name: str = ""
+    kind: str = ""
+    status: str = ""  # Created/Running/Succeeded/Failed
+    created: float = 0.0
+    finished: Optional[float] = None
+
+
+@dataclass
+class Cron(BaseObject):
+    KIND = "Cron"
+    #: standard 5-field cron expression (own parser, kubedl_tpu.cron.cronexpr)
+    schedule: str = ""
+    #: the workload to materialize each fire — any registered kind
+    #: (reference: RawExtension template, cron_types.go:40-44)
+    template: Optional[JobObject] = None
+    concurrency_policy: ConcurrencyPolicy = ConcurrencyPolicy.ALLOW
+    suspend: bool = False
+    #: skip a missed run older than this (reference: startingDeadlineSeconds)
+    starting_deadline_seconds: Optional[float] = None
+    history_limit: int = 10
+    # -- status --
+    active: List[str] = field(default_factory=list)  # live workload names
+    last_schedule_time: Optional[float] = None
+    history: List[CronHistoryEntry] = field(default_factory=list)

@@ -1,0 +1,5 @@
+"""Host-side data plane: token-file datasets with a native prefetch path."""
+
+from kubedl_tpu.data.native import NativeTokenLoader, TokenFileDataset, native_available
+
+__all__ = ["NativeTokenLoader", "TokenFileDataset", "native_available"]

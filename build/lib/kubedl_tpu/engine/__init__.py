@@ -1,0 +1,4 @@
+"""The shared job-controller engine (reference: pkg/job_controller/)."""
+
+from kubedl_tpu.engine.job_controller import JobEngine, job_key, replica_name  # noqa: F401
+from kubedl_tpu.engine.expectations import ControllerExpectations  # noqa: F401

@@ -1,0 +1,94 @@
+"""Create/delete expectation cache suppressing redundant reconciles.
+
+Reference: pkg/job_controller/expectations.go:28-47 + the borrowed
+k8s.io/kubernetes controller expectations pattern. A reconcile that issues N
+creates records `ExpectCreations(key, N)`; watch events observing those
+creations decrement it; reconciles are no-ops for a key until its
+expectations are satisfied (or expire), preventing double-creates when a
+reconcile re-enters before the cache catches up.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+EXPECTATION_TIMEOUT = 5 * 60.0
+
+
+@dataclass
+class _Exp:
+    adds: int = 0
+    dels: int = 0
+    timestamp: float = field(default_factory=time.time)
+
+    def fulfilled(self) -> bool:
+        return self.adds <= 0 and self.dels <= 0
+
+    def expired(self) -> bool:
+        return time.time() - self.timestamp > EXPECTATION_TIMEOUT
+
+
+def expectation_key(job_key: str, rtype: str, resource: str) -> str:
+    """`jobKey/replicatype/{pods,services}` (reference: GenExpectation*Key)."""
+    return f"{job_key}/{rtype.lower()}/{resource}"
+
+
+class ControllerExpectations:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._exps: dict[str, _Exp] = {}
+
+    def expect_creations(self, key: str, count: int) -> None:
+        with self._lock:
+            self._exps[key] = _Exp(adds=count)
+
+    def expect_deletions(self, key: str, count: int) -> None:
+        with self._lock:
+            self._exps[key] = _Exp(dels=count)
+
+    def creation_observed(self, key: str) -> None:
+        self._lower(key, adds=1)
+
+    def deletion_observed(self, key: str) -> None:
+        self._lower(key, dels=1)
+
+    def _lower(self, key: str, adds: int = 0, dels: int = 0) -> None:
+        with self._lock:
+            exp = self._exps.get(key)
+            if exp is not None:
+                exp.adds -= adds
+                exp.dels -= dels
+
+    def satisfied(self, key: str) -> bool:
+        with self._lock:
+            exp = self._exps.get(key)
+            if exp is None:
+                return True
+            if exp.fulfilled() or exp.expired():
+                return True
+            return False
+
+    def delete_expectations(self, key: str) -> None:
+        with self._lock:
+            self._exps.pop(key, None)
+
+    def delete_job_expectations(self, job_key: str) -> None:
+        """Drop every '<job_key>/<rtype>/<resource>' entry for a job."""
+        prefix = job_key + "/"
+        with self._lock:
+            for k in [k for k in self._exps if k.startswith(prefix)]:
+                del self._exps[k]
+
+    def all_satisfied(self, job_key: str) -> bool:
+        """All of one job's expectations fulfilled ('/'-bounded so job
+        'train' is not blocked by job 'train2')."""
+        prefix = job_key + "/"
+        with self._lock:
+            return all(
+                exp.fulfilled() or exp.expired()
+                for k, exp in self._exps.items()
+                if k.startswith(prefix)
+            )

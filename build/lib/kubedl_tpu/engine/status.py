@@ -1,0 +1,130 @@
+"""Default job status machine: replica counting + success/failure semantics.
+
+Reference analogues: UpdateJobStatus implementations (the canonical one is
+controllers/tensorflow/status.go:56-215) and the replica-status bookkeeping
+in pkg/job_controller/status.go. Success: master/chief completion by
+default, worker-0 for masterless kinds, or all workers under
+SuccessPolicy.ALL_WORKERS.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.api.interface import JobObject, WorkloadController
+from kubedl_tpu.api.types import (
+    JobConditionType,
+    ReplicaStatus,
+    ReplicaType,
+    RestartPolicy,
+    SuccessPolicy,
+    is_retryable_exit_code,
+)
+from kubedl_tpu.core.objects import Pod, PodPhase
+
+
+def count_replica_statuses(pods: List[Pod]) -> Dict[ReplicaType, ReplicaStatus]:
+    out: Dict[ReplicaType, ReplicaStatus] = {}
+    for pod in pods:
+        rt_label = pod.metadata.labels.get(constants.LABEL_REPLICA_TYPE, "")
+        try:
+            rtype = ReplicaType(rt_label)
+        except ValueError:
+            continue
+        rs = out.setdefault(rtype, ReplicaStatus())
+        if pod.status.phase == PodPhase.RUNNING:
+            rs.active += 1
+        elif pod.status.phase == PodPhase.SUCCEEDED:
+            rs.succeeded += 1
+        elif pod.status.phase == PodPhase.FAILED:
+            if pod.is_evicted():
+                rs.evicted += 1
+            rs.failed += 1
+    return out
+
+
+def pod_failure_is_permanent(pod: Pod, policy: RestartPolicy) -> bool:
+    """Would this failed pod NOT be restarted? (it then counts toward job
+    failure). Mirrors pod.go:305-317 + train_util exit-code classes."""
+    if policy == RestartPolicy.NEVER:
+        return True
+    if policy == RestartPolicy.EXIT_CODE:
+        code = pod.status.exit_code()
+        if pod.is_evicted():
+            return False  # evictions are always retryable
+        return code is not None and not is_retryable_exit_code(code)
+    # Always / OnFailure / OnFailureSlice restart any failure.
+    return False
+
+
+def _pods_of(pods: List[Pod], rtype: ReplicaType) -> List[Pod]:
+    return [
+        p
+        for p in pods
+        if p.metadata.labels.get(constants.LABEL_REPLICA_TYPE) == rtype.value
+    ]
+
+
+def _success_reached(
+    job: JobObject, controller: WorkloadController, pods: List[Pod]
+) -> bool:
+    specs = job.spec.replica_specs
+    if job.spec.success_policy == SuccessPolicy.ALL_WORKERS:
+        # ALL_WORKERS means all *worker* replicas (reference:
+        # SuccessPolicyAllWorkers, status.go) — PS/evaluator groups that
+        # never exit must not block success.
+        worker_types = [rt for rt in specs if rt == ReplicaType.WORKER] or list(specs)
+        for rtype in worker_types:
+            group = _pods_of(pods, rtype)
+            if len(group) < specs[rtype].replicas or any(
+                p.status.phase != PodPhase.SUCCEEDED for p in group
+            ):
+                return False
+        return bool(pods)
+    # DEFAULT policy: a master-role replica type finishing wins; otherwise
+    # worker-0 finishing wins (reference: status.go:56-215).
+    master_types = [rt for rt in specs if controller.is_master_role(rt)]
+    if master_types:
+        for rt in master_types:
+            group = _pods_of(pods, rt)
+            if group and all(p.status.phase == PodPhase.SUCCEEDED for p in group):
+                return True
+        return False
+    for pod in _pods_of(pods, ReplicaType.WORKER):
+        if (
+            pod.metadata.labels.get(constants.LABEL_REPLICA_INDEX) == "0"
+            and pod.status.phase == PodPhase.SUCCEEDED
+        ):
+            return True
+    return False
+
+
+def evaluate(
+    job: JobObject, controller: WorkloadController, pods: List[Pod]
+) -> Tuple[Optional[JobConditionType], str, str]:
+    """Compute the job-level phase implied by current pod states.
+
+    Returns (condition, reason, message); condition None = no transition.
+    Does NOT consider backoff/deadline — the engine layers those on top.
+    """
+    if _success_reached(job, controller, pods):
+        return JobConditionType.SUCCEEDED, "JobSucceeded", "success policy satisfied"
+
+    for rtype, spec in job.spec.replica_specs.items():
+        for pod in _pods_of(pods, rtype):
+            if pod.status.phase == PodPhase.FAILED and pod_failure_is_permanent(
+                pod, spec.restart_policy
+            ):
+                code = pod.status.exit_code()
+                return (
+                    JobConditionType.FAILED,
+                    "ReplicaFailed",
+                    f"{pod.metadata.name} failed permanently (exit={code})",
+                )
+
+    if pods and all(p.status.phase == PodPhase.RUNNING for p in pods):
+        total = sum(rs.replicas for rs in job.spec.replica_specs.values())
+        if len(pods) >= total:
+            return JobConditionType.RUNNING, "JobRunning", "all replicas running"
+    return None, "", ""

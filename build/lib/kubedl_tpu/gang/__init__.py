@@ -1,0 +1,12 @@
+"""Gang scheduling: atomic TPU-slice acquisition.
+
+On TPU, gang scheduling is a hard dependency, not a pluggable option the way
+the reference treats kube-batch/coscheduler (pkg/gang_schedule/): a
+partially-placed ICI job wedges the whole slice. The scheduler admits a job
+only when its full slice demand is free, then binds replicas to hosts
+deterministically so mesh coordinates are stable across restarts.
+"""
+
+from kubedl_tpu.gang.interface import GangScheduler  # noqa: F401
+from kubedl_tpu.gang.slice_scheduler import SliceGangScheduler, SliceInventory  # noqa: F401
+from kubedl_tpu.gang.registry import GANG_REGISTRY, get_gang_scheduler, register_gang_scheduler  # noqa: F401

@@ -1,0 +1,52 @@
+"""Gang scheduler contract.
+
+Reference: `GangScheduler` interface {CreateGang, BindPodToGang, GetGang,
+DeleteGang, Name} (pkg/gang_schedule/interface.go:30-49). The TPU contract
+adds explicit admission (`try_admit`) — the reference delegates admission to
+an external kube-batch scheduler; here the slice inventory is ours — and
+deterministic host binding so TPU mesh coordinates survive restarts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kubedl_tpu.api.interface import JobObject
+from kubedl_tpu.core.objects import Pod, PodGroup
+
+
+class GangScheduler:
+    NAME = "gang"
+
+    def create_gang(self, job: JobObject) -> PodGroup:
+        """Ensure the job's PodGroup exists (min_member = ALL replicas;
+        reference sets MinMember=totalReplicas, batch_scheduler/
+        scheduler.go:58-89)."""
+        raise NotImplementedError
+
+    def get_gang(self, job: JobObject) -> Optional[PodGroup]:
+        raise NotImplementedError
+
+    def try_admit(self, gang: PodGroup) -> bool:
+        """Attempt atomic placement; True once the full slice demand is
+        reserved. Idempotent."""
+        raise NotImplementedError
+
+    def bind_pod_to_gang(
+        self, job: JobObject, gang: PodGroup, pod: Pod, replica_index: int
+    ) -> None:
+        """Assign the pod a node within the gang's reserved slices
+        (reference: BindPodToGang sets pod.schedulerName + PodGroup
+        annotation, pod.go:376-384)."""
+        raise NotImplementedError
+
+    def delete_gang(self, job: JobObject) -> None:
+        """Release slices + remove the PodGroup."""
+        raise NotImplementedError
+
+    def slice_demand(self, job: JobObject):
+        """(slice_type, num_slices) the job's CURRENT spec demands — the
+        engine compares this against the reserved gang to detect elastic
+        resize (grow/shrink => coordinated restart-from-checkpoint).
+        None = this scheduler doesn't support resize detection."""
+        return None

@@ -1,0 +1,33 @@
+"""Gang scheduler registry (reference: pkg/gang_schedule/registry/
+registry.go:32-53 + `--gang-scheduler-name` selection in main.go:61)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from kubedl_tpu.gang.interface import GangScheduler
+
+GANG_REGISTRY: Dict[str, Callable[..., GangScheduler]] = {}
+
+
+def register_gang_scheduler(name: str, factory: Callable[..., GangScheduler]) -> None:
+    GANG_REGISTRY[name] = factory
+
+
+def get_gang_scheduler(name: str, **kwargs) -> GangScheduler:
+    try:
+        factory = GANG_REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown gang scheduler {name!r}; registered: {sorted(GANG_REGISTRY)}"
+        ) from None
+    return factory(**kwargs)
+
+
+def _register_builtin() -> None:
+    from kubedl_tpu.gang.slice_scheduler import SliceGangScheduler
+
+    register_gang_scheduler("slice", SliceGangScheduler)
+
+
+_register_builtin()

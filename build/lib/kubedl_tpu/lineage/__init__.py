@@ -1,0 +1,11 @@
+"""Model lineage: Model / ModelVersion tracking + artifact image building.
+
+Reference: apis/model/v1alpha1 + controllers/model — each successful training
+job can publish a ModelVersion; a builder turns the artifact into a
+deployable image (reference uses kaniko pods; here a local bundle builder
+packages checkpoint dirs into a content-addressed artifact registry).
+"""
+
+from kubedl_tpu.lineage.types import Model, ModelVersion, ModelVersionPhase  # noqa: F401
+from kubedl_tpu.lineage.controller import ModelVersionController  # noqa: F401
+from kubedl_tpu.lineage.builder import ArtifactRegistry, LocalBundleBuilder  # noqa: F401

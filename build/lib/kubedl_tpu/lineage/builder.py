@@ -1,0 +1,98 @@
+"""Artifact builders: turn a model-output directory into a deployable image.
+
+Reference: the kaniko builder pod flow (controllers/model/
+modelversion_controller.go:371-454 — dockerfile ConfigMap + kaniko pod
+pushing `repo:v<uid5>`). TPU-native stand-in: a content-addressed local
+artifact registry; `LocalBundleBuilder` packages the checkpoint dir plus a
+manifest into `<registry>/<repo>/<tag>/`. The serving controller mounts
+these bundles directly — no container pull needed for in-process JAX
+predictors.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+from pathlib import Path
+from typing import Optional
+
+
+class ArtifactRegistry:
+    """Filesystem-backed image registry: `<root>/<repo>/<tag>/`."""
+
+    def __init__(self, root: str) -> None:
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def path(self, repo: str, tag: str) -> Path:
+        return self.root / repo / tag
+
+    def exists(self, repo: str, tag: str) -> bool:
+        return (self.path(repo, tag) / "manifest.json").exists()
+
+    def manifest(self, repo: str, tag: str) -> Optional[dict]:
+        p = self.path(repo, tag) / "manifest.json"
+        if not p.exists():
+            return None
+        return json.loads(p.read_text())
+
+    def tags(self, repo: str) -> list:
+        d = self.root / repo
+        if not d.is_dir():
+            return []
+        return sorted(p.name for p in d.iterdir() if (p / "manifest.json").exists())
+
+
+class BuildError(Exception):
+    pass
+
+
+class LocalBundleBuilder:
+    """Copy the artifact tree into the registry and write a manifest with a
+    content digest — the kaniko-pod analogue, synchronous and local."""
+
+    def __init__(self, registry: ArtifactRegistry) -> None:
+        self.registry = registry
+
+    def build(self, source_dir: str, repo: str, tag: str) -> dict:
+        src = Path(source_dir)
+        if not src.is_dir():
+            raise BuildError(f"model output dir {source_dir!r} does not exist")
+        dest = self.registry.path(repo, tag)
+        # a registry nested inside the model dir would make copytree copy
+        # the tree into its own subtree — unbounded recursion, found by a
+        # drive whose storage_root contained artifact_registry_root
+        if dest.resolve().is_relative_to(src.resolve()):
+            raise BuildError(
+                f"artifact registry {dest} lies inside model dir {src}; "
+                "use a registry root outside the model storage root"
+            )
+        payload = dest / "model"
+        if payload.exists():
+            shutil.rmtree(payload)
+        dest.mkdir(parents=True, exist_ok=True)
+        shutil.copytree(src, payload)
+        digest = self._digest(payload)
+        manifest = {
+            "repo": repo,
+            "tag": tag,
+            "digest": f"sha256:{digest}",
+            "built_at": time.time(),
+            "files": sum(len(fs) for _, _, fs in os.walk(payload)),
+        }
+        (dest / "manifest.json").write_text(json.dumps(manifest, indent=2))
+        return manifest
+
+    @staticmethod
+    def _digest(root: Path) -> str:
+        h = hashlib.sha256()
+        for p in sorted(root.rglob("*")):
+            if p.is_file():
+                h.update(p.relative_to(root).as_posix().encode())
+                with open(p, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+        return h.hexdigest()

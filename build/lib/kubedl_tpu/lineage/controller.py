@@ -1,0 +1,130 @@
+"""ModelVersion controller.
+
+Reference: controllers/model/modelversion_controller.go — on MV creation:
+ensure the parent Model exists (:86-114), provision storage (:239-325),
+launch the image build (:371-454), track phase ImageBuilding ->
+Succeeded/Failed and tag `repo:v<uid5>` (:137-220), and update the Model's
+LatestVersion.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from kubedl_tpu.core.manager import ControllerManager, EventRecorder, owner_mapper
+from kubedl_tpu.core.store import AlreadyExists, NotFound, ObjectStore
+from kubedl_tpu.lineage.builder import ArtifactRegistry, BuildError, LocalBundleBuilder
+from kubedl_tpu.lineage.types import Model, ModelVersion, ModelVersionPhase
+
+log = logging.getLogger("kubedl_tpu.lineage")
+
+
+class ModelVersionController:
+    NAME = "modelversion-controller"
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        registry: ArtifactRegistry,
+        recorder: Optional[EventRecorder] = None,
+        local_node: str = "",
+    ) -> None:
+        self.store = store
+        self.registry = registry
+        self.builder = LocalBundleBuilder(registry)
+        self.recorder = recorder or EventRecorder(store)
+        #: node this builder runs on — node-local artifacts must match
+        #: (the kaniko-pod-on-the-artifact-node analogue)
+        self.local_node = local_node
+
+    def setup(self, manager: ControllerManager) -> None:
+        manager.register(
+            self.NAME,
+            self.reconcile,
+            watch_kinds=["ModelVersion"],
+            mapper=owner_mapper("ModelVersion"),
+        )
+
+    # ------------------------------------------------------------------
+
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        mv = self.store.try_get("ModelVersion", name, namespace)
+        if mv is None:
+            return None
+        assert isinstance(mv, ModelVersion)
+        if mv.phase in (ModelVersionPhase.SUCCEEDED, ModelVersionPhase.FAILED):
+            return None
+
+        self._ensure_model(mv)
+
+        repo = mv.image_repo or f"models/{mv.model_name}"
+        tag = mv.image_tag()
+        self._set_phase(mv, ModelVersionPhase.IMAGE_BUILDING, "")
+        try:
+            from kubedl_tpu.lineage.storage import StorageError, get_storage_provider
+
+            src = get_storage_provider(mv.storage_provider).artifact_dir(
+                mv, local_node=self.local_node
+            )
+            manifest = self.builder.build(src, repo, tag)
+        except (BuildError, StorageError) as e:
+            self._set_phase(mv, ModelVersionPhase.FAILED, str(e))
+            self.recorder.event(mv, "Warning", "BuildFailed", str(e))
+            return None
+        image = f"{repo}:{tag}"
+        mv.image = image
+        self._set_phase(mv, ModelVersionPhase.SUCCEEDED, manifest["digest"])
+        self.recorder.event(mv, "Normal", "BuildSucceeded", f"built {image}")
+        self._bump_model(mv)
+        return None
+
+    # ------------------------------------------------------------------
+
+    def _ensure_model(self, mv: ModelVersion) -> None:
+        model = self.store.try_get("Model", mv.model_name, mv.metadata.namespace)
+        if model is None:
+            m = Model(description=f"auto-created for {mv.metadata.name}")
+            m.metadata.name = mv.model_name
+            m.metadata.namespace = mv.metadata.namespace
+            try:
+                self.store.create(m)
+            except AlreadyExists:
+                pass
+
+    def _bump_model(self, mv: ModelVersion) -> None:
+        def mutate(obj: Model) -> None:  # type: ignore[type-arg]
+            obj.latest_version = mv.metadata.name
+            if mv.metadata.name not in obj.versions:
+                obj.versions.append(mv.metadata.name)
+
+        try:
+            self.store.update_with_retry(
+                "Model", mv.model_name, mv.metadata.namespace, mutate
+            )
+        except NotFound:
+            pass
+
+    def _set_phase(self, mv: ModelVersion, phase: ModelVersionPhase, msg: str) -> None:
+        def mutate(obj: ModelVersion) -> None:  # type: ignore[type-arg]
+            obj.phase = phase
+            obj.message = msg
+            obj.image = mv.image
+
+        try:
+            updated = self.store.update_with_retry(
+                "ModelVersion", mv.metadata.name, mv.metadata.namespace, mutate
+            )
+            mv.metadata.resource_version = updated.metadata.resource_version
+            mv.phase = phase
+        except NotFound:
+            pass
+
+    # -- queries used by serving/console --------------------------------
+
+    def versions_of(self, model_name: str, namespace: str = "default") -> List[ModelVersion]:
+        return [
+            mv
+            for mv in self.store.list("ModelVersion", namespace)  # type: ignore[misc]
+            if getattr(mv, "model_name", "") == model_name
+        ]

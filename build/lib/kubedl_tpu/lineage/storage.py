@@ -1,0 +1,165 @@
+"""Model-output storage providers: the union behind ModelVersion storage.
+
+Reference: `controllers/model/storage/storage_provider.go:1-35` dispatches
+NFS / LocalStorage / AWSEfs providers (modelversion_types.go:72-115), each
+knowing how to (a) provision the PV/PVC for a ModelVersion and (b) mount
+the output dir into training pods (AddModelVolumeToPodSpec,
+pkg/job_controller/job.go:312-339).
+
+TPU-native equivalents over the self-hosted substrate:
+
+- **shared** (NFS/EFS-style): one root every node sees — the only layout
+  that works for multi-host slice jobs, where every host writes its own
+  checkpoint shards (`kubedl_tpu.training.checkpoint`) into the same tree.
+  "nfs" and "efs" are registered aliases so specs written against the
+  reference's union port over directly.
+- **local**: node-pinned output (LocalStorage path+nodeName). The artifact
+  only exists on the node that trained; the MV records `node_name`
+  (pinned to the master/worker-0 node via GetNodeForModelOutput) and the
+  builder validates it runs co-located before reading the path.
+
+Providers are a registry (reference: GetStorageProvider) so a cloud bucket
+provider can be plugged in without touching the engine or the builder.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, Optional
+
+from kubedl_tpu.core.objects import Volume
+
+
+class StorageError(Exception):
+    pass
+
+
+class StorageProvider:
+    """One storage flavor: how jobs write and builders read an artifact."""
+
+    NAME = ""
+    #: whether the artifact is visible from any node (shared filesystem)
+    SHARED = True
+
+    def provision(self, root: str) -> str:
+        """Make the output root exist (the PV/PVC-provisioning analogue,
+        modelversion_controller.go:239-325). Returns the resolved root."""
+        Path(root).mkdir(parents=True, exist_ok=True)
+        return root
+
+    def add_model_volume(self, pod, root: str) -> None:
+        """Mount the output dir into a training pod
+        (AddModelVolumeToPodSpec, job.go:312-339)."""
+        pod.spec.volumes.append(
+            Volume(name="kubedl-model", host_path=root, mount_path=root)
+        )
+
+    def artifact_dir(self, mv, local_node: str = "") -> str:
+        """Where the builder reads this ModelVersion's artifact. Raises
+        StorageError when the artifact isn't reachable from here."""
+        return mv.storage_root
+
+
+class SharedDirProvider(StorageProvider):
+    NAME = "shared"
+    SHARED = True
+
+
+class NodeLocalProvider(StorageProvider):
+    NAME = "local"
+    SHARED = False
+
+    def artifact_dir(self, mv, local_node: str = "") -> str:
+        if mv.node_name and local_node and mv.node_name != local_node:
+            raise StorageError(
+                f"node-local artifact lives on {mv.node_name!r}, "
+                f"builder is on {local_node!r} — use a 'shared' storage "
+                "provider for multi-host jobs"
+            )
+        return mv.storage_root
+
+
+class RemoteBlobProvider(StorageProvider):
+    """Network-remote artifact storage over the blob server
+    (`kubedl_tpu.remote`) — the AWS-EFS/object-store analogue
+    (aws_efs_provider.go), and the first provider whose artifacts cross
+    a real network boundary.
+
+    ``storage_root`` is a SELF-DESCRIBING URL: ``http://host:port/blobs/
+    <prefix>``. Training pods write into a local staging dir (returned by
+    :meth:`provision` — the engine mounts and exports THAT as
+    KUBEDL_MODEL_PATH); the builder's :meth:`artifact_dir` uploads fresh
+    local staging to the remote prefix and otherwise downloads the prefix
+    into a local cache — so the blob server is the source of truth and
+    build/serve work from any host."""
+
+    NAME = "http"
+    SHARED = True
+
+    def __init__(self, staging_root: str = "") -> None:
+        import os
+        import tempfile
+
+        self.staging_root = staging_root or os.path.join(
+            tempfile.gettempdir(), f"kubedl-remote-staging-{os.getuid()}"
+        )
+
+    def _staging_dir(self, remote_root: str) -> Path:
+        import hashlib
+
+        digest = hashlib.sha256(remote_root.encode()).hexdigest()[:16]
+        return Path(self.staging_root) / digest
+
+    def provision(self, root: str) -> str:
+        from kubedl_tpu.remote.client import is_remote_root
+
+        if not is_remote_root(root):
+            raise StorageError(
+                f"http storage_root must be http(s)://…/blobs/<prefix>, got {root!r}"
+            )
+        d = self._staging_dir(root)
+        d.mkdir(parents=True, exist_ok=True)
+        return str(d)
+
+    def add_model_volume(self, pod, root: str) -> None:
+        # root here is the resolved local staging dir
+        super().add_model_volume(pod, root)
+
+    def artifact_dir(self, mv, local_node: str = "") -> str:
+        from kubedl_tpu.remote.client import download_tree, upload_tree
+
+        remote_root = mv.storage_root
+        staging = self._staging_dir(remote_root)
+        if staging.is_dir() and any(staging.rglob("*")):
+            # fresh local training output: publish it, then build from it
+            upload_tree(str(staging), remote_root)
+            return str(staging)
+        cache = Path(self.staging_root) / "fetch" / staging.name
+        cache.mkdir(parents=True, exist_ok=True)
+        n = download_tree(remote_root, str(cache))
+        if n == 0:
+            raise StorageError(f"no artifact blobs under {remote_root}")
+        return str(cache)
+
+
+_PROVIDERS: Dict[str, StorageProvider] = {}
+
+
+def register_storage_provider(provider: StorageProvider, *aliases: str) -> None:
+    for name in (provider.NAME, *aliases):
+        _PROVIDERS[name] = provider
+
+
+def get_storage_provider(name: str) -> StorageProvider:
+    """Reference: GetStorageProvider (storage_provider.go:1-35)."""
+    try:
+        return _PROVIDERS[name or "shared"]
+    except KeyError:
+        raise StorageError(
+            f"unknown storage provider {name!r}; known: {sorted(_PROVIDERS)}"
+        ) from None
+
+
+register_storage_provider(SharedDirProvider(), "nfs", "efs")
+register_storage_provider(NodeLocalProvider())
+register_storage_provider(RemoteBlobProvider())

@@ -1,0 +1,52 @@
+"""Model / ModelVersion objects.
+
+Reference: apis/model/v1alpha1/{model,modelversion}_types.go — Model is the
+logical lineage head (Status.LatestVersion, model_types.go:27-38);
+ModelVersion is one artifact: a storage ref plus a target image repo, built
+into an image tagged `repo:v<uid5>` (modelversion_controller.go:137-220).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from kubedl_tpu.core.objects import BaseObject
+
+
+class ModelVersionPhase(str, enum.Enum):
+    PENDING = "Pending"
+    IMAGE_BUILDING = "ImageBuilding"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclass
+class Model(BaseObject):
+    KIND = "Model"
+    description: str = ""
+    latest_version: str = ""  # Status.LatestVersion analogue
+    versions: list = field(default_factory=list)
+
+
+@dataclass
+class ModelVersion(BaseObject):
+    KIND = "ModelVersion"
+    model_name: str = ""
+    image_repo: str = ""
+    #: Filesystem root holding the trained artifact (checkpoint dir). The
+    #: reference's Storage union (NFS/LocalStorage/AWSEfs,
+    #: modelversion_types.go:72-115) maps to a storage provider name + root.
+    storage_root: str = ""
+    storage_provider: str = "shared"
+    #: Node that produced the artifact (LocalStorage nodeName pinning,
+    #: job.go:341-382).
+    node_name: str = ""
+    created_by: str = ""  # "<Kind>/<job-name>"
+    # -- status --
+    phase: ModelVersionPhase = ModelVersionPhase.PENDING
+    image: str = ""  # final image ref "repo:v<uid5>"
+    message: str = ""
+
+    def image_tag(self) -> str:
+        return f"v{self.metadata.uid[-5:]}"

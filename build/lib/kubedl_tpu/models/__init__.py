@@ -1,0 +1,16 @@
+"""Model zoo: TPU-first reference workloads for the framework.
+
+The reference operator only *launches* user models; its example zoo
+(example/tf mnist, example/pytorch resnet, BASELINE.md configs) defines what
+must run here. TPU-native equivalents:
+
+- :mod:`kubedl_tpu.models.llama` — the flagship: Llama-3-family decoder
+  (GQA + RoPE + SwiGLU, scanned layers, full sharding rules) for the
+  "Llama-3-8B on v5e-32" north-star config.
+- :mod:`kubedl_tpu.models.mlp` — MNIST-class MLP (the reference's kind-CPU
+  e2e mnist analogue).
+- :mod:`kubedl_tpu.models.resnet` — ResNet-50 analogue for the PyTorchJob
+  ResNet config.
+"""
+
+from kubedl_tpu.models.llama import LlamaConfig, llama_forward, llama_init  # noqa: F401

@@ -1,0 +1,3 @@
+"""Metrics, events and structured tracing for the control plane."""
+
+from kubedl_tpu.observability.metrics import JobMetrics, MetricsRegistry  # noqa: F401

@@ -1,0 +1,241 @@
+"""Prometheus-style metrics, dependency-free.
+
+Reference: pkg/metrics/job_metrics.go:32-194 + status_counter.go:22-81 —
+counters kubedl_jobs_{created,deleted,successful,failed,restarted}{kind},
+live running/pending gauges, and first/all-pods launch-delay histograms;
+exposed on :8443/metrics (monitor.go:27-36). Same metric family names here
+(prefix `kubedl_tpu_`), exported in Prometheus text format by
+:meth:`MetricsRegistry.render` (served by the console API).
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Callable, Dict, List, Optional, Tuple
+
+LabelKV = Tuple[Tuple[str, str], ...]
+
+
+def _labels(labels: Dict[str, str]) -> LabelKV:
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_labels(kv: LabelKV) -> str:
+    if not kv:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in kv) + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str) -> None:
+        self.name, self.help = name, help_
+        self._lock = threading.Lock()
+        self._values: Dict[LabelKV, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        kv = _labels(labels)
+        with self._lock:
+            self._values[kv] = self._values.get(kv, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(_labels(labels), 0.0)
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [
+                {"labels": dict(kv), "value": v}
+                for kv, v in sorted(self._values.items())
+            ]
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            for kv, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(kv)} {v}")
+        return out
+
+
+class Gauge:
+    """A gauge whose value may be a live callback (the reference's
+    running/pending gauges list-and-count on scrape, status_counter.go)."""
+
+    def __init__(self, name: str, help_: str) -> None:
+        self.name, self.help = name, help_
+        self._lock = threading.Lock()
+        self._values: Dict[LabelKV, float] = {}
+        self._callbacks: Dict[LabelKV, Callable[[], float]] = {}
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[_labels(labels)] = value
+
+    def set_function(self, fn: Callable[[], float], **labels: str) -> None:
+        with self._lock:
+            self._callbacks[_labels(labels)] = fn
+
+    def value(self, **labels: str) -> float:
+        kv = _labels(labels)
+        with self._lock:
+            if kv in self._callbacks:
+                return self._callbacks[kv]()
+            return self._values.get(kv, 0.0)
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            items = dict(self._values)
+            callbacks = dict(self._callbacks)
+        for kv, fn in callbacks.items():
+            try:
+                items[kv] = fn()
+            except Exception:
+                continue
+        return [
+            {"labels": dict(kv), "value": v} for kv, v in sorted(items.items())
+        ]
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            items = dict(self._values)
+            for kv, fn in self._callbacks.items():
+                try:
+                    items[kv] = fn()
+                except Exception:
+                    continue
+        for kv, v in sorted(items.items()):
+            out.append(f"{self.name}{_fmt_labels(kv)} {v}")
+        return out
+
+
+_DEFAULT_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
+
+
+class Histogram:
+    def __init__(
+        self, name: str, help_: str, buckets: Tuple[float, ...] = _DEFAULT_BUCKETS
+    ) -> None:
+        self.name, self.help = name, help_
+        self.buckets = tuple(sorted(buckets))
+        self._lock = threading.Lock()
+        self._counts: Dict[LabelKV, List[int]] = {}
+        self._sum: Dict[LabelKV, float] = {}
+        self._total: Dict[LabelKV, int] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        kv = _labels(labels)
+        with self._lock:
+            counts = self._counts.setdefault(kv, [0] * len(self.buckets))
+            i = bisect_left(self.buckets, value)  # first bucket with value <= le
+            if i < len(self.buckets):
+                counts[i] += 1
+            self._sum[kv] = self._sum.get(kv, 0.0) + value
+            self._total[kv] = self._total.get(kv, 0) + 1
+
+    def summary(self, **labels: str) -> Tuple[int, float]:
+        kv = _labels(labels)
+        with self._lock:
+            return self._total.get(kv, 0), self._sum.get(kv, 0.0)
+
+    def snapshot(self) -> List[dict]:
+        """Structured view for dashboards: per label-set bucket counts
+        (non-cumulative), sum and total."""
+        with self._lock:
+            return [
+                {
+                    "labels": dict(kv),
+                    "buckets": list(self.buckets),
+                    "counts": list(counts),
+                    "sum": self._sum.get(kv, 0.0),
+                    "total": self._total.get(kv, 0),
+                }
+                for kv, counts in sorted(self._counts.items())
+            ]
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for kv, counts in sorted(self._counts.items()):
+                cum = 0
+                for b, c in zip(self.buckets, counts):
+                    cum += c
+                    lbl = dict(kv)
+                    lbl["le"] = repr(b)
+                    out.append(f"{self.name}_bucket{_fmt_labels(_labels(lbl))} {cum}")
+                lbl = dict(kv)
+                lbl["le"] = "+Inf"
+                out.append(
+                    f"{self.name}_bucket{_fmt_labels(_labels(lbl))} {self._total[kv]}"
+                )
+                out.append(f"{self.name}_sum{_fmt_labels(kv)} {self._sum[kv]}")
+                out.append(f"{self.name}_count{_fmt_labels(kv)} {self._total[kv]}")
+        return out
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: List[object] = []
+
+    def counter(self, name: str, help_: str) -> Counter:
+        m = Counter(name, help_)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def gauge(self, name: str, help_: str) -> Gauge:
+        m = Gauge(name, help_)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def histogram(self, name: str, help_: str, **kw) -> Histogram:
+        m = Histogram(name, help_, **kw)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def render(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.extend(m.render())  # type: ignore[attr-defined]
+        return "\n".join(lines) + "\n"
+
+
+class JobMetrics:
+    """The job-controller metric family (reference:
+    pkg/metrics/job_metrics.go:64-117)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry or MetricsRegistry()
+        r = self.registry
+        self.created = r.counter("kubedl_tpu_jobs_created", "Jobs created")
+        self.deleted = r.counter("kubedl_tpu_jobs_deleted", "Jobs deleted")
+        self.successful = r.counter("kubedl_tpu_jobs_successful", "Jobs succeeded")
+        self.failed = r.counter("kubedl_tpu_jobs_failed", "Jobs failed")
+        self.restarted = r.counter("kubedl_tpu_jobs_restarted", "Job gang restarts")
+        self.running = r.gauge("kubedl_tpu_jobs_running", "Jobs currently running")
+        self.pending = r.gauge("kubedl_tpu_jobs_pending", "Jobs currently pending")
+        self.first_pod_launch_delay = r.histogram(
+            "kubedl_tpu_jobs_first_pod_launch_delay_seconds",
+            "Job created -> first pod running",
+        )
+        self.all_pods_launch_delay = r.histogram(
+            "kubedl_tpu_jobs_all_pods_launch_delay_seconds",
+            "Job created -> all pods running",
+        )
+        # TPU north-star additions (BASELINE.md):
+        self.first_step_delay = r.histogram(
+            "kubedl_tpu_jobs_first_step_delay_seconds",
+            "Job created -> first training step reported",
+        )
+        self.tokens_per_sec_per_chip = r.gauge(
+            "kubedl_tpu_tokens_per_sec_per_chip", "Training throughput per chip"
+        )
+
+
+#: Process-wide default, mirroring the reference's promauto default registry.
+DEFAULT_JOB_METRICS = JobMetrics()

@@ -1,0 +1,150 @@
+"""Structured tracing around control-plane phases + XLA profiler hook.
+
+The reference has NO tracing (SURVEY.md §5: observability is logs + metrics
+only, three log stacks coexisting). The TPU build adds what the survey
+prescribes: structured spans around reconcile phases, exportable as Chrome
+trace-event JSON (load in chrome://tracing or Perfetto alongside an xprof
+capture), and an annotation-driven `jax.profiler` hook so device traces land
+next to the TensorBoard logdir (see observability.tensorboard `profile`).
+
+Zero-dependency by design: a lock-guarded ring buffer, thread-aware, cheap
+enough to leave on in production (a span is one time.perf_counter call and
+one deque append on exit).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+
+@dataclass
+class Span:
+    name: str
+    start: float  # perf_counter seconds
+    duration: float
+    thread: str
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+
+class Tracer:
+    """Ring-buffered span recorder.
+
+    Usage::
+
+        with TRACER.span("reconcile", kind="TPUJob", job="ns/name"):
+            ...
+    """
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=capacity)
+        self.enabled = True
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[Dict[str, Any]]:
+        if not self.enabled:
+            yield attrs
+            return
+        t0 = time.perf_counter()
+        try:
+            yield attrs  # callers may add attrs mid-span
+        finally:
+            dur = time.perf_counter() - t0
+            with self._lock:
+                self._spans.append(
+                    Span(
+                        name=name,
+                        start=t0,
+                        duration=dur,
+                        thread=threading.current_thread().name,
+                        attrs=dict(attrs),
+                    )
+                )
+
+    def spans(self, name: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            out = list(self._spans)
+        if name is not None:
+            out = [s for s in out if s.name == name]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    # ---- aggregation ------------------------------------------------------
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-span-name {count, total_s, max_s} — the quick 'where does
+        reconcile time go' answer without exporting anything."""
+        agg: Dict[str, Dict[str, float]] = {}
+        for s in self.spans():
+            a = agg.setdefault(s.name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            a["count"] += 1
+            a["total_s"] += s.duration
+            a["max_s"] = max(a["max_s"], s.duration)
+        return agg
+
+    # ---- export -----------------------------------------------------------
+
+    def chrome_trace(self) -> str:
+        """Chrome trace-event JSON ('X' complete events, µs timebase)."""
+        tids: Dict[str, int] = {}
+        events = []
+        for s in self.spans():
+            tid = tids.setdefault(s.thread, len(tids) + 1)
+            events.append(
+                {
+                    "name": s.name,
+                    "ph": "X",
+                    "ts": s.start * 1e6,
+                    "dur": s.duration * 1e6,
+                    "pid": 1,
+                    "tid": tid,
+                    "args": s.attrs,
+                }
+            )
+        return json.dumps({"traceEvents": events})
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.chrome_trace())
+
+
+#: process-wide default tracer (the engine and manager use this)
+TRACER = Tracer()
+
+
+# ---------------------------------------------------------------------------
+# Device-side: xprof capture around training steps.
+
+
+@contextlib.contextmanager
+def xprof_trace(logdir: str, enabled: bool = True) -> Iterator[None]:
+    """Wrap a training region in a `jax.profiler` trace whose output lands
+    under ``logdir`` — the same directory the TensorBoard sidecar serves
+    when its config says `profile: true`. No-op when disabled or when the
+    profiler is unavailable (e.g. double-start)."""
+    if not enabled:
+        yield
+        return
+    try:
+        import jax
+
+        jax.profiler.start_trace(logdir)
+    except Exception:
+        yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass

@@ -1,0 +1,341 @@
+"""Operator: single-binary assembly of the whole control plane.
+
+Reference: main.go:54-118 — flags -> manager (leader election) -> scheme ->
+gang registry -> workload-gated controller setup -> storage backends ->
+persist controllers -> metrics endpoint -> start. Same shape here, minus
+the parts the self-hosted substrate makes moot (scheme registration,
+leader election across replicas).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubedl_tpu.api.interface import JobObject, WorkloadController
+from kubedl_tpu.core.manager import ControllerManager, owner_mapper
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.engine.job_controller import JobEngine
+from kubedl_tpu.gang.slice_scheduler import SliceGangScheduler, SliceInventory
+from kubedl_tpu.lineage.builder import ArtifactRegistry
+from kubedl_tpu.lineage.controller import ModelVersionController
+from kubedl_tpu.observability.metrics import JobMetrics, MetricsRegistry
+from kubedl_tpu.runtime.executor import ContainerRuntime, Kubelet, SubprocessRuntime
+from kubedl_tpu.utils.features import FeatureGates
+from kubedl_tpu.workloads.registry import WORKLOAD_REGISTRY, parse_workload_gate
+
+log = logging.getLogger("kubedl_tpu.operator")
+
+
+@dataclass
+class OperatorOptions:
+    """Startup flags (reference: cmd/options/options.go:24-49 +
+    docs/startup_flags.md)."""
+
+    workloads: str = "*"
+    max_concurrent_reconciles: int = 2
+    feature_gates: str = ""
+    cluster_domain: str = ""
+    artifact_registry_root: str = "/tmp/kubedl-tpu-registry"
+    pod_log_dir: str = ""
+    #: emit loopback addresses instead of svc DNS (local process runtime)
+    local_addresses: bool = False
+    #: workload-controller construction kwargs per kind
+    controller_kwargs: Dict[str, dict] = field(default_factory=dict)
+    #: durable metadata mirror (reference: --meta-storage flag,
+    #: persist_controller.go:30-34). "" disables; "sqlite" enables.
+    meta_storage: str = ""
+    #: durable event sink (reference: --event-storage flag)
+    event_storage: str = ""
+    #: SQLite database path for the built-in backend (":memory:" or a file)
+    storage_db_path: str = ":memory:"
+    #: region stamped on mirrored rows (reference: REGION env)
+    region: str = ""
+    #: node identity of this operator/builder process — node-local
+    #: ModelVersion artifacts (storage_provider="local") must be built
+    #: co-located with their node_name; "" disables the guard (single-host)
+    node_name: str = ""
+    #: QPS probe for serving autoscale: callable(pod) -> float | None
+    #: (e.g. kubedl_tpu.serving.controller.http_qps_probe). None disables
+    #: load-driven scaling (autoscale min/max clamping still applies).
+    serving_qps_probe: Optional[object] = None
+    #: persistent XLA compilation-cache dir injected into every training/
+    #: serving pod (KUBEDL_COMPILE_CACHE_DIR) so gang restarts, resizes,
+    #: and resumes deserialize compiled programs instead of re-lowering
+    #: them (round-2 startup regression, VERDICT.md). Default is per-user
+    #: (a fixed world-writable path would let another user poison the
+    #: serialized executables). "" disables.
+    compile_cache_dir: str = field(default_factory=lambda: os.path.join(
+        tempfile.gettempdir(), f"kubedl-tpu-compile-cache-{os.getuid()}"
+    ))
+    #: lease-based leader election (reference: main.go:76-84
+    #: "kubedl-election"): with True, this operator campaigns for the
+    #: lease in its store and reconciles ONLY while holding it; losing
+    #: the lease stops the operator (crash-only — restart to re-campaign)
+    leader_elect: bool = False
+    #: candidate identity; defaults to hostname-pid
+    leader_identity: str = ""
+    leader_lease_ttl: float = 5.0
+    #: base URL of a remote store (kubedl_tpu.remote.RemoteStoreServer);
+    #: enables meta_storage/event_storage="http" (network persist mirror)
+    remote_storage_url: str = ""
+
+
+class ValidationError(ValueError):
+    """Admission rejection (reference: validating webhook deny)."""
+
+    def __init__(self, kind: str, errors: List[str]) -> None:
+        super().__init__(f"{kind} rejected: " + "; ".join(errors))
+        self.errors = errors
+
+
+class Operator:
+    def __init__(
+        self,
+        options: Optional[OperatorOptions] = None,
+        runtime: Optional[ContainerRuntime] = None,
+        inventory: Optional[SliceInventory] = None,
+        store: Optional[ObjectStore] = None,
+    ) -> None:
+        self.options = options or OperatorOptions()
+        #: pass an existing store to run several operators against one
+        #: object world (HA deployments — pair with leader_elect=True)
+        self.store = store or ObjectStore()
+        self.manager = ControllerManager(self.store)
+        self.metrics_registry = MetricsRegistry()
+        self.metrics = JobMetrics(self.metrics_registry)
+        self.features = FeatureGates()
+        if self.options.feature_gates:
+            self.features.set_from_string(self.options.feature_gates)
+        self.inventory = inventory or SliceInventory()
+        self.gang = SliceGangScheduler(self.store, self.inventory)
+        self.engines: Dict[str, JobEngine] = {}
+        self.controllers: Dict[str, WorkloadController] = {}
+
+        # workload-gated controller setup (reference: controllers.go:29-45)
+        enabled = parse_workload_gate(self.options.workloads, list(WORKLOAD_REGISTRY))
+        for kind in enabled:
+            kwargs = dict(self.options.controller_kwargs.get(kind, {}))
+            factory = WORKLOAD_REGISTRY[kind]
+            try:
+                controller = factory(
+                    cluster_domain=self.options.cluster_domain,
+                    local_addresses=self.options.local_addresses,
+                    **kwargs,
+                )
+            except TypeError:
+                controller = factory(**kwargs)
+            engine = JobEngine(
+                store=self.store,
+                controller=controller,
+                recorder=self.manager.recorder,
+                gang_scheduler=self.gang,
+                metrics=self.metrics,
+                features=self.features,
+                cluster_domain=self.options.cluster_domain,
+                compile_cache_dir=self.options.compile_cache_dir,
+            )
+            self.engines[kind] = engine
+            self.controllers[kind] = controller
+            self.manager.register(
+                f"{kind.lower()}-controller",
+                engine.reconcile,
+                watch_kinds=[kind, "Pod", "Service", "PodGroup"],
+                mapper=self._engine_mapper(kind),
+                workers=self.options.max_concurrent_reconciles,
+            )
+            # live running/pending gauges (reference: status_counter.go:22-81)
+            self._register_status_gauges(kind)
+
+        # pod runtime
+        self.kubelet = Kubelet(
+            self.store, runtime or SubprocessRuntime(self.options.pod_log_dir)
+        )
+        self.kubelet.setup(self.manager)
+
+        # model lineage
+        self.artifact_registry = ArtifactRegistry(self.options.artifact_registry_root)
+        self.lineage = ModelVersionController(
+            self.store, self.artifact_registry, self.manager.recorder,
+            local_node=self.options.node_name,
+        )
+        self.lineage.setup(self.manager)
+
+        # cron workflows over every enabled kind (reference: controllers/apps)
+        from kubedl_tpu.cron.controller import CronController
+
+        self.cron = CronController(
+            self.store, list(self.engines), self.manager.recorder,
+            submitter=self.submit,
+        )
+        self.cron.setup(self.manager)
+
+        # persistence: storage backends + persist controllers
+        # (reference: main.go:104-107 — RegisterStorageBackends then
+        # persist.SetupWithManager)
+        self.object_backend = None
+        self.event_backend = None
+        if self.options.meta_storage or self.options.event_storage:
+            from kubedl_tpu.persist import PersistControllers, default_registry
+
+            registry = default_registry(
+                self.options.storage_db_path,
+                remote_url=self.options.remote_storage_url,
+            )
+            if self.options.meta_storage:
+                self.object_backend = registry.object_backend(
+                    self.options.meta_storage
+                )
+            if self.options.event_storage:
+                self.event_backend = registry.event_backend(
+                    self.options.event_storage
+                )
+            self.persist = PersistControllers(
+                self.store,
+                kinds=list(self.engines),
+                object_backend=self.object_backend,
+                event_backend=self.event_backend,
+                region=self.options.region,
+            )
+            self.persist.setup(self.manager)
+
+        # inference serving (reference: controllers/serving)
+        from kubedl_tpu.serving.controller import InferenceController
+
+        self.serving = InferenceController(
+            self.store,
+            self.manager.recorder,
+            local_addresses=self.options.local_addresses,
+            cluster_domain=self.options.cluster_domain,
+            qps_probe=self.options.serving_qps_probe,
+            compile_cache_dir=self.options.compile_cache_dir,
+        )
+        self.serving.setup(self.manager)
+
+    def _engine_mapper(self, kind: str):
+        """owner_mapper plus the gang-release nudge: a PodGroup deletion
+        frees slices, so every QUEUED job of this kind is requeued
+        immediately instead of waiting out its admission poll (round-1
+        weakness: gang admission busy-polled at 1s forever)."""
+        from kubedl_tpu.api.types import JobConditionType
+
+        base = owner_mapper(kind)
+
+        def mapper(event, obj, old):
+            keys = base(event, obj, old)
+            if obj.kind == "PodGroup" and event == "DELETED":
+                for j in self.store.list(kind, None):  # every namespace
+                    if (
+                        j.status.phase == JobConditionType.QUEUED
+                        and (j.metadata.namespace, j.metadata.name) not in keys
+                    ):
+                        keys.append((j.metadata.namespace, j.metadata.name))
+            return keys
+
+        return mapper
+
+    def _register_status_gauges(self, kind: str) -> None:
+        from kubedl_tpu.api.types import JobConditionType
+
+        def count(phase: JobConditionType) -> float:
+            n = 0
+            for obj in self.store.list(kind, namespace=None):
+                if isinstance(obj, JobObject) and obj.status.phase == phase:
+                    n += 1
+            return float(n)
+
+        self.metrics.running.set_function(
+            lambda: count(JobConditionType.RUNNING), kind=kind
+        )
+        self.metrics.pending.set_function(
+            lambda: count(JobConditionType.CREATED)
+            + count(JobConditionType.QUEUED),
+            kind=kind,
+        )
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        if not self.options.leader_elect:
+            self.manager.start()
+            return
+        # HA mode (reference: main.go:76-84): reconcile only while holding
+        # the lease. The follower builds everything but starts nothing;
+        # on acquisition it resyncs (kick_all) and runs; on LOSS it stops
+        # for good (crash-only — the process restarts to re-campaign).
+        from kubedl_tpu.core.leases import LeaderElector
+
+        self.elector = LeaderElector(
+            self.store,
+            identity=self.options.leader_identity,
+            ttl=self.options.leader_lease_ttl,
+        )
+
+        def on_started() -> None:
+            self.manager.start()
+            self.manager.kick_all()
+
+        self.elector.start(on_started=on_started, on_stopped=self._on_deposed)
+
+    def _on_deposed(self) -> None:
+        self.kubelet.shutdown()
+        self.manager.stop()
+
+    def stop(self) -> None:
+        elector = getattr(self, "elector", None)
+        if elector is not None:
+            elector.stop()
+        self.kubelet.shutdown()
+        self.manager.stop()
+        for backend in (self.object_backend, self.event_backend):
+            if backend is not None:
+                backend.close()
+
+    def __enter__(self) -> "Operator":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, job: JobObject) -> JobObject:
+        """Admission + create (the reference's defaulting/validating
+        webhook chain runs in-process here): defaults are applied, the
+        kind's validation rules run, then the object lands in the store."""
+        engine = self.engines.get(job.kind)
+        if engine is None:
+            raise ValidationError(
+                job.kind, [f"workload kind {job.kind!r} is not enabled"]
+            )
+        # validate BEFORE defaulting: the user must get a 400 for a
+        # disallowed replica group, not have it silently pruned (defaulting
+        # still degrades gracefully on the reconcile path)
+        errs = engine.controller.validate(job)
+        if errs:
+            raise ValidationError(job.kind, errs)
+        engine.controller.apply_defaults(job)
+        return self.store.create(job)  # type: ignore[return-value]
+
+    def wait_for_phase(
+        self, kind: str, name: str, phases, timeout: float = 30.0, namespace: str = "default"
+    ) -> JobObject:
+        if not isinstance(phases, (list, tuple, set)):
+            phases = [phases]
+
+        def check() -> bool:
+            obj = self.store.try_get(kind, name, namespace)
+            return obj is not None and obj.status.phase in phases  # type: ignore[attr-defined]
+
+        self.manager.wait(check, timeout=timeout)
+        obj = self.store.try_get(kind, name, namespace)
+        if obj is None:
+            raise LookupError(f"{kind} {namespace}/{name} vanished")
+        return obj  # type: ignore[return-value]
+
+    def render_metrics(self) -> str:
+        return self.metrics_registry.render()

@@ -1,0 +1,14 @@
+"""TPU kernels (pallas) for the hot ops.
+
+The reference has no native compute code at all (SURVEY.md §2: 100% Go
+orchestration); these kernels are the TPU build's data-plane floor:
+- flash_attention: fused attention, O(S) memory, MXU-tiled.
+"""
+
+from kubedl_tpu.ops import flash_attention as _flash_module
+from kubedl_tpu.ops.flash_attention import flash_attention, make_flash_attention  # noqa: F401
+
+# keep the submodule reachable as an attribute despite the function
+# re-export shadowing its name (import kubedl_tpu.ops.flash_attention
+# would otherwise bind the function)
+flash_attention_module = _flash_module

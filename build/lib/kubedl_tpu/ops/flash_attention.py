@@ -1,0 +1,485 @@
+"""Flash attention: fused pallas TPU kernel with online softmax.
+
+Single-chip counterpart of `kubedl_tpu.parallel.ring` (which runs the same
+recurrence *across* chips): scores never materialize in HBM — each (q-block,
+k-block) tile streams through VMEM, the MXU does the two matmuls, and a
+running (max, sum, acc) triple in VMEM scratch folds blocks in
+(the flash-attention recurrence). Memory is O(S·hd) instead of O(S²);
+causal blocks above the diagonal are predicated off entirely (half the
+FLOPs at long S).
+
+Grid layout: (batch, q_heads, q_blocks, k_blocks), k innermost so the
+scratch accumulator carries across k-steps of one q-tile — the canonical
+pallas accumulation pattern (pallas_guide.md: grid iterates last dim
+fastest; scratch persists). GQA is free: the K/V BlockSpec index map sends
+q-head h to kv-head h//group, no repeated K/V in memory.
+
+Backward is a custom VJP over two more pallas kernels (the canonical
+flash-2 split): a dQ kernel accumulating over k-blocks and a dK/dV kernel
+accumulating over q-blocks, both recomputing P from the saved lse — same
+O(S·hd) memory profile as the forward, and independently tileable.
+1024x1024 tiles are the measured v5e sweet spot (VMEM-bound above that);
+in-model they run 2.6x faster than the stock jax pallas TPU flash kernel
+on the bench model's hd=64 GQA shapes.
+
+On CPU (tests) the kernel runs in pallas interpret mode; numerics match
+the dense oracle `kubedl_tpu.models.llama.attention`.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int, n_k: int,
+):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: skip k-blocks strictly above the diagonal
+    run = (j * block_k <= i * block_q + block_q - 1) if causal else (j <= n_k)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]  # [bq, hd]
+        k = k_ref[0, 0]  # [bk, hd]
+        v = v_ref[0, 0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if causal:
+            rows = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_ref[:, :1]  # [bq, 1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_ref[:, :1] * corr + p.sum(axis=-1, keepdims=True)
+        pv = lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == n_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        # lse is [B, H, Sq, 1] (trailing singleton keeps the block shape
+        # legal for mosaic's (8, 128) tiling rule); squeezed by _fwd
+        lse_ref[0, 0] = m_ref[:, :1] + jnp.log(l)
+
+
+def _fwd(
+    q: jax.Array,  # [B, H, Sq, hd]
+    k: jax.Array,  # [B, KV, Sk, hd]
+    v: jax.Array,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Sq, hd = q.shape
+    KV, Sk = k.shape[1], k.shape[2]
+    group = H // KV
+    bq = min(block_q, Sq)
+    bk = min(block_k, Sk)
+    if Sq % bq or Sk % bk:
+        raise ValueError(f"seq lengths ({Sq},{Sk}) must divide blocks ({bq},{bk})")
+    n_q, n_k = Sq // bq, Sk // bk
+    scale = 1.0 / math.sqrt(hd)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=bq, block_k=bk, n_k=n_k,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // group, j, 0)),
+            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // group, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sq, hd), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, hd), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse[..., 0]
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref, acc_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int, n_k: int,
+):
+    """dQ kernel: grid (B, H, n_q, n_k), k innermost — the dq tile for one
+    q-block accumulates across k-blocks in VMEM scratch (same pattern as
+    the forward, with p recomputed from the saved lse)."""
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = (j * block_k <= i * block_q + block_q - 1) if causal else (j <= n_k)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]  # [bq, 1]
+        d = d_ref[0, 0]  # [bq, 1]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - d)
+        acc_ref[:] += lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(j == n_k - 1)
+    def _finalize():
+        dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkdv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, scale: float, causal: bool, block_q: int, block_k: int, n_q: int,
+):
+    """dK/dV kernel: grid (B, H, n_k, n_q), q innermost — each k-block's
+    gradient accumulates across the q-blocks that attend to it."""
+    j = pl.program_id(2)
+    i = pl.program_id(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = (j * block_k <= i * block_q + block_q - 1) if causal else (i <= n_q)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        d = d_ref[0, 0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dv_acc[:] += lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0, 0],
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - d)).astype(q.dtype)
+        dk_acc[:] += lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(i == n_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(
+    res, do: jax.Array, causal: bool, block_q: int, block_k: int,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused flash backward: dq via one kernel, dk/dv via another, both
+    with the same O(S·hd) memory profile as the forward. GQA: kernels run
+    at q-head granularity against the shared kv-head block (BlockSpec index
+    maps h -> h//group); dk/dv are then summed over the group."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    q, k, v, out, lse = res
+    B, H, Sq, hd = q.shape
+    KV, Sk = k.shape[1], k.shape[2]
+    group = H // KV
+    bq = min(block_q, Sq)
+    bk = min(block_k, Sk)
+    n_q, n_k = Sq // bq, Sk // bk
+    scale = 1.0 / math.sqrt(hd)
+
+    # D_i = rowsum(dO * O): tiny elementwise pre-pass, XLA fuses it
+    d = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)[..., None]
+    lse4 = lse[..., None]  # [B, H, Sq, 1]
+
+    q_spec = pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // group, j, 0))
+    row_spec = pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=bq, block_k=bk, n_k=n_k,
+        ),
+        grid=(B, H, n_q, n_k),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((B, H, Sq, hd), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, hd), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse4, d)[0]
+
+    # dk/dv at q-head granularity (grid swaps the two inner axes)
+    q_spec2 = pl.BlockSpec((1, 1, bq, hd), lambda b, h, j, i: (b, h, i, 0))
+    kv_spec2 = pl.BlockSpec((1, 1, bk, hd), lambda b, h, j, i: (b, h // group, j, 0))
+    row_spec2 = pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0))
+    dkv_spec = pl.BlockSpec((1, 1, bk, hd), lambda b, h, j, i: (b, h, j, 0))
+
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(
+            _bwd_dkdv_kernel, scale=scale, causal=causal,
+            block_q=bq, block_k=bk, n_q=n_q,
+        ),
+        grid=(B, H, n_k, n_q),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=[dkv_spec, dkv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sk, hd), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Sk, hd), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, hd), jnp.float32),
+            pltpu.VMEM((bk, hd), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse4, d)
+    dk = dk_h.reshape(B, KV, group, Sk, hd).sum(axis=2).astype(k.dtype)
+    dv = dv_h.reshape(B, KV, group, Sk, hd).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, block_q, block_k, bwd_block_q, bwd_block_k, interpret):
+    out, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, bwd_block_q, bwd_block_k, interpret):
+    out, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, bwd_block_q, bwd_block_k, interpret, res, do):
+    return _bwd_pallas(res, do, causal, bwd_block_q, bwd_block_k, interpret)
+
+
+# optimize_remat: under jax.checkpoint the fwd kernel's residuals (q, k, v,
+# out, lse) are plumbed properly instead of re-running the whole forward
+# kernel in backward — measured in-model, the recompute was ~24 x fwd
+# (~140ms of the 643ms bench step)
+_flash.defvjp(_flash_fwd, _flash_bwd, optimize_remat=True)
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+#: Times the pallas kernel was traced into a compiled graph. Incremented at
+#: trace time (once per compile, not per step) — bench.py asserts this is
+#: nonzero to prove the fused kernel is in the hot path, not the oracle.
+TRACE_COUNT = 0
+
+
+def flash_attention(
+    q: jax.Array,  # [B, S, H, hd] — llama layout
+    k: jax.Array,  # [B, S, KV, hd]
+    v: jax.Array,
+    causal: bool = True,
+    mask: Optional[jax.Array] = None,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    bwd_block_q: int = 1024,
+    bwd_block_k: int = 1024,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Drop-in for `kubedl_tpu.models.llama.attention` (same signature, so
+    it slots into `llama_forward(..., attn_fn=flash_attention)`). Arbitrary
+    masks fall back to the dense oracle — flash handles the causal/full
+    cases that training uses. Forward and backward kernels tile
+    independently. Default 1024x1024 tiles are the measured v5e sweet spot
+    in-model (S=2048, hd=64: 649ms fwd+bwd for the 24-layer bench model vs
+    974ms at 256-tiles, 1673ms for the stock jax pallas TPU kernel; 2048
+    tiles exceed VMEM). Small sequences clamp blocks to S automatically."""
+    if mask is not None:
+        from kubedl_tpu.models.llama import attention
+
+        return attention(q, k, v, causal=causal, mask=mask)
+    if interpret is None:
+        interpret = _default_interpret()
+    qt = q.transpose(0, 2, 1, 3)  # [B, H, S, hd]
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    S = qt.shape[2]
+    # fit every tiling to the actual sequence length (a seq divisible by
+    # 128 but not by the preferred block shrinks the block, not the path)
+    bq = fit_block(S, block_q)
+    bk = fit_block(S, block_k)
+    bwd_q = fit_block(S, bwd_block_q)
+    bwd_k = fit_block(S, bwd_block_k)
+    if not (bq and bk and bwd_q and bwd_k):
+        from kubedl_tpu.models.llama import attention
+
+        return attention(q, k, v, causal=causal)
+    # counted only on the actual kernel path — a dense-oracle fallback must
+    # not satisfy the bench's "pallas kernel really traced" gate
+    global TRACE_COUNT
+    TRACE_COUNT += 1
+    out = _flash(qt, kt, vt, causal, bq, bk, bwd_q, bwd_k, interpret)
+    return out.transpose(0, 2, 1, 3)
+
+
+def fit_block(seq_len: int, want: int) -> int:
+    """Largest legal block <= ``want`` for this sequence length: the whole
+    sequence if it fits in one block, else the largest multiple-of-128
+    divisor (mosaic tiling wants 128-lane-aligned score tiles). 0 = no
+    legal block — caller falls back to the dense oracle."""
+    if seq_len <= want:
+        return seq_len
+    for b in range(min(want, seq_len), 127, -128):
+        if b % 128 == 0 and seq_len % b == 0:
+            return b
+    return 0
+
+
+def supports(seq_len: int, block_q: int = 1024, block_k: int = 1024) -> bool:
+    """Whether a legal tiling exists for this shape (a seq divisible by 128
+    always tiles — the block shrinks below the preferred size if needed)."""
+    return fit_block(seq_len, block_q) > 0 and fit_block(seq_len, block_k) > 0
+
+
+def make_flash_attention(
+    mesh,
+    batch_axes: Tuple[str, ...] = ("replica", "data", "fsdp"),
+    head_axis: str = "tensor",
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+):
+    """Mesh-aware flash attention for the trainer hot path.
+
+    pallas_call can't be auto-partitioned by XLA's SPMD partitioner, so on a
+    multi-device mesh the kernel is wrapped in `shard_map` over the batch
+    (data-like) and head (tensor) axes — attention is embarrassingly
+    parallel over both, so the body needs no collectives. On a trivial mesh
+    the kernel is called directly.
+    """
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    bt = tuple(
+        a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1
+    )
+    ht = (
+        head_axis
+        if head_axis in mesh.axis_names and mesh.shape[head_axis] > 1
+        else None
+    )
+
+    if not bt and ht is None:
+
+        def direct(q, k, v, causal=True, mask=None):
+            return flash_attention(
+                q, k, v, causal=causal, mask=mask,
+                block_q=block_q, block_k=block_k, interpret=interpret,
+            )
+
+        return direct
+
+    def build(head):
+        spec = P(bt if bt else None, None, head, None)  # [B, S, H, hd]
+        inner = shard_map(
+            functools.partial(
+                flash_attention, causal=True,
+                block_q=block_q, block_k=block_k, interpret=interpret,
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return NamedSharding(mesh, spec), inner
+
+    variants = {None: build(None)}
+    if ht is not None:
+        variants[ht] = build(ht)
+
+    def attn_fn(q, k, v, causal=True, mask=None):
+        if mask is not None or not causal:
+            from kubedl_tpu.models.llama import attention
+
+            return attention(q, k, v, causal=causal, mask=mask)
+        # head sharding needs every head count divisible by the axis
+        t = mesh.shape[ht] if ht is not None else 1
+        key = ht if ht is not None and q.shape[2] % t == 0 and k.shape[2] % t == 0 else None
+        sharding, inner = variants[key]
+        q = jax.lax.with_sharding_constraint(q, sharding)
+        k = jax.lax.with_sharding_constraint(k, sharding)
+        v = jax.lax.with_sharding_constraint(v, sharding)
+        return inner(q, k, v)
+
+    return attn_fn

@@ -1,0 +1,16 @@
+"""SPMD parallelism: device meshes, sharding rules, ring attention.
+
+This is the in-process half of the TPU story (SURVEY.md §2.5): the operator
+hands every worker `KUBEDL_MESH_AXES` + `jax.distributed` bootstrap; this
+package turns them into a concrete `jax.sharding.Mesh`, lays out
+dp/fsdp/tp/sp axes, and provides the collectives-based building blocks
+(ring attention for context parallelism) the reference delegated to NCCL/MPI
+frameworks inside user containers.
+"""
+
+from kubedl_tpu.parallel.mesh import (  # noqa: F401
+    batch_axes,
+    build_mesh,
+    initialize_from_env,
+    mesh_from_env,
+)

@@ -1,0 +1,96 @@
+"""Mesh construction and distributed bootstrap.
+
+The consumer side of the operator's env contract
+(`kubedl_tpu.workloads.tpujob`): a worker process calls
+:func:`initialize_from_env` (wraps `jax.distributed.initialize` with the
+KUBEDL_* variables) and :func:`mesh_from_env` to get the logical mesh the
+job requested. Axis order follows MeshSpec.AXIS_ORDER — DCN-crossing axes
+outermost, ICI-hungry (tensor) innermost — the scaling-book layout recipe.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.api.topology import MeshSpec
+
+#: Axes a batch dimension is sharded over (all data-parallel-like axes).
+DATA_AXES = ("replica", "data", "fsdp")
+#: The sequence/context-parallel mesh axis (ring attention shards over it).
+SEQUENCE_AXIS = "sp"
+
+
+def build_mesh(
+    spec: Optional[MeshSpec] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Build a `jax.sharding.Mesh` from a MeshSpec.
+
+    With no spec, the whole device set becomes a 1-axis "data" mesh. Axes of
+    size 1 are kept so sharding rules can always name them.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if spec is None or not spec.axes:
+        spec = MeshSpec({"data": len(devices)})
+    names = [a for a, _ in spec.ordered()]
+    sizes = [s for _, s in spec.ordered()]
+    total = int(np.prod(sizes))
+    if total != len(devices):
+        raise ValueError(
+            f"mesh {dict(spec.ordered())} needs {total} devices, have {len(devices)}"
+        )
+    arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+def mesh_from_env(devices: Optional[Sequence] = None) -> Mesh:
+    raw = os.environ.get(constants.ENV_MESH_AXES, "")
+    spec = MeshSpec.from_env(raw) if raw else None
+    return build_mesh(spec, devices)
+
+
+def initialize_from_env() -> None:
+    """`jax.distributed.initialize` from the operator-injected env.
+
+    Replaces the reference's per-framework bootstrap (TF_CONFIG parsing,
+    torch.distributed.init_process_group on MASTER_ADDR, mpirun hostfiles).
+    No-op when the job is single-process.
+    """
+    n = int(os.environ.get(constants.ENV_NUM_PROCESSES, "1"))
+    if n <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=os.environ[constants.ENV_COORDINATOR_ADDRESS],
+        num_processes=n,
+        process_id=int(os.environ[constants.ENV_PROCESS_ID]),
+    )
+
+
+def batch_axes(mesh: Mesh) -> tuple:
+    """The tuple of mesh axes a batch dim shards over."""
+    return tuple(a for a in DATA_AXES if a in mesh.axis_names and mesh.shape[a] > 1) or (
+        tuple(a for a in DATA_AXES if a in mesh.axis_names)[:1] or (None,)
+    )
+
+
+def batch_pspec(mesh: Mesh) -> P:
+    """[B, S, ...] batches: B over data-like axes, S over the sequence-
+    parallel axis when the mesh has one (context parallelism)."""
+    axes = tuple(a for a in DATA_AXES if a in mesh.axis_names)
+    seq = SEQUENCE_AXIS if SEQUENCE_AXIS in mesh.axis_names else None
+    return P(axes if axes else None, seq)
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Place a host-local batch onto the mesh, sharded over data axes."""
+    sharding = NamedSharding(mesh, batch_pspec(mesh))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    return mesh.shape[name] if name in mesh.axis_names else 1

@@ -1,0 +1,32 @@
+"""Metadata persistence: mirror control-plane state to external stores.
+
+Reference: pkg/storage/ (backend interfaces + MySQL/SLS impls, DMO row
+types, converters) and controllers/persist/ (job/pod/event persist
+controllers). Here the durable store is SQLite (stdlib, zero-dep analogue
+of the reference's gorm+MySQL), and persist controllers ride the same
+ControllerManager workqueues the reconcilers use.
+"""
+
+from kubedl_tpu.persist.backends import (
+    EventStorageBackend,
+    ObjectStorageBackend,
+    Query,
+    StorageRegistry,
+    default_registry,
+)
+from kubedl_tpu.persist.controller import PersistControllers
+from kubedl_tpu.persist.dmo import EventInfo, JobInfo, ReplicaInfo
+from kubedl_tpu.persist.sqlite_backend import SQLiteBackend
+
+__all__ = [
+    "EventInfo",
+    "EventStorageBackend",
+    "JobInfo",
+    "ObjectStorageBackend",
+    "PersistControllers",
+    "Query",
+    "ReplicaInfo",
+    "SQLiteBackend",
+    "StorageRegistry",
+    "default_registry",
+]

@@ -1,0 +1,191 @@
+"""Storage-backend plugin contract + registry.
+
+Reference: pkg/storage/backends/interface.go:31-74 (ObjectStorageBackend /
+EventStorageBackend) and pkg/storage/backends/registry/registry.go:32-116
+(named-backend registration selected by --meta-storage / --event-storage
+flags). Query mirrors backends/query.go (filters + pagination).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from kubedl_tpu.persist.dmo import EventInfo, JobInfo, ReplicaInfo
+
+
+@dataclass
+class Query:
+    """List filter (reference: pkg/storage/backends/query.go)."""
+
+    name: str = ""
+    namespace: str = ""
+    kind: str = ""
+    phase: str = ""
+    #: time-range filter on creation timestamp
+    start_time: Optional[float] = None
+    end_time: Optional[float] = None
+    #: include rows already deleted from the live store
+    include_deleted: bool = True
+    limit: int = 0  # 0 = unlimited
+    offset: int = 0
+
+
+class ObjectStorageBackend:
+    """Durable mirror of jobs + pods (reference: interface.go:31-58)."""
+
+    def initialize(self) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+    def name(self) -> str:
+        raise NotImplementedError
+
+    # ---- jobs ----
+    def save_job(self, job: JobInfo) -> None:
+        raise NotImplementedError
+
+    def get_job(self, namespace: str, name: str, kind: str = "") -> Optional[JobInfo]:
+        raise NotImplementedError
+
+    def list_jobs(self, query: Query) -> List[JobInfo]:
+        raise NotImplementedError
+
+    def mark_job_deleted(self, namespace: str, name: str, kind: str = "") -> None:
+        """Record etcd deletion without losing history (reference:
+        UpdateJobRecordStopped + is_in_etcd=0, mysql.go)."""
+        raise NotImplementedError
+
+    def remove_job_record(self, namespace: str, name: str, kind: str = "") -> None:
+        raise NotImplementedError
+
+    # ---- pods ----
+    def save_pod(self, pod: ReplicaInfo) -> None:
+        raise NotImplementedError
+
+    def list_pods(self, job_uid: str) -> List[ReplicaInfo]:
+        raise NotImplementedError
+
+    def mark_pod_deleted(self, namespace: str, name: str) -> None:
+        raise NotImplementedError
+
+
+class EventStorageBackend:
+    """Durable event sink (reference: interface.go:60-74; MySQL or
+    Aliyun-SLS in the reference)."""
+
+    def initialize(self) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+    def name(self) -> str:
+        raise NotImplementedError
+
+    def save_event(self, ev: EventInfo) -> None:
+        raise NotImplementedError
+
+    def list_events(
+        self, involved_kind: str, involved_name: str, namespace: str = ""
+    ) -> List[EventInfo]:
+        raise NotImplementedError
+
+
+class StorageRegistry:
+    """Named-backend registry (reference: registry.go:32-116)."""
+
+    def __init__(self) -> None:
+        self._object_backends: Dict[str, Callable[[], ObjectStorageBackend]] = {}
+        self._event_backends: Dict[str, Callable[[], EventStorageBackend]] = {}
+
+    def register_object_backend(
+        self, name: str, factory: Callable[[], ObjectStorageBackend]
+    ) -> None:
+        self._object_backends[name] = factory
+
+    def register_event_backend(
+        self, name: str, factory: Callable[[], EventStorageBackend]
+    ) -> None:
+        self._event_backends[name] = factory
+
+    def object_backend(self, name: str) -> ObjectStorageBackend:
+        if name not in self._object_backends:
+            raise KeyError(
+                f"unknown object storage backend {name!r}; "
+                f"registered: {sorted(self._object_backends)}"
+            )
+        backend = self._object_backends[name]()
+        backend.initialize()
+        return backend
+
+    def event_backend(self, name: str) -> EventStorageBackend:
+        if name not in self._event_backends:
+            raise KeyError(
+                f"unknown event storage backend {name!r}; "
+                f"registered: {sorted(self._event_backends)}"
+            )
+        backend = self._event_backends[name]()
+        backend.initialize()
+        return backend
+
+
+def default_registry(
+    db_path: str = ":memory:", remote_url: str = ""
+) -> StorageRegistry:
+    """Registry with the built-in SQLite backend under both roles
+    (the reference registers MySQL for objects+events and SLS for events,
+    registry.go:32-53). With ``remote_url`` set, the "http" backend
+    (network-remote store, the MySQL-over-the-wire analogue) registers
+    under both roles too."""
+    from kubedl_tpu.persist.sqlite_backend import SQLiteBackend
+
+    reg = StorageRegistry()
+    # One shared backend instance per registry so object + event mirrors
+    # land in the same database file.
+    shared: Dict[str, SQLiteBackend] = {}
+
+    def factory() -> SQLiteBackend:
+        if "b" not in shared:
+            shared["b"] = SQLiteBackend(db_path)
+        return shared["b"]
+
+    reg.register_object_backend("sqlite", factory)
+    reg.register_event_backend("sqlite", factory)
+
+    # JSONL log-store backend (second real plugin; reference analogue:
+    # the Aliyun SLS log-store event sink, sls_logstore.go). For a file
+    # db_path the log root sits alongside it; for :memory: a temp dir.
+    from kubedl_tpu.persist.jsonl_backend import JSONLBackend
+
+    shared_jsonl: Dict[str, JSONLBackend] = {}
+
+    def jsonl_factory() -> JSONLBackend:
+        if "b" not in shared_jsonl:
+            if db_path and db_path != ":memory:":
+                root = db_path + ".jsonl.d"
+            else:
+                import tempfile
+
+                root = tempfile.mkdtemp(prefix="kubedl-jsonl-")
+            shared_jsonl["b"] = JSONLBackend(root)
+        return shared_jsonl["b"]
+
+    reg.register_object_backend("jsonl", jsonl_factory)
+    reg.register_event_backend("jsonl", jsonl_factory)
+
+    if remote_url:
+        from kubedl_tpu.persist.http_backend import HTTPBackend
+
+        shared_http: Dict[str, HTTPBackend] = {}
+
+        def http_factory() -> HTTPBackend:
+            if "b" not in shared_http:
+                shared_http["b"] = HTTPBackend(remote_url)
+            return shared_http["b"]
+
+        reg.register_object_backend("http", http_factory)
+        reg.register_event_backend("http", http_factory)
+    return reg

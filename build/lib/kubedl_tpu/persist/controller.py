@@ -1,0 +1,106 @@
+"""Persist controllers: mirror live store state into durable backends.
+
+Reference: controllers/persist/ — per-kind job persist controllers sharing
+one ``jobPersistHandler`` (object/job/job_persist_controller.go:35-123),
+pod persist (object/pod/pod_persist_controller.go:1-137), and event persist
+tailing Events (event/event_persist_controller.go:43-103); enabled by the
+--meta-storage / --event-storage flags (persist_controller.go:30-73).
+
+Each mirror is a normal manager-registered controller: watch events feed a
+workqueue, the reconcile reads the latest object and upserts its DMO row;
+a NotFound read means the object left etcd, which soft-deletes the row
+(deleted=1, is_in_etcd=0) so history survives the live object.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from kubedl_tpu.api.interface import JobObject
+from kubedl_tpu.core.manager import ControllerManager
+from kubedl_tpu.core.objects import BaseObject, Event, Pod
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.persist.backends import EventStorageBackend, ObjectStorageBackend
+from kubedl_tpu.persist.dmo import event_to_dmo, job_to_dmo, pod_to_dmo
+
+log = logging.getLogger("kubedl_tpu.persist")
+
+
+def _self_mapper(event: str, obj: BaseObject, old: Optional[BaseObject]):
+    return [(obj.metadata.namespace, obj.metadata.name)]
+
+
+class PersistControllers:
+    def __init__(
+        self,
+        store: ObjectStore,
+        kinds: List[str],
+        object_backend: Optional[ObjectStorageBackend] = None,
+        event_backend: Optional[EventStorageBackend] = None,
+        region: str = "",
+    ) -> None:
+        self.store = store
+        self.kinds = kinds
+        self.objects = object_backend
+        self.events = event_backend
+        self.region = region
+
+    # ---- wiring (reference: persist.SetupWithManager) --------------------
+
+    def setup(self, manager: ControllerManager) -> None:
+        if self.objects is not None:
+            for kind in self.kinds:
+                manager.register(
+                    f"persist-{kind.lower()}",
+                    self._job_reconciler(kind),
+                    watch_kinds=[kind],
+                    mapper=_self_mapper,
+                )
+            manager.register(
+                "persist-pod",
+                self._reconcile_pod,
+                watch_kinds=["Pod"],
+                mapper=_self_mapper,
+            )
+        if self.events is not None:
+            manager.register(
+                "persist-event",
+                self._reconcile_event,
+                watch_kinds=["Event"],
+                mapper=_self_mapper,
+            )
+
+    # ---- job mirror (reference: jobPersistHandler Save/Delete) -----------
+
+    def _job_reconciler(self, kind: str):
+        def reconcile(namespace: str, name: str) -> Optional[float]:
+            assert self.objects is not None
+            obj = self.store.try_get(kind, name, namespace)
+            if obj is None:
+                self.objects.mark_job_deleted(namespace, name, kind)
+            elif isinstance(obj, JobObject):
+                self.objects.save_job(job_to_dmo(obj, self.region))
+            return None
+
+        return reconcile
+
+    # ---- pod mirror ------------------------------------------------------
+
+    def _reconcile_pod(self, namespace: str, name: str) -> Optional[float]:
+        assert self.objects is not None
+        obj = self.store.try_get("Pod", name, namespace)
+        if obj is None:
+            self.objects.mark_pod_deleted(namespace, name)
+        elif isinstance(obj, Pod):
+            self.objects.save_pod(pod_to_dmo(obj, self.region))
+        return None
+
+    # ---- event mirror (reference: event_persist_controller.go:43-103) ---
+
+    def _reconcile_event(self, namespace: str, name: str) -> Optional[float]:
+        assert self.events is not None
+        obj = self.store.try_get("Event", name, namespace)
+        if isinstance(obj, Event):
+            self.events.save_event(event_to_dmo(obj, self.region))
+        return None

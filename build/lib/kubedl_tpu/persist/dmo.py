@@ -1,0 +1,182 @@
+"""Data-model objects (DMO): flat rows mirrored into durable storage.
+
+Reference: pkg/storage/dmo/types.go:30-171 (JobInfo/ReplicaInfo/EventInfo
+gorm rows with tenant/owner/region/deleted/is_in_etcd columns) and
+pkg/storage/dmo/converters/{job,pod,event}.go (k8s object -> DMO). The TPU
+build adds a ``payload`` column holding the full object as JSON so the
+console can serve detail/yaml views straight from the mirror.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.api.interface import JobObject
+from kubedl_tpu.core.objects import Event, Pod
+
+
+def to_jsonable(obj: Any) -> Any:
+    """Recursively lower dataclasses/enums to plain JSON types (the
+    RawExtension-codec analogue, reference pkg/util/runtime/runtime.go)."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            out[f.name] = to_jsonable(getattr(obj, f.name))
+        return out
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, dict):
+        return {
+            (k.value if isinstance(k, enum.Enum) else k): to_jsonable(v)
+            for k, v in obj.items()
+        }
+    if isinstance(obj, (list, tuple)):
+        return [to_jsonable(v) for v in obj]
+    return obj
+
+
+@dataclass
+class JobInfo:
+    """One workload-job row (reference: dmo.Job, types.go:70-115)."""
+
+    uid: str = ""
+    name: str = ""
+    namespace: str = "default"
+    kind: str = ""
+    phase: str = ""
+    created_at: float = 0.0
+    started_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    tenant: str = ""
+    owner: str = ""
+    region: str = ""
+    deleted: bool = False
+    is_in_etcd: bool = True
+    #: full object as JSON for detail/yaml console views
+    payload: str = ""
+
+
+@dataclass
+class ReplicaInfo:
+    """One pod row (reference: dmo.Pod, types.go:117-148)."""
+
+    uid: str = ""
+    name: str = ""
+    namespace: str = "default"
+    job_uid: str = ""
+    job_name: str = ""
+    replica_type: str = ""
+    replica_index: int = 0
+    phase: str = ""
+    node: str = ""
+    pod_ip: str = ""
+    host_ip: str = ""
+    exit_code: Optional[int] = None
+    reason: str = ""
+    created_at: float = 0.0
+    started_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    deleted: bool = False
+    is_in_etcd: bool = True
+
+
+@dataclass
+class EventInfo:
+    """One event row (reference: dmo.Event, types.go:150-171)."""
+
+    name: str = ""
+    namespace: str = "default"
+    involved_kind: str = ""
+    involved_name: str = ""
+    type: str = "Normal"
+    reason: str = ""
+    message: str = ""
+    count: int = 1
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
+    region: str = ""
+
+
+# ---- converters (reference: pkg/storage/dmo/converters) -------------------
+
+
+def job_to_dmo(job: JobObject, region: str = "") -> JobInfo:
+    """Reference: converters/job.go ConvertJobToDMOJob."""
+    status = job.status
+    anns = job.metadata.annotations
+    return JobInfo(
+        uid=job.metadata.uid,
+        name=job.metadata.name,
+        namespace=job.metadata.namespace,
+        kind=job.kind,
+        phase=status.phase.value if status.phase else "Created",
+        created_at=job.metadata.creation_timestamp,
+        started_at=status.start_time,
+        finished_at=status.completion_time,
+        tenant=anns.get(constants.ANNOTATION_TENANCY, ""),
+        owner=anns.get(constants.ANNOTATION_OWNER, ""),
+        region=region,
+        deleted=False,
+        is_in_etcd=True,
+        payload=json.dumps(to_jsonable(job)),
+    )
+
+
+def pod_to_dmo(pod: Pod, region: str = "") -> ReplicaInfo:
+    """Reference: converters/pod.go ConvertPodToDMOPod."""
+    labels = pod.metadata.labels
+    ref = pod.metadata.controller_ref()
+    try:
+        index = int(labels.get(constants.LABEL_REPLICA_INDEX, "0"))
+    except ValueError:
+        index = 0
+    return ReplicaInfo(
+        uid=pod.metadata.uid,
+        name=pod.metadata.name,
+        namespace=pod.metadata.namespace,
+        job_uid=ref.uid if ref else "",
+        job_name=labels.get(constants.LABEL_JOB_NAME, ref.name if ref else ""),
+        replica_type=labels.get(constants.LABEL_REPLICA_TYPE, ""),
+        replica_index=index,
+        phase=pod.status.phase.value,
+        node=pod.spec.node_name,
+        pod_ip=pod.status.pod_ip,
+        host_ip=pod.status.host_ip,
+        exit_code=pod.status.exit_code(),
+        reason=pod.status.reason,
+        created_at=pod.metadata.creation_timestamp,
+        started_at=pod.status.start_time,
+        finished_at=pod.status.finish_time,
+        deleted=False,
+        is_in_etcd=True,
+    )
+
+
+def event_to_dmo(ev: Event, region: str = "") -> EventInfo:
+    """Reference: converters/event.go ConvertEventToDMOEvent."""
+    return EventInfo(
+        name=ev.metadata.name,
+        namespace=ev.metadata.namespace,
+        involved_kind=ev.involved_kind,
+        involved_name=ev.involved_name,
+        type=ev.type,
+        reason=ev.reason,
+        message=ev.message,
+        count=ev.count,
+        first_timestamp=ev.metadata.creation_timestamp,
+        last_timestamp=ev.timestamp,
+        region=region,
+    )
+
+
+def row_to_dict(row: Any) -> Dict[str, Any]:
+    return dataclasses.asdict(row)
+
+
+def rows_to_dicts(rows: List[Any]) -> List[Dict[str, Any]]:
+    return [row_to_dict(r) for r in rows]

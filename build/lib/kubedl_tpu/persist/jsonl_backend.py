@@ -1,0 +1,199 @@
+"""JSONL storage backend: append-only newline-delimited-JSON mirror.
+
+Second registered backend proving the plugin registry carries more than
+one real implementation (reference ships MySQL for objects+events plus an
+Aliyun SLS *log-store* event sink, sls_logstore.go — this is the
+log-store-shaped analogue: every save appends a record; reads replay the
+log, last-write-wins by (namespace, name)).
+
+Files under the root: ``jobs.jsonl``, ``pods.jsonl``, ``events.jsonl``.
+Durable across operator restarts, greppable, no database dependency —
+the right shape for shipping job history into a log pipeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from kubedl_tpu.persist.backends import (
+    EventStorageBackend,
+    ObjectStorageBackend,
+    Query,
+)
+from kubedl_tpu.persist.dmo import EventInfo, JobInfo, ReplicaInfo
+
+
+class JSONLBackend(ObjectStorageBackend, EventStorageBackend):
+    def __init__(self, root: str) -> None:
+        self._root = Path(root)
+        self._lock = threading.RLock()
+        self._files: Dict[str, object] = {}
+        #: incremental last-write-wins views so reads are O(live rows), not
+        #: O(log history); the file is replayed once per log on first use
+        self._views: Dict[str, Dict[tuple, dict]] = {}
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def initialize(self) -> None:
+        self._root.mkdir(parents=True, exist_ok=True)
+
+    def close(self) -> None:
+        with self._lock:
+            for f in self._files.values():
+                f.close()  # type: ignore[attr-defined]
+            self._files.clear()
+            self._views.clear()  # re-open re-reads the files
+
+    def name(self) -> str:
+        return "jsonl"
+
+    # ---- log primitives --------------------------------------------------
+
+    def _append(self, log: str, record: dict) -> None:
+        with self._lock:
+            f = self._files.get(log)
+            if f is None:
+                f = open(self._root / f"{log}.jsonl", "a")
+                self._files[log] = f
+            f.write(json.dumps(record) + "\n")  # type: ignore[attr-defined]
+            f.flush()  # type: ignore[attr-defined]
+            self._apply(self._view(log), record)
+
+    def _replay(self, log: str) -> List[dict]:
+        path = self._root / f"{log}.jsonl"
+        if not path.exists():
+            return []
+        out = []
+        with self._lock, open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        return out
+
+    @staticmethod
+    def _apply(view: Dict[tuple, dict], rec: dict) -> None:
+        """Fold one record into a last-write-wins view; ``_op: remove``
+        tombstones drop the key (the log keeps history, reads don't)."""
+        ns, n, k = (rec.get("namespace", ""), rec.get("name", ""),
+                    rec.get("kind", ""))
+        if rec.get("_op") == "remove":
+            for key in [key for key in view
+                        if key[0] == ns and key[1] == n
+                        and (not k or key[2] == k)]:
+                view.pop(key)
+            return
+        view[(ns, n, k)] = rec
+
+    def _view(self, log: str) -> Dict[tuple, dict]:
+        """The live view for one log; built from disk exactly once."""
+        with self._lock:
+            view = self._views.get(log)
+            if view is None:
+                view = {}
+                for rec in self._replay(log):
+                    self._apply(view, rec)
+                self._views[log] = view
+            return view
+
+    def _latest(self, log: str) -> Dict[tuple, dict]:
+        return self._view(log)
+
+    # ---- jobs ------------------------------------------------------------
+
+    def save_job(self, job: JobInfo) -> None:
+        self._append("jobs", dataclasses.asdict(job))
+
+    def get_job(self, namespace: str, name: str, kind: str = "") -> Optional[JobInfo]:
+        for (ns, n, k), rec in self._latest("jobs").items():
+            if ns == namespace and n == name and (not kind or k == kind):
+                return JobInfo(**rec)
+        return None
+
+    def list_jobs(self, query: Query) -> List[JobInfo]:
+        rows = [JobInfo(**r) for r in self._latest("jobs").values()]
+        out = []
+        for r in rows:
+            if query.name and query.name not in r.name:  # substring match
+                continue
+            if query.namespace and r.namespace != query.namespace:
+                continue
+            if query.kind and r.kind != query.kind:
+                continue
+            if query.phase and r.phase != query.phase:
+                continue
+            if query.start_time is not None and r.created_at < query.start_time:
+                continue
+            if query.end_time is not None and r.created_at > query.end_time:
+                continue
+            if not query.include_deleted and r.deleted:
+                continue
+            out.append(r)
+        out.sort(key=lambda r: r.created_at, reverse=True)
+        if query.offset:
+            out = out[query.offset:]
+        if query.limit:
+            out = out[: query.limit]
+        return out
+
+    def _mark_job(self, namespace: str, name: str, kind: str, **updates) -> None:
+        row = self.get_job(namespace, name, kind)
+        if row is None:
+            return
+        for k, v in updates.items():
+            setattr(row, k, v)
+        self._append("jobs", dataclasses.asdict(row))
+
+    def mark_job_deleted(self, namespace: str, name: str, kind: str = "") -> None:
+        self._mark_job(namespace, name, kind, deleted=True, is_in_etcd=False)
+
+    def remove_job_record(self, namespace: str, name: str, kind: str = "") -> None:
+        # append-only log: removal is a tombstone record; reads replaying
+        # the log drop the key, the raw history stays greppable
+        self._append("jobs", {"_op": "remove", "namespace": namespace,
+                              "name": name, "kind": kind})
+
+    # ---- pods ------------------------------------------------------------
+
+    def save_pod(self, pod: ReplicaInfo) -> None:
+        self._append("pods", dataclasses.asdict(pod))
+
+    def list_pods(self, job_uid: str) -> List[ReplicaInfo]:
+        view = self._view("pods")
+        rows = [ReplicaInfo(**r) for r in view.values() if r.get("job_uid") == job_uid]
+        rows.sort(key=lambda r: (r.replica_type, r.replica_index))
+        return rows
+
+    def mark_pod_deleted(self, namespace: str, name: str) -> None:
+        rec = self._view("pods").get((namespace, name, ""))
+        if rec is not None:
+            rec = dict(rec)
+            rec["deleted"] = True
+            rec["is_in_etcd"] = False
+            self._append("pods", rec)
+
+    # ---- events ----------------------------------------------------------
+
+    def save_event(self, ev: EventInfo) -> None:
+        self._append("events", dataclasses.asdict(ev))
+
+    def list_events(
+        self, involved_kind: str, involved_name: str, namespace: str = ""
+    ) -> List[EventInfo]:
+        view = self._view("events")
+        out = []
+        for rec in view.values():
+            if involved_kind and rec.get("involved_kind") != involved_kind:
+                continue
+            if involved_name and rec.get("involved_name") != involved_name:
+                continue
+            if namespace and rec.get("namespace") != namespace:
+                continue
+            out.append(EventInfo(**rec))
+        out.sort(key=lambda e: e.last_timestamp)
+        return out

@@ -1,0 +1,322 @@
+"""SQLite storage backend — the gorm+MySQL analogue.
+
+Reference: pkg/storage/backends/objects/mysql/mysql.go (tables
+``job_info`` / ``replica_info`` / ``event_info`` auto-created at
+:413-440, upsert-style SaveJob/SavePod, soft-delete via
+deleted/is_in_etcd columns). SQLite is stdlib and file-or-memory backed,
+which keeps the persistence layer zero-dependency while preserving the
+reference's schema and query semantics. WAL mode + a process-wide lock
+make it safe under the manager's multi-threaded reconcile workers.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import List, Optional
+
+from kubedl_tpu.persist.backends import (
+    EventStorageBackend,
+    ObjectStorageBackend,
+    Query,
+)
+from kubedl_tpu.persist.dmo import EventInfo, JobInfo, ReplicaInfo
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS job_info (
+    uid TEXT PRIMARY KEY,
+    name TEXT NOT NULL,
+    namespace TEXT NOT NULL,
+    kind TEXT NOT NULL,
+    phase TEXT NOT NULL DEFAULT '',
+    created_at REAL NOT NULL DEFAULT 0,
+    started_at REAL,
+    finished_at REAL,
+    tenant TEXT NOT NULL DEFAULT '',
+    owner TEXT NOT NULL DEFAULT '',
+    region TEXT NOT NULL DEFAULT '',
+    deleted INTEGER NOT NULL DEFAULT 0,
+    is_in_etcd INTEGER NOT NULL DEFAULT 1,
+    payload TEXT NOT NULL DEFAULT ''
+);
+CREATE INDEX IF NOT EXISTS idx_job_ns_name ON job_info(namespace, name);
+CREATE TABLE IF NOT EXISTS replica_info (
+    uid TEXT PRIMARY KEY,
+    name TEXT NOT NULL,
+    namespace TEXT NOT NULL,
+    job_uid TEXT NOT NULL DEFAULT '',
+    job_name TEXT NOT NULL DEFAULT '',
+    replica_type TEXT NOT NULL DEFAULT '',
+    replica_index INTEGER NOT NULL DEFAULT 0,
+    phase TEXT NOT NULL DEFAULT '',
+    node TEXT NOT NULL DEFAULT '',
+    pod_ip TEXT NOT NULL DEFAULT '',
+    host_ip TEXT NOT NULL DEFAULT '',
+    exit_code INTEGER,
+    reason TEXT NOT NULL DEFAULT '',
+    created_at REAL NOT NULL DEFAULT 0,
+    started_at REAL,
+    finished_at REAL,
+    deleted INTEGER NOT NULL DEFAULT 0,
+    is_in_etcd INTEGER NOT NULL DEFAULT 1
+);
+CREATE INDEX IF NOT EXISTS idx_replica_job ON replica_info(job_uid);
+CREATE TABLE IF NOT EXISTS event_info (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT NOT NULL,
+    namespace TEXT NOT NULL,
+    involved_kind TEXT NOT NULL DEFAULT '',
+    involved_name TEXT NOT NULL DEFAULT '',
+    type TEXT NOT NULL DEFAULT 'Normal',
+    reason TEXT NOT NULL DEFAULT '',
+    message TEXT NOT NULL DEFAULT '',
+    count INTEGER NOT NULL DEFAULT 1,
+    first_timestamp REAL NOT NULL DEFAULT 0,
+    last_timestamp REAL NOT NULL DEFAULT 0,
+    region TEXT NOT NULL DEFAULT '',
+    UNIQUE(namespace, name)
+);
+"""
+
+_JOB_COLS = (
+    "uid,name,namespace,kind,phase,created_at,started_at,finished_at,"
+    "tenant,owner,region,deleted,is_in_etcd,payload"
+)
+_REPLICA_COLS = (
+    "uid,name,namespace,job_uid,job_name,replica_type,replica_index,phase,"
+    "node,pod_ip,host_ip,exit_code,reason,created_at,started_at,finished_at,"
+    "deleted,is_in_etcd"
+)
+
+
+class SQLiteBackend(ObjectStorageBackend, EventStorageBackend):
+    def __init__(self, path: str = ":memory:") -> None:
+        self._path = path
+        self._lock = threading.RLock()
+        self._conn: Optional[sqlite3.Connection] = None
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def initialize(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                return
+            self._conn = sqlite3.connect(self._path, check_same_thread=False)
+            self._conn.row_factory = sqlite3.Row
+            if self._path != ":memory:":
+                self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+
+    def name(self) -> str:
+        return "sqlite"
+
+    def _db(self) -> sqlite3.Connection:
+        if self._conn is None:
+            self.initialize()
+        assert self._conn is not None
+        return self._conn
+
+    # ---- jobs (reference: mysql.go SaveJob/GetJob/ListJobs) --------------
+
+    def save_job(self, job: JobInfo) -> None:
+        with self._lock:
+            self._db().execute(
+                f"INSERT INTO job_info ({_JOB_COLS}) VALUES "
+                "(?,?,?,?,?,?,?,?,?,?,?,?,?,?) "
+                "ON CONFLICT(uid) DO UPDATE SET "
+                "phase=excluded.phase, started_at=excluded.started_at, "
+                "finished_at=excluded.finished_at, payload=excluded.payload, "
+                "deleted=excluded.deleted, is_in_etcd=excluded.is_in_etcd",
+                (
+                    job.uid, job.name, job.namespace, job.kind, job.phase,
+                    job.created_at, job.started_at, job.finished_at,
+                    job.tenant, job.owner, job.region,
+                    int(job.deleted), int(job.is_in_etcd), job.payload,
+                ),
+            )
+            self._db().commit()
+
+    def get_job(self, namespace: str, name: str, kind: str = "") -> Optional[JobInfo]:
+        sql = f"SELECT {_JOB_COLS} FROM job_info WHERE namespace=? AND name=?"
+        args: List = [namespace, name]
+        if kind:
+            sql += " AND kind=?"
+            args.append(kind)
+        sql += " ORDER BY created_at DESC LIMIT 1"
+        with self._lock:
+            row = self._db().execute(sql, args).fetchone()
+        return self._job_from_row(row) if row else None
+
+    def list_jobs(self, query: Query) -> List[JobInfo]:
+        sql = f"SELECT {_JOB_COLS} FROM job_info WHERE 1=1"
+        args: List = []
+        if query.name:
+            sql += " AND name LIKE ?"
+            args.append(f"%{query.name}%")
+        if query.namespace:
+            sql += " AND namespace=?"
+            args.append(query.namespace)
+        if query.kind:
+            sql += " AND kind=?"
+            args.append(query.kind)
+        if query.phase:
+            sql += " AND phase=?"
+            args.append(query.phase)
+        if query.start_time is not None:
+            sql += " AND created_at>=?"
+            args.append(query.start_time)
+        if query.end_time is not None:
+            sql += " AND created_at<=?"
+            args.append(query.end_time)
+        if not query.include_deleted:
+            sql += " AND deleted=0"
+        sql += " ORDER BY created_at DESC"
+        if query.limit:
+            sql += " LIMIT ? OFFSET ?"
+            args += [query.limit, query.offset]
+        with self._lock:
+            rows = self._db().execute(sql, args).fetchall()
+        return [self._job_from_row(r) for r in rows]
+
+    def mark_job_deleted(self, namespace: str, name: str, kind: str = "") -> None:
+        sql = "UPDATE job_info SET deleted=1, is_in_etcd=0 WHERE namespace=? AND name=?"
+        args: List = [namespace, name]
+        if kind:
+            sql += " AND kind=?"
+            args.append(kind)
+        with self._lock:
+            self._db().execute(sql, args)
+            self._db().commit()
+
+    def remove_job_record(self, namespace: str, name: str, kind: str = "") -> None:
+        sql = "DELETE FROM job_info WHERE namespace=? AND name=?"
+        args: List = [namespace, name]
+        if kind:
+            sql += " AND kind=?"
+            args.append(kind)
+        with self._lock:
+            self._db().execute(sql, args)
+            self._db().commit()
+
+    @staticmethod
+    def _job_from_row(row: sqlite3.Row) -> JobInfo:
+        return JobInfo(
+            uid=row["uid"], name=row["name"], namespace=row["namespace"],
+            kind=row["kind"], phase=row["phase"], created_at=row["created_at"],
+            started_at=row["started_at"], finished_at=row["finished_at"],
+            tenant=row["tenant"], owner=row["owner"], region=row["region"],
+            deleted=bool(row["deleted"]), is_in_etcd=bool(row["is_in_etcd"]),
+            payload=row["payload"],
+        )
+
+    # ---- pods (reference: mysql.go SavePod/ListPods/StopPod) -------------
+
+    def save_pod(self, pod: ReplicaInfo) -> None:
+        with self._lock:
+            self._db().execute(
+                f"INSERT INTO replica_info ({_REPLICA_COLS}) VALUES "
+                "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?) "
+                "ON CONFLICT(uid) DO UPDATE SET "
+                "phase=excluded.phase, node=excluded.node, "
+                "pod_ip=excluded.pod_ip, host_ip=excluded.host_ip, "
+                "exit_code=excluded.exit_code, reason=excluded.reason, "
+                "started_at=excluded.started_at, "
+                "finished_at=excluded.finished_at, "
+                "deleted=excluded.deleted, is_in_etcd=excluded.is_in_etcd",
+                (
+                    pod.uid, pod.name, pod.namespace, pod.job_uid, pod.job_name,
+                    pod.replica_type, pod.replica_index, pod.phase, pod.node,
+                    pod.pod_ip, pod.host_ip, pod.exit_code, pod.reason,
+                    pod.created_at, pod.started_at, pod.finished_at,
+                    int(pod.deleted), int(pod.is_in_etcd),
+                ),
+            )
+            self._db().commit()
+
+    def list_pods(self, job_uid: str) -> List[ReplicaInfo]:
+        with self._lock:
+            rows = self._db().execute(
+                f"SELECT {_REPLICA_COLS} FROM replica_info WHERE job_uid=? "
+                "ORDER BY replica_type, replica_index",
+                (job_uid,),
+            ).fetchall()
+        return [
+            ReplicaInfo(
+                uid=r["uid"], name=r["name"], namespace=r["namespace"],
+                job_uid=r["job_uid"], job_name=r["job_name"],
+                replica_type=r["replica_type"], replica_index=r["replica_index"],
+                phase=r["phase"], node=r["node"], pod_ip=r["pod_ip"],
+                host_ip=r["host_ip"], exit_code=r["exit_code"],
+                reason=r["reason"], created_at=r["created_at"],
+                started_at=r["started_at"], finished_at=r["finished_at"],
+                deleted=bool(r["deleted"]), is_in_etcd=bool(r["is_in_etcd"]),
+            )
+            for r in rows
+        ]
+
+    def mark_pod_deleted(self, namespace: str, name: str) -> None:
+        with self._lock:
+            self._db().execute(
+                "UPDATE replica_info SET deleted=1, is_in_etcd=0 "
+                "WHERE namespace=? AND name=?",
+                (namespace, name),
+            )
+            self._db().commit()
+
+    # ---- events (reference: mysql.go SaveEvent/ListEvent) ----------------
+
+    def save_event(self, ev: EventInfo) -> None:
+        with self._lock:
+            self._db().execute(
+                "INSERT INTO event_info (name,namespace,involved_kind,"
+                "involved_name,type,reason,message,count,first_timestamp,"
+                "last_timestamp,region) VALUES (?,?,?,?,?,?,?,?,?,?,?) "
+                "ON CONFLICT(namespace, name) DO UPDATE SET "
+                "message=excluded.message, count=excluded.count, "
+                "last_timestamp=excluded.last_timestamp",
+                (
+                    ev.name, ev.namespace, ev.involved_kind, ev.involved_name,
+                    ev.type, ev.reason, ev.message, ev.count,
+                    ev.first_timestamp, ev.last_timestamp, ev.region,
+                ),
+            )
+            self._db().commit()
+
+    def list_events(
+        self, involved_kind: str, involved_name: str, namespace: str = ""
+    ) -> List[EventInfo]:
+        sql = (
+            "SELECT name,namespace,involved_kind,involved_name,type,reason,"
+            "message,count,first_timestamp,last_timestamp,region "
+            "FROM event_info WHERE 1=1"
+        )
+        args: List = []
+        if involved_kind:
+            sql += " AND involved_kind=?"
+            args.append(involved_kind)
+        if involved_name:
+            sql += " AND involved_name=?"
+            args.append(involved_name)
+        if namespace:
+            sql += " AND namespace=?"
+            args.append(namespace)
+        sql += " ORDER BY last_timestamp"
+        with self._lock:
+            rows = self._db().execute(sql, args).fetchall()
+        return [
+            EventInfo(
+                name=r["name"], namespace=r["namespace"],
+                involved_kind=r["involved_kind"], involved_name=r["involved_name"],
+                type=r["type"], reason=r["reason"], message=r["message"],
+                count=r["count"], first_timestamp=r["first_timestamp"],
+                last_timestamp=r["last_timestamp"], region=r["region"],
+            )
+            for r in rows
+        ]

@@ -1,0 +1,22 @@
+"""Pod runtime: the kubelet/executor analogue.
+
+The reference hands pods to Kubernetes (api-server -> kubelet -> container).
+Here a :class:`~kubedl_tpu.runtime.executor.Kubelet` controller watches bound
+pods and realizes them through a pluggable ContainerRuntime:
+
+- :class:`SubprocessRuntime` — argv containers as real OS processes (the
+  production path on a TPU host: one process per host, `jax.distributed`
+  inside).
+- :class:`ThreadRuntime` — `entrypoint` callables ("pkg.mod:fn") in threads;
+  the fast path for tests and single-host jobs (no interpreter spawn, shares
+  the TPU client).
+- :class:`FakeRuntime` — manual phase transitions for engine unit tests
+  (the reference's fake-client trick, SURVEY.md §4).
+"""
+
+from kubedl_tpu.runtime.executor import (  # noqa: F401
+    FakeRuntime,
+    Kubelet,
+    SubprocessRuntime,
+    ThreadRuntime,
+)

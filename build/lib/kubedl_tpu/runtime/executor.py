@@ -1,0 +1,375 @@
+"""Kubelet: watches pods, runs containers, reports phases back to the store."""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Dict, Optional
+
+from kubedl_tpu.core.manager import ControllerManager
+from kubedl_tpu.core.objects import BaseObject, ContainerStatus, Pod, PodPhase
+from kubedl_tpu.core.store import NotFound, ObjectStore
+
+log = logging.getLogger("kubedl_tpu.runtime")
+
+
+class ProcHandle:
+    """One running container; wait() returns the exit code."""
+
+    def wait(self) -> int:
+        raise NotImplementedError
+
+    def kill(self) -> None:
+        raise NotImplementedError
+
+
+class ContainerRuntime:
+    def start(self, pod: Pod, env: Dict[str, str]) -> ProcHandle:
+        raise NotImplementedError
+
+
+class _SubprocHandle(ProcHandle):
+    def __init__(self, proc: subprocess.Popen) -> None:
+        self.proc = proc
+
+    def wait(self) -> int:
+        return self.proc.wait()
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+class SubprocessRuntime(ContainerRuntime):
+    """Run the main container's argv as a real OS process. `python` in the
+    argv resolves to the current interpreter so env (JAX flags) carries."""
+
+    def __init__(self, log_dir: str = "") -> None:
+        self.log_dir = log_dir
+
+    def start(self, pod: Pod, env: Dict[str, str]) -> ProcHandle:
+        main = pod.spec.main_container()
+        argv = list(main.command)
+        if not argv:
+            raise ValueError(f"pod {pod.metadata.name}: empty command")
+        if argv[0] == "python":
+            argv[0] = sys.executable
+        full_env = {**os.environ, **env}
+        stdout = None
+        if self.log_dir:
+            # namespaced: same-named pods in different namespaces must not
+            # share (or leak) a log file
+            ns_dir = os.path.join(self.log_dir, pod.metadata.namespace)
+            os.makedirs(ns_dir, exist_ok=True)
+            stdout = open(  # noqa: SIM115 - handle outlives this scope
+                os.path.join(ns_dir, f"{pod.metadata.name}.log"), "ab"
+            )
+        proc = subprocess.Popen(
+            argv,
+            env=full_env,
+            cwd=main.working_dir or None,
+            stdout=stdout,
+            stderr=subprocess.STDOUT if stdout else None,
+        )
+        return _SubprocHandle(proc)
+
+
+#: env key under which ThreadRuntime passes the cancellation Event object
+#: (entrypoints poll `env.get(CANCEL_EVENT_KEY)` between steps; cooperative
+#: — threads can't be killed)
+CANCEL_EVENT_KEY = "_KUBEDL_CANCEL"
+
+
+class _ThreadHandle(ProcHandle):
+    def __init__(self, fn: Callable[[Dict[str, str]], object], env: Dict[str, str]) -> None:
+        self._exit = 0
+        self._done = threading.Event()
+        self._cancel = threading.Event()
+        env = dict(env)
+        env[CANCEL_EVENT_KEY] = self._cancel  # type: ignore[assignment]
+
+        def run() -> None:
+            try:
+                rc = fn(env)
+                self._exit = int(rc) if isinstance(rc, int) else 0
+            except SystemExit as e:
+                # sys.exit(None)=0, sys.exit(int)=int, sys.exit(str)=failure
+                if e.code is None:
+                    self._exit = 0
+                elif isinstance(e.code, int):
+                    self._exit = e.code
+                else:
+                    log.error("entrypoint exited with message: %s", e.code)
+                    self._exit = 1
+            except Exception:
+                log.error("entrypoint raised:\n%s", traceback.format_exc())
+                self._exit = 1
+            finally:
+                self._done.set()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def wait(self) -> int:
+        self._done.wait()
+        return self._exit
+
+    def kill(self) -> None:
+        # threads are not killable; entrypoints poll env[CANCEL_EVENT_KEY]
+        self._cancel.set()
+
+
+class ThreadRuntime(ContainerRuntime):
+    """Resolve `container.entrypoint` ("pkg.mod:fn") and call fn(env) in a
+    thread. fn returns an int exit code (or None == 0)."""
+
+    def start(self, pod: Pod, env: Dict[str, str]) -> ProcHandle:
+        main = pod.spec.main_container()
+        if not main.entrypoint:
+            raise ValueError(f"pod {pod.metadata.name}: no entrypoint for ThreadRuntime")
+        mod_name, _, fn_name = main.entrypoint.partition(":")
+        fn = getattr(importlib.import_module(mod_name), fn_name)
+        return ThreadRuntime.spawn(fn, env)
+
+    @staticmethod
+    def spawn(fn: Callable, env: Dict[str, str]) -> ProcHandle:
+        return _ThreadHandle(fn, env)
+
+
+class FakeRuntime(ContainerRuntime):
+    """Containers never actually run; tests drive phases via Kubelet-free
+    store updates (see tests/helpers.py)."""
+
+    def start(self, pod: Pod, env: Dict[str, str]) -> ProcHandle:  # pragma: no cover
+        raise RuntimeError("FakeRuntime pods are driven manually by tests")
+
+
+class Kubelet:
+    """Realizes Pending pods and reports their lifecycle.
+
+    One Kubelet instance typically serves ALL simulated nodes on this
+    machine (locally it plays every TPU host); pass `nodes` to restrict it
+    to a subset for multi-agent setups.
+    """
+
+    NAME = "kubelet"
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        runtime: ContainerRuntime,
+        nodes: Optional[set] = None,
+        pod_ip: str = "127.0.0.1",
+    ) -> None:
+        self.store = store
+        self.runtime = runtime
+        self.nodes = nodes
+        self.pod_ip = pod_ip
+        self._lock = threading.Lock()
+        self._running: Dict[str, ProcHandle] = {}
+        #: (ns, pod, volume) -> (pod uid, ConfigMap resource version) last
+        #: materialized; cleared when the pod is deleted
+        self._materialized: Dict[tuple, tuple] = {}
+
+    def setup(self, manager: ControllerManager) -> None:
+        def mapper(event: str, obj: BaseObject, old):
+            if obj.kind == "ConfigMap":
+                # re-sync mounted ConfigMap volumes of running pods (real
+                # kubelet semantics; e.g. MPI hostfile refresh on scale)
+                keys = []
+                for pod in self.store.list("Pod", obj.metadata.namespace):
+                    if any(
+                        v.config_map == obj.metadata.name
+                        for v in pod.spec.volumes  # type: ignore[union-attr]
+                    ):
+                        keys.append((pod.metadata.namespace, pod.metadata.name))
+                return keys
+            return [(obj.metadata.namespace, obj.metadata.name)]
+
+        manager.register(
+            self.NAME,
+            self.reconcile,
+            watch_kinds=["Pod", "ConfigMap"],
+            mapper=mapper,
+            workers=4,
+        )
+
+    # ------------------------------------------------------------------
+
+    def _served(self, pod: Pod) -> bool:
+        return self.nodes is None or pod.spec.node_name in self.nodes
+
+    @staticmethod
+    def _pod_env(pod: Pod) -> Dict[str, str]:
+        env: Dict[str, str] = {}
+        for c in pod.spec.init_containers + pod.spec.containers:
+            for e in c.env:
+                env[e.name] = e.value
+        env["KUBEDL_POD_NAME"] = pod.metadata.name
+        env["KUBEDL_POD_NAMESPACE"] = pod.metadata.namespace
+        env["KUBEDL_NODE_NAME"] = pod.spec.node_name
+        return env
+
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        key = f"{namespace}/{name}"
+        pod = self.store.try_get("Pod", name, namespace)
+        if pod is None:
+            with self._lock:
+                handle = self._running.pop(key, None)
+                for sk in [k for k in self._materialized
+                           if (k[0], k[1]) == (namespace, name)]:
+                    del self._materialized[sk]
+            if handle is not None:
+                handle.kill()
+            return None
+        assert isinstance(pod, Pod)
+        if not self._served(pod) or pod.is_terminal():
+            return None
+        with self._lock:
+            already_running = key in self._running
+            if not already_running:
+                if pod.status.phase != PodPhase.PENDING:
+                    return None
+                # reserve the slot before leaving the lock
+                self._running[key] = _PlaceholderHandle()
+        if already_running:
+            # keep mounted ConfigMap volumes fresh (outside self._lock —
+            # materialization takes it internally)
+            try:
+                self._materialize_config_volumes(pod)
+            except RuntimeError:
+                pass  # ConfigMap deleted mid-run; keep last snapshot
+            return None
+        try:
+            self._launch(pod, key)
+        except Exception as e:
+            log.error("launch %s failed: %s", key, e)
+            with self._lock:
+                self._running.pop(key, None)
+            self._set_phase(pod, PodPhase.FAILED, reason=f"LaunchError: {e}", exit_code=1)
+        return None
+
+    def _launch(self, pod: Pod, key: str) -> None:
+        env = self._pod_env(pod)
+        self._materialize_config_volumes(pod)
+        # init containers run to completion first (code-sync etc.)
+        for init in pod.spec.init_containers:
+            if init.command:
+                rc = subprocess.call(init.command, env={**os.environ, **env})
+                if rc != 0:
+                    raise RuntimeError(f"init container {init.name} exited {rc}")
+        handle = self.runtime.start(pod, env)
+        with self._lock:
+            self._running[key] = handle
+        self._set_phase(pod, PodPhase.RUNNING)
+
+        def reap() -> None:
+            code = handle.wait()
+            with self._lock:
+                self._running.pop(key, None)
+            phase = PodPhase.SUCCEEDED if code == 0 else PodPhase.FAILED
+            self._set_phase(pod, phase, exit_code=code)
+            # a same-name replacement pod may have been created while this
+            # process was dying (gang restart) — give it a launch pass now
+            # that the _running slot is free
+            self.reconcile(pod.metadata.namespace, pod.metadata.name)
+
+        threading.Thread(target=reap, daemon=True, name=f"reap-{key}").start()
+
+    def _materialize_config_volumes(self, pod: Pod) -> None:
+        """Write ConfigMap-backed volumes to their mount path (the kubelet
+        side of the reference's ConfigMap volume mounts). Files are swapped
+        in atomically (write-then-rename, the real kubelet's symlink-swap
+        equivalent) so a running process never reads a torn hostfile, and
+        unchanged ConfigMap versions are skipped."""
+        from kubedl_tpu.core.objects import ConfigMap, config_mount_path
+
+        for vol in pod.spec.volumes:
+            if not vol.config_map:
+                continue
+            cm = self.store.try_get(
+                "ConfigMap", vol.config_map, pod.metadata.namespace
+            )
+            if not isinstance(cm, ConfigMap):
+                raise RuntimeError(f"ConfigMap {vol.config_map} not found")
+            sync_key = (pod.metadata.namespace, pod.metadata.name, vol.name)
+            stamp = (pod.metadata.uid, cm.metadata.resource_version)
+            with self._lock:
+                if self._materialized.get(sync_key) == stamp:
+                    continue
+            root = vol.mount_path or config_mount_path(
+                pod.metadata.namespace, pod.metadata.name, vol.name
+            )
+            os.makedirs(root, exist_ok=True)
+            for fname, content in cm.data.items():
+                path = os.path.join(root, fname)
+                # per-thread tmp name: concurrent materializers must never
+                # interleave writes into the same tmp file
+                tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+                with open(tmp, "w") as f:
+                    f.write(content)
+                if content.startswith("#!"):
+                    os.chmod(tmp, 0o755)
+                os.replace(tmp, path)
+            with self._lock:
+                self._materialized[sync_key] = stamp
+
+    class _StalePod(Exception):
+        pass
+
+    def _set_phase(
+        self,
+        pod: Pod,
+        phase: PodPhase,
+        reason: str = "",
+        exit_code: Optional[int] = None,
+    ) -> None:
+        def mutate(obj: Pod) -> None:  # type: ignore[type-arg]
+            if obj.metadata.uid != pod.metadata.uid:
+                # same-name pod recreated after a gang restart: the old
+                # process's lifecycle must not stamp the fresh pod
+                raise Kubelet._StalePod()
+            obj.status.phase = phase
+            obj.status.pod_ip = self.pod_ip
+            obj.status.host_ip = self.pod_ip
+            if reason:
+                obj.status.reason = reason
+            if phase == PodPhase.RUNNING and obj.status.start_time is None:
+                obj.status.start_time = time.time()
+            if phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                obj.status.finish_time = time.time()
+                obj.status.container_statuses = [
+                    ContainerStatus(exit_code=exit_code if exit_code is not None else 0)
+                ]
+
+        try:
+            self.store.update_with_retry(
+                "Pod", pod.metadata.name, pod.metadata.namespace, mutate
+            )
+        except (NotFound, Kubelet._StalePod):
+            pass
+
+    def shutdown(self) -> None:
+        with self._lock:
+            handles = list(self._running.values())
+            self._running.clear()
+        for h in handles:
+            h.kill()
+
+
+class _PlaceholderHandle(ProcHandle):
+    def wait(self) -> int:  # pragma: no cover
+        return 0
+
+    def kill(self) -> None:
+        pass

@@ -1,0 +1,12 @@
+"""Inference serving: multi-predictor canary deployments over ModelVersions.
+
+Reference: controllers/serving/ + apis/serving/v1alpha1 (SURVEY.md §2.3
+Inference row): an Inference object fans out into per-predictor deployments
+gated on the predictor's model artifact being built, fronted by one entry
+service with weighted canary traffic across predictors (the reference uses
+an Istio VirtualService; here a TrafficPolicy object consumed by the
+router/console).
+"""
+
+from kubedl_tpu.serving.controller import InferenceController  # noqa: F401
+from kubedl_tpu.serving.types import Inference, Predictor, TrafficPolicy  # noqa: F401

@@ -1,0 +1,429 @@
+"""Inference controller: predictor deployments + canary traffic.
+
+Reference: controllers/serving/inference_controller.go — reconcile flow:
+entry Service (:279-336) -> per-predictor Deployment gated on the model
+image being built (:149-204, predictor.go:37-115) -> weighted VirtualService
+across predictors (:206-274). Here "Deployment" is a replicated pod set the
+controller levels itself (the engine's diff-by-index pattern, scoped to
+predictors), and the VirtualService is a TrafficPolicy object.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.core.manager import ControllerManager, EventRecorder
+from kubedl_tpu.core.objects import (
+    BaseObject,
+    OwnerRef,
+    Pod,
+    PodPhase,
+    Port,
+    Service,
+)
+from kubedl_tpu.core.store import AlreadyExists, NotFound, ObjectStore
+from kubedl_tpu.lineage.types import Model, ModelVersion, ModelVersionPhase
+from kubedl_tpu.serving.framework import apply_setter
+from kubedl_tpu.serving.types import (
+    Inference,
+    Predictor,
+    PredictorStatus,
+    TrafficPolicy,
+    TrafficRoute,
+)
+
+log = logging.getLogger("kubedl_tpu.serving")
+
+LABEL_INFERENCE = constants.API_GROUP + "/inference-name"
+LABEL_PREDICTOR = constants.API_GROUP + "/predictor-name"
+
+#: entry service ports (reference: :279-336 — 8080 http / 9000 grpc)
+HTTP_PORT = 8080
+GRPC_PORT = 9000
+
+
+def http_qps_probe(port: int = 8080, timeout: float = 2.0):
+    """Default QPS probe for real deployments: GET the engine's /v1/stats
+    on the pod's IP (falls back to loopback for process pods)."""
+    import json as _json
+    import urllib.request
+
+    def probe(pod) -> Optional[float]:
+        host = getattr(pod.status, "pod_ip", "") or "127.0.0.1"
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/v1/stats", timeout=timeout
+        ) as r:
+            return float(_json.loads(r.read()).get("qps", 0.0))
+
+    return probe
+
+
+class InferenceController:
+    NAME = "inference-controller"
+
+    #: seconds between autoscale changes for one predictor (flap damping)
+    AUTOSCALE_COOLDOWN = 30.0
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        recorder: Optional[EventRecorder] = None,
+        local_addresses: bool = False,
+        cluster_domain: str = "",
+        qps_probe=None,
+        clock=None,
+        compile_cache_dir: str = "",
+    ) -> None:
+        self.store = store
+        self.recorder = recorder or EventRecorder(store)
+        self.local_addresses = local_addresses
+        self.cluster_domain = cluster_domain
+        #: injected into predictor pods so replica scale-ups / restarts
+        #: deserialize the decode/prefill programs instead of recompiling
+        self.compile_cache_dir = compile_cache_dir
+        #: qps_probe(pod) -> Optional[float]: live QPS of one predictor
+        #: replica (the /v1/stats "qps" field). Transport is
+        #: deployment-specific, so it's injected; None disables
+        #: target_qps-driven scaling (min/max clamping still applies).
+        self.qps_probe = qps_probe
+        import time as _time
+
+        self.clock = clock or _time.time
+        self._last_scale: Dict[tuple, float] = {}
+
+    def setup(self, manager: ControllerManager) -> None:
+        manager.register(
+            self.NAME,
+            self.reconcile,
+            watch_kinds=["Inference", "Pod", "Service", "ModelVersion"],
+            mapper=self._mapper,
+        )
+
+    def _mapper(self, event: str, obj: BaseObject, old):
+        if obj.kind == "Inference":
+            return [(obj.metadata.namespace, obj.metadata.name)]
+        if obj.kind in ("Pod", "Service"):
+            name = obj.metadata.labels.get(LABEL_INFERENCE)
+            return [(obj.metadata.namespace, name)] if name else []
+        if obj.kind == "ModelVersion":
+            # an artifact finishing its build may unblock predictors
+            return [
+                (inf.metadata.namespace, inf.metadata.name)
+                for inf in self.store.list("Inference", obj.metadata.namespace)
+            ]
+        return []
+
+    # ------------------------------------------------------------------
+
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        inf = self.store.try_get("Inference", name, namespace)
+        if inf is None:
+            for key in [k for k in self._last_scale
+                        if k[0] == namespace and k[1] == name]:
+                self._last_scale.pop(key, None)
+            return None
+        assert isinstance(inf, Inference)
+
+        self._sync_entry_service(inf)
+        pods = self._pods_of(inf)
+        statuses: Dict[str, PredictorStatus] = {}
+        ready_weights: Dict[str, int] = {}
+        for pred in inf.predictors:
+            status = self._sync_predictor(inf, pred, pods)
+            statuses[pred.name] = status
+            if status.ready_replicas > 0:
+                ready_weights[pred.name] = max(pred.traffic_weight, 0)
+        self._gc_removed_predictors(inf, pods)
+        self._sync_traffic(inf, ready_weights)
+        self._update_status(inf, statuses)
+        if self.qps_probe is not None and any(
+            p.autoscale is not None and p.autoscale.target_qps
+            for p in inf.predictors
+        ):
+            return 10.0  # autoscale needs a periodic signal sweep
+        return None
+
+    # ---------------------------------------------------------- services
+
+    def _entry_host(self, inf: Inference) -> str:
+        if self.local_addresses:
+            return "127.0.0.1"
+        base = f"{inf.metadata.name}.{inf.metadata.namespace}.svc"
+        return f"{base}.{self.cluster_domain}" if self.cluster_domain else base
+
+    def _sync_entry_service(self, inf: Inference) -> None:
+        """Entry service fronting every predictor (reference :279-336)."""
+        existing = self.store.try_get(
+            "Service", inf.metadata.name, inf.metadata.namespace
+        )
+        if existing is not None:
+            return
+        svc = Service()
+        svc.metadata.name = inf.metadata.name
+        svc.metadata.namespace = inf.metadata.namespace
+        svc.metadata.labels = {LABEL_INFERENCE: inf.metadata.name}
+        svc.metadata.owner_refs.append(self._owner(inf))
+        svc.spec.selector = {LABEL_INFERENCE: inf.metadata.name}
+        svc.spec.ports = [Port("http", HTTP_PORT), Port("grpc", GRPC_PORT)]
+        try:
+            self.store.create(svc)
+        except AlreadyExists:
+            pass
+
+    # --------------------------------------------------------- predictors
+
+    def _resolve_model_version(
+        self, inf: Inference, pred: Predictor
+    ) -> Optional[ModelVersion]:
+        ns = inf.metadata.namespace
+        if pred.model_version:
+            mv = self.store.try_get("ModelVersion", pred.model_version, ns)
+            return mv if isinstance(mv, ModelVersion) else None
+        if pred.model_name:
+            model = self.store.try_get("Model", pred.model_name, ns)
+            if isinstance(model, Model) and model.latest_version:
+                mv = self.store.try_get("ModelVersion", model.latest_version, ns)
+                return mv if isinstance(mv, ModelVersion) else None
+        return None
+
+    def _sync_predictor(
+        self, inf: Inference, pred: Predictor, pods: List[Pod]
+    ) -> PredictorStatus:
+        """One predictor = a leveled replica set, gated on the artifact
+        being built (reference :149-204)."""
+        mv = self._resolve_model_version(inf, pred)
+        if mv is None:
+            return PredictorStatus(message="model version not found")
+        if mv.phase != ModelVersionPhase.SUCCEEDED:
+            # reference: predictor deployment waits for the image build
+            return PredictorStatus(
+                message=f"waiting for artifact build ({mv.phase.value})"
+            )
+
+        self._sync_predictor_service(inf, pred)
+        replicas = self._desired_replicas(inf, pred, pods)
+        mine = [
+            p for p in pods
+            if p.metadata.labels.get(LABEL_PREDICTOR) == pred.name
+        ]
+        have = {
+            int(p.metadata.labels.get(constants.LABEL_REPLICA_INDEX, "-1")): p
+            for p in mine
+        }
+        for i in range(replicas):
+            if i in have:
+                continue
+            pod = self._new_predictor_pod(inf, pred, mv, i)
+            try:
+                self.store.create(pod)
+            except AlreadyExists:
+                pass
+        for i, p in have.items():
+            if i >= replicas:
+                self.store.try_delete("Pod", p.metadata.name, p.metadata.namespace)
+        ready = sum(1 for p in mine if p.status.phase == PodPhase.RUNNING)
+        return PredictorStatus(
+            replicas=replicas, ready_replicas=ready, image=mv.image
+        )
+
+    def _desired_replicas(self, inf: Inference, pred: Predictor,
+                          pods: List[Pod]) -> int:
+        """Replica target: spec count, clamped to the autoscale window, and
+        — when a QPS probe is wired and target_qps is set — driven by the
+        live load (ceil(total_qps / target_qps)) with a scale-down
+        cooldown. The reference only STUBS autoScale in its API
+        (inference_types.go:96-104); here it closes the loop."""
+        import math
+
+        a = pred.autoscale
+        if a is None:
+            return pred.replicas
+        clamped = min(max(pred.replicas, a.min_replicas), a.max_replicas)
+        if self.qps_probe is None or not a.target_qps:
+            return clamped
+        mine_running = [
+            p for p in pods
+            if p.metadata.labels.get(LABEL_PREDICTOR) == pred.name
+            and p.status.phase == PodPhase.RUNNING
+        ]
+        prev = inf.predictor_statuses.get(pred.name)
+        current = prev.replicas if prev is not None and prev.replicas else clamped
+        if not mine_running:
+            return current
+        # probe all replicas CONCURRENTLY (reconcile shares a worker pool
+        # with every other controller; sequential 2s timeouts would starve
+        # it) and keep failures distinct from zero load
+        from concurrent.futures import ThreadPoolExecutor
+
+        def safe_probe(p):
+            try:
+                v = self.qps_probe(p)
+                return float(v) if v is not None else None
+            except Exception:
+                return None
+
+        with ThreadPoolExecutor(max_workers=min(8, len(mine_running))) as ex:
+            readings = list(ex.map(safe_probe, mine_running))
+        healthy = [v for v in readings if v is not None]
+        if not healthy:
+            return current  # no signal: never act blind
+        qps = sum(healthy)
+        desired = max(1, math.ceil(qps / a.target_qps))
+        desired = min(max(desired, a.min_replicas), a.max_replicas)
+        key = (inf.metadata.namespace, inf.metadata.name, pred.name)
+        now = self.clock()
+        if desired == current:
+            return current
+        if desired < current and len(healthy) < len(readings):
+            # HPA rule: missing metrics never justify a scale-DOWN — an
+            # overloaded replica that can't answer its probe is the worst
+            # moment to delete capacity
+            return current
+        if desired < current and (
+            now - self._last_scale.get(key, 0.0) < self.AUTOSCALE_COOLDOWN
+        ):
+            return current  # damp scale-down flapping
+        self._last_scale[key] = now
+        self.recorder.event(
+            inf, "Normal", "Autoscaled",
+            f"predictor {pred.name}: {current} -> {desired} replicas "
+            f"(qps {qps:.2f}, target {a.target_qps})",
+        )
+        return desired
+
+    def _new_predictor_pod(
+        self, inf: Inference, pred: Predictor, mv: ModelVersion, index: int
+    ) -> Pod:
+        template = pred.template.deep_copy()
+        pod = Pod(spec=template.spec)
+        pod.metadata.name = f"{inf.metadata.name}-{pred.name}-{index}"
+        pod.metadata.namespace = inf.metadata.namespace
+        pod.metadata.labels = {
+            **template.labels,
+            LABEL_INFERENCE: inf.metadata.name,
+            LABEL_PREDICTOR: pred.name,
+            constants.LABEL_REPLICA_INDEX: str(index),
+        }
+        pod.metadata.owner_refs.append(self._owner(inf))
+        apply_setter(inf, pred, pod, mv, HTTP_PORT)
+        if self.compile_cache_dir:
+            main = pod.spec.main_container()
+            if main.get_env(constants.ENV_COMPILE_CACHE_DIR) is None:
+                main.set_env(
+                    constants.ENV_COMPILE_CACHE_DIR, self.compile_cache_dir
+                )
+        return pod
+
+    def _sync_predictor_service(self, inf: Inference, pred: Predictor) -> None:
+        """Per-predictor backing service — the canary routes' targets
+        (reference: predictor.go:37-115 Deployment+Service per predictor;
+        the entry service alone cannot enforce a weighted split)."""
+        name = f"{inf.metadata.name}-{pred.name}"
+        if self.store.try_get("Service", name, inf.metadata.namespace) is not None:
+            return
+        svc = Service()
+        svc.metadata.name = name
+        svc.metadata.namespace = inf.metadata.namespace
+        svc.metadata.labels = {
+            LABEL_INFERENCE: inf.metadata.name,
+            LABEL_PREDICTOR: pred.name,
+        }
+        svc.metadata.owner_refs.append(self._owner(inf))
+        svc.spec.selector = {
+            LABEL_INFERENCE: inf.metadata.name,
+            LABEL_PREDICTOR: pred.name,
+        }
+        svc.spec.ports = [Port("http", HTTP_PORT)]
+        try:
+            self.store.create(svc)
+        except AlreadyExists:
+            pass
+
+    def _gc_removed_predictors(self, inf: Inference, pods: List[Pod]) -> None:
+        names = {p.name for p in inf.predictors}
+        for key in [k for k in self._last_scale
+                    if k[0] == inf.metadata.namespace
+                    and k[1] == inf.metadata.name and k[2] not in names]:
+            self._last_scale.pop(key, None)
+        for pod in pods:
+            pname = pod.metadata.labels.get(LABEL_PREDICTOR, "")
+            if pname and pname not in names:
+                self.store.try_delete("Pod", pod.metadata.name, pod.metadata.namespace)
+        for svc in self.store.list(
+            "Service", inf.metadata.namespace, {LABEL_INFERENCE: inf.metadata.name}
+        ):
+            pname = svc.metadata.labels.get(LABEL_PREDICTOR, "")
+            if pname and pname not in names:
+                self.store.try_delete(
+                    "Service", svc.metadata.name, svc.metadata.namespace
+                )
+
+    # ------------------------------------------------------------ traffic
+
+    def _sync_traffic(self, inf: Inference, ready_weights: Dict[str, int]) -> None:
+        """Normalize weights over READY predictors into a TrafficPolicy
+        (reference VirtualService :206-274: canary split must never route
+        to a predictor with no backing pods)."""
+        total = sum(ready_weights.values())
+        routes = []
+        if total > 0:
+            acc = 0
+            items = sorted(ready_weights.items())
+            for i, (pname, w) in enumerate(items):
+                pct = (100 - acc) if i == len(items) - 1 else round(w * 100 / total)
+                acc += pct
+                routes.append(
+                    TrafficRoute(
+                        predictor=pname,
+                        weight=pct,
+                        service=f"{inf.metadata.name}-{pname}",
+                    )
+                )
+
+        def mutate(tp: TrafficPolicy) -> None:  # type: ignore[type-arg]
+            tp.host = self._entry_host(inf)
+            tp.routes = routes
+
+        try:
+            self.store.update_with_retry(
+                "TrafficPolicy", inf.metadata.name, inf.metadata.namespace, mutate
+            )
+        except NotFound:
+            tp = TrafficPolicy(host=self._entry_host(inf), routes=routes)
+            tp.metadata.name = inf.metadata.name
+            tp.metadata.namespace = inf.metadata.namespace
+            tp.metadata.owner_refs.append(self._owner(inf))
+            try:
+                self.store.create(tp)
+            except AlreadyExists:
+                pass
+
+    # ------------------------------------------------------------- status
+
+    def _update_status(
+        self, inf: Inference, statuses: Dict[str, PredictorStatus]
+    ) -> None:
+        endpoint = f"{self._entry_host(inf)}:{HTTP_PORT}"
+
+        def mutate(obj: Inference) -> None:  # type: ignore[type-arg]
+            obj.predictor_statuses = statuses
+            obj.endpoint = endpoint
+
+        try:
+            self.store.update_with_retry(
+                "Inference", inf.metadata.name, inf.metadata.namespace, mutate
+            )
+        except NotFound:
+            pass
+
+    # ------------------------------------------------------------ helpers
+
+    def _pods_of(self, inf: Inference) -> List[Pod]:
+        return self.store.list(  # type: ignore[return-value]
+            "Pod", inf.metadata.namespace, {LABEL_INFERENCE: inf.metadata.name}
+        )
+
+    def _owner(self, inf: Inference) -> OwnerRef:
+        return OwnerRef(kind=inf.kind, name=inf.metadata.name, uid=inf.metadata.uid)

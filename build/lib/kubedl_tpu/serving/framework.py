@@ -1,0 +1,86 @@
+"""Per-framework predictor environment setters.
+
+Reference: controllers/serving/framework/ — a `Setter` registry keyed by
+framework (types.go:26-33) whose TFServing impl injects MODEL_NAME /
+MODEL_BASE_PATH (tfserving.go:29-54). Same shape here, plus the TPU-native
+JAX setter that wires the bundled artifact into the in-repo server.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.core.objects import Pod
+from kubedl_tpu.lineage.types import ModelVersion
+from kubedl_tpu.serving.types import Framework, Inference, Predictor
+
+Setter = Callable[[Inference, Predictor, Pod, ModelVersion, int], None]
+
+SETTERS: Dict[Framework, Setter] = {}
+
+
+def register_setter(framework: Framework, setter: Setter) -> None:
+    SETTERS[framework] = setter
+
+
+def apply_setter(
+    inf: Inference, pred: Predictor, pod: Pod, mv: ModelVersion, port: int
+) -> None:
+    setter = SETTERS.get(inf.framework)
+    if setter is None:
+        raise KeyError(f"no setter registered for framework {inf.framework}")
+    setter(inf, pred, pod, mv, port)
+
+
+def _tfserving_setter(
+    inf: Inference, pred: Predictor, pod: Pod, mv: ModelVersion, port: int
+) -> None:
+    """Reference: framework/tfserving.go:29-54."""
+    main = pod.spec.main_container()
+    main.set_env("MODEL_NAME", mv.model_name)
+    main.set_env("MODEL_BASE_PATH", f"/models/{mv.model_name}")
+    main.set_env("KUBEDL_ARTIFACT", mv.image)
+
+
+def _jax_setter(
+    inf: Inference, pred: Predictor, pod: Pod, mv: ModelVersion, port: int
+) -> None:
+    """TPU-native: point the in-repo JAX server at the artifact's
+    checkpoint and give it the serve config; default the entrypoint so an
+    empty predictor template serves out of the box."""
+    main = pod.spec.main_container()
+    if not main.command and not main.entrypoint:
+        main.entrypoint = "kubedl_tpu.serving.server:serve_main"
+    main.set_env(constants.ENV_MODEL_PATH, mv.storage_root)
+    serve_cfg = {
+        "model_name": mv.model_name,
+        "artifact": mv.image,
+        "port": port,
+        "batching": (
+            {"max_batch_size": pred.batching.max_batch_size,
+             "timeout_ms": pred.batching.timeout_ms}
+            if pred.batching else None
+        ),
+    }
+    # template-provided keys win (e.g. a custom port or preset)
+    existing = main.get_env("KUBEDL_SERVE_CONFIG")
+    if existing:
+        serve_cfg.update(json.loads(existing))
+    main.set_env("KUBEDL_SERVE_CONFIG", json.dumps(serve_cfg))
+
+
+def _triton_setter(
+    inf: Inference, pred: Predictor, pod: Pod, mv: ModelVersion, port: int
+) -> None:
+    """Reference parity: Triton is enum-only there (inference_types.go:
+    106-111) — we inject the standard repository layout env and leave the
+    container image to the user."""
+    main = pod.spec.main_container()
+    main.set_env("TRITON_MODEL_REPOSITORY", mv.storage_root)
+
+
+register_setter(Framework.TF_SERVING, _tfserving_setter)
+register_setter(Framework.JAX, _jax_setter)
+register_setter(Framework.TRITON, _triton_setter)

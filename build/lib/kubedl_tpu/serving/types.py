@@ -1,0 +1,97 @@
+"""Inference CRD types.
+
+Reference: apis/serving/v1alpha1/inference_types.go:37-117 — Inference
+{framework, predictors[]}; predictor = modelVersion + replicas +
+trafficWeight + template + autoScale + batching stubs. TrafficPolicy is the
+in-store analogue of the Istio VirtualService the reference programs
+(inference_controller.go:206-274).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubedl_tpu.core.objects import BaseObject, PodTemplateSpec
+
+
+class Framework(str, enum.Enum):
+    """Serving frameworks (reference: inference_types.go:106-111 — the
+    reference implements TFServing and enumerates Triton; the TPU-native
+    default here is the JAX server)."""
+
+    JAX = "JAXServing"
+    TF_SERVING = "TFServing"
+    TRITON = "Triton"
+
+
+@dataclass
+class AutoScaleSpec:
+    """Predictor autoscaling bounds (reference carries this as a stub on
+    the predictor spec; the console surfaces it)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_qps: Optional[float] = None
+
+
+@dataclass
+class BatchingSpec:
+    """Server-side request batching knobs (reference: batching stub)."""
+
+    max_batch_size: int = 1
+    timeout_ms: int = 0
+
+
+@dataclass
+class Predictor:
+    """One model variant behind the endpoint (reference:
+    inference_types.go:57-95)."""
+
+    name: str = "default"
+    #: ModelVersion to serve; empty = the model's latest version
+    model_version: str = ""
+    #: Model whose latest version to track when model_version is empty
+    model_name: str = ""
+    replicas: int = 1
+    #: Canary weight 0-100; weights are normalized across ready predictors
+    traffic_weight: int = 100
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    autoscale: Optional[AutoScaleSpec] = None
+    batching: Optional[BatchingSpec] = None
+
+
+@dataclass
+class PredictorStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    image: str = ""  # model artifact ref being served
+    message: str = ""
+
+
+@dataclass
+class Inference(BaseObject):
+    KIND = "Inference"
+    framework: Framework = Framework.JAX
+    predictors: List[Predictor] = field(default_factory=list)
+    # -- status --
+    predictor_statuses: Dict[str, PredictorStatus] = field(default_factory=dict)
+    endpoint: str = ""  # entry service DNS
+
+
+@dataclass
+class TrafficRoute:
+    predictor: str
+    weight: int  # normalized percentage
+    service: str  # backing per-predictor service name
+
+
+@dataclass
+class TrafficPolicy(BaseObject):
+    """Weighted canary routing table (VirtualService analogue,
+    inference_controller.go:206-274; gateway "kubedl-serving-gateway")."""
+
+    KIND = "TrafficPolicy"
+    host: str = ""  # entry service host
+    routes: List[TrafficRoute] = field(default_factory=list)

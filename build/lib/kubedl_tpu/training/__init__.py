@@ -1,0 +1,10 @@
+"""Training harness: sharded train loop, optimizer, checkpointing, data.
+
+The in-container half of the stack. The operator launches one process per
+TPU host running :func:`kubedl_tpu.training.trainer.train_main`; it
+bootstraps `jax.distributed` from the injected env, builds the mesh, and
+drives the jitted train step. First-step latency and tokens/sec/chip are
+reported through the metrics conventions in BASELINE.md.
+"""
+
+from kubedl_tpu.training.trainer import Trainer, TrainConfig  # noqa: F401

@@ -1,0 +1,64 @@
+"""Data pipelines.
+
+The reference operator ships no data plane (user containers bring their
+own); the TPU build needs one for its example workloads and benchmarks:
+
+- :class:`SyntheticTokens` — on-device PRNG token batches; zero host->device
+  traffic, the right default for throughput benchmarking.
+- :class:`ByteCorpus` — byte-level tokenization of a local text file with
+  random crops; enough to demonstrate real convergence end-to-end.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SyntheticTokens:
+    """Deterministic synthetic next-token data, generated on device."""
+
+    def __init__(self, batch: int, seq: int, vocab: int, seed: int = 0) -> None:
+        self.batch, self.seq, self.vocab = batch, seq, vocab
+        self._key = jax.random.PRNGKey(seed)
+
+        @jax.jit
+        def sample(key):
+            key, sub = jax.random.split(key)
+            toks = jax.random.randint(sub, (batch, seq), 0, vocab, jnp.int32)
+            return key, toks
+
+        self._sample = sample
+
+    def __iter__(self) -> Iterator[jax.Array]:
+        return self
+
+    def __next__(self) -> jax.Array:
+        self._key, batch = self._sample(self._key)
+        return batch
+
+
+class ByteCorpus:
+    """Byte-level LM dataset over a text file (vocab 256)."""
+
+    VOCAB = 256
+
+    def __init__(self, path: str, batch: int, seq: int, seed: int = 0) -> None:
+        with open(path, "rb") as f:
+            self.data = np.frombuffer(f.read(), dtype=np.uint8)
+        if len(self.data) < seq + 1:
+            raise ValueError(f"corpus {path} shorter than seq+1={seq + 1}")
+        self.batch, self.seq = batch, seq
+        self.rng = np.random.default_rng(seed)
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        return self
+
+    def __next__(self) -> np.ndarray:
+        starts = self.rng.integers(0, len(self.data) - self.seq - 1, self.batch)
+        out = np.stack([self.data[s : s + self.seq] for s in starts])
+        return out.astype(np.int32)

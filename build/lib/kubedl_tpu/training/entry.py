@@ -1,0 +1,157 @@
+"""Worker entrypoint: what a TPUJob pod runs.
+
+Usable two ways (matching the two container runtimes):
+- subprocess: `python -m kubedl_tpu.training.entry`
+- in-process: entrypoint string "kubedl_tpu.training.entry:train_main"
+
+Reads the operator-injected bootstrap env (KUBEDL_*), initializes
+`jax.distributed`, builds the mesh, **restores from the latest checkpoint**
+(slice-granular restart-from-checkpoint, SURVEY.md §7 hard-part b: a gang
+restart re-enters here and loses at most one save interval), trains with
+periodic saves, and writes the final state to KUBEDL_MODEL_PATH (feeding
+the ModelVersion lineage pipeline). The train config rides the env as JSON
+under KUBEDL_TRAIN_CONFIG.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+#: last run's summary, for in-process harnesses (bench.py) to read back
+LAST_SUMMARY: Optional[dict] = None
+
+
+def _model_preset(name: str):
+    from kubedl_tpu.models import llama, moe
+
+    if "moe" in name:
+        return moe.preset(name)
+    return llama.preset(name)
+
+
+def train_main(env: Optional[Dict[str, str]] = None) -> int:
+    global LAST_SUMMARY
+    if env:
+        os.environ.update({k: v for k, v in env.items() if isinstance(v, str)})
+    # import jax only after env is set (JAX_PLATFORMS etc.)
+    from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested
+
+    ensure_cpu_if_requested()
+    from kubedl_tpu.utils.compile_cache import enable_compilation_cache
+
+    # before the first trace: a gang restart / resize / resume re-enters
+    # here and must deserialize, not recompile, the unchanged train step
+    enable_compilation_cache()
+    import jax
+
+    from kubedl_tpu.api import constants
+    from kubedl_tpu.parallel.mesh import initialize_from_env, mesh_from_env
+    from kubedl_tpu.training.checkpoint import restore_checkpoint
+    from kubedl_tpu.training.data import SyntheticTokens
+    from kubedl_tpu.training.trainer import TrainConfig, Trainer
+
+    initialize_from_env()
+
+    raw = os.environ.get("KUBEDL_TRAIN_CONFIG", "{}")
+    opts = json.loads(raw)
+    model = _model_preset(opts.get("model", "tiny"))
+    import dataclasses
+
+    for knob in ("remat_policy", "loss_chunk"):
+        if knob in opts and hasattr(model, knob):
+            model = dataclasses.replace(model, **{knob: opts[knob]})
+    cfg = TrainConfig(
+        model=model,
+        global_batch=int(opts.get("global_batch", 8)),
+        seq_len=int(opts.get("seq_len", min(128, model.max_seq))),
+        steps=int(opts.get("steps", 5)),
+        learning_rate=float(opts.get("learning_rate", 3e-4)),
+        grad_accum=int(opts.get("grad_accum", 1)),
+        attn_impl=opts.get("attn_impl", "auto"),
+        context_parallel_impl=opts.get("context_parallel_impl", "ring"),
+        microbatches=int(opts.get("microbatches", 0)),
+        ckpt_every=int(opts.get("ckpt_every", 0)),
+        opt_moment_dtype=opts.get("opt_moment_dtype", "float32"),
+    )
+    mesh = mesh_from_env()
+    trainer = Trainer(cfg, mesh)
+
+    out = os.environ.get(constants.ENV_MODEL_PATH, "")
+    ckpt_dir = os.environ.get(constants.ENV_CKPT_DIR, "")
+    if not ckpt_dir and out and cfg.ckpt_every:
+        ckpt_dir = os.path.join(out, "checkpoints")
+
+    # restore-from-latest: a gang restart resumes instead of retraining.
+    # The fresh init doubles as the restore template (shardings/structure)
+    # and is reused as-is on a cold start — init runs exactly once.
+    state = None
+    if ckpt_dir:
+        template = trainer.init_state()
+        state = restore_checkpoint(ckpt_dir, template)
+        if state is not None:
+            step = int(jax.device_get(state["step"]))
+            print(json.dumps({"resumed_from_step": step}), flush=True)
+        else:
+            state = template
+
+    data_path = opts.get("data_path", "")
+    if data_path:
+        # real token file through the native prefetch loader (C++ ring,
+        # numpy fallback) — batch assembly off the critical path
+        from kubedl_tpu.data import TokenFileDataset
+
+        data = TokenFileDataset(
+            data_path, cfg.global_batch, cfg.seq_len,
+            seed=cfg.seed, token_bytes=int(opts.get("token_bytes", 4)),
+        )
+    else:
+        data = SyntheticTokens(cfg.global_batch, cfg.seq_len, model.vocab_size)
+    first_step_wall = {}
+    cancel = (env or {}).get("_KUBEDL_CANCEL")  # ThreadRuntime cancellation
+    # fault injection (net-new vs reference, SURVEY.md §5 "No fault
+    # injection anywhere"): die retryably ONCE at a given step — exercises
+    # the slice-granular restart-from-checkpoint path end to end
+    fault_step = int(os.environ.get("KUBEDL_FAULT_ONCE_AT_STEP", "-1"))
+    fault_marker = os.environ.get("KUBEDL_FAULT_MARKER", "")
+
+    def on_step(i, metrics):
+        if "t" not in first_step_wall:
+            first_step_wall["t"] = time.time()
+        if cancel is not None and getattr(cancel, "is_set", lambda: False)():
+            raise SystemExit(137)  # retryable: gang restart requested
+        if (
+            fault_step >= 0
+            and i == fault_step
+            and fault_marker
+            and not os.path.exists(fault_marker)
+        ):
+            with open(fault_marker, "w") as f:
+                f.write("fired")
+            raise SystemExit(137)
+
+    state, summary = trainer.fit(
+        iter(data),
+        state=state,
+        on_step=on_step,
+        ckpt_dir=ckpt_dir or None,
+        ckpt_every=cfg.ckpt_every,
+    )
+    summary["first_step_wall_time"] = first_step_wall.get("t", time.time())
+    LAST_SUMMARY = summary
+    print(json.dumps({"worker_summary": summary}), flush=True)
+
+    if out and os.path.abspath(ckpt_dir or "") != os.path.abspath(out):
+        # publish the final state at the model-path root — serving and the
+        # ModelVersion build read `latest` from there, not from checkpoints/
+        from kubedl_tpu.training.checkpoint import save_checkpoint
+
+        save_checkpoint(out, state, int(jax.device_get(state["step"])))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(train_main())

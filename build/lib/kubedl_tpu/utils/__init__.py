@@ -1,0 +1,1 @@
+"""Shared utilities: feature gates, workload gate, serde, logging, ports."""

@@ -1,0 +1,54 @@
+"""Feature gates (reference: pkg/features/features.go:24-45 — k8s
+featuregate with GangScheduling and DAGScheduling both beta/default-on,
+driven by a `--feature-gates` flag)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+GANG_SCHEDULING = "GangScheduling"
+DAG_SCHEDULING = "DAGScheduling"
+HOST_NETWORK = "HostNetworkWiring"
+SLICE_RESTART = "SliceGranularRestart"  # TPU addition
+
+_DEFAULTS: Dict[str, bool] = {
+    GANG_SCHEDULING: True,
+    DAG_SCHEDULING: True,
+    HOST_NETWORK: True,
+    SLICE_RESTART: True,
+}
+
+
+class FeatureGates:
+    def __init__(self, overrides: Dict[str, bool] | None = None) -> None:
+        self._lock = threading.Lock()
+        self._gates = dict(_DEFAULTS)
+        if overrides:
+            self.set_from_map(overrides)
+
+    def enabled(self, name: str) -> bool:
+        with self._lock:
+            if name not in self._gates:
+                raise KeyError(f"unknown feature gate {name!r}")
+            return self._gates[name]
+
+    def set_from_map(self, overrides: Dict[str, bool]) -> None:
+        with self._lock:
+            for k, v in overrides.items():
+                if k not in self._gates:
+                    raise KeyError(f"unknown feature gate {k!r}")
+                self._gates[k] = v
+
+    def set_from_string(self, s: str) -> None:
+        """Parse `Gate1=true,Gate2=false` (the --feature-gates format)."""
+        overrides = {}
+        for part in filter(None, (p.strip() for p in s.split(","))):
+            k, _, v = part.partition("=")
+            overrides[k] = v.strip().lower() in ("true", "1", "yes")
+        self.set_from_map(overrides)
+
+
+#: Process-wide default gate set (controllers take a FeatureGates but default
+#: to this, mirroring the reference's package-level KubeDLFeatureGates).
+DEFAULT_GATES = FeatureGates()

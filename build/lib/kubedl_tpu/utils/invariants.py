@@ -1,0 +1,122 @@
+"""Control-plane consistency checker.
+
+The reference has no race detection or sanitizers at all (SURVEY.md §5:
+`make test` has no -race). This TPU build makes invariant checking a
+first-class debug tool: :func:`check_invariants` sweeps the live store for
+states that indicate a controller bug — the control-plane analogue of a
+sanitizer pass. Call it from tests/drives after any scenario (it is
+read-only and cheap: one store snapshot + one inventory snapshot).
+
+Checked invariants:
+
+I1  every Pod/Service with a controller owner ref points at a live object
+    (within one GC interval, orphans must be collected, not accumulate);
+I2  no two pods of one job claim the same (replica_type, replica_index);
+I3  every slice reservation in the inventory has a live PodGroup owner,
+    and no PodGroup claims a slice the inventory thinks is free;
+I4  a terminal job (Succeeded/Failed) holds no slice reservation;
+I5  a QUEUED job has zero pods (atomic gang admission means all or
+    nothing).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from kubedl_tpu.api.constants import (
+    LABEL_JOB_KIND,
+    LABEL_JOB_NAME,
+    LABEL_REPLICA_INDEX,
+    LABEL_REPLICA_TYPE,
+)
+from kubedl_tpu.api.types import JobConditionType
+
+
+def check_invariants(operator) -> List[str]:
+    """Returns violations (empty = consistent). Read-only."""
+    store = operator.store
+    out: List[str] = []
+
+    jobs = {}
+    for kind in operator.engines:
+        for j in store.list(kind, None):
+            jobs[(kind, j.metadata.namespace, j.metadata.name)] = j
+
+    # I1: owner refs point at live objects
+    for kind in ("Pod", "Service", "PodGroup"):
+        for obj in store.list(kind, None):
+            ref = obj.metadata.controller_ref()
+            if ref is None or ref.kind not in operator.engines:
+                continue
+            if (ref.kind, obj.metadata.namespace, ref.name) not in jobs:
+                out.append(
+                    f"I1: {kind} {obj.metadata.namespace}/{obj.metadata.name} "
+                    f"owned by missing {ref.kind} {ref.name}"
+                )
+
+    # I2: unique replica indices per job (one pod snapshot, reused by I5)
+    all_pods = store.list("Pod", None)
+    seen = {}
+    for p in all_pods:
+        labels = p.metadata.labels
+        if LABEL_JOB_NAME not in labels or LABEL_REPLICA_TYPE not in labels:
+            continue
+        key = (
+            p.metadata.namespace, labels.get(LABEL_JOB_KIND),
+            labels[LABEL_JOB_NAME], labels[LABEL_REPLICA_TYPE],
+            labels.get(LABEL_REPLICA_INDEX),
+        )
+        if key in seen:
+            out.append(
+                f"I2: duplicate replica index: {p.metadata.name} vs {seen[key]}"
+            )
+        seen[key] = p.metadata.name
+
+    # I3: inventory <-> PodGroup agreement (ONE consistent snapshot —
+    # repeated describe() calls could interleave with a release and
+    # report transient false positives)
+    holders = operator.inventory.describe()
+    by_holder: dict = {}
+    for slice_name, holder in holders.items():
+        if holder != "<free>":
+            by_holder.setdefault(holder, []).append(slice_name)
+    gangs = {
+        f"{g.metadata.namespace}/{g.metadata.name}": g
+        for g in store.list("PodGroup", None)
+    }
+    for holder, names in by_holder.items():
+        if holder not in gangs:
+            out.append(f"I3: slices {names} held by missing gang {holder}")
+    for key, g in gangs.items():
+        for s in getattr(g, "assigned_slices", []):
+            if holders.get(s) != key:
+                out.append(
+                    f"I3: gang {key} claims slice {s} but inventory says "
+                    f"{holders.get(s)!r}"
+                )
+
+    # I4/I5: job phase coherence
+    from kubedl_tpu.gang.slice_scheduler import owner_key
+
+    for (kind, ns, name), j in jobs.items():
+        phase = j.status.phase
+        gang_key = owner_key(ns, name)
+        if j.status.is_terminal():
+            for slice_name in by_holder.get(gang_key, []):
+                out.append(
+                    f"I4: terminal {kind} {ns}/{name} still holds slice "
+                    f"{slice_name}"
+                )
+        if phase == JobConditionType.QUEUED:
+            pods = [
+                p for p in all_pods
+                if p.metadata.namespace == ns
+                and p.metadata.labels.get(LABEL_JOB_NAME) == name
+                and p.metadata.labels.get(LABEL_JOB_KIND) == kind
+            ]
+            if pods:
+                out.append(
+                    f"I5: QUEUED {kind} {ns}/{name} has {len(pods)} pods "
+                    "(gang admission must be atomic)"
+                )
+    return out

@@ -1,0 +1,40 @@
+"""JAX platform guard.
+
+Some environments pre-register accelerator PJRT plugins in every Python
+process via sitecustomize and force `jax_platforms` to include them,
+overriding the JAX_PLATFORMS env var. For CPU-only contexts (unit tests,
+the multi-chip dry run on virtual devices) that makes backend init dial
+hardware that isn't reachable and hang. This guard restores the env var's
+intent BEFORE any backend is initialized.
+
+Call :func:`ensure_cpu_if_requested` before the first `jax.devices()` /
+computation. No-op when the env doesn't request a pure-CPU platform set, so
+real TPU runs are untouched.
+"""
+
+from __future__ import annotations
+
+import os
+
+_ACCEL_PLATFORMS = ("tpu", "gpu", "cuda", "rocm", "axon")
+#: plugins to unregister in CPU mode. Standard platforms (tpu/gpu) stay
+#: registered — `jax_platforms=cpu` already keeps them uninitialized, and
+#: popping them breaks MLIR lowering registration for those platforms.
+_FORCE_UNREGISTER = ("axon",)
+
+
+def ensure_cpu_if_requested() -> None:
+    want = os.environ.get("JAX_PLATFORMS", "")
+    platforms = [p.strip() for p in want.split(",") if p.strip()]
+    if not platforms or any(p in _ACCEL_PLATFORMS for p in platforms):
+        return  # accelerators intended (or no preference): leave alone
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", ",".join(platforms))
+        from jax._src import xla_bridge
+
+        for name in _FORCE_UNREGISTER:
+            xla_bridge._backend_factories.pop(name, None)  # noqa: SLF001
+    except Exception:
+        pass

@@ -1,0 +1,19 @@
+"""Workload kinds: each = a parallelism strategy as a deployed topology.
+
+Reference inventory (SURVEY.md §2.2): TFJob (PS/Worker), PyTorchJob
+(Master/Worker DDP), XDLJob, XGBoostJob (Rabit), MarsJob, ElasticDLJob,
+MPIJob (Launcher/Worker). TPU-native set:
+
+- :class:`TPUJob` — the flagship: SPMD JAX over gang-scheduled slices,
+  `jax.distributed` bootstrap (replaces TFJob+PyTorchJob's role).
+- :class:`TorchXLAJob` — PyTorch/XLA PJRT compatibility kind (the
+  reference's PyTorchJob with `backend: xla`).
+- :class:`MPIJob` — Launcher/Worker hostfile kind for mpirun-style code.
+- :class:`XGBoostJob` — Rabit tracker/worker boosting.
+- :class:`ElasticJob` — master-only self-scaling kind (ElasticDL analogue).
+- :class:`PSJob` — parameter-server/worker topology (TFJob/XDLJob analogue
+  for frameworks that still want async PS).
+"""
+
+from kubedl_tpu.workloads.tpujob import TPUJob, TPUJobController  # noqa: F401
+from kubedl_tpu.workloads.registry import WORKLOAD_REGISTRY, register_workload  # noqa: F401

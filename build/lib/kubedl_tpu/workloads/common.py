@@ -1,0 +1,102 @@
+"""Shared endpoint/addressing helpers for workload controllers.
+
+Every compat kind derives replica endpoints the same way the reference does:
+stable headless-service DNS `name-rtype-i.ns.svc[.domain]:port`
+(controllers/tensorflow/tensorflow.go:124-146), with the port swapped for the
+pod's actual random host port under host-network mode (tensorflow.go:136-143).
+In local mode (pods are processes on this host) addresses collapse to
+127.0.0.1.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.api.interface import JobObject, ReconcileContext
+from kubedl_tpu.api.types import (
+    DAGCondition,
+    ReplicaPhase,
+    ReplicaSpec,
+    ReplicaType,
+)
+from kubedl_tpu.engine.job_controller import replica_name
+
+
+def replica_dns(
+    job: JobObject,
+    rtype: ReplicaType,
+    index: int,
+    cluster_domain: str = "",
+    local_addresses: bool = False,
+) -> str:
+    if local_addresses:
+        return "127.0.0.1"
+    base = f"{replica_name(job, rtype, index)}.{job.metadata.namespace}.svc"
+    return f"{base}.{cluster_domain}" if cluster_domain else base
+
+
+def replica_port(
+    spec: ReplicaSpec, rtype: ReplicaType, index: int, ctx: Optional[ReconcileContext]
+) -> int:
+    """Service port, or the pod's actual host port under host-network mode.
+
+    Host ports are random-per-pod (reference: pod.go:470-486), so peers
+    created in a *later* reconcile pass must read them back from the live
+    pod's spec — ctx.host_ports only covers pods built this pass
+    (reference analogue: service target-port re-read, service.go:218-234).
+    """
+    if ctx is not None:
+        hp = ctx.host_ports.get(f"{rtype.value}-{index}")
+        if hp:
+            return hp
+        for pod in ctx.pods:
+            labels = pod.metadata.labels
+            if (
+                labels.get(constants.LABEL_REPLICA_TYPE) == rtype.value
+                and labels.get(constants.LABEL_REPLICA_INDEX) == str(index)
+            ):
+                ports = pod.spec.main_container().ports
+                if ports and ports[0].host_port:
+                    return ports[0].host_port
+                break
+    main = spec.template.spec.main_container()
+    for p in main.ports:
+        if p.name == constants.DEFAULT_PORT_NAME:
+            return p.port
+    return constants.DEFAULT_PORT
+
+
+def add_dag_edge(
+    job: JobObject,
+    downstream: ReplicaType,
+    upstream: ReplicaType,
+    phase: ReplicaPhase = ReplicaPhase.RUNNING,
+) -> None:
+    """Idempotently add a startup-ordering edge during defaulting (every
+    compat kind gates some group on another — reference: per-kind
+    GetReconcileOrders + DAGCondition defaults, dag_sched.go:29-68)."""
+    specs = job.spec.replica_specs
+    if downstream not in specs or upstream not in specs:
+        return
+    spec = specs[downstream]
+    if not any(d.upstream == upstream for d in spec.depends_on):
+        spec.depends_on.append(DAGCondition(upstream, phase))
+
+
+def replica_endpoints(
+    job: JobObject,
+    rtype: ReplicaType,
+    ctx: Optional[ReconcileContext] = None,
+    cluster_domain: str = "",
+    local_addresses: bool = False,
+) -> List[str]:
+    """All `host:port` endpoints for one replica group, in index order."""
+    spec = job.spec.replica_specs.get(rtype)
+    if spec is None:
+        return []
+    return [
+        f"{replica_dns(job, rtype, i, cluster_domain, local_addresses)}"
+        f":{replica_port(spec, rtype, i, ctx)}"
+        for i in range(spec.replicas)
+    ]

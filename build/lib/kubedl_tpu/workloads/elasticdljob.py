@@ -1,0 +1,63 @@
+"""ElasticDLJob: master-only elastic training.
+
+Capability parity with the reference's ElasticDL controller
+(controllers/elasticdl/): the CRD declares ONLY a Master replica type
+(apis/training/v1alpha1/elasticdljob_types.go:62-65) — the master process
+itself elastically spawns and scales its workers/PS. The engine creates no
+Services for it (pkg/job_controller/job.go:253-257), and the master pod is
+named `elasticdl-<job>-master` for compatibility with ElasticDL's own
+discovery (pkg/job_controller/pod.go:412-415) — here the master receives
+its canonical name via env instead, since naming is store-internal.
+
+TPU mapping: elasticity becomes slice grow/shrink — the master asks the
+operator for more/fewer slice gangs (SURVEY.md §2.5 elastic DP row); the
+env below hands it the operator's coordinator address for that.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadController
+from kubedl_tpu.api.types import ReplicaType
+from kubedl_tpu.core.objects import Pod
+
+
+@dataclass
+class ElasticDLJob(JobObject):
+    KIND = "ElasticDLJob"
+
+
+class ElasticDLJobController(WorkloadController):
+    KIND = "ElasticDLJob"
+    NAME = "elasticdljob-controller"
+    ALLOWED_REPLICA_TYPES = (ReplicaType.MASTER,)
+
+    def object_factory(self) -> ElasticDLJob:
+        return ElasticDLJob()
+
+    # ALLOWED_REPLICA_TYPES: only Master is legal (reference:
+    # elasticdljob_types.go:62-65); base defaulting prunes the rest.
+
+    def reconcile_orders(self) -> List[ReplicaType]:
+        return [ReplicaType.MASTER]
+
+    def is_master_role(self, rtype: ReplicaType) -> bool:
+        return rtype == ReplicaType.MASTER
+
+    def needs_service(self, rtype: ReplicaType, job=None) -> bool:
+        return False  # reference: job.go:253-257 skips ElasticDL services
+
+    def set_mesh_spec(
+        self,
+        job: JobObject,
+        pod: Pod,
+        rtype: ReplicaType,
+        index: int,
+        ctx: ReconcileContext,
+    ) -> None:
+        main = pod.spec.main_container()
+        main.set_env("ELASTICDL_JOB_NAME", job.metadata.name)
+        main.set_env("ELASTICDL_MASTER_POD", f"elasticdl-{job.metadata.name}-master")
+        main.set_env("ELASTICDL_NAMESPACE", job.metadata.namespace)

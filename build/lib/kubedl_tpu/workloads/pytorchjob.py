@@ -1,0 +1,126 @@
+"""PyTorchJob: single-master / N-worker DDP.
+
+Capability parity with the reference's PyTorch controller
+(controllers/pytorch/): env MASTER_ADDR / MASTER_PORT / WORLD_SIZE / RANK
+injected per pod, master addressed as `localhost` inside the master pod and
+by its service DNS from workers, worker rank offset +1
+(pytorchjob_controller.go:195-245); a Service is created for the Master only
+(pkg/job_controller/job.go:259-263); master-first reconcile order.
+
+TPU-first: ``backend="xla"`` (the default) additionally emits the torch_xla
+PJRT environment (`PJRT_DEVICE=TPU`) so the same job spec drives
+torch_xla's XLA:TPU DDP instead of NCCL — the reference's NCCL/Gloo init
+maps onto PJRT + XLA collectives (SURVEY.md §2.5 allreduce row).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadController
+from kubedl_tpu.api.types import ReplicaType
+from kubedl_tpu.core.objects import Pod
+from kubedl_tpu.workloads.common import add_dag_edge, replica_dns, replica_port
+
+
+@dataclass
+class PyTorchJob(JobObject):
+    KIND = "PyTorchJob"
+    #: "xla" wires torch_xla/PJRT (TPU); "gloo" leaves device wiring to the
+    #: container (CPU smoke / kind-style CI).
+    backend: str = "xla"
+
+
+class PyTorchJobController(WorkloadController):
+    KIND = "PyTorchJob"
+    NAME = "pytorchjob-controller"
+    ALLOWED_REPLICA_TYPES = (ReplicaType.MASTER, ReplicaType.WORKER)
+
+    def validate(self, job):
+        errs = super().validate(job)
+        master = job.spec.replica_specs.get(ReplicaType.MASTER)
+        if master is not None and master.replicas > 1:
+            errs.append("PyTorchJob allows at most one Master (rank 0)")
+        return errs
+
+    def object_factory(self) -> PyTorchJob:
+        return PyTorchJob()
+
+    def apply_defaults(self, job: JobObject) -> None:
+        """Workers DAG-wait for the master to be Running — rank-0 must own
+        the rendezvous before ranks 1..N dial in."""
+        super().apply_defaults(job)
+        add_dag_edge(job, ReplicaType.WORKER, ReplicaType.MASTER)
+
+    def reconcile_orders(self) -> List[ReplicaType]:
+        return [ReplicaType.MASTER, ReplicaType.WORKER]
+
+    def is_master_role(self, rtype: ReplicaType) -> bool:
+        return rtype == ReplicaType.MASTER
+
+    def needs_service(self, rtype: ReplicaType, job=None) -> bool:
+        """Master-only services (reference: job.go:259-263) — except for
+        masterless specs, where worker-0 hosts the rendezvous and must be
+        addressable."""
+        if rtype == ReplicaType.MASTER:
+            return True
+        return (
+            job is not None
+            and ReplicaType.MASTER not in job.spec.replica_specs
+            and rtype == ReplicaType.WORKER
+        )
+
+    # ------------------------------------------------------------------
+
+    def set_mesh_spec(
+        self,
+        job: JobObject,
+        pod: Pod,
+        rtype: ReplicaType,
+        index: int,
+        ctx: ReconcileContext,
+    ) -> None:
+        assert isinstance(job, PyTorchJob)
+        main = pod.spec.main_container()
+        master_spec = job.spec.replica_specs.get(ReplicaType.MASTER)
+        n_workers = (
+            job.spec.replica_specs[ReplicaType.WORKER].replicas
+            if ReplicaType.WORKER in job.spec.replica_specs
+            else 0
+        )
+        world_size = (1 if master_spec else 0) + n_workers
+
+        if rtype == ReplicaType.MASTER:
+            # the master talks to itself over loopback (reference:
+            # pytorchjob_controller.go:195-245)
+            addr = "localhost"
+            rank = 0
+            port = replica_port(master_spec, rtype, index, ctx)
+        elif master_spec is not None:
+            addr = replica_dns(
+                job, ReplicaType.MASTER, 0, self.cluster_domain, self.local_addresses
+            )
+            rank = index + 1
+            port = replica_port(master_spec, ReplicaType.MASTER, 0, ctx)
+        else:
+            # masterless: worker-0 hosts the rendezvous — every rank must
+            # dial the SAME endpoint
+            worker_spec = job.spec.replica_specs[ReplicaType.WORKER]
+            addr = (
+                "localhost"
+                if index == 0
+                else replica_dns(
+                    job, ReplicaType.WORKER, 0,
+                    self.cluster_domain, self.local_addresses,
+                )
+            )
+            rank = index
+            port = replica_port(worker_spec, ReplicaType.WORKER, 0, ctx)
+
+        main.set_env("MASTER_ADDR", addr)
+        main.set_env("MASTER_PORT", str(port))
+        main.set_env("WORLD_SIZE", str(world_size))
+        main.set_env("RANK", str(rank))
+        if job.backend == "xla":
+            main.set_env("PJRT_DEVICE", "TPU")

@@ -1,0 +1,60 @@
+"""Workload registry + gate.
+
+Reference: SetupWithManagerMap (controllers/controllers.go:29-45) populated
+by per-kind add_*.go files, filtered by workloadgate
+(pkg/util/workloadgate/workload_gate.go:27-113): `--workloads` / env
+`WORKLOADS_ENABLE` with `*` / `-foo` / `auto` syntax.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List
+
+from kubedl_tpu.api.interface import WorkloadController
+
+WORKLOAD_REGISTRY: Dict[str, Callable[..., WorkloadController]] = {}
+
+
+def register_workload(kind: str, factory: Callable[..., WorkloadController]) -> None:
+    WORKLOAD_REGISTRY[kind] = factory
+
+
+def parse_workload_gate(expr: str, known: List[str]) -> List[str]:
+    """`*` all, `-Kind` exclusion, comma list inclusion (reference:
+    workload_gate.go:27-113). `auto` behaves like `*` here — CRD discovery
+    is moot when the registry is in-process."""
+    expr = (expr or os.environ.get("WORKLOADS_ENABLE", "") or "*").strip()
+    if expr in ("*", "auto", "all"):
+        return list(known)
+    parts = [p.strip() for p in expr.split(",") if p.strip()]
+    excluded = {p[1:] for p in parts if p.startswith("-")}
+    included = [p for p in parts if not p.startswith("-")]
+    if included:
+        return [k for k in included if k in known and k not in excluded]
+    return [k for k in known if k not in excluded]
+
+
+def _register_builtin() -> None:
+    """One registration per kind (reference: controllers/add_<kind>.go files
+    populating SetupWithManagerMap)."""
+    from kubedl_tpu.workloads.elasticdljob import ElasticDLJobController
+    from kubedl_tpu.workloads.marsjob import MarsJobController
+    from kubedl_tpu.workloads.mpijob import MPIJobController
+    from kubedl_tpu.workloads.pytorchjob import PyTorchJobController
+    from kubedl_tpu.workloads.tfjob import TFJobController
+    from kubedl_tpu.workloads.tpujob import TPUJobController
+    from kubedl_tpu.workloads.xdljob import XDLJobController
+    from kubedl_tpu.workloads.xgboostjob import XGBoostJobController
+
+    register_workload("TPUJob", TPUJobController)
+    register_workload("TFJob", TFJobController)
+    register_workload("PyTorchJob", PyTorchJobController)
+    register_workload("XDLJob", XDLJobController)
+    register_workload("XGBoostJob", XGBoostJobController)
+    register_workload("MarsJob", MarsJobController)
+    register_workload("ElasticDLJob", ElasticDLJobController)
+    register_workload("MPIJob", MPIJobController)
+
+
+_register_builtin()

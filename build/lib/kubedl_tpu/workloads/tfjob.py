@@ -1,0 +1,112 @@
+"""TFJob: PS/Worker data parallelism (async or sync).
+
+Capability parity with the reference's TensorFlow controller
+(controllers/tensorflow/): roles PS/Worker/Chief/Master/Evaluator
+(apis/training/v1alpha1/tfjob_types.go:79-98), a per-pod `TF_CONFIG` JSON
+{cluster, task, environment:"cloud"} (tensorflow.go:75-152), endpoints as
+headless-svc DNS (tensorflow.go:124-146), reconcile order
+PS -> Master -> Chief -> Worker (tfjob_controller.go:318-325), evaluators
+excluded from the cluster spec (tensorflow.go:112-116), and success from
+chief/master completion or worker-0 / all-workers per SuccessPolicy
+(status.go:56-215).
+
+TPU-first notes: the PS pattern itself is obsolete on TPU (SURVEY.md §2.5) —
+this kind exists so reference users can bring TF_CONFIG-consuming code
+unchanged. Workers additionally receive the `jax.distributed` bootstrap env
+(coordinator = worker-0) so the same job spec can run a JAX data-parallel
+entrypoint with zero PS replicas, which is the recommended TPU path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+import json
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadController
+from kubedl_tpu.api.types import ReplicaType
+from kubedl_tpu.core.objects import Pod
+from kubedl_tpu.workloads.common import add_dag_edge, replica_endpoints
+
+#: TF_CONFIG cluster-role names, in reconcile order.
+TF_ROLE = {
+    ReplicaType.PS: "ps",
+    ReplicaType.MASTER: "master",
+    ReplicaType.CHIEF: "chief",
+    ReplicaType.WORKER: "worker",
+    ReplicaType.EVALUATOR: "evaluator",
+}
+
+
+@dataclass
+class TFJob(JobObject):
+    KIND = "TFJob"
+
+
+class TFJobController(WorkloadController):
+    KIND = "TFJob"
+    NAME = "tfjob-controller"
+    ALLOWED_REPLICA_TYPES = (ReplicaType.PS, ReplicaType.MASTER, ReplicaType.CHIEF, ReplicaType.WORKER, ReplicaType.EVALUATOR)
+
+    def object_factory(self) -> TFJob:
+        return TFJob()
+
+    def apply_defaults(self, job: JobObject) -> None:
+        """Besides common defaults: workers DAG-wait for PS Running (the
+        reference's canonical DAG example, dag_sched.go:29-68)."""
+        super().apply_defaults(job)
+        add_dag_edge(job, ReplicaType.WORKER, ReplicaType.PS)
+
+    def reconcile_orders(self) -> List[ReplicaType]:
+        return [
+            ReplicaType.PS,
+            ReplicaType.MASTER,
+            ReplicaType.CHIEF,
+            ReplicaType.WORKER,
+            ReplicaType.EVALUATOR,
+        ]
+
+    def is_master_role(self, rtype: ReplicaType) -> bool:
+        return rtype in (ReplicaType.MASTER, ReplicaType.CHIEF)
+
+    # ------------------------------------------------------------------
+
+    def _cluster(self, job: JobObject, ctx: ReconcileContext) -> dict:
+        """The TF_CONFIG `cluster` dict — evaluators excluded
+        (reference: tensorflow.go:112-116)."""
+        cluster = {}
+        for rtype, role in TF_ROLE.items():
+            if rtype == ReplicaType.EVALUATOR or rtype not in job.spec.replica_specs:
+                continue
+            cluster[role] = replica_endpoints(
+                job, rtype, ctx, self.cluster_domain, self.local_addresses
+            )
+        return cluster
+
+    def set_mesh_spec(
+        self,
+        job: JobObject,
+        pod: Pod,
+        rtype: ReplicaType,
+        index: int,
+        ctx: ReconcileContext,
+    ) -> None:
+        main = pod.spec.main_container()
+        tf_config = {
+            "cluster": self._cluster(job, ctx),
+            "task": {"type": TF_ROLE[rtype], "index": index},
+            "environment": "cloud",
+        }
+        main.set_env("TF_CONFIG", json.dumps(tf_config))
+
+        # JAX bootstrap for the TPU-native path: workers form the mesh,
+        # coordinator is worker-0 (PS/evaluator replicas stay out of it).
+        if rtype == ReplicaType.WORKER:
+            workers = replica_endpoints(
+                job, rtype, ctx, self.cluster_domain, self.local_addresses
+            )
+            main.set_env(constants.ENV_COORDINATOR_ADDRESS, workers[0])
+            main.set_env(constants.ENV_NUM_PROCESSES, str(len(workers)))
+            main.set_env(constants.ENV_PROCESS_ID, str(index))

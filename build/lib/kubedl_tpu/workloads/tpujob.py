@@ -1,0 +1,129 @@
+"""TPUJob: the flagship SPMD workload kind.
+
+The TPU-native successor to the reference's TFJob/PyTorchJob: a single
+Worker replica group runs one process per TPU host over a gang-scheduled
+slice. Instead of TF_CONFIG (controllers/tensorflow/tensorflow.go:75-152) or
+MASTER_ADDR/RANK (controllers/pytorch/pytorchjob_controller.go:195-245), the
+controller emits the `jax.distributed.initialize` bootstrap:
+
+- KUBEDL_COORDINATOR_ADDRESS — worker-0's address (stable headless-svc DNS
+  or an explicit host:port for local runs)
+- KUBEDL_NUM_PROCESSES / KUBEDL_PROCESS_ID
+- TPU_WORKER_HOSTNAMES / TPU_WORKER_ID — what the Cloud TPU runtime reads
+- KUBEDL_SLICE_TOPOLOGY + KUBEDL_MESH_AXES — mesh-axis hints so in-process
+  code can lay logical axes over ICI without re-deriving topology
+- MEGASCALE_* — DCN coordination for multislice jobs
+
+An optional Evaluator replica group (DAG-gated on workers Running) mirrors
+TFJob's evaluator-outside-the-cluster-spec behavior (tensorflow.go:112-116).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadController
+from kubedl_tpu.api.topology import MeshSpec
+from kubedl_tpu.api.types import ReplicaType
+from kubedl_tpu.core.objects import Pod
+from kubedl_tpu.engine.job_controller import replica_name
+
+
+@dataclass
+class TPUJob(JobObject):
+    KIND = "TPUJob"
+    #: Number of slices (multislice over DCN when > 1).
+    num_slices: int = 1
+    #: Logical mesh requested by the user; defaults to pure data-parallel
+    #: over all chips.
+    mesh: Optional[MeshSpec] = None
+
+
+class TPUJobController(WorkloadController):
+    KIND = "TPUJob"
+    NAME = "tpujob-controller"
+    ALLOWED_REPLICA_TYPES = (ReplicaType.WORKER, ReplicaType.EVALUATOR)
+
+    def object_factory(self) -> TPUJob:
+        return TPUJob()
+
+    def apply_defaults(self, job: JobObject) -> None:
+        """Workers span num_slices full slices: replicas = hosts*num_slices
+        (one process per TPU host, multislice over DCN)."""
+        super().apply_defaults(job)
+        assert isinstance(job, TPUJob)
+        spec = job.spec.replica_specs.get(ReplicaType.WORKER)
+        if spec is not None and spec.topology is not None:
+            spec.replicas = spec.topology.hosts * max(job.num_slices, 1)
+
+    def reconcile_orders(self) -> List[ReplicaType]:
+        return [ReplicaType.WORKER, ReplicaType.EVALUATOR]
+
+    def is_master_role(self, rtype: ReplicaType) -> bool:
+        return False  # SPMD: success comes from worker-0 (status machine)
+
+    def needs_service(self, rtype: ReplicaType, job=None) -> bool:
+        return rtype == ReplicaType.WORKER
+
+    # ------------------------------------------------------------------
+
+    def _worker_host(self, job: JobObject, index: int) -> str:
+        name = replica_name(job, ReplicaType.WORKER, index)
+        base = f"{name}.{job.metadata.namespace}.svc"
+        return f"{base}.{self.cluster_domain}" if self.cluster_domain else base
+
+    def _coordinator(self, job: JobObject) -> str:
+        port = int(
+            job.metadata.annotations.get(
+                constants.API_GROUP + "/coordinator-port", constants.DEFAULT_PORT
+            )
+        )
+        if self.local_addresses:
+            return f"127.0.0.1:{port}"
+        return f"{self._worker_host(job, 0)}:{port}"
+
+    def set_mesh_spec(
+        self,
+        job: JobObject,
+        pod: Pod,
+        rtype: ReplicaType,
+        index: int,
+        ctx: ReconcileContext,
+    ) -> None:
+        assert isinstance(job, TPUJob)
+        spec = job.spec.replica_specs[rtype]
+        main = pod.spec.main_container()
+        if rtype == ReplicaType.EVALUATOR:
+            # evaluators run outside the mesh (reference: tensorflow.go:112-116);
+            # keep any model path the engine already injected
+            if main.get_env(constants.ENV_MODEL_PATH) is None:
+                main.set_env(constants.ENV_MODEL_PATH, constants.DEFAULT_MODEL_PATH)
+            return
+        n = spec.replicas
+        hostnames = ",".join(self._worker_host(job, i) for i in range(n))
+        main.set_env(constants.ENV_COORDINATOR_ADDRESS, self._coordinator(job))
+        main.set_env(constants.ENV_NUM_PROCESSES, str(n))
+        main.set_env(constants.ENV_PROCESS_ID, str(index))
+        main.set_env(constants.ENV_TPU_WORKER_HOSTNAMES, hostnames)
+        main.set_env(constants.ENV_TPU_WORKER_ID, str(index))
+        if spec.topology is not None:
+            topo = spec.topology
+            shape = "x".join(str(d) for d in topo.physical_mesh)
+            main.set_env(
+                constants.ENV_TPU_SLICE_TOPOLOGY, f"{topo.name}:{shape}"
+            )
+            mesh = job.mesh or spec.mesh or MeshSpec.for_slice(
+                topo, num_slices=job.num_slices
+            )
+            main.set_env(constants.ENV_MESH_AXES, mesh.to_env())
+        elif job.mesh is not None:
+            main.set_env(constants.ENV_MESH_AXES, job.mesh.to_env())
+        if job.num_slices > 1:
+            main.set_env(constants.ENV_MEGASCALE_COORDINATOR, self._coordinator(job))
+            main.set_env(constants.ENV_MEGASCALE_NUM_SLICES, str(job.num_slices))
+            hosts_per_slice = max(n // job.num_slices, 1)
+            main.set_env(
+                constants.ENV_MEGASCALE_SLICE_ID, str(index // hosts_per_slice)
+            )

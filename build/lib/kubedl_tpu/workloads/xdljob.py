@@ -1,0 +1,145 @@
+"""XDLJob: PS/Worker/Scheduler sparse-model data parallelism.
+
+Capability parity with the reference's XDL controller (controllers/xdl/):
+a cluster JSON describing every role's endpoints handed to each pod
+(xdl.go:30-102), roles PS/Worker/Scheduler
+(apis/training/v1alpha1/xdljob_types.go:88-104), and the partial success
+policy `MinFinishWorkerNum` / `MinFinishWorkerPercentage`
+(xdljob_types.go:44-52): the job succeeds once enough workers finish, even
+while PS/scheduler replicas (which never exit on their own) are still up.
+
+TPU note: sparse embedding PS is host-RAM work; dense tower training belongs
+on the slice. Workers therefore also get the JAX bootstrap env so the dense
+path can run SPMD while the PS group stays in the CPU pool.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass
+from typing import List, Optional
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadController
+from kubedl_tpu.api.types import JobConditionType, ReplicaType
+from kubedl_tpu.core.objects import Pod, PodPhase
+from kubedl_tpu.workloads.common import add_dag_edge, replica_endpoints
+
+XDL_ROLE = {
+    ReplicaType.SCHEDULER: "scheduler",
+    ReplicaType.PS: "ps",
+    ReplicaType.WORKER: "worker",
+}
+
+
+@dataclass
+class XDLJob(JobObject):
+    KIND = "XDLJob"
+    #: Partial success: job succeeds once this many workers finished
+    #: (reference: xdljob_types.go:44-48).
+    min_finish_worker_num: Optional[int] = None
+    #: ... or this percentage of workers (xdljob_types.go:49-52).
+    min_finish_worker_percentage: Optional[float] = None
+
+
+class XDLJobController(WorkloadController):
+    KIND = "XDLJob"
+    NAME = "xdljob-controller"
+    ALLOWED_REPLICA_TYPES = (ReplicaType.SCHEDULER, ReplicaType.PS, ReplicaType.WORKER)
+
+    def object_factory(self) -> XDLJob:
+        return XDLJob()
+
+    def apply_defaults(self, job: JobObject) -> None:
+        """PS and workers wait for the scheduler; workers also wait for PS."""
+        super().apply_defaults(job)
+        add_dag_edge(job, ReplicaType.PS, ReplicaType.SCHEDULER)
+        add_dag_edge(job, ReplicaType.WORKER, ReplicaType.SCHEDULER)
+        add_dag_edge(job, ReplicaType.WORKER, ReplicaType.PS)
+
+    def reconcile_orders(self) -> List[ReplicaType]:
+        return [ReplicaType.SCHEDULER, ReplicaType.PS, ReplicaType.WORKER]
+
+    def is_master_role(self, rtype: ReplicaType) -> bool:
+        return False  # masterless: success comes from worker completion
+
+    # ------------------------------------------------------------------
+
+    def set_mesh_spec(
+        self,
+        job: JobObject,
+        pod: Pod,
+        rtype: ReplicaType,
+        index: int,
+        ctx: ReconcileContext,
+    ) -> None:
+        main = pod.spec.main_container()
+        cluster = {
+            role: replica_endpoints(
+                job, rt, ctx, self.cluster_domain, self.local_addresses
+            )
+            for rt, role in XDL_ROLE.items()
+            if rt in job.spec.replica_specs
+        }
+        main.set_env("XDL_CLUSTER_SPEC", json.dumps(cluster))
+        main.set_env("XDL_TASK_NAME", XDL_ROLE[rtype])
+        main.set_env("XDL_TASK_INDEX", str(index))
+        if rtype == ReplicaType.WORKER:
+            workers = cluster.get("worker", [])
+            if workers:
+                main.set_env(constants.ENV_COORDINATOR_ADDRESS, workers[0])
+                main.set_env(constants.ENV_NUM_PROCESSES, str(len(workers)))
+                main.set_env(constants.ENV_PROCESS_ID, str(index))
+
+    # ---- partial success (reference: xdljob_types.go:44-52) ------------
+
+    def _finish_threshold(self, job: XDLJob) -> Optional[int]:
+        spec = job.spec.replica_specs.get(ReplicaType.WORKER)
+        if spec is None:
+            return None
+        threshold: Optional[int] = None
+        if job.min_finish_worker_num is not None:
+            threshold = min(job.min_finish_worker_num, spec.replicas)
+        elif job.min_finish_worker_percentage is not None:
+            threshold = math.ceil(
+                spec.replicas * job.min_finish_worker_percentage / 100.0
+            )
+        # non-positive values are invalid, not "succeed instantly"
+        return threshold if threshold and threshold > 0 else None
+
+    def evaluate(self, job: JobObject, pods: List[Pod]):
+        """With a partial-success threshold set, the default masterless
+        worker-0 success rule must not fire — success is decided solely by
+        the finished-worker count in update_job_status."""
+        cond, reason, msg = super().evaluate(job, pods)
+        assert isinstance(job, XDLJob)
+        if (
+            cond == JobConditionType.SUCCEEDED
+            and self._finish_threshold(job) is not None
+        ):
+            return None, "", ""
+        return cond, reason, msg
+
+    def update_job_status(
+        self, job: JobObject, pods: List[Pod], ctx: ReconcileContext
+    ) -> None:
+        assert isinstance(job, XDLJob)
+        threshold = self._finish_threshold(job)
+        if threshold is None or job.status.is_terminal():
+            return
+        succeeded = sum(
+            1
+            for p in pods
+            if p.metadata.labels.get(constants.LABEL_REPLICA_TYPE)
+            == ReplicaType.WORKER.value
+            and p.status.phase == PodPhase.SUCCEEDED
+        )
+        if succeeded >= threshold:
+            # the engine's post-hook _on_transition stamps completion_time,
+            # metrics and events for this transition
+            job.status.set_condition(
+                JobConditionType.SUCCEEDED,
+                "MinWorkersFinished",
+                f"{succeeded} workers finished >= threshold {threshold}",
+            )

@@ -1,0 +1,81 @@
+"""XGBoostJob: Master (Rabit tracker) / Worker allreduce boosting.
+
+Capability parity with the reference's XGBoost controller
+(controllers/xgboost/): every pod gets MASTER_ADDR / MASTER_PORT /
+WORLD_SIZE / RANK + PYTHONUNBUFFERED=1 (pod.go:73-118); the master hosts the
+Rabit tracker, workers connect and allreduce gradients. RANK is 0 for the
+master and index+1 for workers.
+
+TPU note: boosting is CPU/host-side work — this kind exists for parity and
+for mixed pipelines (feature prep on the CPU pool feeding TPU training
+jobs); its replicas are topology-less so the gang scheduler places them in
+the CPU pool.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadController
+from kubedl_tpu.api.types import ReplicaType
+from kubedl_tpu.core.objects import Pod
+from kubedl_tpu.workloads.common import add_dag_edge, replica_dns, replica_port
+
+
+@dataclass
+class XGBoostJob(JobObject):
+    KIND = "XGBoostJob"
+
+
+class XGBoostJobController(WorkloadController):
+    KIND = "XGBoostJob"
+    NAME = "xgboostjob-controller"
+    ALLOWED_REPLICA_TYPES = (ReplicaType.MASTER, ReplicaType.WORKER)
+
+    def object_factory(self) -> XGBoostJob:
+        return XGBoostJob()
+
+    def apply_defaults(self, job: JobObject) -> None:
+        """Workers wait for the tracker: the Rabit rendezvous lives on the
+        master, so workers DAG-gate on master Running."""
+        super().apply_defaults(job)
+        add_dag_edge(job, ReplicaType.WORKER, ReplicaType.MASTER)
+
+    def reconcile_orders(self) -> List[ReplicaType]:
+        return [ReplicaType.MASTER, ReplicaType.WORKER]
+
+    def is_master_role(self, rtype: ReplicaType) -> bool:
+        return rtype == ReplicaType.MASTER
+
+    # ------------------------------------------------------------------
+
+    def set_mesh_spec(
+        self,
+        job: JobObject,
+        pod: Pod,
+        rtype: ReplicaType,
+        index: int,
+        ctx: ReconcileContext,
+    ) -> None:
+        main = pod.spec.main_container()
+        specs = job.spec.replica_specs
+        master_spec = specs.get(ReplicaType.MASTER)
+        world_size = sum(rs.replicas for rs in specs.values())
+        # all ranks must dial ONE tracker endpoint: the master, or worker-0
+        # when masterless
+        tracker_rt = ReplicaType.MASTER if master_spec else ReplicaType.WORKER
+        master_addr = replica_dns(
+            job, tracker_rt, 0, self.cluster_domain, self.local_addresses
+        )
+        master_port = replica_port(specs[tracker_rt], tracker_rt, 0, ctx)
+        if master_spec:
+            rank = 0 if rtype == ReplicaType.MASTER else index + 1
+        else:
+            rank = index
+        main.set_env("MASTER_ADDR", master_addr)
+        main.set_env("MASTER_PORT", str(master_port))
+        main.set_env("WORLD_SIZE", str(world_size))
+        main.set_env("RANK", str(rank))
+        main.set_env("WORKER_PORT", str(replica_port(specs[rtype], rtype, index, ctx)))
+        main.set_env("PYTHONUNBUFFERED", "1")

@@ -1,0 +1,76 @@
+#!/usr/bin/env python3
+"""Render the deploy surface from one values file (the helm/kustomize
+analogue; reference: helm/kubedl/Chart.yaml + templates and the
+config/{crd,rbac,manager} kustomize bases).
+
+    python deploy/render.py [--values deploy/values.yaml] [--out deploy/rendered]
+
+Outputs:
+- every template under deploy/templates/ with ${placeholders} substituted
+  (strict: a missing value fails the render, it does not emit garbage),
+- deploy/rendered/schemas/<Kind>.json — the CRD-equivalent JSON Schema
+  for every API kind, generated from the dataclasses
+  (kubedl_tpu.api.schema), the artifact set config/crd/bases/ carries in
+  the reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import string
+import sys
+from pathlib import Path
+
+HERE = Path(__file__).resolve().parent
+sys.path.insert(0, str(HERE.parent))
+
+
+def load_values(path: Path) -> dict:
+    import yaml
+
+    values = yaml.safe_load(path.read_text()) or {}
+    return {k: "" if v is None else str(v) for k, v in values.items()}
+
+
+def render(values_file: Path, out_dir: Path) -> list:
+    values = load_values(values_file)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    written = []
+    for tpl in sorted((HERE / "templates").glob("*")):
+        if not tpl.is_file():
+            continue
+        try:
+            body = string.Template(tpl.read_text()).substitute(values)
+        except KeyError as e:
+            raise SystemExit(
+                f"{tpl.name}: no value for placeholder {e} in {values_file}"
+            ) from e
+        dest = out_dir / tpl.name
+        dest.write_text(body)
+        written.append(dest)
+
+    from kubedl_tpu.api.schema import workload_schemas
+
+    schema_dir = out_dir / "schemas"
+    schema_dir.mkdir(exist_ok=True)
+    for kind, schema in workload_schemas().items():
+        dest = schema_dir / f"{kind}.json"
+        dest.write_text(json.dumps(schema, indent=2) + "\n")
+        written.append(dest)
+    return written
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--values", default=str(HERE / "values.yaml"))
+    ap.add_argument("--out", default=str(HERE / "rendered"))
+    args = ap.parse_args(argv)
+    written = render(Path(args.values), Path(args.out))
+    for p in written:
+        print(p)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""MNIST-class convergence workload (BASELINE target 1 analogue;
+reference: example/tf/mnist). Runs as a pod command under any workload
+kind:
+
+    python examples/mnist_convnet.py [--steps 150] [--batch 128]
+
+Trains the convnet family on MNIST-shaped synthetic digits (fixed class
+templates + noise — learnable structure without a dataset download) and
+exits 0 only if the loss dropped AND held-out accuracy clears 90%.
+Prints one worker_summary JSON line like the LM entrypoint does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested
+
+ensure_cpu_if_requested()
+from kubedl_tpu.utils.compile_cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=150)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--min-accuracy", type=float, default=0.9)
+    args = ap.parse_args()
+
+    from kubedl_tpu.models import convnet
+
+    cfg = convnet.ConvNetConfig()
+    data = convnet.SyntheticDigits(cfg, args.batch)
+    params, summary = convnet.fit(cfg, iter(data), steps=args.steps)
+
+    test_images, test_labels = next(iter(
+        convnet.SyntheticDigits(cfg, 512, seed=99)
+    ))[:2]
+    acc = convnet.accuracy(params, test_images, test_labels, cfg)
+    summary["accuracy"] = round(acc, 4)
+    print(json.dumps({"worker_summary": summary}), flush=True)
+    ok = summary["final_loss"] < summary["first_loss"] and acc >= args.min_accuracy
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

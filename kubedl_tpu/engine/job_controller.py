@@ -804,13 +804,22 @@ class JobEngine:
     def _delete_pod(self, pod: Pod) -> None:
         self.store.try_delete("Pod", pod.metadata.name, pod.metadata.namespace)
 
+    def _model_version_name(self, job: JobObject) -> str:
+        return f"mv-{job.metadata.name}-{job.metadata.uid[-5:]}"
+
     def _create_model_version(self, job: JobObject, ctx: ReconcileContext) -> None:
         """Publish the job's output as a ModelVersion (reference:
         createModelVersion, job.go:341-382)."""
         from kubedl_tpu.lineage.types import ModelVersion
 
-        mv_name = f"mv-{job.metadata.name}-{job.metadata.uid[-5:]}"
-        if job.status.model_version == mv_name:
+        mv_name = self._model_version_name(job)
+        if (
+            self.store.try_get("ModelVersion", mv_name, job.metadata.namespace)
+            is not None
+        ):
+            if job.status.model_version != mv_name:
+                job.status.model_version = mv_name
+                self._update_status(job)
             return
         spec_ref = job.spec.model_version
         assert spec_ref is not None
@@ -842,6 +851,12 @@ class JobEngine:
             self.recorder.event(job, "Normal", "JobRunning", "all replicas running")
         elif cond == JobConditionType.SUCCEEDED:
             job.status.completion_time = time.time()
+            # the MV name is deterministic: stamp it in the SAME status
+            # write as the success condition, so no client snapshot can
+            # observe Succeeded with an empty model_version (the MV object
+            # itself is created in _finalize moments later)
+            if job.spec.model_version is not None and not job.status.model_version:
+                job.status.model_version = self._model_version_name(job)
             self.metrics.successful.inc(kind=self.controller.KIND)
             self.recorder.event(job, "Normal", "JobSucceeded", "job succeeded")
         elif cond == JobConditionType.FAILED:

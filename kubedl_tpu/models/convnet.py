@@ -1,0 +1,167 @@
+"""Small convnet family (the MNIST-class workload, BASELINE target 1:
+"example/tf MNIST ... converge[s] on a slice scheduled end-to-end by the
+operator"; reference example: example/tf/mnist).
+
+TPU-first shape: NHWC layout (XLA's native conv layout on TPU), bf16-able
+`lax.conv_general_dilated` so the convolutions tile onto the MXU, pure
+functional params, one jitted train step with donated state. Small on
+purpose — this is the convergence-proof workload, not the flagship — but
+it exercises the conv path none of the LM families touch.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ConvNetConfig:
+    image_size: int = 28
+    channels: int = 1
+    classes: int = 10
+    width: int = 32  # first conv's filters; second doubles it
+    hidden: int = 128
+    dtype: Any = jnp.float32
+
+
+def convnet_init(key: jax.Array, cfg: ConvNetConfig) -> Params:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+
+    def he(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, jnp.float32) * math.sqrt(2.0 / fan_in)
+        ).astype(cfg.dtype)
+
+    w1, w2 = cfg.width, cfg.width * 2
+    flat = (cfg.image_size // 4) ** 2 * w2  # two 2x2 pools
+    return {
+        "conv1": he(k1, (3, 3, cfg.channels, w1), 9 * cfg.channels),
+        "b1": jnp.zeros((w1,), cfg.dtype),
+        "conv2": he(k2, (3, 3, w1, w2), 9 * w1),
+        "b2": jnp.zeros((w2,), cfg.dtype),
+        "dense": he(k3, (flat, cfg.hidden), flat),
+        "b3": jnp.zeros((cfg.hidden,), cfg.dtype),
+        "head": he(k4, (cfg.hidden, cfg.classes), cfg.hidden),
+        "b4": jnp.zeros((cfg.classes,), cfg.dtype),
+    }
+
+
+def _conv(x: jax.Array, w: jax.Array) -> jax.Array:
+    return lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _pool(x: jax.Array) -> jax.Array:
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+
+
+def convnet_forward(params: Params, images: jax.Array, cfg: ConvNetConfig) -> jax.Array:
+    """images [B, H, W, C] -> logits [B, classes] (fp32)."""
+    x = images.astype(cfg.dtype)
+    x = _pool(jax.nn.relu(_conv(x, params["conv1"]) + params["b1"]))
+    x = _pool(jax.nn.relu(_conv(x, params["conv2"]) + params["b2"]))
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["dense"] + params["b3"])
+    return (x @ params["head"] + params["b4"]).astype(jnp.float32)
+
+
+def convnet_loss(
+    params: Params, batch: Tuple[jax.Array, jax.Array], cfg: ConvNetConfig
+) -> jax.Array:
+    images, labels = batch
+    logits = convnet_forward(params, images, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+
+
+def accuracy(params: Params, images, labels, cfg: ConvNetConfig) -> float:
+    logits = convnet_forward(params, jnp.asarray(images), cfg)
+    return float((jnp.argmax(logits, -1) == jnp.asarray(labels)).mean())
+
+
+class SyntheticDigits:
+    """MNIST-shaped synthetic data with REAL learnable structure: each
+    class k is a fixed random template + noise, so a correct training
+    loop must converge to high accuracy while a broken one stays at
+    chance. No dataset download (zero-egress environments)."""
+
+    def __init__(self, cfg: ConvNetConfig, batch: int, seed: int = 0,
+                 noise: float = 0.3, template_seed: int = 1234) -> None:
+        self.cfg = cfg
+        self.batch = batch
+        self.noise = noise
+        # templates are the TASK (fixed across train/eval splits);
+        # ``seed`` only drives the sampling/noise stream
+        key = jax.random.PRNGKey(template_seed)
+        self.templates = jax.random.uniform(
+            key, (cfg.classes, cfg.image_size, cfg.image_size, cfg.channels)
+        )
+        self._key = jax.random.PRNGKey(seed + 1)
+
+        @jax.jit
+        def sample(key):
+            key, k1, k2 = jax.random.split(key, 3)
+            labels = jax.random.randint(k1, (batch,), 0, cfg.classes)
+            images = self.templates[labels]
+            images = images + self.noise * jax.random.normal(
+                k2, images.shape
+            )
+            return key, images, labels
+
+        self._sample = sample
+
+    def __iter__(self) -> Iterator[Tuple[jax.Array, jax.Array]]:
+        return self
+
+    def __next__(self):
+        self._key, images, labels = self._sample(self._key)
+        return images, labels
+
+
+def fit(
+    cfg: ConvNetConfig,
+    data: Iterator,
+    steps: int = 100,
+    learning_rate: float = 1e-3,
+    seed: int = 0,
+    params: Optional[Params] = None,
+) -> Tuple[Params, Dict[str, float]]:
+    """Minimal adam loop, one jitted donated step (the example-workload
+    trainer; the LM families use training.Trainer)."""
+    import optax
+
+    tx = optax.adam(learning_rate)
+    params = params or convnet_init(jax.random.PRNGKey(seed), cfg)
+    state = {"params": params, "opt": tx.init(params)}
+
+    @jax.jit
+    def step(state, batch):
+        loss, grads = jax.value_and_grad(convnet_loss)(
+            state["params"], batch, cfg
+        )
+        updates, opt = tx.update(grads, state["opt"], state["params"])
+        return {
+            "params": optax.apply_updates(state["params"], updates),
+            "opt": opt,
+        }, loss
+
+    first = last = None
+    for i in range(steps):
+        state, loss = step(state, next(data))
+        if i == 0:
+            first = float(loss)
+    last = float(loss)
+    return state["params"], {"first_loss": first, "final_loss": last,
+                             "steps": steps}

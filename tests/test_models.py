@@ -456,3 +456,73 @@ def test_chunked_loss_matches_full(tmp_path):
                       jax.tree_util.tree_leaves(g_chunk)):
         np.testing.assert_allclose(np.asarray(kf), np.asarray(kc),
                                    rtol=2e-4, atol=2e-4)
+
+
+class TestConvNet:
+    """The MNIST-class convergence family (BASELINE target 1 analogue)."""
+
+    def test_forward_shapes(self):
+        import jax
+
+        from kubedl_tpu.models import convnet
+
+        cfg = convnet.ConvNetConfig(width=8, hidden=16)
+        params = convnet.convnet_init(jax.random.PRNGKey(0), cfg)
+        imgs = jax.numpy.zeros((4, 28, 28, 1))
+        logits = convnet.convnet_forward(params, imgs, cfg)
+        assert logits.shape == (4, 10)
+
+    def test_converges_on_synthetic_digits(self):
+        from kubedl_tpu.models import convnet
+
+        cfg = convnet.ConvNetConfig(width=8, hidden=32)
+        data = convnet.SyntheticDigits(cfg, batch=64)
+        params, s = convnet.fit(cfg, iter(data), steps=120, learning_rate=3e-3)
+        assert s["final_loss"] < s["first_loss"]
+        imgs, labels = next(iter(convnet.SyntheticDigits(cfg, 256, seed=7)))
+        acc = convnet.accuracy(params, imgs, labels, cfg)
+        assert acc > 0.9, acc  # chance is 0.1
+
+
+def test_mnist_example_through_operator(tmp_path):
+    """BASELINE target 1 done-criterion: the MNIST-class workload
+    CONVERGES as a pod scheduled end-to-end by the operator (the example
+    script exits nonzero unless accuracy >= 90%)."""
+    import sys as _sys
+
+    from tests.helpers import make_tpujob
+
+    from kubedl_tpu.api.types import JobConditionType
+    from kubedl_tpu.operator import Operator, OperatorOptions
+    from kubedl_tpu.runtime.executor import SubprocessRuntime
+
+    logs = str(tmp_path / "logs")
+    opts = OperatorOptions(
+        local_addresses=True, pod_log_dir=logs,
+        artifact_registry_root=str(tmp_path / "reg"),
+        compile_cache_dir=str(tmp_path / "cc"),
+    )
+    import pathlib
+
+    script = pathlib.Path(__file__).resolve().parents[1] / "examples" / "mnist_convnet.py"
+    with Operator(opts, runtime=SubprocessRuntime(logs)) as op:
+        job = make_tpujob(
+            "mnist", workers=1,
+            command=[_sys.executable, str(script), "--steps", "80",
+                     "--batch", "64", "--min-accuracy", "0.85"],
+        )
+        op.submit(job)
+        got = op.wait_for_phase(
+            "TPUJob", "mnist",
+            [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+            timeout=300,
+        )
+        assert got.status.phase == JobConditionType.SUCCEEDED
+    log = pathlib.Path(logs) / "default" / "mnist-worker-0.log"
+    import json as _json
+
+    summary = None
+    for line in log.read_text().splitlines():
+        if "worker_summary" in line:
+            summary = _json.loads(line)["worker_summary"]
+    assert summary and summary["accuracy"] >= 0.85, summary

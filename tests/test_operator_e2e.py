@@ -337,12 +337,19 @@ def test_shared_storage_two_worker_train_build_serve(tmp_path):
         shard_files = _glob.glob(str(shared_root / "step-*" / "shards-p*.npz"))
         pids = {f.rsplit("shards-", 1)[1] for f in shard_files}
         assert {"p0.npz", "p1.npz"} <= pids, shard_files
-        # MV build consumed the shared artifact
-        mv_name = got.status.model_version
+        # MV build consumed the shared artifact (re-read the job each
+        # poll: the MV name now rides the success status write, but a
+        # hedge against any stale snapshot keeps this loop robust)
         deadline = time.time() + 30
         mv = None
         while time.time() < deadline:
-            mv = op.store.try_get("ModelVersion", mv_name, "default")
+            mv_name = op.store.get(
+                "TPUJob", "shared2", "default"
+            ).status.model_version
+            mv = (
+                op.store.try_get("ModelVersion", mv_name, "default")
+                if mv_name else None
+            )
             if mv is not None and mv.phase in (
                 ModelVersionPhase.SUCCEEDED, ModelVersionPhase.FAILED
             ):

@@ -212,3 +212,32 @@ class TestRemoteE2E:
                 assert pods and pods[0].name == "rtrain-worker-0"
                 evs = srv.backend.list_events("TPUJob", "rtrain")
                 assert any(e.reason for e in evs)
+
+
+class TestBlobEdgeCases:
+    """Review r3 findings: prefix boundaries, in-flight temp files,
+    unencoded keys."""
+
+    def test_prefix_matches_on_path_boundary(self, server):
+        put_blob(server.base_url, "models/m1/w.bin", b"one")
+        put_blob(server.base_url, "models/m10/w.bin", b"ten")
+        assert list_blobs(server.base_url, "models/m1") == ["models/m1/w.bin"]
+        assert list_blobs(server.base_url, "models/m10") == ["models/m10/w.bin"]
+
+    def test_inflight_tmp_uploads_invisible(self, server, tmp_path):
+        put_blob(server.base_url, "m/a.bin", b"done")
+        # simulate a crashed/in-progress PUT's temp file on the server
+        (server.root / "m" / "b.bin.tmp-upload").write_bytes(b"partial")
+        assert list_blobs(server.base_url, "m") == ["m/a.bin"]
+        # and the reserved suffix can't be uploaded or fetched directly
+        from kubedl_tpu.remote.client import RemoteError
+
+        with pytest.raises(RemoteError):
+            put_blob(server.base_url, "m/x.tmp-upload", b"no")
+        with pytest.raises(RemoteError):
+            get_blob(server.base_url, "m/b.bin.tmp-upload")
+
+    def test_keys_with_spaces_and_specials(self, server):
+        put_blob(server.base_url, "team a/m#1/w&x.bin", b"odd")
+        assert list_blobs(server.base_url, "team a") == ["team a/m#1/w&x.bin"]
+        assert get_blob(server.base_url, "team a/m#1/w&x.bin") == b"odd"

@@ -662,3 +662,22 @@ class TestMPILegacy:
         data = codec.encode(job)
         back = codec.decode_object(data)
         assert back.legacy_spec.processing_units == 4
+
+
+def test_mars_route_deleted_when_web_host_cleared():
+    """Review r3: unpublishing (clearing webHost) must delete the route,
+    not keep serving the old hostname until job deletion."""
+    engine, store, driver = make_engine(MarsJobController(local_addresses=True))
+    job = MarsJob()
+    job.metadata.name = "mars4"
+    job.web_host = "mars.example.com"
+    add_replicas(job, ReplicaType.SCHEDULER, 1)
+    add_replicas(job, ReplicaType.WEBSERVICE, 1)
+    store.create(job)
+    reconcile(engine, job)
+    assert store.try_get("IngressRoute", "mars4-web") is not None
+    job2 = store.get("MarsJob", "mars4")
+    job2.web_host = ""
+    store.update(job2)
+    reconcile(engine, job2)
+    assert store.try_get("IngressRoute", "mars4-web") is None
