@@ -1,0 +1,219 @@
+"""Node lifecycle: heartbeat-driven failure detection for pod hosts.
+
+The reference inherits this from Kubernetes (the node controller marks a
+node NotReady after its kubelet stops posting leases, then evicts its
+pods). The self-hosted substrate does the equivalent here:
+
+- kubelets call :meth:`NodeHeartbeater.start` for the node names they
+  serve; each renews ``Node.last_heartbeat`` every ``interval``.
+- :class:`NodeLifecycleController` watches Node objects; one that misses
+  heartbeats past ``grace`` flips NotReady and every non-terminal pod
+  bound to it is failed with a RETRYABLE exit (the SIGKILL class), so a
+  gang job on that host restarts whole-slice from its checkpoint — the
+  same recovery path a worker crash takes. A node that resumes
+  heartbeating flips back Ready.
+
+Opt-in by construction: pods on hosts that never registered a Node
+object are untouched, so single-process test setups and unpinned pods
+see no behavior change.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional
+
+from kubedl_tpu.core.manager import ControllerManager, EventRecorder
+from kubedl_tpu.core.objects import ContainerStatus, Node, Pod, PodPhase
+from kubedl_tpu.core.store import AlreadyExists, Conflict, NotFound, ObjectStore
+
+log = logging.getLogger("kubedl_tpu.core.nodes")
+
+#: exit code stamped on evicted pods: the retryable (SIGKILL) class, so
+#: restart policies treat node loss like preemption, not a code bug
+EVICT_EXIT_CODE = 137
+
+NODE_NAMESPACE = "kubedl-system"
+
+
+class NodeHeartbeater:
+    """Renews Node objects for the hosts one kubelet serves."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        node_names: List[str],
+        interval: float = 5.0,
+        clock=time.time,
+    ) -> None:
+        self.store = store
+        self.node_names = [n for n in node_names if n]
+        self.interval = interval
+        self.clock = clock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat_once(self) -> None:
+        now = self.clock()
+        for name in self.node_names:
+            try:
+                def mutate(obj: Node) -> None:
+                    obj.last_heartbeat = now
+                    if not obj.ready:
+                        obj.ready = True
+                        obj.reason = "heartbeat resumed"
+
+                self.store.update_with_retry("Node", name, NODE_NAMESPACE, mutate)
+            except NotFound:
+                node = Node(ready=True, last_heartbeat=now)
+                node.metadata.name = name
+                node.metadata.namespace = NODE_NAMESPACE
+                try:
+                    self.store.create(node)
+                except AlreadyExists:
+                    pass
+            except Conflict:
+                pass  # next beat wins
+
+    def start(self) -> None:
+        if not self.node_names:
+            return
+        if self._thread is not None and self._thread.is_alive():
+            return  # already beating
+        self._stop.clear()  # restartable after stop() (kubelet comeback)
+        self.beat_once()
+
+        def loop() -> None:
+            while not self._stop.wait(self.interval):
+                try:
+                    self.beat_once()
+                except Exception:
+                    log.exception("node heartbeat failed")
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="node-heartbeat"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+class NodeLifecycleController:
+    """Mark stale nodes NotReady and evict their pods (retryably)."""
+
+    NAME = "node-lifecycle"
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        recorder: Optional[EventRecorder] = None,
+        grace: float = 15.0,
+        clock=time.time,
+    ) -> None:
+        self.store = store
+        self.recorder = recorder or EventRecorder(store)
+        self.grace = grace
+        self.clock = clock
+
+    def setup(self, manager: ControllerManager) -> None:
+        manager.register(
+            self.NAME,
+            self.reconcile,
+            watch_kinds=["Node"],
+            mapper=lambda e, obj, old: [
+                (obj.metadata.namespace, obj.metadata.name)
+            ],
+        )
+
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        node = self.store.try_get("Node", name, namespace)
+        if not isinstance(node, Node):
+            return None
+        age = self.clock() - node.last_heartbeat
+        if age <= self.grace:
+            if not node.ready:
+                # recovered between our watch event and now
+                self._set_ready(node, True, "heartbeat resumed")
+            # re-check shortly after the deadline would pass
+            return max(self.grace - age, 0.05) + 0.05
+        if node.ready:
+            # the flip re-checks staleness INSIDE the mutate: a heartbeat
+            # landing between our read and this write must win (a kubelet
+            # stalled just past grace that resumes is alive — evicting
+            # its whole gang would be a spurious restart)
+            if not self._flip_not_ready(node, age):
+                return max(self.grace / 3.0, 0.05)
+            self.recorder.event(
+                node, "Warning", "NodeNotReady",
+                f"{name}: no heartbeat for {age:.1f}s",
+            )
+        self._evict_pods(name)
+        return self.grace  # keep checking: pods may land on it while dead
+
+    # ------------------------------------------------------------------
+
+    class _StillBeating(Exception):
+        pass
+
+    def _flip_not_ready(self, node: Node, age: float) -> bool:
+        def mutate(obj: Node) -> None:
+            if self.clock() - obj.last_heartbeat <= self.grace:
+                raise NodeLifecycleController._StillBeating()
+            obj.ready = False
+            obj.reason = f"no heartbeat for {age:.1f}s (grace {self.grace}s)"
+
+        try:
+            self.store.update_with_retry(
+                "Node", node.metadata.name, node.metadata.namespace, mutate
+            )
+            return True
+        except (NodeLifecycleController._StillBeating, NotFound, Conflict):
+            return False
+
+    def _set_ready(self, node: Node, ready: bool, reason: str) -> None:
+        def mutate(obj: Node) -> None:
+            obj.ready = ready
+            obj.reason = reason
+
+        try:
+            self.store.update_with_retry(
+                "Node", node.metadata.name, node.metadata.namespace, mutate
+            )
+        except NotFound:
+            pass
+
+    def _evict_pods(self, node_name: str) -> None:
+        for pod in self.store.list("Pod", namespace=None):
+            assert isinstance(pod, Pod)
+            if pod.spec.node_name != node_name or pod.is_terminal():
+                continue
+
+            def mutate(obj: Pod) -> None:
+                if obj.is_terminal():
+                    return
+                obj.status.phase = PodPhase.FAILED
+                # the exact k8s eviction reason: Pod.is_evicted() keys on
+                # it, making node loss retryable under EVERY restart
+                # policy (the NodeLost detail rides the Event)
+                obj.status.reason = "Evicted"
+                obj.status.finish_time = self.clock()
+                obj.status.container_statuses = [
+                    ContainerStatus(exit_code=EVICT_EXIT_CODE)
+                ]
+
+            try:
+                self.store.update_with_retry(
+                    "Pod", pod.metadata.name, pod.metadata.namespace, mutate
+                )
+                self.recorder.event(
+                    pod, "Warning", "Evicted",
+                    f"node {node_name} NotReady; pod failed retryably",
+                )
+            except NotFound:
+                continue

@@ -251,6 +251,23 @@ class ConfigMap(BaseObject):
 
 
 @dataclass
+class Node(BaseObject):
+    """A pod-hosting machine (the kubernetes Node analogue). The reference
+    delegates node lifecycle to the k8s node controller; the self-hosted
+    substrate needs its own: kubelets heartbeat their Node objects and the
+    NodeLifecycleController (core/nodes.py) marks stale ones NotReady and
+    evicts their pods with a RETRYABLE failure, feeding the normal
+    slice-granular gang-restart machinery."""
+
+    KIND = "Node"
+    ready: bool = True
+    #: unix time of the owning kubelet's last heartbeat
+    last_heartbeat: float = 0.0
+    #: human-readable reason for the current readiness state
+    reason: str = ""
+
+
+@dataclass
 class IngressRoute(BaseObject):
     """Host/path -> backing-service routing rule (the reference's
     networking.k8s.io Ingress analogue, controllers/mars/ingress.go:37-166:

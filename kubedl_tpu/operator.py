@@ -82,6 +82,13 @@ class OperatorOptions:
     #: base URL of a remote store (kubedl_tpu.remote.RemoteStoreServer);
     #: enables meta_storage/event_storage="http" (network persist mirror)
     remote_storage_url: str = ""
+    #: node-failure detection: a Node object that misses heartbeats this
+    #: long flips NotReady and its pods fail RETRYABLY (gang restart).
+    #: Pods on hosts without a registered Node object are untouched.
+    node_grace_seconds: float = 15.0
+    #: node names THIS process's kubelet heartbeats (opt-in; defaults to
+    #: [node_name] when node_name is set)
+    heartbeat_nodes: List[str] = field(default_factory=list)
 
 
 class ValidationError(ValueError):
@@ -155,6 +162,23 @@ class Operator:
             self.store, runtime or SubprocessRuntime(self.options.pod_log_dir)
         )
         self.kubelet.setup(self.manager)
+
+        # node lifecycle: heartbeat-driven failure detection (the k8s
+        # node-controller analogue the reference delegates to the cluster)
+        from kubedl_tpu.core.nodes import NodeHeartbeater, NodeLifecycleController
+
+        self.node_lifecycle = NodeLifecycleController(
+            self.store, self.manager.recorder,
+            grace=self.options.node_grace_seconds,
+        )
+        self.node_lifecycle.setup(self.manager)
+        beat_names = self.options.heartbeat_nodes or (
+            [self.options.node_name] if self.options.node_name else []
+        )
+        self.node_heartbeater = NodeHeartbeater(
+            self.store, beat_names,
+            interval=max(self.options.node_grace_seconds / 3.0, 0.5),
+        )
 
         # model lineage
         self.artifact_registry = ArtifactRegistry(self.options.artifact_registry_root)
@@ -259,6 +283,7 @@ class Operator:
     # ------------------------------------------------------------------
 
     def start(self) -> None:
+        self.node_heartbeater.start()
         if not self.options.leader_elect:
             self.manager.start()
             return
@@ -288,6 +313,7 @@ class Operator:
         elector = getattr(self, "elector", None)
         if elector is not None:
             elector.stop()
+        self.node_heartbeater.stop()
         self.kubelet.shutdown()
         self.manager.stop()
         for backend in (self.object_backend, self.event_backend):

@@ -233,7 +233,19 @@ class Kubelet:
                 handle.kill()
             return None
         assert isinstance(pod, Pod)
-        if not self._served(pod) or pod.is_terminal():
+        if not self._served(pod):
+            return None
+        if pod.is_terminal():
+            # a pod marked terminal EXTERNALLY (node-lifecycle eviction)
+            # may still have a live local process: kill it, or its
+            # same-name replacement can never launch (the reap thread
+            # frees the slot and relaunches). In the normal flow the
+            # handle is popped before the terminal phase is stamped, so a
+            # live handle here always means external termination.
+            with self._lock:
+                handle = self._running.get(key)
+            if handle is not None and not isinstance(handle, _PlaceholderHandle):
+                handle.kill()
             return None
         with self._lock:
             already_running = key in self._running
@@ -338,6 +350,13 @@ class Kubelet:
             if obj.metadata.uid != pod.metadata.uid:
                 # same-name pod recreated after a gang restart: the old
                 # process's lifecycle must not stamp the fresh pod
+                raise Kubelet._StalePod()
+            if obj.is_terminal():
+                # terminal is final: a pod already failed EXTERNALLY
+                # (node-lifecycle eviction, exit 137 retryable) must not
+                # be overwritten by the reaped kill signal (-15, which
+                # would read as a permanent code-bug failure) or
+                # resurrected to Running by an in-flight launch
                 raise Kubelet._StalePod()
             obj.status.phase = phase
             obj.status.pod_ip = self.pod_ip

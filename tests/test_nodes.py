@@ -1,0 +1,205 @@
+"""Node lifecycle: heartbeat-driven failure detection (the k8s
+node-controller analogue the reference delegates to the cluster).
+A host that stops heartbeating flips NotReady and its pods fail
+RETRYABLY — feeding the same slice-granular gang-restart machinery a
+worker crash does."""
+
+import time
+
+import pytest
+
+from kubedl_tpu.core.nodes import (
+    EVICT_EXIT_CODE,
+    NODE_NAMESPACE,
+    NodeHeartbeater,
+    NodeLifecycleController,
+)
+from kubedl_tpu.core.objects import Node, PodPhase
+from kubedl_tpu.core.store import ObjectStore
+
+
+class TestHeartbeatAndEviction:
+    def _setup(self, grace=10.0):
+        store = ObjectStore()
+        t = {"now": 1000.0}
+        clock = lambda: t["now"]
+        hb = NodeHeartbeater(store, ["nodeA"], clock=clock)
+        ctrl = NodeLifecycleController(store, grace=grace, clock=clock)
+        return store, t, hb, ctrl
+
+    def test_heartbeat_registers_and_renews(self):
+        store, t, hb, ctrl = self._setup()
+        hb.beat_once()
+        node = store.get("Node", "nodeA", NODE_NAMESPACE)
+        assert node.ready and node.last_heartbeat == 1000.0
+        t["now"] = 1005.0
+        hb.beat_once()
+        assert store.get("Node", "nodeA", NODE_NAMESPACE).last_heartbeat == 1005.0
+
+    def test_fresh_node_untouched_and_requeues(self):
+        store, t, hb, ctrl = self._setup(grace=10.0)
+        hb.beat_once()
+        requeue = ctrl.reconcile(NODE_NAMESPACE, "nodeA")
+        assert requeue is not None and requeue == pytest.approx(10.05, abs=0.2)
+        assert store.get("Node", "nodeA", NODE_NAMESPACE).ready
+
+    def test_stale_node_not_ready_and_pods_evicted(self):
+        from tests.helpers import make_tpujob
+
+        store, t, hb, ctrl = self._setup(grace=10.0)
+        hb.beat_once()
+        # two pods on nodeA, one on nodeB (no Node object), one terminal
+        from kubedl_tpu.core.objects import Pod
+
+        def pod(name, node, phase=PodPhase.RUNNING):
+            p = Pod()
+            p.metadata.name = name
+            p.spec.containers.append(
+                __import__("kubedl_tpu.core.objects", fromlist=["Container"]).Container()
+            )
+            p.spec.node_name = node
+            p.status.phase = phase
+            store.create(p)
+            return p
+
+        pod("a1", "nodeA")
+        pod("a2", "nodeA", PodPhase.PENDING)
+        pod("b1", "nodeB")
+        pod("a3", "nodeA", PodPhase.SUCCEEDED)
+
+        t["now"] = 1011.0  # past grace
+        ctrl.reconcile(NODE_NAMESPACE, "nodeA")
+        node = store.get("Node", "nodeA", NODE_NAMESPACE)
+        assert not node.ready and "no heartbeat" in node.reason
+        for name in ("a1", "a2"):
+            p = store.get("Pod", name)
+            assert p.status.phase == PodPhase.FAILED
+            assert p.status.container_statuses[0].exit_code == EVICT_EXIT_CODE
+            assert p.is_evicted()  # retryable under EVERY restart policy
+        assert store.get("Pod", "b1").status.phase == PodPhase.RUNNING
+        assert store.get("Pod", "a3").status.phase == PodPhase.SUCCEEDED
+        assert any(e.reason == "NodeNotReady" for e in store.list("Event", None))
+
+    def test_heartbeat_resume_flips_ready(self):
+        store, t, hb, ctrl = self._setup(grace=10.0)
+        hb.beat_once()
+        t["now"] = 1020.0
+        ctrl.reconcile(NODE_NAMESPACE, "nodeA")
+        assert not store.get("Node", "nodeA", NODE_NAMESPACE).ready
+        hb.beat_once()  # kubelet comes back
+        node = store.get("Node", "nodeA", NODE_NAMESPACE)
+        assert node.ready and node.reason == "heartbeat resumed"
+
+
+def test_node_loss_gang_restarts_job(tmp_path):
+    """E2e: a gang job whose host dies restarts whole-slice and completes
+    once the node returns — node loss takes the same recovery path as a
+    worker crash."""
+    from tests.helpers import make_tpujob
+
+    from kubedl_tpu.api.types import JobConditionType, ReplicaType, RestartPolicy
+    from kubedl_tpu.operator import Operator, OperatorOptions
+    from kubedl_tpu.runtime.executor import SubprocessRuntime
+
+    logs = str(tmp_path / "logs")
+    opts = OperatorOptions(
+        local_addresses=True, pod_log_dir=logs,
+        artifact_registry_root=str(tmp_path / "reg"),
+        node_grace_seconds=1.0, heartbeat_nodes=["hostX"],
+    )
+    marker = tmp_path / "node-recovered"
+    with Operator(opts, runtime=SubprocessRuntime(logs)) as op:
+        # pin the worker to hostX so the eviction targets it. The command
+        # sleeps until the marker exists (flaky-job pattern): the first
+        # attempt hangs, gets evicted on node loss, and the post-recovery
+        # attempt exits 0.
+        job = make_tpujob(
+            "nodeloss", workers=1,
+            command=["bash", "-c",
+                     f"for i in $(seq 300); do test -f {marker} && exit 0; "
+                     "sleep 1; done; exit 1"],
+            restart_policy=RestartPolicy.ON_FAILURE_SLICE,
+        )
+        spec = job.spec.replica_specs[ReplicaType.WORKER]
+        spec.template.spec.node_name = "hostX"
+        op.submit(job)
+        assert op.manager.wait(
+            lambda: any(
+                p.status.phase.value == "Running"
+                for p in op.store.list("Pod")
+            ), timeout=30,
+        )
+        # the node dies: stop heartbeating; the hung pod must be evicted
+        # retryably (its local process killed) and the job gang-restart
+        op.node_heartbeater.stop()
+        assert op.manager.wait(
+            lambda: op.store.get("TPUJob", "nodeloss").status.restart_count >= 1,
+            timeout=30,
+        ), "node loss never triggered a gang restart"
+        # node comes back; the retried attempt can now succeed
+        marker.write_text("up")
+        op.node_heartbeater.start()  # restartable after stop()
+        got = op.wait_for_phase(
+            "TPUJob", "nodeloss",
+            [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+            timeout=90,
+        )
+        assert got.status.phase == JobConditionType.SUCCEEDED
+        evicted = [e for e in op.store.list("Event", None)
+                   if e.reason == "Evicted"]
+        assert evicted, "eviction event missing"
+
+
+def test_heartbeat_racing_the_flip_wins():
+    """Review r3: a heartbeat landing between the staleness read and the
+    NotReady write must WIN — no spurious whole-gang eviction for a
+    kubelet that stalled just past grace and recovered."""
+    store = ObjectStore()
+    t = {"now": 1000.0}
+    hb = NodeHeartbeater(store, ["nodeA"], clock=lambda: t["now"])
+    ctrl = NodeLifecycleController(store, grace=10.0, clock=lambda: t["now"])
+    hb.beat_once()
+    from kubedl_tpu.core.objects import Container, Pod
+
+    p = Pod()
+    p.metadata.name = "p1"
+    p.spec.containers.append(Container())
+    p.spec.node_name = "nodeA"
+    p.status.phase = PodPhase.RUNNING
+    store.create(p)
+    t["now"] = 1011.0  # stale...
+    # ...but the kubelet beats again before the controller's write lands:
+    # simulate by patching _flip_not_ready's clock view via a beat first
+    hb.beat_once()  # heartbeat at 1011 -> age 0 inside the mutate
+    ctrl.reconcile(NODE_NAMESPACE, "nodeA")
+    assert store.get("Node", "nodeA", NODE_NAMESPACE).ready
+    assert store.get("Pod", "p1").status.phase == PodPhase.RUNNING
+
+
+def test_kubelet_never_overwrites_terminal_phase(tmp_path):
+    """Review r3: the reaped kill signal (-15) must not clobber an
+    eviction's retryable exit 137, and a launch must not resurrect an
+    evicted pod to Running."""
+    from kubedl_tpu.core.objects import Container, ContainerStatus, Pod
+    from kubedl_tpu.runtime.executor import Kubelet, FakeRuntime
+
+    store = ObjectStore()
+    kubelet = Kubelet(store, FakeRuntime())
+    p = Pod()
+    p.metadata.name = "p1"
+    p.spec.containers.append(Container(command=["true"]))
+    store.create(p)
+    # externally evicted (terminal, retryable)
+    def evict(obj):
+        obj.status.phase = PodPhase.FAILED
+        obj.status.reason = "Evicted"
+        obj.status.container_statuses = [ContainerStatus(exit_code=137)]
+    store.update_with_retry("Pod", "p1", "default", evict)
+    # a late reap stamps the kill signal -> must be a no-op
+    kubelet._set_phase(store.get("Pod", "p1"), PodPhase.FAILED, exit_code=-15)
+    got = store.get("Pod", "p1")
+    assert got.status.container_statuses[0].exit_code == 137
+    assert got.is_evicted()
+    # an in-flight launch must not resurrect it either
+    kubelet._set_phase(store.get("Pod", "p1"), PodPhase.RUNNING)
+    assert store.get("Pod", "p1").status.phase == PodPhase.FAILED
