@@ -109,18 +109,23 @@ def bench_serving(on_tpu: bool) -> dict:
     decode = jax.jit(lambda p, c, t: llama.decode_step_batched(p, c, t, cfg))
     out = {"model": preset, "n_params": cfg.num_params()}
     steps = 32 if on_tpu else 8
-    for B in (1, 8):
-        cache = llama.init_batched_cache(cfg, B, max_seq)
-        toks = jnp.ones((B, 1), jnp.int32)
-        logits, cache = decode(params, cache, toks)  # compile
-        float(jax.device_get(jnp.sum(logits)))  # true barrier
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            logits, cache = decode(params, cache, toks)
-        float(jax.device_get(jnp.sum(logits)))
-        dt = (time.perf_counter() - t0) / steps
-        out[f"decode_ms_per_token_b{B}"] = round(dt * 1e3, 3)
-        out[f"decode_tokens_per_sec_b{B}"] = round(B / dt, 1)
+    variants = {"": params}
+    if on_tpu:
+        # weight-only int8: decode is HBM-bound, halved weight bytes
+        variants["_int8"] = llama.quantize_params(params, cfg)
+    for suffix, p in variants.items():
+        for B in (1, 8):
+            cache = llama.init_batched_cache(cfg, B, max_seq)
+            toks = jnp.ones((B, 1), jnp.int32)
+            logits, cache = decode(p, cache, toks)  # compile
+            float(jax.device_get(jnp.sum(logits)))  # true barrier
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                logits, cache = decode(p, cache, toks)
+            float(jax.device_get(jnp.sum(logits)))
+            dt = (time.perf_counter() - t0) / steps
+            out[f"decode_ms_per_token_b{B}{suffix}"] = round(dt * 1e3, 3)
+            out[f"decode_tokens_per_sec_b{B}{suffix}"] = round(B / dt, 1)
     # time-to-first-token: 64-token prompt via batched prefill (ONE
     # forward fills the cache and yields the first token's logits —
     # round 2 paid 64 sequential decode steps here: 633ms on v5e)

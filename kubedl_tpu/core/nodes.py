@@ -54,6 +54,10 @@ class NodeHeartbeater:
         self.clock = clock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: bumped by every start(): a loop whose join timed out in stop()
+        #: (e.g. blocked in a stalled remote-store write) exits on its
+        #: next wakeup instead of running beside a newer loop forever
+        self._gen = 0
 
     def beat_once(self) -> None:
         now = self.clock()
@@ -80,13 +84,18 @@ class NodeHeartbeater:
     def start(self) -> None:
         if not self.node_names:
             return
-        if self._thread is not None and self._thread.is_alive():
-            return  # already beating
+        # always supersede: bumping the generation retires any previous
+        # loop (including one whose stop() join timed out while blocked in
+        # a stalled store write) the moment it unblocks
         self._stop.clear()  # restartable after stop() (kubelet comeback)
+        self._gen += 1
+        gen = self._gen
         self.beat_once()
 
         def loop() -> None:
             while not self._stop.wait(self.interval):
+                if self._gen != gen:
+                    return  # superseded by a newer start()
                 try:
                     self.beat_once()
                 except Exception:
@@ -101,7 +110,10 @@ class NodeHeartbeater:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
-            self._thread = None
+            if not self._thread.is_alive():
+                self._thread = None
+            # a thread stuck past the join timeout keeps its reference;
+            # generation checks retire it once it unblocks
 
 
 class NodeLifecycleController:
@@ -120,6 +132,21 @@ class NodeLifecycleController:
         self.recorder = recorder or EventRecorder(store)
         self.grace = grace
         self.clock = clock
+        #: (ns, name) -> (last_heartbeat value seen, OUR clock when seen).
+        #: Staleness is judged by when THIS controller observed the
+        #: heartbeat change (k8s lease-observation semantics) — comparing
+        #: the producer's wall clock against ours would let cross-host
+        #: clock skew eat the whole grace window and evict healthy nodes.
+        self._observed: dict = {}
+
+    def _observed_age(self, node: Node) -> float:
+        key = (node.metadata.namespace, node.metadata.name)
+        now = self.clock()
+        prev = self._observed.get(key)
+        if prev is None or prev[0] != node.last_heartbeat:
+            self._observed[key] = (node.last_heartbeat, now)
+            return 0.0
+        return now - prev[1]
 
     def setup(self, manager: ControllerManager) -> None:
         manager.register(
@@ -134,8 +161,9 @@ class NodeLifecycleController:
     def reconcile(self, namespace: str, name: str) -> Optional[float]:
         node = self.store.try_get("Node", name, namespace)
         if not isinstance(node, Node):
+            self._observed.pop((namespace, name), None)
             return None
-        age = self.clock() - node.last_heartbeat
+        age = self._observed_age(node)
         if age <= self.grace:
             if not node.ready:
                 # recovered between our watch event and now
@@ -163,7 +191,9 @@ class NodeLifecycleController:
 
     def _flip_not_ready(self, node: Node, age: float) -> bool:
         def mutate(obj: Node) -> None:
-            if self.clock() - obj.last_heartbeat <= self.grace:
+            # skew-safe re-check: a heartbeat VALUE change since our last
+            # observation means the kubelet is alive — abort the flip
+            if self._observed_age(obj) <= self.grace:
                 raise NodeLifecycleController._StillBeating()
             obj.ready = False
             obj.reason = f"no heartbeat for {age:.1f}s (grace {self.grace}s)"
@@ -188,6 +218,9 @@ class NodeLifecycleController:
         except NotFound:
             pass
 
+    class _AlreadyTerminal(Exception):
+        pass
+
     def _evict_pods(self, node_name: str) -> None:
         for pod in self.store.list("Pod", namespace=None):
             assert isinstance(pod, Pod)
@@ -196,7 +229,9 @@ class NodeLifecycleController:
 
             def mutate(obj: Pod) -> None:
                 if obj.is_terminal():
-                    return
+                    # terminal concurrently (e.g. it SUCCEEDED): no write,
+                    # no watch churn, and no misleading Evicted event
+                    raise NodeLifecycleController._AlreadyTerminal()
                 obj.status.phase = PodPhase.FAILED
                 # the exact k8s eviction reason: Pod.is_evicted() keys on
                 # it, making node loss retryable under EVERY restart
@@ -215,5 +250,5 @@ class NodeLifecycleController:
                     pod, "Warning", "Evicted",
                     f"node {node_name} NotReady; pod failed retryably",
                 )
-            except NotFound:
+            except (NotFound, NodeLifecycleController._AlreadyTerminal):
                 continue

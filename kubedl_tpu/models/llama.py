@@ -218,6 +218,52 @@ def param_pspecs(cfg: LlamaConfig) -> Params:
     return specs
 
 
+# ---- weight-only int8 (serving) --------------------------------------------
+
+#: weights quantized for serving (norms stay float: tiny and sensitive)
+_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def _quant_leaf(w: jax.Array) -> Dict[str, jax.Array]:
+    """Symmetric per-column int8: scale over the CONTRACTION axis (-2), so
+    `deq(w)` folds into the consuming matmul as a per-output-column scale
+    and XLA fuses convert+scale into the dot — HBM reads the int8 bytes,
+    half the bf16 traffic."""
+    a = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(a), axis=-2, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.clip(jnp.round(a / s), -127, 127).astype(jnp.int8)
+    return {"q8": q, "s8": s.astype(jnp.bfloat16)}
+
+
+def quantize_params(params: Params, cfg: LlamaConfig) -> Params:
+    """Weight-only int8 for the decode/prefill paths (serving: decode is
+    HBM-bandwidth-bound, and weights dominate the bytes — int8 halves
+    them). Embedding/lm_head and all layer matmuls quantize; norms stay
+    in their float dtype. Training never sees quantized params."""
+    out: Params = dict(params)
+    out["embed"] = _quant_leaf(params["embed"])
+    if "lm_head" in params:
+        out["lm_head"] = _quant_leaf(params["lm_head"])
+    layers = dict(params["layers"])
+    for key in _QUANT_KEYS:
+        layers[key] = _quant_leaf(layers[key])
+    out["layers"] = layers
+    return out
+
+
+def deq(w) -> jax.Array:
+    """Dequantize an int8 weight leaf ({"q8","s8"} -> bf16); identity for
+    raw arrays, so every consumer works with either representation."""
+    if isinstance(w, dict) and "q8" in w:
+        return w["q8"].astype(w["s8"].dtype) * w["s8"]
+    return w
+
+
+def _wdim(w, axis: int) -> int:
+    return (w["q8"] if isinstance(w, dict) and "q8" in w else w).shape[axis]
+
+
 # ---- building blocks -------------------------------------------------------
 
 def rmsnorm(
@@ -302,24 +348,24 @@ def _block(
     hd = cfg.head_dim
     po = cfg.norm_plus_one
     h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps, po)
-    n_heads = lp["wq"].shape[-1] // hd  # local (tensor-split) head count
-    n_kv = lp["wk"].shape[-1] // hd
-    q = (h @ lp["wq"]).reshape(B, S, n_heads, hd)
-    k = (h @ lp["wk"]).reshape(B, S, n_kv, hd)
-    v = (h @ lp["wv"]).reshape(B, S, n_kv, hd)
+    n_heads = _wdim(lp["wq"], -1) // hd  # local (tensor-split) head count
+    n_kv = _wdim(lp["wk"], -1) // hd
+    q = (h @ deq(lp["wq"])).reshape(B, S, n_heads, hd)
+    k = (h @ deq(lp["wk"])).reshape(B, S, n_kv, hd)
+    v = (h @ deq(lp["wv"])).reshape(B, S, n_kv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     attn = (attn_fn or attention)(q, k, v).reshape(B, S, n_heads * hd)
     # named for remat_policy="attn": save the attention output so backward
     # never re-runs the (flash) attention kernel, recompute everything else
     attn = checkpoint_name(attn, "attn_out")
-    attn_out = attn @ lp["wo"]  # row-parallel: partial sums under tp
+    attn_out = attn @ deq(lp["wo"])  # row-parallel: partial sums under tp
     if tp_axis:
         attn_out = lax.psum(attn_out, tp_axis)
     x = x + attn_out
     h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps, po)
-    gate = _act(cfg)((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-    mlp = (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    gate = _act(cfg)((h @ deq(lp["w_gate"])).astype(jnp.float32)).astype(h.dtype)
+    mlp = (gate * (h @ deq(lp["w_up"]))) @ deq(lp["w_down"])
     if tp_axis:
         mlp = lax.psum(mlp, tp_axis)
     return x + mlp
@@ -330,7 +376,7 @@ def llama_hidden(
 ) -> jax.Array:
     """tokens [B, S] int32 -> final-norm hidden states [B, S, D]."""
     B, S = tokens.shape
-    x = params["embed"][tokens].astype(cfg.dtype)
+    x = gather_embed(params["embed"], tokens).astype(cfg.dtype)
     if cfg.embed_scale:  # Gemma scales inputs by sqrt(dim)
         x = x * math.sqrt(cfg.dim)
     cos, sin = rope_freqs(cfg, S)
@@ -344,8 +390,15 @@ def llama_hidden(
     return rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.norm_plus_one)
 
 
+def gather_embed(embed, tokens: jax.Array) -> jax.Array:
+    """Token embedding lookup; int8 embeds gather q8 rows then scale."""
+    if isinstance(embed, dict) and "q8" in embed:
+        return embed["q8"][tokens].astype(embed["s8"].dtype) * embed["s8"]
+    return embed[tokens]
+
+
 def lm_head_of(params: Params, cfg: LlamaConfig) -> jax.Array:
-    return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return deq(params["embed"]).T if cfg.tie_embeddings else deq(params["lm_head"])
 
 
 def llama_forward(
@@ -520,7 +573,7 @@ def decode_step_batched(
     hd = cfg.head_dim
     pos = cache["pos"]  # [B]
     max_s = cache["k"].shape[2]
-    x = params["embed"][tokens].astype(cfg.dtype)  # [B, 1, D]
+    x = gather_embed(params["embed"], tokens).astype(cfg.dtype)  # [B, 1, D]
     if cfg.embed_scale:
         x = x * math.sqrt(cfg.dim)
     cos, sin = rope_freqs(cfg, max_s)
@@ -539,16 +592,16 @@ def decode_step_batched(
     def body(x, inp):
         lp, ck, cv = inp  # ck/cv: [B, T, KV, hd] this layer's cache
         h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps, cfg.norm_plus_one)
-        q = rot((h @ lp["wq"]).reshape(B, 1, cfg.n_heads, hd))
-        k = rot((h @ lp["wk"]).reshape(B, 1, cfg.n_kv_heads, hd))
-        v = (h @ lp["wv"]).reshape(B, 1, cfg.n_kv_heads, hd)
+        q = rot((h @ deq(lp["wq"])).reshape(B, 1, cfg.n_heads, hd))
+        k = rot((h @ deq(lp["wk"])).reshape(B, 1, cfg.n_kv_heads, hd))
+        v = (h @ deq(lp["wv"])).reshape(B, 1, cfg.n_kv_heads, hd)
         ck = _row_update(ck, k, pos)
         cv = _row_update(cv, v, pos)
         attn = attention(q, ck, cv, causal=False, mask=mask)
-        x = x + attn.reshape(B, 1, cfg.n_heads * hd) @ lp["wo"]
+        x = x + attn.reshape(B, 1, cfg.n_heads * hd) @ deq(lp["wo"])
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps, cfg.norm_plus_one)
-        gate = _act(cfg)((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-        x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+        gate = _act(cfg)((h @ deq(lp["w_gate"])).astype(jnp.float32)).astype(h.dtype)
+        x = x + (gate * (h @ deq(lp["w_up"]))) @ deq(lp["w_down"])
         return x, (ck, cv)
 
     x, (new_k, new_v) = lax.scan(
@@ -589,7 +642,7 @@ def prefill_batched(
     hd = cfg.head_dim
     max_s = cache["k"].shape[2]
     active = lengths > 0
-    x = params["embed"][tokens].astype(cfg.dtype)  # [B, S, D]
+    x = gather_embed(params["embed"], tokens).astype(cfg.dtype)  # [B, S, D]
     if cfg.embed_scale:
         x = x * math.sqrt(cfg.dim)
     cos, sin = rope_freqs(cfg, S)
@@ -598,14 +651,14 @@ def prefill_batched(
     def body(x, inp):
         lp, ck, cv = inp
         h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps, cfg.norm_plus_one)
-        q = apply_rope((h @ lp["wq"]).reshape(B, S, cfg.n_heads, hd), cos, sin)
-        k = apply_rope((h @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd), cos, sin)
-        v = (h @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+        q = apply_rope((h @ deq(lp["wq"])).reshape(B, S, cfg.n_heads, hd), cos, sin)
+        k = apply_rope((h @ deq(lp["wk"])).reshape(B, S, cfg.n_kv_heads, hd), cos, sin)
+        v = (h @ deq(lp["wv"])).reshape(B, S, cfg.n_kv_heads, hd)
         attn = attention(q, k, v, causal=True)
-        x = x + attn.reshape(B, S, cfg.n_heads * hd) @ lp["wo"]
+        x = x + attn.reshape(B, S, cfg.n_heads * hd) @ deq(lp["wo"])
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps, cfg.norm_plus_one)
-        gate = _act(cfg)((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-        x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+        gate = _act(cfg)((h @ deq(lp["w_gate"])).astype(jnp.float32)).astype(h.dtype)
+        x = x + (gate * (h @ deq(lp["w_up"]))) @ deq(lp["w_down"])
         # prompts start at position 0 (rows are reset on admission)
         ck = jnp.where(sel, lax.dynamic_update_slice_in_dim(ck, k, 0, axis=1), ck)
         cv = jnp.where(sel, lax.dynamic_update_slice_in_dim(cv, v, 0, axis=1), cv)
@@ -636,7 +689,7 @@ def decode_step(
     B = tokens.shape[0]
     hd = cfg.head_dim
     pos = cache["pos"]
-    x = params["embed"][tokens].astype(cfg.dtype)  # [B, 1, D]
+    x = gather_embed(params["embed"], tokens).astype(cfg.dtype)  # [B, 1, D]
     if cfg.embed_scale:
         x = x * math.sqrt(cfg.dim)
     cos, sin = rope_freqs(cfg, cfg.max_seq)
@@ -649,9 +702,9 @@ def decode_step(
     for layer in range(cfg.n_layers):
         lp = jax.tree_util.tree_map(lambda a: a[layer], params["layers"])
         h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps, cfg.norm_plus_one)
-        q = (h @ lp["wq"]).reshape(B, 1, cfg.n_heads, hd)
-        k = (h @ lp["wk"]).reshape(B, 1, cfg.n_kv_heads, hd)
-        v = (h @ lp["wv"]).reshape(B, 1, cfg.n_kv_heads, hd)
+        q = (h @ deq(lp["wq"])).reshape(B, 1, cfg.n_heads, hd)
+        k = (h @ deq(lp["wk"])).reshape(B, 1, cfg.n_kv_heads, hd)
+        v = (h @ deq(lp["wv"])).reshape(B, 1, cfg.n_kv_heads, hd)
         q = apply_rope(q, cos_t, sin_t)
         k = apply_rope(k, cos_t, sin_t)
         ck = lax.dynamic_update_slice_in_dim(cache["k"][layer], k, pos, axis=1)
@@ -659,10 +712,10 @@ def decode_step(
         new_k.append(ck)
         new_v.append(cv)
         attn = attention(q, ck, cv, causal=False, mask=valid)
-        x = x + attn.reshape(B, 1, cfg.n_heads * hd) @ lp["wo"]
+        x = x + attn.reshape(B, 1, cfg.n_heads * hd) @ deq(lp["wo"])
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps, cfg.norm_plus_one)
-        gate = _act(cfg)((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-        x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+        gate = _act(cfg)((h @ deq(lp["w_gate"])).astype(jnp.float32)).astype(h.dtype)
+        x = x + (gate * (h @ deq(lp["w_up"]))) @ deq(lp["w_down"])
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.norm_plus_one)
     logits = (x[:, 0] @ lm_head_of(params, cfg)).astype(jnp.float32)
     cache = {

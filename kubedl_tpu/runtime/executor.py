@@ -284,6 +284,16 @@ class Kubelet:
         with self._lock:
             self._running[key] = handle
         self._set_phase(pod, PodPhase.RUNNING)
+        # an eviction landing DURING launch (init containers etc.) found
+        # only the placeholder handle and could kill nothing; now that the
+        # real handle exists, honor any terminal phase stamped meanwhile
+        fresh = self.store.try_get("Pod", pod.metadata.name, pod.metadata.namespace)
+        if (
+            fresh is None
+            or fresh.metadata.uid != pod.metadata.uid
+            or fresh.is_terminal()
+        ):
+            handle.kill()
 
         def reap() -> None:
             code = handle.wait()

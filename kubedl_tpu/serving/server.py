@@ -56,7 +56,8 @@ class LlamaEngine:
     one compile serves every mix of in-flight requests."""
 
     def __init__(self, preset: str = "tiny", ckpt_dir: str = "",
-                 batch: int = 0, max_seq: int = 0, max_batch: int = 4) -> None:
+                 batch: int = 0, max_seq: int = 0, max_batch: int = 4,
+                 quantize: str = "") -> None:
         import jax
 
         from kubedl_tpu.models import llama
@@ -71,6 +72,14 @@ class LlamaEngine:
             if state is not None:
                 params = state["params"]
                 log.info("restored checkpoint from %s", ckpt_dir)
+        if quantize == "int8":
+            # weight-only int8: decode is HBM-bound and weights dominate
+            # the bytes — halves the per-token floor (docs/serving.md)
+            params = llama.quantize_params(params, self.cfg)
+            log.info("serving with int8 weight-only quantization")
+        elif quantize:
+            raise ValueError(f"unknown quantize mode {quantize!r}")
+        self.quantize = quantize
         self.params = params
         self._llama = llama
         self._jax = jax
@@ -398,8 +407,11 @@ def serve_main(env: Optional[Dict[str, str]] = None) -> int:
     # contradicted the k8s deployment story)
     host = cfg.get("host") or os.environ.get("KUBEDL_SERVE_HOST", "127.0.0.1")
     preset = cfg.get("preset", os.environ.get("KUBEDL_SERVE_PRESET", "tiny"))
-    engine = LlamaEngine(preset=preset, ckpt_dir=ckpt,
-                         max_batch=int(cfg.get("max_batch", 4)))
+    engine = LlamaEngine(
+        preset=preset, ckpt_dir=ckpt,
+        max_batch=int(cfg.get("max_batch", 4)),
+        quantize=cfg.get("quantize", os.environ.get("KUBEDL_SERVE_QUANTIZE", "")),
+    )
     server = ThreadingHTTPServer(
         (host, port), make_handler(engine, cfg.get("model_name", preset))
     )

@@ -67,7 +67,8 @@ class TestHeartbeatAndEviction:
         pod("b1", "nodeB")
         pod("a3", "nodeA", PodPhase.SUCCEEDED)
 
-        t["now"] = 1011.0  # past grace
+        ctrl.reconcile(NODE_NAMESPACE, "nodeA")  # observe the heartbeat
+        t["now"] = 1011.0  # past grace with no new heartbeat observed
         ctrl.reconcile(NODE_NAMESPACE, "nodeA")
         node = store.get("Node", "nodeA", NODE_NAMESPACE)
         assert not node.ready and "no heartbeat" in node.reason
@@ -83,6 +84,7 @@ class TestHeartbeatAndEviction:
     def test_heartbeat_resume_flips_ready(self):
         store, t, hb, ctrl = self._setup(grace=10.0)
         hb.beat_once()
+        ctrl.reconcile(NODE_NAMESPACE, "nodeA")  # observe
         t["now"] = 1020.0
         ctrl.reconcile(NODE_NAMESPACE, "nodeA")
         assert not store.get("Node", "nodeA", NODE_NAMESPACE).ready
@@ -167,10 +169,11 @@ def test_heartbeat_racing_the_flip_wins():
     p.spec.node_name = "nodeA"
     p.status.phase = PodPhase.RUNNING
     store.create(p)
+    ctrl.reconcile(NODE_NAMESPACE, "nodeA")  # observe
     t["now"] = 1011.0  # stale...
     # ...but the kubelet beats again before the controller's write lands:
-    # simulate by patching _flip_not_ready's clock view via a beat first
-    hb.beat_once()  # heartbeat at 1011 -> age 0 inside the mutate
+    # the flip's in-mutate observation sees the CHANGED value and aborts
+    hb.beat_once()
     ctrl.reconcile(NODE_NAMESPACE, "nodeA")
     assert store.get("Node", "nodeA", NODE_NAMESPACE).ready
     assert store.get("Pod", "p1").status.phase == PodPhase.RUNNING
@@ -203,3 +206,57 @@ def test_kubelet_never_overwrites_terminal_phase(tmp_path):
     # an in-flight launch must not resurrect it either
     kubelet._set_phase(store.get("Pod", "p1"), PodPhase.RUNNING)
     assert store.get("Pod", "p1").status.phase == PodPhase.FAILED
+
+
+def test_clock_skew_does_not_evict_healthy_node():
+    """Review r3: staleness is judged by when THIS controller OBSERVED the
+    heartbeat change, not by comparing producer vs controller wall clocks
+    — a kubelet whose clock is far behind must not be evicted while its
+    heartbeats keep arriving."""
+    store = ObjectStore()
+    ctrl_t = {"now": 10_000.0}
+    kubelet_t = {"now": 0.0}  # 10,000s behind the controller's clock
+    hb = NodeHeartbeater(store, ["nodeA"], clock=lambda: kubelet_t["now"])
+    ctrl = NodeLifecycleController(store, grace=10.0,
+                                   clock=lambda: ctrl_t["now"])
+    hb.beat_once()
+    ctrl.reconcile(NODE_NAMESPACE, "nodeA")  # first observation
+    # heartbeats keep arriving (values change); controller time advances
+    for _ in range(5):
+        kubelet_t["now"] += 5.0
+        ctrl_t["now"] += 5.0
+        hb.beat_once()
+        ctrl.reconcile(NODE_NAMESPACE, "nodeA")
+    assert store.get("Node", "nodeA", NODE_NAMESPACE).ready
+    # now the kubelet actually stops: observed value freezes -> NotReady
+    ctrl_t["now"] += 11.0
+    ctrl.reconcile(NODE_NAMESPACE, "nodeA")
+    assert not store.get("Node", "nodeA", NODE_NAMESPACE).ready
+
+
+def test_eviction_skips_concurrently_terminal_pod_quietly():
+    """Review r3: a pod that reached a terminal phase between the list
+    snapshot and the eviction write gets neither a store write nor a
+    misleading Evicted event."""
+    from kubedl_tpu.core.objects import Container, Pod
+
+    store = ObjectStore()
+    t = {"now": 1000.0}
+    ctrl = NodeLifecycleController(store, grace=1.0, clock=lambda: t["now"])
+    hb = NodeHeartbeater(store, ["nodeA"], clock=lambda: t["now"])
+    hb.beat_once()
+    p = Pod()
+    p.metadata.name = "p1"
+    p.spec.containers.append(Container())
+    p.spec.node_name = "nodeA"
+    p.status.phase = PodPhase.SUCCEEDED  # terminal before eviction runs
+    store.create(p)
+    rv = store.get("Pod", "p1").metadata.resource_version
+    ctrl.reconcile(NODE_NAMESPACE, "nodeA")  # observe
+    t["now"] += 2.0
+    ctrl.reconcile(NODE_NAMESPACE, "nodeA")  # stale -> evict pass
+
+    got = store.get("Pod", "p1")
+    assert got.status.phase == PodPhase.SUCCEEDED
+    assert got.metadata.resource_version == rv  # no no-op write
+    assert not any(e.reason == "Evicted" for e in store.list("Event", None))

@@ -571,3 +571,91 @@ def test_generate_timeout_frees_slot():
         assert eng._waiting == []
     finally:
         eng._thread.join(timeout=1)
+
+
+class TestInt8Quantization:
+    """Weight-only int8 for serving (decode is HBM-bound; measured on
+    v5e-1 Gemma-2B: b1 119 -> 199 tok/s, b8 793 -> 1218 tok/s)."""
+
+    def test_quantize_roundtrip_accuracy(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kubedl_tpu.models import llama
+
+        cfg = llama.TINY
+        p = llama.llama_init(jax.random.PRNGKey(0), cfg)
+        qp = llama.quantize_params(p, cfg)
+        # per-column symmetric: dequantized weights within 1/127 of scale
+        w = np.asarray(p["layers"]["wq"], np.float32)
+        dq = np.asarray(llama.deq(qp["layers"]["wq"]), np.float32)
+        colmax = np.abs(w).max(axis=-2, keepdims=True)
+        # int8 step + bf16 scale rounding (~2^-8 relative)
+        bound = colmax / 127.0 + np.abs(w) * 2.0 ** -7 + 1e-6
+        assert np.all(np.abs(w - dq) <= bound)
+        # norms untouched
+        assert qp["layers"]["attn_norm"] is p["layers"]["attn_norm"]
+
+    def test_forward_decode_prefill_close_to_fp(self):
+        import jax
+        import jax.numpy as jnp
+
+        from kubedl_tpu.models import llama
+
+        cfg = llama.TINY
+        p = llama.llama_init(jax.random.PRNGKey(0), cfg)
+        qp = llama.quantize_params(p, cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                  cfg.vocab_size)
+        lf = llama.llama_forward(p, toks, cfg)
+        lq = llama.llama_forward(qp, toks, cfg)
+        rel = float(jnp.abs(lf - lq).max() / (jnp.abs(lf).max() + 1e-9))
+        assert rel < 0.1, rel
+        # decode + prefill paths run with quantized params
+        cache = llama.init_batched_cache(cfg, 2, 32)
+        logits, cache = llama.decode_step_batched(qp, cache, toks[:, :1], cfg)
+        assert logits.shape == (2, cfg.vocab_size)
+        pre, _ = llama.prefill_batched(
+            qp, llama.init_batched_cache(cfg, 2, 32), toks,
+            jnp.array([16, 16]), cfg,
+        )
+        assert pre.shape == (2, cfg.vocab_size)
+        # the single-sequence decode_step path accepts quantized params too
+        sc = llama.init_cache(cfg, 2, 32)
+        ls, _ = llama.decode_step(qp, sc, toks[:, :1], cfg)
+        assert ls.shape == (2, cfg.vocab_size)
+
+    def test_engine_serves_quantized(self):
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        eng = LlamaEngine(preset="tiny", max_batch=2, max_seq=64,
+                          quantize="int8")
+        try:
+            got = eng.generate([5, 9, 13], max_tokens=6)
+            assert len(got["token_ids"]) == 6
+            assert got["prompt_len"] == 3
+        finally:
+            eng.close()
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="quantize"):
+            LlamaEngine(preset="tiny", quantize="fp4")
+
+    def test_tied_embeddings_quantized(self):
+        """Gemma ties lm_head to the embedding: the quantized head path
+        (deq(embed).T) must work too."""
+        import jax
+        import jax.numpy as jnp
+
+        from kubedl_tpu.models import llama
+
+        cfg = llama.TINY_GEMMA
+        p = llama.llama_init(jax.random.PRNGKey(0), cfg)
+        qp = llama.quantize_params(p, cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0,
+                                  cfg.vocab_size)
+        lf = llama.llama_forward(p, toks, cfg)
+        lq = llama.llama_forward(qp, toks, cfg)
+        rel = float(jnp.abs(lf - lq).max() / (jnp.abs(lf).max() + 1e-9))
+        assert rel < 0.15, rel
