@@ -148,6 +148,7 @@ class ConsoleServer:
         # model lineage + slice fleet (console views over live objects)
         r("GET", "/api/v1/model/list", ConsoleServer._h_model_list)
         r("GET", "/api/v1/cluster/slices", ConsoleServer._h_cluster_slices)
+        r("GET", "/api/v1/cluster/nodes", ConsoleServer._h_cluster_nodes)
         # data/code sources, ConfigMap-backed CRUD (reference: console
         # backend datasource/codesource handlers). The source kind is a
         # path capture, never sniffed from the full path (a codesource
@@ -516,6 +517,24 @@ class ConsoleServer:
         """Slice fleet detail: topology, hosts, holder — the TPU-native
         analogue of the reference's node/resource ClusterInfo page."""
         return {"slices": self.operator.inventory.detail()}
+
+    def _h_cluster_nodes(self, req: Request):
+        """Node health (heartbeat-registered hosts + their pod counts)."""
+        pods = self.operator.store.list("Pod", namespace=None)
+        by_node: Dict[str, int] = {}
+        for p in pods:
+            if p.spec.node_name:
+                by_node[p.spec.node_name] = by_node.get(p.spec.node_name, 0) + 1
+        nodes = []
+        for n in self.operator.store.list("Node", namespace=None):
+            nodes.append({
+                "name": n.metadata.name,
+                "ready": n.ready,
+                "reason": n.reason,
+                "last_heartbeat": n.last_heartbeat,
+                "pods": by_node.get(n.metadata.name, 0),
+            })
+        return {"nodes": sorted(nodes, key=lambda x: x["name"])}
 
     #: seconds a probed QPS value stays fresh — the charts page polls and
     #: the probe (HTTP, 2s timeout) must not serially block the handler

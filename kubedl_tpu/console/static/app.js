@@ -44,6 +44,7 @@ window.addEventListener('hashchange', route);
 VIEWS.overview = async () => {
   const o = (await api('/api/v1/data/overview')).data;
   const sl = (await api('/api/v1/cluster/slices')).data.slices;
+  const nodes = (await api('/api/v1/cluster/nodes')).data.nodes;
   const tiles = [
     [o.jobTotal, 'jobs'], [o.jobPhases.Running || 0, 'running'],
     [o.podRunning + '/' + o.podTotal, 'pods running'],
@@ -59,6 +60,15 @@ VIEWS.overview = async () => {
       <td>${esc(s.chips)}</td><td class=muted>${esc(s.hosts.join(', '))}</td>
       <td>${s.allocated_to ? esc(s.allocated_to) : '<span class=muted>free</span>'}</td>
       </tr>`).join('') || '<tr><td colspan=5 class=muted>no slices registered</td></tr>'}
+    </tbody></table>
+    <h2>Nodes</h2>
+    <table><thead><tr><th>node</th><th>state</th><th>pods</th>
+      <th>last heartbeat</th><th>reason</th></tr></thead>
+    <tbody>${nodes.map(n => `<tr><td>${esc(n.name)}</td>
+      <td>${phaseTag(n.ready ? 'Running' : 'Failed')}</td>
+      <td>${esc(n.pods)}</td><td class=muted>${esc(fmt(n.last_heartbeat))}</td>
+      <td class=muted>${esc(n.reason)}</td></tr>`).join('')
+      || '<tr><td colspan=5 class=muted>no heartbeat-registered nodes</td></tr>'}
     </tbody></table>
     <h2>Jobs by phase</h2>
     <div class="tiles">${Object.entries(o.jobPhases).map(([p, n]) =>

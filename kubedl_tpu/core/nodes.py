@@ -139,14 +139,22 @@ class NodeLifecycleController:
         #: clock skew eat the whole grace window and evict healthy nodes.
         self._observed: dict = {}
 
-    def _observed_age(self, node: Node) -> float:
+    def _observe(self, node: Node):
+        """Returns (age_seconds, value_changed). First observation after a
+        controller (re)start counts as age 0 — give the node a full grace
+        window — but NOT as a changed value: only a real new heartbeat
+        may flip a NotReady node back Ready (a dead node must not read
+        Ready for a grace window after every operator restart)."""
         key = (node.metadata.namespace, node.metadata.name)
         now = self.clock()
         prev = self._observed.get(key)
-        if prev is None or prev[0] != node.last_heartbeat:
+        if prev is None:
             self._observed[key] = (node.last_heartbeat, now)
-            return 0.0
-        return now - prev[1]
+            return 0.0, False
+        if prev[0] != node.last_heartbeat:
+            self._observed[key] = (node.last_heartbeat, now)
+            return 0.0, True
+        return now - prev[1], False
 
     def setup(self, manager: ControllerManager) -> None:
         manager.register(
@@ -163,10 +171,11 @@ class NodeLifecycleController:
         if not isinstance(node, Node):
             self._observed.pop((namespace, name), None)
             return None
-        age = self._observed_age(node)
+        age, changed = self._observe(node)
         if age <= self.grace:
-            if not node.ready:
-                # recovered between our watch event and now
+            if not node.ready and changed:
+                # a REAL new heartbeat arrived between our watch event and
+                # now (the heartbeater's own beat also flips Ready)
                 self._set_ready(node, True, "heartbeat resumed")
             # re-check shortly after the deadline would pass
             return max(self.grace - age, 0.05) + 0.05
@@ -193,7 +202,7 @@ class NodeLifecycleController:
         def mutate(obj: Node) -> None:
             # skew-safe re-check: a heartbeat VALUE change since our last
             # observation means the kubelet is alive — abort the flip
-            if self._observed_age(obj) <= self.grace:
+            if self._observe(obj)[0] <= self.grace:
                 raise NodeLifecycleController._StillBeating()
             obj.ready = False
             obj.reason = f"no heartbeat for {age:.1f}s (grace {self.grace}s)"

@@ -224,13 +224,17 @@ def param_pspecs(cfg: LlamaConfig) -> Params:
 _QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
-def _quant_leaf(w: jax.Array) -> Dict[str, jax.Array]:
-    """Symmetric per-column int8: scale over the CONTRACTION axis (-2), so
-    `deq(w)` folds into the consuming matmul as a per-output-column scale
-    and XLA fuses convert+scale into the dot — HBM reads the int8 bytes,
-    half the bf16 traffic."""
+def _quant_leaf(w: jax.Array, axis: int = -2) -> Dict[str, jax.Array]:
+    """Symmetric int8 with the scale reduced over ``axis``. For matmul
+    weights that is the CONTRACTION axis (-2): `deq(w)` folds into the
+    consuming matmul as a per-output-column scale and XLA fuses
+    convert+scale into the dot — HBM reads the int8 bytes, half the bf16
+    traffic. The embedding table instead scales PER ROW (axis=-1): one
+    outlier token's norm must not inflate the int8 step for every token,
+    and its consumers (a row gather; a dim-contraction when tied as the
+    lm_head) factor a per-row scale just as well."""
     a = w.astype(jnp.float32)
-    s = jnp.max(jnp.abs(a), axis=-2, keepdims=True) / 127.0
+    s = jnp.max(jnp.abs(a), axis=axis, keepdims=True) / 127.0
     s = jnp.maximum(s, 1e-8)
     q = jnp.clip(jnp.round(a / s), -127, 127).astype(jnp.int8)
     return {"q8": q, "s8": s.astype(jnp.bfloat16)}
@@ -242,7 +246,7 @@ def quantize_params(params: Params, cfg: LlamaConfig) -> Params:
     them). Embedding/lm_head and all layer matmuls quantize; norms stay
     in their float dtype. Training never sees quantized params."""
     out: Params = dict(params)
-    out["embed"] = _quant_leaf(params["embed"])
+    out["embed"] = _quant_leaf(params["embed"], axis=-1)  # per-token rows
     if "lm_head" in params:
         out["lm_head"] = _quant_leaf(params["lm_head"])
     layers = dict(params["layers"])
@@ -391,9 +395,10 @@ def llama_hidden(
 
 
 def gather_embed(embed, tokens: jax.Array) -> jax.Array:
-    """Token embedding lookup; int8 embeds gather q8 rows then scale."""
+    """Token embedding lookup; int8 embeds gather q8 rows + their per-row
+    scales (embed quantizes per row — see `_quant_leaf`)."""
     if isinstance(embed, dict) and "q8" in embed:
-        return embed["q8"][tokens].astype(embed["s8"].dtype) * embed["s8"]
+        return embed["q8"][tokens].astype(embed["s8"].dtype) * embed["s8"][tokens]
     return embed[tokens]
 
 

@@ -58,6 +58,7 @@ def _jax_setter(
         "model_name": mv.model_name,
         "artifact": mv.image,
         "port": port,
+        "quantize": pred.quantize,
         "batching": (
             {"max_batch_size": pred.batching.max_batch_size,
              "timeout_ms": pred.batching.timeout_ms}

@@ -472,3 +472,16 @@ def test_charts_endpoint_serves_metric_snapshots(console):
     gauges = d["gauges"]
     assert any(r["labels"].get("kind") == "TPUJob" for r in gauges["running"])
     assert d["serving"] == []  # no inference objects in this fixture
+
+
+def test_cluster_nodes_endpoint(console):
+    op, srv = console
+    from kubedl_tpu.core.nodes import NodeHeartbeater
+
+    hb = NodeHeartbeater(op.store, ["hostZ"])
+    hb.beat_once()
+    status, resp = call(srv, "GET", "/api/v1/cluster/nodes")
+    assert status == 200
+    nodes = resp["data"]["nodes"]
+    assert [n["name"] for n in nodes] == ["hostZ"]
+    assert nodes[0]["ready"] is True and nodes[0]["pods"] == 0

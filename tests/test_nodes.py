@@ -260,3 +260,25 @@ def test_eviction_skips_concurrently_terminal_pod_quietly():
     assert got.status.phase == PodPhase.SUCCEEDED
     assert got.metadata.resource_version == rv  # no no-op write
     assert not any(e.reason == "Evicted" for e in store.list("Event", None))
+
+
+def test_controller_restart_does_not_resurrect_dead_node():
+    """Review r3: a NotReady node must stay NotReady across a controller
+    restart (empty observation map) until a REAL new heartbeat arrives."""
+    store = ObjectStore()
+    t = {"now": 1000.0}
+    hb = NodeHeartbeater(store, ["nodeA"], clock=lambda: t["now"])
+    ctrl = NodeLifecycleController(store, grace=10.0, clock=lambda: t["now"])
+    hb.beat_once()
+    ctrl.reconcile(NODE_NAMESPACE, "nodeA")
+    t["now"] = 1020.0
+    ctrl.reconcile(NODE_NAMESPACE, "nodeA")
+    assert not store.get("Node", "nodeA", NODE_NAMESPACE).ready
+    # controller restarts with no memory
+    ctrl2 = NodeLifecycleController(store, grace=10.0, clock=lambda: t["now"])
+    ctrl2.reconcile(NODE_NAMESPACE, "nodeA")
+    assert not store.get("Node", "nodeA", NODE_NAMESPACE).ready  # stays dead
+    # a real heartbeat flips it back (the heartbeater's own beat does too)
+    t["now"] = 1025.0
+    hb.beat_once()
+    assert store.get("Node", "nodeA", NODE_NAMESPACE).ready

@@ -659,3 +659,23 @@ class TestInt8Quantization:
         lq = llama.llama_forward(qp, toks, cfg)
         rel = float(jnp.abs(lf - lq).max() / (jnp.abs(lf).max() + 1e-9))
         assert rel < 0.15, rel
+
+
+def test_predictor_quantize_rides_serve_config():
+    """quantize is a first-class Predictor field: the JAX setter plumbs it
+    into KUBEDL_SERVE_CONFIG so a canary can A/B int8 vs full precision."""
+    import json as _json
+
+    store, ctrl = setup()
+    make_mv(store)
+    make_inference(store, [
+        Predictor(name="fp", model_version="mv1"),
+        Predictor(name="q8", model_version="mv1", quantize="int8"),
+    ])
+    ctrl.reconcile("default", "inf1")
+    from tests.helpers import env_of as _env_of
+
+    cfg_fp = _json.loads(_env_of(store.get("Pod", "inf1-fp-0"))["KUBEDL_SERVE_CONFIG"])
+    cfg_q8 = _json.loads(_env_of(store.get("Pod", "inf1-q8-0"))["KUBEDL_SERVE_CONFIG"])
+    assert cfg_fp["quantize"] == ""
+    assert cfg_q8["quantize"] == "int8"
