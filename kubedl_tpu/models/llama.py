@@ -487,6 +487,57 @@ def chunked_next_token_nll(
     return total / (B * n_pos)
 
 
+# ---- sharded serving -------------------------------------------------------
+
+def serving_shardings(params: Params, cfg: LlamaConfig, mesh) -> Params:
+    """NamedSharding tree for (possibly int8-quantized) params on a
+    serving mesh — BASELINE target 5 runs Gemma-2B on a v5e-4, so the
+    decode/prefill weights shard over a "tensor" axis (megatron split,
+    `param_pspecs`) and XLA inserts the collectives. Quantized leaves
+    shard q8 like the weight; scale dims of size 1 (the reduced axis)
+    stay unsharded."""
+    from jax.sharding import NamedSharding
+
+    pspecs = param_pspecs(cfg)  # omits lm_head for tied configs already
+    names = set(mesh.axis_names)
+
+    def prune(spec: P) -> P:
+        return P(*(a if a in names else None for a in spec))
+
+    def leaf_sharding(leaf, spec: P):
+        spec = prune(spec)
+        if isinstance(leaf, dict) and "q8" in leaf:
+            s_spec = P(*(
+                a if leaf["s8"].shape[i] != 1 else None
+                for i, a in enumerate(spec)
+            ))
+            return {
+                "q8": NamedSharding(mesh, spec),
+                "s8": NamedSharding(mesh, s_spec),
+            }
+        return NamedSharding(mesh, spec)
+
+    out: Params = {
+        "embed": leaf_sharding(params["embed"], pspecs["embed"]),
+        "final_norm": NamedSharding(mesh, prune(pspecs["final_norm"])),
+        "layers": {
+            k: leaf_sharding(params["layers"][k], pspecs["layers"][k])
+            for k in params["layers"]
+        },
+    }
+    if "lm_head" in params:
+        out["lm_head"] = leaf_sharding(params["lm_head"], pspecs["lm_head"])
+    return out
+
+
+def shard_serving_params(params: Params, cfg: LlamaConfig, mesh) -> Params:
+    """device_put the params onto their serving shardings (one transfer at
+    engine start; decode then runs fully sharded). The shardings tree
+    mirrors the params structure, so a single pytree device_put covers
+    raw and quantized leaves alike."""
+    return jax.device_put(params, serving_shardings(params, cfg, mesh))
+
+
 # ---- pipeline hooks --------------------------------------------------------
 
 def pipeline_hooks(cfg: LlamaConfig):

@@ -57,7 +57,7 @@ class LlamaEngine:
 
     def __init__(self, preset: str = "tiny", ckpt_dir: str = "",
                  batch: int = 0, max_seq: int = 0, max_batch: int = 4,
-                 quantize: str = "") -> None:
+                 quantize: str = "", mesh_axes: Optional[Dict] = None) -> None:
         import jax
 
         from kubedl_tpu.models import llama
@@ -80,6 +80,18 @@ class LlamaEngine:
         elif quantize:
             raise ValueError(f"unknown quantize mode {quantize!r}")
         self.quantize = quantize
+        self.mesh = None
+        if mesh_axes:
+            # multi-chip serving (BASELINE target 5: Gemma-2B on v5e-4):
+            # megatron-shard the weights over the mesh; XLA inserts the
+            # collectives in the jitted decode/prefill
+            from kubedl_tpu.api.topology import MeshSpec
+            from kubedl_tpu.parallel.mesh import build_mesh
+
+            spec = MeshSpec({k: int(v) for k, v in mesh_axes.items()})
+            self.mesh = build_mesh(spec, jax.devices()[: spec.size()])
+            params = llama.shard_serving_params(params, self.cfg, self.mesh)
+            log.info("serving over mesh %s", dict(mesh_axes))
         self.params = params
         self._llama = llama
         self._jax = jax
@@ -368,6 +380,22 @@ def make_handler(engine: LlamaEngine, model_name: str):
     return Handler
 
 
+def engine_kwargs(cfg: Dict, ckpt_dir: str) -> Dict:
+    """How KUBEDL_SERVE_CONFIG maps onto the engine (kept separate so the
+    config->engine plumbing is testable without binding a server)."""
+    return {
+        "preset": cfg.get(
+            "preset", os.environ.get("KUBEDL_SERVE_PRESET", "tiny")
+        ),
+        "ckpt_dir": ckpt_dir,
+        "max_batch": int(cfg.get("max_batch", 4)),
+        "quantize": cfg.get(
+            "quantize", os.environ.get("KUBEDL_SERVE_QUANTIZE", "")
+        ),
+        "mesh_axes": cfg.get("mesh") or None,
+    }
+
+
 def serve_main(env: Optional[Dict[str, str]] = None) -> int:
     """Container entrypoint (ThreadRuntime-compatible)."""
     if env:
@@ -406,16 +434,13 @@ def serve_main(env: Optional[Dict[str, str]] = None) -> int:
     # cross-host deployments (round-2 weak #6: a hard-coded 127.0.0.1
     # contradicted the k8s deployment story)
     host = cfg.get("host") or os.environ.get("KUBEDL_SERVE_HOST", "127.0.0.1")
-    preset = cfg.get("preset", os.environ.get("KUBEDL_SERVE_PRESET", "tiny"))
-    engine = LlamaEngine(
-        preset=preset, ckpt_dir=ckpt,
-        max_batch=int(cfg.get("max_batch", 4)),
-        quantize=cfg.get("quantize", os.environ.get("KUBEDL_SERVE_QUANTIZE", "")),
-    )
+    kwargs = engine_kwargs(cfg, ckpt)
+    engine = LlamaEngine(**kwargs)
+    model_name = cfg.get("model_name", kwargs["preset"])
     server = ThreadingHTTPServer(
-        (host, port), make_handler(engine, cfg.get("model_name", preset))
+        (host, port), make_handler(engine, model_name)
     )
-    log.info("serving %s on :%d", cfg.get("model_name", preset), port)
+    log.info("serving %s on :%d", model_name, port)
 
     cancel = (env or {}).get("_KUBEDL_CANCEL")
     if cancel is not None:
