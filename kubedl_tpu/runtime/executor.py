@@ -65,6 +65,10 @@ class SubprocessRuntime(ContainerRuntime):
         if argv[0] == "python":
             argv[0] = sys.executable
         full_env = {**os.environ, **env}
+        # spawn timestamp: entrypoints attribute pod-spawn -> process-start
+        # latency in their startup breakdown (launch-delay parity with the
+        # reference's job_metrics.go:139-194, but per-phase)
+        full_env.setdefault("KUBEDL_SPAWN_TS", repr(time.time()))
         stdout = None
         if self.log_dir:
             # namespaced: same-named pods in different namespaces must not

@@ -35,26 +35,57 @@ def _model_preset(name: str):
 
 def train_main(env: Optional[Dict[str, str]] = None) -> int:
     global LAST_SUMMARY
+    t_start = time.time()
+    # startup attribution (BASELINE.md north star — the reference
+    # instruments exactly this window, pkg/metrics/job_metrics.go:139-194):
+    # each phase's wall seconds ride the worker summary so a slow cold
+    # start is diagnosable from the pod log alone
+    phases: Dict[str, float] = {}
+    spawn_ts = float(os.environ.get("KUBEDL_SPAWN_TS", 0) or 0)
+    if spawn_ts:
+        phases["spawn_to_proc"] = max(t_start - spawn_ts, 0.0)
     if env:
         os.environ.update({k: v for k, v in env.items() if isinstance(v, str)})
     # import jax only after env is set (JAX_PLATFORMS etc.)
     from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested
 
     ensure_cpu_if_requested()
-    from kubedl_tpu.utils.compile_cache import enable_compilation_cache
+    from kubedl_tpu.utils.compile_cache import (
+        cache_entry_count, enable_compilation_cache,
+    )
 
     # before the first trace: a gang restart / resize / resume re-enters
     # here and must deserialize, not recompile, the unchanged train step
-    enable_compilation_cache()
+    cache_dir = enable_compilation_cache()
+    cache_before = cache_entry_count(cache_dir)
     import jax
 
     from kubedl_tpu.api import constants
     from kubedl_tpu.parallel.mesh import initialize_from_env, mesh_from_env
+
+    initialize_from_env()
+
+    # single-process jobs: bring the TPU client up in the background while
+    # python pays for the heavy framework imports below (multi-process
+    # jobs already initialized the backend via jax.distributed above)
+    dev_thread = None
+    if int(os.environ.get(constants.ENV_NUM_PROCESSES, "1")) <= 1:
+        import threading
+
+        dev_thread = threading.Thread(target=jax.devices, daemon=True,
+                                      name="kubedl-devinit")
+        dev_thread.start()
+    t0 = time.time()
     from kubedl_tpu.training.checkpoint import restore_checkpoint
     from kubedl_tpu.training.data import SyntheticTokens
     from kubedl_tpu.training.trainer import TrainConfig, Trainer
 
-    initialize_from_env()
+    phases["imports"] = time.time() - t0
+    t0 = time.time()
+    if dev_thread is not None:
+        dev_thread.join()
+    jax.devices()
+    phases["jax_device_init"] = time.time() - t0
 
     raw = os.environ.get("KUBEDL_TRAIN_CONFIG", "{}")
     opts = json.loads(raw)
@@ -77,8 +108,13 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
         ckpt_every=int(opts.get("ckpt_every", 0)),
         opt_moment_dtype=opts.get("opt_moment_dtype", "float32"),
     )
+    t0 = time.time()
     mesh = mesh_from_env()
     trainer = Trainer(cfg, mesh)
+    phases["trainer_build"] = time.time() - t0
+    # overlap the two big cold-start compiles: the train step AOT-compiles
+    # in a background thread while init_state compiles+runs on this one
+    trainer.warm_compile_async()
 
     out = os.environ.get(constants.ENV_MODEL_PATH, "")
     ckpt_dir = os.environ.get(constants.ENV_CKPT_DIR, "")
@@ -88,15 +124,15 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
     # restore-from-latest: a gang restart resumes instead of retraining.
     # The fresh init doubles as the restore template (shardings/structure)
     # and is reused as-is on a cold start — init runs exactly once.
-    state = None
+    t0 = time.time()
+    state = trainer.init_state()
     if ckpt_dir:
-        template = trainer.init_state()
-        state = restore_checkpoint(ckpt_dir, template)
-        if state is not None:
+        restored = restore_checkpoint(ckpt_dir, state)
+        if restored is not None:
+            state = restored
             step = int(jax.device_get(state["step"]))
             print(json.dumps({"resumed_from_step": step}), flush=True)
-        else:
-            state = template
+    phases["state_init"] = time.time() - t0
 
     data_path = opts.get("data_path", "")
     if data_path:
@@ -141,6 +177,16 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
         ckpt_every=cfg.ckpt_every,
     )
     summary["first_step_wall_time"] = first_step_wall.get("t", time.time())
+    phases["total_to_first_step"] = summary["first_step_wall_time"] - (
+        spawn_ts or t_start
+    )
+    summary["startup_phases"] = {k: round(v, 3) for k, v in phases.items()}
+    summary["compile_cache"] = {
+        "dir": cache_dir,
+        "entries_before": cache_before,
+        "entries_after": cache_entry_count(cache_dir),
+        "warm_compile_used": trainer._warm_compiled is not None,
+    }
     LAST_SUMMARY = summary
     print(json.dumps({"worker_summary": summary}), flush=True)
 

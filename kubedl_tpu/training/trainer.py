@@ -36,6 +36,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -136,6 +137,12 @@ class TrainConfig:
     #: mu is a running mean of grads and tolerates bf16; nu (the second
     #: moment) stays fp32 because rsqrt amplifies its quantization.
     opt_moment_dtype: str = "float32"
+    #: PRNG implementation for parameter init. "rbg" (the TPU-native
+    #: counter RNG) compiles the 350M-param init in ~10s where threefry's
+    #: per-tensor unroll took 52s on v5e — cold startup-to-first-step is a
+    #: north-star metric (reference: pkg/metrics/job_metrics.go:139-194).
+    #: "" = jax default (threefry).
+    init_rng_impl: str = "rbg"
     seed: int = 0
 
 
@@ -182,7 +189,65 @@ class Trainer:
         )
         self.batch_sharding = NamedSharding(self.mesh, meshlib.batch_pspec(self.mesh))
         self.attn_impl = "dense"
+        #: background AOT compile of the train step (see warm_compile_async)
+        self._warm_thread: Optional[Any] = None
+        self._warm_compiled: Optional[Any] = None
+        self.state_shardings = self._state_shardings()
         self._build_fns()
+
+    def _state_shardings(self):
+        """Explicit shardings for the WHOLE train state, not just params.
+
+        Optimizer moments (adam mu/nu) shard exactly like the parameter
+        they track — that is what makes fsdp actually scale optimizer HBM —
+        and scalars (step, schedule counts) replicate. Making this explicit
+        (instead of leaving opt_state to GSPMD propagation) pins the
+        executable's input signature, which (a) documents the memory
+        layout and (b) lets `warm_compile_async` AOT-compile the step with
+        a byte-identical program while init is still compiling.
+
+        Moment leaves are matched to their parameter by key-path suffix
+        (mu's tree path ends with the param's path) plus shape equality;
+        anything unmatched replicates.
+        """
+        rep = NamedSharding(self.mesh, P())
+        key = jax.random.PRNGKey(0)
+        params_sds = jax.eval_shape(self.family.init, key)
+        p_leaves = jax.tree_util.tree_flatten_with_path(
+            self.param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding)
+        )[0]
+        s_leaves = jax.tree_util.tree_flatten_with_path(params_sds)[0]
+        # (path-as-strings, shape) -> sharding for every param
+        entries = [
+            (tuple(str(k) for k in path), sds.shape, sh)
+            for (path, sh), (_, sds) in zip(p_leaves, s_leaves)
+        ]
+
+        def match(path, leaf):
+            strs = tuple(str(k) for k in path)
+            for ppath, pshape, sh in entries:
+                n = len(ppath)
+                if len(strs) >= n and strs[-n:] == ppath and leaf.shape == pshape:
+                    return sh
+            return rep
+
+        opt_sds = jax.eval_shape(self.tx.init, params_sds)
+        o_leaves, o_def = jax.tree_util.tree_flatten_with_path(opt_sds)
+        opt_sh = jax.tree_util.tree_unflatten(
+            o_def, [match(p, l) for p, l in o_leaves]
+        )
+        # state abstract shapes, reused by warm_compile_async (saves an
+        # eval_shape re-trace on the cold critical path)
+        self._state_sds = {
+            "params": params_sds,
+            "opt_state": opt_sds,
+            "step": jax.ShapeDtypeStruct((), jnp.int32),
+        }
+        return {
+            "params": self.param_shardings,
+            "opt_state": opt_sh,
+            "step": rep,
+        }
 
     def _prune_spec(self, spec: P) -> P:
         names = set(self.mesh.axis_names)
@@ -343,11 +408,16 @@ class Trainer:
             return new_state, {"loss": loss, "grad_norm": gnorm}
 
         with self.mesh:
-            self.init_fn = jax.jit(init_fn)
+            # out_/in_shardings pin the state's layout explicitly: the
+            # train step's input signature is then independent of what
+            # GSPMD would have propagated, so the AOT warm compile and the
+            # dispatch compile produce the same program (same cache key)
+            self.init_fn = jax.jit(init_fn, out_shardings=self.state_shardings)
             self.train_step = jax.jit(
                 train_step,
                 donate_argnums=(0,),
-                in_shardings=(None, self.batch_sharding),
+                in_shardings=(self.state_shardings, self.batch_sharding),
+                out_shardings=(self.state_shardings, None),
             )
 
     def _make_pipeline_loss(self, attn_fn):
@@ -402,12 +472,63 @@ class Trainer:
 
     # ------------------------------------------------------------------
 
+    def _init_key(self):
+        impl = self.cfg.init_rng_impl
+        if impl:
+            # typed key: carries its impl through split()/normal()
+            return jax.random.key(self.cfg.seed, impl=impl)
+        return jax.random.PRNGKey(self.cfg.seed)
+
     def init_state(self) -> Dict[str, Any]:
         with self.mesh:
-            return self.init_fn(jax.random.PRNGKey(self.cfg.seed))
+            return self.init_fn(self._init_key())
+
+    def warm_compile_async(self) -> None:
+        """AOT-compile the train step in a background thread, overlapping
+        it with ``init_state``'s compile — the two big cold-start compiles
+        then cost max() instead of sum(). The lowered program is built
+        from eval_shape (no device work), so the thread only occupies the
+        compiler. `fit` joins the thread and dispatches through the
+        compiled executable; any mismatch falls back to the plain jit
+        (which, with the persistent compilation cache enabled, hits the
+        entry this compile just wrote instead of recompiling)."""
+        if self._warm_thread is not None:
+            return
+        import threading
+
+        def work():
+            try:
+                sds_state = self._state_sds
+                sds_batch = jax.ShapeDtypeStruct(
+                    (self.cfg.global_batch, self.cfg.seq_len), jnp.int32
+                )
+                with self.mesh:
+                    self._warm_compiled = self.train_step.lower(
+                        sds_state, sds_batch
+                    ).compile()
+            except Exception:  # never let a warm-up kill the job
+                import logging
+
+                logging.getLogger("kubedl_tpu.training.trainer").warning(
+                    "warm compile failed; dispatch will compile", exc_info=True
+                )
+
+        self._warm_thread = threading.Thread(target=work, daemon=True,
+                                             name="kubedl-warm-compile")
+        self._warm_thread.start()
+
+    def _resolve_step_fn(self):
+        """Join the warm compile (if started) and pick the step callable."""
+        if self._warm_thread is not None:
+            self._warm_thread.join()
+            self._warm_thread = None
+        return self._warm_compiled or self.train_step
 
     def shard_batch(self, batch) -> jax.Array:
-        return jax.device_put(jnp.asarray(batch), self.batch_sharding)
+        if isinstance(batch, jax.Array):
+            return jax.device_put(batch, self.batch_sharding)
+        # host batches (numpy): one hop straight onto the mesh
+        return jax.device_put(np.asarray(batch), self.batch_sharding)
 
     def fit(
         self,
@@ -430,7 +551,13 @@ class Trainer:
         steps = steps or self.cfg.steps
         state = state or self.init_state()
         ckpt_every = self.cfg.ckpt_every if ckpt_every is None else ckpt_every
+        # this scalar fetch is a true barrier on init/restore execution AND
+        # on any concurrent AOT executable load sharing the device link —
+        # timed so startup attribution can see it (it precedes the
+        # first-step clock)
+        t_sync = time.perf_counter()
         start = int(jax.device_get(state["step"]))
+        pre_loop_sync_s = time.perf_counter() - t_sync
         tokens_per_step = self.cfg.global_batch * self.cfg.seq_len
         losses: List[Any] = []
         t0 = time.perf_counter()
@@ -438,10 +565,28 @@ class Trainer:
         first_loss = None
         t_run = t0
         ckpt_overhead = 0.0
+        step_fn = self._resolve_step_fn()
         with self.mesh:
             for i in range(start, steps):
                 batch = self.shard_batch(next(data))
-                state, metrics = self.train_step(state, batch)
+                if i == start and step_fn is not self.train_step:
+                    try:
+                        state, metrics = step_fn(state, batch)
+                    except (TypeError, ValueError):
+                        # AOT executable rejected the args (sharding/layout
+                        # drift — argument validation raises TypeError/
+                        # ValueError BEFORE any execution, so donation has
+                        # not consumed the buffers): fall back to the jit,
+                        # which recompiles or hits the persistent cache
+                        # entry the AOT compile wrote. Runtime failures
+                        # (XlaRuntimeError etc.) propagate — retrying them
+                        # with donated/deleted buffers would mask the
+                        # real error.
+                        step_fn = self.train_step
+                        self._warm_compiled = None  # don't re-pick it
+                        state, metrics = step_fn(state, batch)
+                else:
+                    state, metrics = step_fn(state, batch)
                 losses.append(metrics["loss"])
                 if i == start:
                     # true barrier: scalar fetch (block_until_ready lies on
@@ -473,6 +618,7 @@ class Trainer:
         steady_steps = len(losses) - 1
         tps = tokens_per_step * steady_steps / total if total > 0 and steady_steps > 0 else 0.0
         summary = {
+            "pre_loop_sync_s": pre_loop_sync_s,
             "first_step_seconds": first_step_s,
             "steps": len(losses),
             "total_steps": steps,
