@@ -50,6 +50,32 @@ def remat_policy_for(name: str):
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             jax.checkpoint_policies.save_only_these_names("attn_out"),
         )
+    if name == "flash":
+        # ONLY the flash kernel's residuals: backward re-runs the
+        # projection/ffn dots (cheap, MXU-bound) but never the attention
+        # kernel; saves ~8GB of stacked dot outputs vs "dots" at b8 —
+        # for memory-capacity-bound shapes
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse"
+        )
+    if name == "attn_flash":
+        # attention output + kernel residuals, dots recomputed
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "flash_out", "flash_lse"
+        )
+    if name == "dots_flash":
+        # dots PLUS the flash kernel's own residuals (out + lse, tagged in
+        # ops/flash_attention._flash_fwd). "dots_attn" was not enough: it
+        # saves the post-transpose attention output but the custom-vjp
+        # backward also needs lse, which no policy could name — so the
+        # forward kernel still re-ran under remat (~43ms/step profiled on
+        # the bench model). Costs lse (f32 [B,H,S]) + out per layer.
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"
+            ),
+        )
     raise ValueError(f"unknown remat_policy {name!r}")
 
 Params = Dict[str, Any]
@@ -69,11 +95,13 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     #: remat the scan body (trade flops for HBM)
     remat: bool = True
-    #: what the remat saves: "dots" (matmul outputs without batch dims —
-    #: the conservative default), "nothing" (full recompute, minimum HBM),
-    #: "attn" (save only each layer's attention output — recompute
-    #: matmuls, keep the flash kernel from running twice in backward)
-    remat_policy: str = "dots"
+    #: what the remat saves: "dots_flash" (matmul outputs AND the flash
+    #: kernel's out/lse residuals — the default, because without the
+    #: residuals the backward must re-run the forward attention kernel
+    #: every layer), "flash" (only the kernel residuals: re-run the
+    #: cheap dots, ~8GB less saved at bench shapes), "dots", "nothing",
+    #: "attn", "attn_flash"
+    remat_policy: str = "dots_flash"
     #: compute the LM loss over sequence chunks of this many positions
     #: (0 = whole sequence at once). The full [B, S, V] fp32 logits are
     #: the single biggest activation (b8 x s2048 x v32k = 2.1 GB before
@@ -126,7 +154,14 @@ LLAMA3_1B = LlamaConfig(
 #: — measured equal-speed and strictly more headroom, docs/performance.md)
 BENCH_350M = LlamaConfig(
     vocab_size=32768, dim=1024, n_layers=24, n_heads=16, n_kv_heads=8,
-    ffn_dim=4096, max_seq=2048, loss_chunk=1024,
+    ffn_dim=4096, max_seq=2048, loss_chunk=0,
+    # "flash" saves ONLY the kernel residuals (out+lse): the backward
+    # re-runs the cheap MXU-bound dots but never the attention kernel,
+    # and the ~8GB of stacked dot outputs "dots" would have saved become
+    # free HBM — which is also what lets loss_chunk=0 (unchunked logits)
+    # win. Full-step sweep on v5e b8 s2048: flash 597-601ms vs dots
+    # 605-614 vs dots_flash 639-647; s8192 b2: flash 868ms vs dots 955.
+    remat_policy="flash",
 )
 TINY = LlamaConfig(
     vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=128,

@@ -53,6 +53,9 @@ class MoEConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    #: see llama.remat_policy_for — "dots_flash" keeps the flash kernel's
+    #: residuals saved so backward never re-runs the forward kernel
+    remat_policy: str = "dots_flash"
 
     @property
     def head_dim(self) -> int:
@@ -265,9 +268,9 @@ def moe_forward(
         return x, aux
 
     if cfg.remat:
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-        )
+        from kubedl_tpu.models.llama import remat_policy_for
+
+        body = jax.checkpoint(body, policy=remat_policy_for(cfg.remat_policy))
     x, auxes = lax.scan(body, x, params["layers"])
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
@@ -299,9 +302,10 @@ def pipeline_hooks(cfg: MoEConfig):
                 return x, aux
 
             if cfg.remat:
+                from kubedl_tpu.models.llama import remat_policy_for
+
                 body = jax.checkpoint(
-                    body,
-                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                    body, policy=remat_policy_for(cfg.remat_policy)
                 )
             x, auxes = lax.scan(body, x, layer_params)
             return x, auxes.sum().astype(jnp.float32)

@@ -82,8 +82,8 @@ def _fwd_kernel(
             preferred_element_type=jnp.float32,
         )
         acc_ref[:] = acc_ref[:] * corr + pv
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        m_ref[:, :1] = m_new
+        l_ref[:, :1] = l_new
 
     @pl.when(j == n_k - 1)
     def _finalize():
@@ -244,6 +244,112 @@ def _bwd_dkdv_kernel(
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref, dk_ref, dv_ref,
+    dq_acc, dk_acc, dv_acc,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+    n_q: int, n_k: int, group: int,
+):
+    """Single-pass flash backward: dq, dk AND dv from one traversal.
+
+    The classic split (separate dQ and dK/dV kernels, flash-2 style) pays
+    the expensive part — the QK^T recompute, the exp, and the dO·V^T
+    product — TWICE. Here the grid is (B, H, n_q, n_k) with k innermost:
+    dq accumulates per-q-block in scratch exactly like the split kernel,
+    while dk/dv accumulate into a WHOLE-SEQUENCE f32 VMEM scratch
+    ([Sk, hd] = 512KB at S=2048) and are written out during the final
+    q-block pass (i == n_q-1 visits every j, causality never skips the
+    last q row-block). One QK matmul, one exp, one dp per tile — the
+    measured win on the bench model is ~19% of the whole train step.
+
+    GQA folds into the same scratch: the grid walks the `group` q-heads
+    of one kv-head consecutively, so dk/dv simply keep accumulating
+    across them (init on the group's first head, write-out on its last)
+    and the kernel emits [B, KV, Sk, hd] directly — no per-q-head dk/dv
+    arrays in HBM and no group-sum pass afterwards.
+    """
+    h = pl.program_id(1)
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    first_in_group = h % group == 0
+    last_in_group = h % group == group - 1
+
+    @pl.when(jnp.logical_and(first_in_group,
+                             jnp.logical_and(i == 0, j == 0)))
+    def _init_kv():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(j == 0)
+    def _init_q():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (j * block_k <= i * block_q + block_q - 1) if causal else (j <= n_k)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        do32 = do.astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        d = d_ref[0, 0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0
+            )
+            cols = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dv_acc[pl.ds(j * block_k, block_k), :] += lax.dot_general(
+            p.astype(do.dtype), do,
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            do32, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - d)
+        ds_c = ds.astype(q.dtype)
+        dq_acc[:] += lax.dot_general(
+            ds_c, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        dk_acc[pl.ds(j * block_k, block_k), :] += lax.dot_general(
+            ds_c, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(j == n_k - 1)
+    def _fin_q():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+    @pl.when(jnp.logical_and(last_in_group, i == n_q - 1))
+    def _fin_kv():
+        dk_ref[0, 0] = dk_acc[pl.ds(j * block_k, block_k), :].astype(
+            dk_ref.dtype
+        )
+        dv_ref[0, 0] = dv_acc[pl.ds(j * block_k, block_k), :].astype(
+            dv_ref.dtype
+        )
+
+
+#: cap on the whole-sequence dk+dv f32 scratch of the fused backward;
+#: beyond it (Sk * hd * 8 bytes) the split two-kernel path is used
+_FUSED_BWD_SCRATCH_BYTES = 8 << 20
+#: above this scratch size the fused kernel's k-tile is clamped to 512 so
+#: scratch + score tiles stay inside scoped VMEM (measured on v5e at
+#: S=8192: 1024x512 fused = 850ms/grad vs 950ms split, vs compile-OOM at
+#: 1024x1024)
+_FUSED_BWD_SMALL_TILE_BYTES = 2 << 20
+
+
 def _bwd_pallas(
     res, do: jax.Array, causal: bool, block_q: int, block_k: int,
     interpret: bool,
@@ -266,6 +372,45 @@ def _bwd_pallas(
     # D_i = rowsum(dO * O): tiny elementwise pre-pass, XLA fuses it
     d = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)[..., None]
     lse4 = lse[..., None]  # [B, H, Sq, 1]
+
+    scratch_bytes = Sk * hd * 8
+    if scratch_bytes <= _FUSED_BWD_SCRATCH_BYTES:
+        if scratch_bytes > _FUSED_BWD_SMALL_TILE_BYTES:
+            bk = min(bk, 512)
+        n_q, n_k = Sq // bq, Sk // bk
+        q_spec = pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0))
+        kv_spec = pl.BlockSpec(
+            (1, 1, bk, hd), lambda b, h, i, j: (b, h // group, j, 0)
+        )
+        row_spec = pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0))
+        # dk/dv come out at KV-HEAD granularity: the kernel accumulates
+        # the whole GQA group in its scratch (grid walks a kv-head's
+        # q-heads consecutively), so no group-sum pass and group-x fewer
+        # HBM bytes written
+        dkv_spec = pl.BlockSpec(
+            (1, 1, bk, hd), lambda b, h, i, j: (b, h // group, j, 0)
+        )
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_fused_kernel, scale=scale, causal=causal,
+                block_q=bq, block_k=bk, n_q=n_q, n_k=n_k, group=group,
+            ),
+            grid=(B, H, n_q, n_k),
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+            out_specs=[q_spec, dkv_spec, dkv_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, H, Sq, hd), q.dtype),
+                jax.ShapeDtypeStruct((B, KV, Sk, hd), k.dtype),
+                jax.ShapeDtypeStruct((B, KV, Sk, hd), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, hd), jnp.float32),
+                pltpu.VMEM((Sk, hd), jnp.float32),
+                pltpu.VMEM((Sk, hd), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v, do, lse4, d)
+        return dq, dk, dv
 
     q_spec = pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0))
     kv_spec = pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // group, j, 0))
@@ -320,7 +465,15 @@ def _flash(q, k, v, causal, block_q, block_k, bwd_block_q, bwd_block_k, interpre
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, bwd_block_q, bwd_block_k, interpret):
+    from jax.ad_checkpoint import checkpoint_name
+
     out, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    # named so a remat policy can SAVE the kernel's residuals: no policy
+    # can name a custom-call output, so without these tags `lse` is never
+    # saveable and jax.checkpoint must re-run the whole forward kernel in
+    # the backward pass (profiled at ~43ms/step on the bench model)
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, out, lse)
 
 
@@ -328,11 +481,15 @@ def _flash_bwd(causal, block_q, block_k, bwd_block_q, bwd_block_k, interpret, re
     return _bwd_pallas(res, do, causal, bwd_block_q, bwd_block_k, interpret)
 
 
-# optimize_remat: under jax.checkpoint the fwd kernel's residuals (q, k, v,
-# out, lse) are plumbed properly instead of re-running the whole forward
-# kernel in backward — measured in-model, the recompute was ~24 x fwd
-# (~140ms of the 643ms bench step)
-_flash.defvjp(_flash_fwd, _flash_bwd, optimize_remat=True)
+# optimize_remat must stay OFF: its remat_opt machinery re-runs the
+# forward kernel in the backward scan REGARDLESS of checkpoint policy
+# (verified by counting _fwd_kernel custom-calls in the lowered HLO).
+# Instead the residuals are tagged with checkpoint_name in _flash_fwd and
+# the "dots_flash" remat policy (models/llama.remat_policy_for) saves
+# them — with that pairing the lowered module contains exactly ONE
+# _fwd_kernel; under plain "dots" the backward re-runs it (~43ms/step on
+# the bench model, profiled).
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def _default_interpret() -> bool:
