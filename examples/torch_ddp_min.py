@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""Minimal REAL torch-DDP workload (BASELINE target 2 analogue;
+reference: the PyTorchJob examples the operator's MASTER_ADDR/RANK env
+contract exists for). Runs as a pod command under a PyTorchJob:
+
+    python examples/torch_ddp_min.py [--steps 5]
+
+Every replica joins a gloo process group from the operator-injected
+MASTER_ADDR / MASTER_PORT / RANK / WORLD_SIZE, broadcasts initial
+weights from rank 0, trains a tiny regression with allreduced grads, and
+asserts via all_gather that every replica holds bit-identical weights —
+the actual lockstep property DDP exists to provide. Exits nonzero on any
+divergence, so a launch-delay benchmark built on this measures a real
+framework bringing up real collectives.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=5)
+    args = ap.parse_args()
+
+    import torch
+    import torch.distributed as dist
+
+    rank = int(os.environ["RANK"])
+    world = int(os.environ["WORLD_SIZE"])
+    dist.init_process_group(
+        "gloo", init_method="env://", rank=rank, world_size=world
+    )
+    try:
+        model = torch.nn.Linear(4, 1)
+        for p in model.parameters():
+            dist.broadcast(p.data, src=0)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        torch.manual_seed(rank)  # different data per replica
+        for _ in range(args.steps):
+            x = torch.randn(8, 4)
+            y = x.sum(dim=1, keepdim=True)
+            loss = ((model(x) - y) ** 2).mean()
+            opt.zero_grad()
+            loss.backward()
+            for p in model.parameters():
+                dist.all_reduce(p.grad)
+                p.grad /= world
+            opt.step()
+        flat = torch.cat([p.data.flatten() for p in model.parameters()])
+        gathered = [torch.zeros_like(flat) for _ in range(world)]
+        dist.all_gather(gathered, flat)
+        if not all(torch.allclose(g, flat) for g in gathered):
+            print("replicas diverged", file=sys.stderr)
+            return 1
+        print(f"ddp-ok rank {rank} world {world} loss {loss.item():.4f}",
+              flush=True)
+        return 0
+    finally:
+        dist.destroy_process_group()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,9 +1,24 @@
-"""Session auth for the console.
+"""Pluggable session auth for the console.
 
-Reference: console/backend/pkg/auth (oauth/session login wired at
-routers/api/auth.go:21-27). The TPU build keeps the same shape without an
-external IdP: a user table (name -> salted SHA-256), bearer-token sessions
-issued at login, validated per request, expired on TTL or logout.
+Reference: console/backend/pkg/auth — an oauth package and a session
+package behind one interface, wired at routers/api/auth.go:21-27. Same
+shape here: credential/identity verification is a PLUGGABLE
+:class:`AuthProvider` (reference's oauth/ldap analogue), while session
+issuance/validation stays in :class:`SessionAuth`.
+
+Providers shipped:
+
+- :class:`StaticUserProvider` — user table (name -> salted SHA-256), the
+  reference session package's analogue.
+- :class:`ProxyHeaderProvider` — trust an identity header asserted by an
+  authenticating reverse proxy (the standard oauth2-proxy deployment
+  pattern: the proxy does the OIDC dance, the console trusts
+  ``X-Auth-Request-User``), optionally gated on a shared-secret header so
+  only the proxy can assert identities. This is the oauth integration
+  that works in a zero-egress environment.
+
+Custom IdPs implement :class:`AuthProvider` and pass instances via
+``SessionAuth(providers=[...])``.
 """
 
 from __future__ import annotations
@@ -14,7 +29,7 @@ import secrets
 import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Dict, List, Mapping, Optional
 
 SESSION_COOKIE = "kubedl-session"
 
@@ -31,33 +46,105 @@ class Session:
     expires_at: float
 
 
-class SessionAuth:
-    """None-auth when ``users`` is empty: every request is ``anonymous``
-    (the reference console also runs open unless auth is configured)."""
+class AuthProvider:
+    """One way of establishing who a request/login is."""
 
-    def __init__(
-        self, users: Optional[Dict[str, str]] = None, session_ttl: float = 12 * 3600.0
-    ) -> None:
-        self._lock = threading.Lock()
+    def authenticate(self, username: str, password: str) -> bool:
+        """Credential login (the /login flow). False = not my user or
+        bad credential."""
+        return False
+
+    def identify_request(self, headers: Mapping[str, str]) -> Optional[str]:
+        """Session-less identity from request headers (proxy/oauth
+        flows). None = this provider asserts nothing for the request."""
+        return None
+
+
+class StaticUserProvider(AuthProvider):
+    """name -> password table, salted-hashed at construction."""
+
+    def __init__(self, users: Dict[str, str]) -> None:
         self._salt = secrets.token_hex(8)
         self._users = {
             name: _hash(password, self._salt)
-            for name, password in (users or {}).items()
+            for name, password in users.items()
         }
+
+    def __bool__(self) -> bool:
+        return bool(self._users)
+
+    def authenticate(self, username: str, password: str) -> bool:
+        want = self._users.get(username)
+        return want is not None and hmac.compare_digest(
+            want, _hash(password, self._salt)
+        )
+
+
+class ProxyHeaderProvider(AuthProvider):
+    """Trust identities asserted by an authenticating reverse proxy.
+
+    ``shared_secret`` is REQUIRED and must arrive in ``secret_header`` on
+    every request — it proves the request really traversed the proxy.
+    Without it, anyone who can reach the console port directly would
+    authenticate as any identity by typing the header, while auth still
+    reports itself enabled — so an empty secret is a constructor error,
+    not a default.
+    """
+
+    def __init__(
+        self,
+        shared_secret: str,
+        user_header: str = "X-Auth-Request-User",
+        secret_header: str = "X-Auth-Request-Secret",
+    ) -> None:
+        if not shared_secret:
+            raise ValueError(
+                "ProxyHeaderProvider requires a shared_secret: without "
+                "one, any direct client could spoof the identity header"
+            )
+        self.user_header = user_header
+        self.shared_secret = shared_secret
+        self.secret_header = secret_header
+
+    def identify_request(self, headers: Mapping[str, str]) -> Optional[str]:
+        user = headers.get(self.user_header, "")
+        if not user:
+            return None
+        if not hmac.compare_digest(
+            headers.get(self.secret_header, ""), self.shared_secret
+        ):
+            return None
+        return user
+
+
+class SessionAuth:
+    """None-auth when no provider is configured: every request is
+    ``anonymous`` (the reference console also runs open unless auth is
+    configured)."""
+
+    def __init__(
+        self,
+        users: Optional[Dict[str, str]] = None,
+        session_ttl: float = 12 * 3600.0,
+        providers: Optional[List[AuthProvider]] = None,
+    ) -> None:
+        self._lock = threading.Lock()
+        self.providers: List[AuthProvider] = list(providers or [])
+        if users:
+            self.providers.insert(0, StaticUserProvider(users))
         self._sessions: Dict[str, Session] = {}
         self.session_ttl = session_ttl
 
     @property
     def enabled(self) -> bool:
-        return bool(self._users)
+        return bool(self.providers)
 
     def login(self, username: str, password: str) -> Optional[Session]:
+        if not any(
+            p.authenticate(username, password) for p in self.providers
+        ):
+            return None
         with self._lock:
-            want = self._users.get(username)
-            if want is None or not hmac.compare_digest(
-                want, _hash(password, self._salt)
-            ):
-                return None
             now = time.time()
             sess = Session(
                 token=secrets.token_urlsafe(32),
@@ -67,6 +154,14 @@ class SessionAuth:
             )
             self._sessions[sess.token] = sess
             return sess
+
+    def identify_request(self, headers: Mapping[str, str]) -> Optional[str]:
+        """Session-less identity (proxy/oauth header flows)."""
+        for p in self.providers:
+            user = p.identify_request(headers)
+            if user:
+                return user
+        return None
 
     def logout(self, token: str) -> None:
         with self._lock:

@@ -88,6 +88,7 @@ class ConsoleServer:
         self._routes: List[Route] = []
         #: (ns, pod) -> (sampled_at, qps) — see _probe_qps_cached
         self._qps_cache: Dict[Tuple[str, str], Tuple[float, Optional[float]]] = {}
+        self._qps_cache_lock = threading.Lock()
         self._register_routes()
         handler = self._make_handler()
         self.httpd = ThreadingHTTPServer((host, port), handler)
@@ -147,6 +148,9 @@ class ConsoleServer:
         r("GET", "/api/v1/data/charts", ConsoleServer._h_charts)
         # model lineage + slice fleet (console views over live objects)
         r("GET", "/api/v1/model/list", ConsoleServer._h_model_list)
+        # storage surfaces for job submission (reference: the pvc list at
+        # routers/api/job.go:29-43 feeds the submit form)
+        r("GET", "/api/v1/storage/list", ConsoleServer._h_storage_list)
         r("GET", "/api/v1/cluster/slices", ConsoleServer._h_cluster_slices)
         r("GET", "/api/v1/cluster/nodes", ConsoleServer._h_cluster_nodes)
         # data/code sources, ConfigMap-backed CRUD (reference: console
@@ -513,6 +517,40 @@ class ConsoleServer:
             })
         return {"models": models}
 
+    def _h_storage_list(self, req: Request):
+        """Storage surfaces a job submission can target (reference: the
+        pvc list the submit form reads, routers/api/job.go:29-43). The
+        TPU-native union: registered storage providers, the operator's
+        configured roots, and every storage root existing ModelVersions
+        already use (deduplicated) — what a user picks for
+        spec.model_version.storage_root."""
+        from kubedl_tpu.lineage import storage as storage_mod
+
+        providers = [
+            {"name": name, "shared": p.SHARED}
+            for name, p in sorted(storage_mod.list_storage_providers().items())
+        ]
+        opts = self.operator.options
+        roots = []
+
+        def add_root(root, provider, source):
+            if root and not any(r["root"] == root for r in roots):
+                roots.append(
+                    {"root": root, "provider": provider, "source": source}
+                )
+
+        add_root(
+            getattr(opts, "artifact_registry_root", ""), "shared",
+            "operator artifact registry",
+        )
+        remote = getattr(opts, "remote_storage_url", "")
+        if remote:
+            add_root(f"{remote}/blobs/models", "http", "remote blob store")
+        for mv in self.operator.store.list("ModelVersion", namespace=None):
+            add_root(mv.storage_root, mv.storage_provider or "shared",
+                     f"ModelVersion {mv.metadata.namespace}/{mv.metadata.name}")
+        return {"providers": providers, "roots": roots}
+
     def _h_cluster_slices(self, req: Request):
         """Slice fleet detail: topology, hosts, holder — the TPU-native
         analogue of the reference's node/resource ClusterInfo page."""
@@ -544,19 +582,26 @@ class ConsoleServer:
     def _probe_qps_cached(self, probe, pod) -> Optional[float]:
         key = (pod.metadata.namespace, pod.metadata.name)
         now = time.time()
-        cached = self._qps_cache.get(key)
+        with self._qps_cache_lock:
+            cached = self._qps_cache.get(key)
         if cached is not None and now - cached[0] < self.QPS_CACHE_TTL:
             return cached[1]
+        # probe OUTSIDE the lock (2s HTTP timeout must not serialize
+        # concurrent handler threads)
         try:
             v = probe(pod)
         except Exception:
             v = None
-        self._qps_cache[key] = (now, v)
-        if len(self._qps_cache) > 4096:  # bounded: GC'd pods age out
-            self._qps_cache = {
-                k: t for k, t in self._qps_cache.items()
-                if now - t[0] < self.QPS_CACHE_TTL
-            }
+        with self._qps_cache_lock:
+            self._qps_cache[key] = (now, v)
+            if len(self._qps_cache) > 4096:  # bounded: GC'd pods age out
+                # prune in place under the lock — wholesale reassignment
+                # could drop entries inserted by a concurrent handler
+                for k in [
+                    k for k, t in self._qps_cache.items()
+                    if now - t[0] >= self.QPS_CACHE_TTL
+                ]:
+                    del self._qps_cache[k]
         return v
 
     def _h_charts(self, req: Request):
@@ -781,10 +826,19 @@ class ConsoleServer:
                 token = self._session_token()
                 if path.startswith("/api/") and path != "/api/v1/login":
                     sess = server.auth.validate(token)
-                    if sess is None:
-                        self._reply(401, {"code": "401", "data": "unauthorized"})
-                        return
-                    username = sess.username
+                    if sess is not None:
+                        username = sess.username
+                    else:
+                        # session-less identity: an authenticating proxy
+                        # (oauth2-proxy pattern) asserts the user via
+                        # headers — pluggable AuthProvider.identify_request
+                        proxied = server.auth.identify_request(self.headers)
+                        if proxied is None:
+                            self._reply(
+                                401, {"code": "401", "data": "unauthorized"}
+                            )
+                            return
+                        username = proxied
                 req = Request(
                     method=method,
                     path=path,

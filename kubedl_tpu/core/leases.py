@@ -82,6 +82,9 @@ class LeaderElector:
         self.ttl = ttl
         self.clock = clock
         self._leader = False
+        #: `transitions` value captured when this elector acquired the
+        #: lease — see check_fence()
+        self.fence_token = -1
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._on_started: Optional[Callable[[], None]] = None
@@ -101,6 +104,7 @@ class LeaderElector:
             lease.metadata.namespace = self.namespace
             try:
                 self.store.create(lease)
+                self.fence_token = lease.transitions
                 return True
             except AlreadyExists:
                 return False
@@ -121,6 +125,7 @@ class LeaderElector:
             obj.holder = self.identity
             obj.renewed_at = fresh_now
             obj.lease_ttl = self.ttl
+            self.fence_token = obj.transitions
 
         try:
             self.store.update_with_retry(
@@ -158,6 +163,30 @@ class LeaderElector:
             )
         except (_LostLease, NotFound, Conflict):
             pass
+
+    def check_fence(self) -> bool:
+        """Best-effort staleness check: True iff this elector still holds
+        the lease AND no leadership transition happened since it acquired
+        (fresh lease read; holder + `transitions` token compared).
+
+        Leadership loss is only *detected* at the next ttl/3 renew tick,
+        so a deposed leader has a window in which `is_leader` still reads
+        True — calling this immediately before committing an external
+        side effect NARROWS that window to the check->commit gap; it does
+        not close it (the caller can still stall between the two). A true
+        guarantee requires the RECEIVER to reject stale tokens: stamp
+        ``fence_token`` into the write and have the downstream system
+        compare it against the highest token it has seen. In-store writes
+        need neither (resourceVersion conflicts reject stale writers).
+        """
+        if not self._leader:
+            return False
+        obj = self.store.try_get("Lease", self.name, self.namespace)
+        return (
+            isinstance(obj, Lease)
+            and obj.holder == self.identity
+            and obj.transitions == self.fence_token
+        )
 
     # ---- campaign loop ---------------------------------------------------
 

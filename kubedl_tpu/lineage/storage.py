@@ -150,6 +150,11 @@ def register_storage_provider(provider: StorageProvider, *aliases: str) -> None:
         _PROVIDERS[name] = provider
 
 
+def list_storage_providers() -> Dict[str, StorageProvider]:
+    """Registered providers incl. aliases (console storage/list route)."""
+    return dict(_PROVIDERS)
+
+
 def get_storage_provider(name: str) -> StorageProvider:
     """Reference: GetStorageProvider (storage_provider.go:1-35)."""
     try:

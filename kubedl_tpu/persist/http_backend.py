@@ -12,6 +12,7 @@ decodes the typed result. The Query/filter semantics run SERVER-side
 from __future__ import annotations
 
 import json
+import urllib.error
 import urllib.request
 from typing import List, Optional
 
@@ -43,8 +44,21 @@ class HTTPBackend(ObjectStorageBackend, EventStorageBackend):
             headers={"Content-Type": "application/json"},
             method="POST",
         )
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            out = json.loads(resp.read())
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                out = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            # surface the server's error message instead of an opaque
+            # "HTTP 500": the remote store replies {"error": ...}
+            body = e.read()
+            try:
+                detail = json.loads(body).get("error", "")
+            except Exception:
+                detail = body[:200].decode("utf-8", "replace")
+            raise RuntimeError(
+                f"remote persist call {method!r} failed "
+                f"(HTTP {e.code}): {detail or e.reason}"
+            ) from e
         return out["result"]
 
     def initialize(self) -> None:

@@ -16,8 +16,10 @@ Blobs are files under ``root/``; keys are sanitized relative paths.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
+import os
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -84,9 +86,27 @@ class RemoteStoreServer:
                     data = self.rfile.read(length)
                     dest = server.root / key
                     dest.parent.mkdir(parents=True, exist_ok=True)
-                    tmp = dest.with_suffix(dest.suffix + ".tmp-upload")
-                    tmp.write_bytes(data)
-                    tmp.replace(dest)
+                    # unique temp per request: concurrent PUTs to the same
+                    # key must not interleave into one staging file
+                    import tempfile as _tempfile
+
+                    fd, tmp_name = _tempfile.mkstemp(
+                        prefix=dest.name + ".", suffix=".tmp-upload",
+                        dir=dest.parent,
+                    )
+                    try:
+                        with os.fdopen(fd, "wb") as f:
+                            f.write(data)
+                            # mkstemp creates 0600; blobs may be read
+                            # directly off a shared filesystem by other
+                            # uids (workers mounting the storage root), so
+                            # restore the pre-mkstemp world-readable mode
+                            os.fchmod(f.fileno(), 0o644)
+                        os.replace(tmp_name, dest)
+                    except BaseException:
+                        with contextlib.suppress(OSError):
+                            os.unlink(tmp_name)
+                        raise
                     self._json(200, {"key": key, "size": len(data)})
                 except Exception as e:
                     self._json(400, {"error": str(e)})

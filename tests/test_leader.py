@@ -38,6 +38,28 @@ class TestLeaderElector:
         # deposed holder cannot renew
         assert not a._renew()
 
+    def test_check_fence_rejects_deposed_leader(self):
+        """The unfenced window: a deposed leader still believes it leads
+        until its next renew tick — check_fence() must say no anyway,
+        because the fencing token moved on (ADVICE r3: nothing stamped or
+        checked `transitions`)."""
+        store = ObjectStore()
+        t = {"now": 100.0}
+        a = LeaderElector(store, identity="a", ttl=5.0, clock=lambda: t["now"])
+        b = LeaderElector(store, identity="b", ttl=5.0, clock=lambda: t["now"])
+        assert a._try_acquire()
+        a._leader = True  # what the campaign loop would set
+        assert a.check_fence()  # holding and un-deposed
+        t["now"] += 6.0
+        assert b._try_acquire()  # expiry takeover bumps transitions
+        b._leader = True
+        # a has NOT ticked its renew loop: is_leader still lies...
+        assert a.is_leader
+        # ...but the fence catches it
+        assert not a.check_fence()
+        assert b.check_fence()
+        assert b.fence_token == 1
+
     def test_release_allows_immediate_takeover(self):
         store = ObjectStore()
         t = {"now": 100.0}
