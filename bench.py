@@ -78,16 +78,36 @@ def bench_control_plane() -> dict:
             local_addresses=True, pod_log_dir=logs,
             artifact_registry_root=os.path.join(tmp, "reg"),
         )
+        mnist = os.path.join(repo, "examples", "mnist_convnet.py")
+        ddp_py = os.path.join(repo, "examples", "torch_ddp_min.py")
+        import importlib.util
+
+        have_torch = importlib.util.find_spec("torch") is not None
+        workloads = {}
         with Operator(opts, runtime=SubprocessRuntime(logs)) as op:
             tf = TFJob(); tf.metadata.name = "b-tf"
-            add(tf, ReplicaType.WORKER, 2,
-                [py, os.path.join(repo, "examples", "mnist_convnet.py"),
-                 "--steps", "80", "--require-tf-config"],
-                env=cpu_env)
+            if os.path.exists(mnist):
+                workloads["TFJob"] = "mnist-convnet>=90%acc"
+                add(tf, ReplicaType.WORKER, 2,
+                    [py, mnist, "--steps", "80", "--require-tf-config"],
+                    env=cpu_env)
+            else:  # installed-wheel/image runs without examples/ on disk
+                workloads["TFJob"] = "env-assert (examples/ not shipped)"
+                add(tf, ReplicaType.WORKER, 2,
+                    [py, "-c",
+                     "import os, json;"
+                     "json.loads(os.environ['TF_CONFIG'])['cluster']['worker']"])
             pt = PyTorchJob(); pt.metadata.name = "b-pt"
-            ddp = [py, os.path.join(repo, "examples", "torch_ddp_min.py")]
+            if have_torch and os.path.exists(ddp_py):
+                workloads["PyTorchJob"] = "torch-ddp-gloo"
+                ddp = [py, ddp_py]
+            else:
+                workloads["PyTorchJob"] = "env-assert (torch/examples absent)"
+                ddp = [py, "-c",
+                       "import os; os.environ['MASTER_ADDR']; os.environ['RANK']"]
             add(pt, ReplicaType.MASTER, 1, ddp)
             add(pt, ReplicaType.WORKER, 3, ddp)
+            workloads["MPIJob"] = "hostfile-contract"
             mpi = MPIJob(); mpi.metadata.name = "b-mpi"
             add(mpi, ReplicaType.LAUNCHER, 1,
                 ["bash", "-c", 'test -s "$OMPI_MCA_orte_default_hostfile"'])
@@ -105,11 +125,7 @@ def bench_control_plane() -> dict:
                 na, sa = op.metrics.all_pods_launch_delay.summary(kind=job.KIND)
                 out[job.KIND] = {
                     "succeeded": ok,
-                    "workload": {
-                        "TFJob": "mnist-convnet>=90%acc",
-                        "PyTorchJob": "torch-ddp-gloo",
-                        "MPIJob": "hostfile-contract",
-                    }[job.KIND],
+                    "workload": workloads[job.KIND],
                     "first_pod_launch_s": round(s1 / n1, 3) if n1 else None,
                     "all_pods_launch_s": round(sa / na, 3) if na else None,
                 }

@@ -154,7 +154,8 @@ def _check_pvc(f: Findings, where: str, obj: dict) -> None:
 
 
 def _check_rbac_binding(f: Findings, where: str, obj: dict) -> None:
-    if not obj.get("roleRef", {}).get("name"):
+    # `roleRef:` with no value parses as None — a finding, not a crash
+    if not ((obj.get("roleRef") or {}).get("name")):
         f.err(where, "binding has no roleRef.name")
     if not obj.get("subjects"):
         f.err(where, "binding has no subjects")
@@ -199,7 +200,9 @@ def validate_manifests(rendered_dir: Path, f: Findings) -> dict:
             elif kind in ("ClusterRoleBinding", "RoleBinding"):
                 _check_rbac_binding(f, where, obj)
             elif kind == "ServiceAccount":
-                f.sa_defined.add(obj["metadata"]["name"])
+                sa_name = (obj.get("metadata") or {}).get("name")
+                if sa_name:  # nameless SA already reported by _check_meta
+                    f.sa_defined.add(sa_name)
     return counts
 
 

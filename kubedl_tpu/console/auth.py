@@ -110,9 +110,12 @@ class ProxyHeaderProvider(AuthProvider):
         user = headers.get(self.user_header, "")
         if not user:
             return None
-        if not hmac.compare_digest(
-            headers.get(self.secret_header, ""), self.shared_secret
-        ):
+        # compare as bytes: compare_digest raises TypeError on non-ASCII
+        # str input, which an attacker could trigger per-request
+        got = headers.get(self.secret_header, "").encode(
+            "utf-8", "surrogateescape"
+        )
+        if not hmac.compare_digest(got, self.shared_secret.encode("utf-8")):
             return None
         return user
 

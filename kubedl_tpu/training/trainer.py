@@ -171,6 +171,17 @@ class Trainer:
     def __init__(self, cfg: TrainConfig, mesh: Optional[Mesh] = None) -> None:
         self.cfg = cfg
         self.mesh = mesh or meshlib.build_mesh(None)
+        if (
+            getattr(cfg.model, "fuse_projections", False)
+            and meshlib.axis_size(self.mesh, "tensor") > 1
+        ):
+            # concat-at-use along the megatron column-split dim would make
+            # GSPMD all-gather the weight shards — keep projections
+            # separate on tensor-parallel meshes
+            cfg = dataclasses.replace(
+                cfg, model=dataclasses.replace(cfg.model, fuse_projections=False)
+            )
+            self.cfg = cfg
         self.family = family_for(cfg.model)
         self.tx = make_optimizer(cfg)
         self.pipe_size = meshlib.axis_size(self.mesh, "pipe")

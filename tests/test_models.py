@@ -57,6 +57,41 @@ class TestLlamaForward:
         actual = sum(x.size for x in jax.tree_util.tree_leaves(params))
         assert actual == CFG.num_params()
 
+    def test_fuse_projections_parity(self, params):
+        """fuse_projections rewrites QKV and gate/up as concat-and-slice
+        (GQA: dq_w != dkv_w) — fused logits must equal unfused exactly
+        (same dots, same order within each output column block)."""
+        import dataclasses
+
+        fused_cfg = dataclasses.replace(CFG, fuse_projections=True)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(3), (2, 16), 0, CFG.vocab_size, jnp.int32
+        )
+        l0 = llama.llama_forward(params, tokens, CFG)
+        l1 = llama.llama_forward(params, tokens, fused_cfg)
+        np.testing.assert_allclose(l0, l1, atol=1e-5, rtol=1e-5)
+
+    def test_fuse_projections_disabled_on_tensor_mesh(self):
+        """The trainer must force fusion OFF when the mesh has a >1
+        tensor axis (concat along the megatron column-split dim would
+        make GSPMD all-gather the shards)."""
+        import dataclasses
+
+        from kubedl_tpu.api.topology import MeshSpec
+        from kubedl_tpu.parallel.mesh import build_mesh
+        from kubedl_tpu.training.trainer import TrainConfig, Trainer
+
+        fused = dataclasses.replace(CFG, fuse_projections=True)
+        mesh = build_mesh(MeshSpec({"data": 4, "tensor": 2}), jax.devices()[:8])
+        tr = Trainer(TrainConfig(model=fused, global_batch=4, seq_len=16), mesh)
+        assert tr.cfg.model.fuse_projections is False
+        # and stays ON for a pure data mesh
+        mesh_dp = build_mesh(MeshSpec({"data": 8}), jax.devices()[:8])
+        tr2 = Trainer(
+            TrainConfig(model=fused, global_batch=8, seq_len=16), mesh_dp
+        )
+        assert tr2.cfg.model.fuse_projections is True
+
     def test_decode_matches_forward(self, params):
         """KV-cache decode must reproduce teacher-forced logits."""
         key = jax.random.PRNGKey(2)
