@@ -13,12 +13,15 @@ WORKDIR /app
 
 COPY pyproject.toml README.md bench.py __graft_entry__.py ./
 COPY kubedl_tpu ./kubedl_tpu
-# example workloads: the control-plane bench runs the real convnet/DDP
-# trainers from here (bench.py degrades to env-asserts when absent)
-COPY examples ./examples
 
 # CPU JAX by default; TPU deployments override with jax[tpu]
 RUN pip install --no-cache-dir -e .
+
+# example workloads: the control-plane bench runs the real convnet/DDP
+# trainers from here (bench.py degrades to env-asserts when absent).
+# After the pip layer: editing a workload script must not bust the
+# dependency-install cache
+COPY examples ./examples
 
 # console + metrics
 EXPOSE 9090

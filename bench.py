@@ -149,38 +149,54 @@ def bench_serving(on_tpu: bool) -> dict:
     params = llama.llama_init(jax.random.PRNGKey(0), cfg)
     decode = jax.jit(lambda p, c, t: llama.decode_step_batched(p, c, t, cfg))
     out = {"model": preset, "n_params": cfg.num_params()}
-    steps = 32 if on_tpu else 8
-    variants = {"": params}
-    if on_tpu:
-        # weight-only int8: decode is HBM-bound, halved weight bytes
-        variants["_int8"] = llama.quantize_params(params, cfg)
-    for suffix, p in variants.items():
+    # 64 dispatched steps per trial: the tunnel's ~6ms dispatch floor
+    # amortizes over the async queue; min of trials kills the +-15%
+    # swings (round-4: int8 b1 measured 175 once, 190-198 steady)
+    steps = 64 if on_tpu else 8
+    trials = 3 if on_tpu else 1
+
+    def measure(p, suffix):
         for B in (1, 8):
             cache = llama.init_batched_cache(cfg, B, max_seq)
             toks = jnp.ones((B, 1), jnp.int32)
             logits, cache = decode(p, cache, toks)  # compile
             float(jax.device_get(jnp.sum(logits)))  # true barrier
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                logits, cache = decode(p, cache, toks)
-            float(jax.device_get(jnp.sum(logits)))
-            dt = (time.perf_counter() - t0) / steps
+            dt = float("inf")
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    logits, cache = decode(p, cache, toks)
+                float(jax.device_get(jnp.sum(logits)))
+                dt = min(dt, (time.perf_counter() - t0) / steps)
             out[f"decode_ms_per_token_b{B}{suffix}"] = round(dt * 1e3, 3)
             out[f"decode_tokens_per_sec_b{B}{suffix}"] = round(B / dt, 1)
+
+    measure(params, "")
     # time-to-first-token: 64-token prompt via batched prefill (ONE
     # forward fills the cache and yields the first token's logits —
     # round 2 paid 64 sequential decode steps here: 633ms on v5e)
     prefill = jax.jit(lambda p, c, t, l: llama.prefill_batched(p, c, t, l, cfg))
-    cache = llama.init_batched_cache(cfg, 1, max_seq)
     toks = jnp.ones((1, 64), jnp.int32)
     lens = jnp.full((1,), 64, jnp.int32)
+    cache = llama.init_batched_cache(cfg, 1, max_seq)
     logits, cache = prefill(params, cache, toks, lens)  # compile
     float(jax.device_get(jnp.sum(logits)))
-    cache = llama.init_batched_cache(cfg, 1, max_seq)
-    t0 = time.perf_counter()
-    logits, cache = prefill(params, cache, toks, lens)
-    float(jax.device_get(jnp.sum(logits)))
-    out["ttft_64_prompt_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    best = float("inf")
+    for _ in range(trials):
+        cache = llama.init_batched_cache(cfg, 1, max_seq)
+        t0 = time.perf_counter()
+        logits, cache = prefill(params, cache, toks, lens)
+        float(jax.device_get(jnp.sum(logits)))
+        best = min(best, (time.perf_counter() - t0) * 1e3)
+    out["ttft_64_prompt_ms"] = round(best, 1)
+    if on_tpu:
+        # weight-only int8: decode is HBM-bound, halved weight bytes.
+        # Measured LAST with the bf16 weights freed first — 7.5GB of
+        # co-resident variants measurably slows the tunnel's dispatch
+        # path (141 vs 198 tok/s b1, round-4)
+        qp = llama.quantize_params(params, cfg)
+        del params, cache, logits
+        measure(qp, "_int8")
     return out
 
 
@@ -417,8 +433,12 @@ def main() -> int:
                 "opt_moment_dtype": "bfloat16",
             }
         else:
+            # 32 steps: the tiny model's only learnable signal is the
+            # init-loss gap above ln(vocab); at 8 steps (inside the lr
+            # warmup) the loss-decrease sanity gate is a coin flip
             train_cfg = {
-                "model": "tiny", "global_batch": 8, "seq_len": 128, "steps": 8,
+                "model": "tiny", "global_batch": 8, "seq_len": 128,
+                "steps": 32, "learning_rate": 3e-3,
             }
 
         logs = os.path.join(tmp, "logs")
