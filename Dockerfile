@@ -11,16 +11,15 @@ FROM python:3.12-slim
 
 WORKDIR /app
 
-COPY pyproject.toml README.md bench.py __graft_entry__.py ./
+COPY pyproject.toml README.md bench.py ./
 COPY kubedl_tpu ./kubedl_tpu
 
 # CPU JAX by default; TPU deployments override with jax[tpu]
 RUN pip install --no-cache-dir -e .
 
-# example workloads: the control-plane bench runs the real convnet/DDP
-# trainers from here (bench.py degrades to env-asserts when absent).
-# After the pip layer: editing a workload script must not bust the
-# dependency-install cache
+# not needed by pip install: kept after the dependency layer so editing
+# a workload/driver script never busts the install cache
+COPY __graft_entry__.py ./
 COPY examples ./examples
 
 # console + metrics

@@ -58,6 +58,15 @@ def remat_policy_for(name: str):
         return jax.checkpoint_policies.save_only_these_names(
             "flash_out", "flash_lse"
         )
+    if name == "flash_rope":
+        # flash residuals + the kernel's INPUTS (post-rope q/k and v):
+        # backward then reconstructs nothing on the attention path —
+        # no norm/projection/rope re-run to feed the bwd kernel. The
+        # round-4 full-step winner at bench shapes (582ms vs 601 flash,
+        # 605 dots, 643 r3-shipped) for ~4GB of saved activations.
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse", "rope_out", "attn_v"
+        )
     if name == "attn_flash":
         # attention output + kernel residuals, dots recomputed
         return jax.checkpoint_policies.save_only_these_names(
@@ -160,13 +169,13 @@ LLAMA3_1B = LlamaConfig(
 BENCH_350M = LlamaConfig(
     vocab_size=32768, dim=1024, n_layers=24, n_heads=16, n_kv_heads=8,
     ffn_dim=4096, max_seq=2048, loss_chunk=0,
-    # "flash" saves ONLY the kernel residuals (out+lse): the backward
-    # re-runs the cheap MXU-bound dots but never the attention kernel,
-    # and the ~8GB of stacked dot outputs "dots" would have saved become
-    # free HBM — which is also what lets loss_chunk=0 (unchunked logits)
-    # win. Full-step sweep on v5e b8 s2048: flash 597-601ms vs dots
-    # 605-614 vs dots_flash 639-647; s8192 b2: flash 868ms vs dots 955.
-    remat_policy="flash",
+    # "flash_rope" saves the kernel residuals AND its inputs (post-rope
+    # q/k, v): backward reconstructs nothing on the attention path while
+    # the ~8GB of stacked dot outputs "dots" would have saved stay free —
+    # which is also what lets loss_chunk=0 (unchunked logits) win.
+    # Full-step sweep on v5e b8 s2048: flash_rope 582ms vs flash 597-601
+    # vs dots 605-614 vs dots_flash 639-647 vs 643 shipped in r3.
+    remat_policy="flash_rope",
 )
 TINY = LlamaConfig(
     vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=128,
@@ -417,8 +426,14 @@ def _block(
         q = (h @ deq(lp["wq"])).reshape(B, S, n_heads, hd)
         k = (h @ deq(lp["wk"])).reshape(B, S, n_kv, hd)
         v = (h @ deq(lp["wv"])).reshape(B, S, n_kv, hd)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
+    # named so "flash_rope" can SAVE the attention kernel's exact inputs:
+    # without these, the backward scan re-runs norm + the q/k/v
+    # projections + rope just to reconstruct the custom-vjp residuals
+    # (the kernel's q/k/v) — measured 601 -> 582 ms/step on the bench
+    # model for ~3.2GB of saved activations
+    q = checkpoint_name(apply_rope(q, cos, sin), "rope_out")
+    k = checkpoint_name(apply_rope(k, cos, sin), "rope_out")
+    v = checkpoint_name(v, "attn_v")
     attn = (attn_fn or attention)(q, k, v).reshape(B, S, n_heads * hd)
     # named for remat_policy="attn": save the attention output so backward
     # never re-runs the (flash) attention kernel, recompute everything else
