@@ -107,9 +107,11 @@ class LlamaConfig:
     #: what the remat saves: "dots_flash" (matmul outputs AND the flash
     #: kernel's out/lse residuals — the default, because without the
     #: residuals the backward must re-run the forward attention kernel
-    #: every layer), "flash" (only the kernel residuals: re-run the
-    #: cheap dots, ~8GB less saved at bench shapes), "dots", "nothing",
-    #: "attn", "attn_flash"
+    #: every layer), "flash_rope" (kernel residuals + its post-rope
+    #: q/k + v inputs: backward reconstructs nothing on the attention
+    #: path — the measured bench winner), "flash" (only the kernel
+    #: residuals: re-run the cheap dots, ~8GB less saved at bench
+    #: shapes), "dots", "dots_attn", "nothing", "attn", "attn_flash"
     remat_policy: str = "dots_flash"
     #: compute the LM loss over sequence chunks of this many positions
     #: (0 = whole sequence at once). The full [B, S, V] fp32 logits are

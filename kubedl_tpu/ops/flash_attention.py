@@ -485,10 +485,11 @@ def _flash_bwd(causal, block_q, block_k, bwd_block_q, bwd_block_k, interpret, re
 # forward kernel in the backward scan REGARDLESS of checkpoint policy
 # (verified by counting _fwd_kernel custom-calls in the lowered HLO).
 # Instead the residuals are tagged with checkpoint_name in _flash_fwd and
-# the "dots_flash" remat policy (models/llama.remat_policy_for) saves
-# them — with that pairing the lowered module contains exactly ONE
-# _fwd_kernel; under plain "dots" the backward re-runs it (~43ms/step on
-# the bench model, profiled).
+# the name-saving remat policies ("dots_flash" default, "flash_rope" the
+# measured bench winner — models/llama.remat_policy_for) save them; with
+# that pairing the lowered module contains exactly ONE _fwd_kernel, and
+# tests/test_ops.py::TestRematKernelCounts guards the property. Under
+# plain "dots" the backward re-runs it (~43ms/step profiled).
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
