@@ -43,8 +43,15 @@ class TestFlashAttention:
                                    atol=2e-5, rtol=2e-5)
 
     @pytest.mark.parametrize("causal", [True, False])
-    def test_gradients_match_dense(self, causal):
-        q, k, v = _qkv(jax.random.PRNGKey(3), S=64, hd=16)
+    @pytest.mark.parametrize(
+        "H,KV", [(4, 2), (8, 2), (8, 1)],  # group 2, 4, and MQA (group=H)
+        ids=["group2", "group4", "mqa"],
+    )
+    def test_gradients_match_dense(self, causal, H, KV):
+        """The fused backward accumulates dk/dv across the whole GQA group
+        in kernel scratch (init on the group's first head, write-out on
+        its last) — exercised at group sizes beyond the bench model's 2."""
+        q, k, v = _qkv(jax.random.PRNGKey(3), S=64, H=H, KV=KV, hd=16)
 
         def loss_flash(q, k, v):
             o = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
@@ -94,6 +101,43 @@ class TestFlashAttention:
 
 
 class TestBlockFitting:
+    def test_fused_bwd_odd_long_seq_gradients(self):
+        """Regression: the fused backward's long-S k-tile shrink must
+        RE-FIT, not clamp — at S=5376/hd=16 the scratch threshold is
+        crossed and fit_block picks 896; a min(bk,512) clamp stopped
+        dividing S and silently dropped the tail k-blocks (NaN dk/dv,
+        dq off by 1e-2)."""
+        from kubedl_tpu.ops import flash_attention_module as fam
+
+        S, hd = 5376, 16
+        # shrink the thresholds so the tiny test shape crosses them the
+        # way S=5376/hd=64 does in production (Sk*hd*8 = 672KB here)
+        old_small, old_cap = (
+            fam._FUSED_BWD_SMALL_TILE_BYTES, fam._FUSED_BWD_SCRATCH_BYTES,
+        )
+        fam._FUSED_BWD_SMALL_TILE_BYTES = 256 << 10
+        fam._FUSED_BWD_SCRATCH_BYTES = 1 << 20
+        try:
+            q, k, v = _qkv(jax.random.PRNGKey(5), B=1, S=S, H=2, KV=1, hd=hd)
+
+            def loss_flash(q, k, v):
+                o = flash_attention(q, k, v, causal=True)
+                return (o * o).sum()
+
+            def loss_dense(q, k, v):
+                o = llama.attention(q, k, v, causal=True)
+                return (o * o).sum()
+
+            g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+            g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+            for a, b in zip(g1, g2):
+                assert np.isfinite(np.asarray(a)).all()
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           atol=2e-3, rtol=2e-3)
+        finally:
+            fam._FUSED_BWD_SMALL_TILE_BYTES = old_small
+            fam._FUSED_BWD_SCRATCH_BYTES = old_cap
+
     def test_fit_block(self):
         from kubedl_tpu.ops.flash_attention import fit_block, supports
 
