@@ -232,6 +232,53 @@ def bench_long_context(on_tpu: bool) -> dict:
     }
 
 
+def bench_flash_numerics(on_tpu: bool) -> dict:
+    """Numerics gate (ADVICE r4): the fused single-pass flash backward and
+    the classic split two-kernel backward must agree ON CHIP. The fused
+    kernel's dk/dv correctness rests on fully-sequential grid semantics
+    (now pinned via compiler_params in ops/flash_attention.py) — interpret-
+    mode tests cannot exercise Mosaic pipelining, so the only place this
+    assumption is actually provable is real hardware."""
+    if not on_tpu:
+        return {"skipped": "not on tpu"}
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.ops import flash_attention as fa
+
+    B, S, H, KV, hd = 1, 1024, 4, 2, 64  # GQA group of 2, one full k-tile +
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (B, S, H, hd), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, KV, hd), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, KV, hd), jnp.bfloat16)
+
+    def loss(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=True, block_q=256, block_k=256)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    grad = jax.grad(loss, argnums=(0, 1, 2))
+    fused = jax.jit(grad)(q, k, v)
+    old = fa._FUSED_BWD_SCRATCH_BYTES
+    try:
+        fa._FUSED_BWD_SCRATCH_BYTES = 0  # force the split two-kernel path
+        split = jax.jit(grad)(q, k, v)  # fresh jit: traces the split path
+    finally:
+        fa._FUSED_BWD_SCRATCH_BYTES = old
+    out = {"shape": f"B{B} S{S} H{H} KV{KV} hd{hd}"}
+    ok = True
+    for name, a, b in zip(("dq", "dk", "dv"), fused, split):
+        a32 = jax.device_get(a).astype("float32")
+        b32 = jax.device_get(b).astype("float32")
+        diff = float(abs(a32 - b32).max())
+        ref = float(abs(b32).max())
+        out[f"{name}_max_abs_diff"] = round(diff, 6)
+        # both paths accumulate in f32 and emit bf16: disagreement beyond
+        # a couple of bf16 ulps of the largest gradient means a real bug
+        ok = ok and diff <= 0.03 * max(ref, 1.0)
+    out["ok"] = ok
+    return out
+
+
 def _tunnel_touch(cache_dir: str = "") -> dict:
     """Probe the platform AND equalize device-init cost, in a THROWAWAY
     subprocess (this parent must not hold the TPU the headline workers
@@ -255,31 +302,37 @@ def _tunnel_touch(cache_dir: str = "") -> dict:
     """
     import subprocess
 
-    code = (
-        "from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested;"
-        "ensure_cpu_if_requested();"
-        "from kubedl_tpu.utils.compile_cache import enable_compilation_cache;"
-        "enable_compilation_cache();"
-        "import jax;"
-        # structural hit/miss proof: jax's own monitoring events, not a
-        # log-string match (which a jax upgrade could silently rename)
-        "from jax._src import monitoring;"
-        "ev = {'hits': 0, 'misses': 0};"
-        "monitoring.register_event_listener(lambda e, **kw:"
-        " ev.__setitem__('hits', ev['hits'] + ('cache_hit' in e))"
-        " or ev.__setitem__('misses', ev['misses'] + ('cache_miss' in e)));"
-        "import jax.numpy as jnp;"
-        "plat = jax.devices()[0].platform;"
-        "jax.jit(lambda a: a @ a + 1.0)(jnp.ones((256, 256))).block_until_ready();"
-        # 4GiB scratch alloc, TPU only: HBM reclaim of the PREVIOUS
-        # client's buffers is lazy — forcing a big allocation makes the
-        # tunnel pay the reclaim now, not inside the next job's measured
-        # startup window (on CPU it would just waste host RAM)
-        "plat == 'tpu' and jax.jit(lambda: jnp.zeros((2**30,), jnp.float32))()"
-        ".block_until_ready();"
-        "print(plat);"
-        "print('CACHE_EVENTS hits=%d misses=%d' % (ev['hits'], ev['misses']))"
-    )
+    # structural hit/miss proof: jax's own monitoring events, not a
+    # log-string match (which a jax upgrade could silently rename). The
+    # private-API import is guarded: if a jax upgrade moves it, platform
+    # detection must still succeed (a broken probe would silently
+    # reclassify a TPU host as a CPU smoke run — ADVICE r4).
+    code = """
+from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested
+ensure_cpu_if_requested()
+from kubedl_tpu.utils.compile_cache import enable_compilation_cache
+enable_compilation_cache()
+import jax
+ev = {'hits': 0, 'misses': 0}
+try:
+    from jax._src import monitoring
+    monitoring.register_event_listener(lambda e, **kw:
+        ev.__setitem__('hits', ev['hits'] + ('cache_hit' in e))
+        or ev.__setitem__('misses', ev['misses'] + ('cache_miss' in e)))
+except Exception:
+    pass
+import jax.numpy as jnp
+plat = jax.devices()[0].platform
+jax.jit(lambda a: a @ a + 1.0)(jnp.ones((256, 256))).block_until_ready()
+# 4GiB scratch alloc, TPU only: HBM reclaim of the PREVIOUS client's
+# buffers is lazy — forcing a big allocation makes the tunnel pay the
+# reclaim now, not inside the next job's measured startup window (on
+# CPU it would just waste host RAM)
+if plat == 'tpu':
+    jax.jit(lambda: jnp.zeros((2**30,), jnp.float32))().block_until_ready()
+print(plat)
+print('CACHE_EVENTS hits=%d misses=%d' % (ev['hits'], ev['misses']))
+"""
     from kubedl_tpu.utils.compile_cache import cache_entry_count
 
     env = dict(os.environ)
@@ -414,6 +467,7 @@ def main() -> int:
 
     summary_warm = None
     warm_error = ""  # why warm is missing: gate-relevant on the subprocess path
+    warm_attempts: list = []  # EVERY warm attempt, recorded in the artifact
     preflight = {}
     with TemporaryDirectory() as tmp:
         cache_dir = os.path.join(tmp, "compile-cache")
@@ -465,6 +519,23 @@ def main() -> int:
                     summary_warm = _run_headline(
                         op, "bench-warm", train_cfg, logs
                     )
+                    warm_attempts.append(summary_warm)
+                    # flaky-stall policy (VERDICT r4 next-step 1): one
+                    # recorded retry, never a silent best-of-N. The
+                    # tunnel has a rare ~55s warm stall mode; with full
+                    # phase attribution the failed attempt stays in the
+                    # artifact, and the retry (after a fresh symmetric
+                    # touch) is what the gate judges.
+                    if (
+                        summary_warm.get("_startup_to_first_step", 0.0)
+                        >= summary.get("_startup_to_first_step", 0.0)
+                        and preflight.get("roundtrip_ok")
+                    ):
+                        _tunnel_touch(cache_dir)
+                        summary_warm = _run_headline(
+                            op, "bench-warm2", train_cfg, logs
+                        )
+                        warm_attempts.append(summary_warm)
                 except Exception as e:
                     warm_error = str(e)
                     print(json.dumps({"warm_run_error": warm_error}),
@@ -473,6 +544,7 @@ def main() -> int:
             print(json.dumps({"subprocess_headline_fallback": str(e)}),
                   file=sys.stderr)
             summary_warm = None  # never pair in-process cold w/ stale warm
+            warm_attempts = []
             warm_error = f"in-process fallback (warm N/A): {e}"
             with Operator(opts, runtime=ThreadRuntime()) as op:
                 summary = _run_headline_inprocess(op, train_cfg)
@@ -519,18 +591,31 @@ def main() -> int:
                 print(json.dumps({"warm_gate_skipped": warm_gate_skipped}),
                       file=sys.stderr)
             elif warm_s >= cold_s:
+                # the FULL warm summary rides the violation (round-4
+                # VERDICT: the payload omitted first_step/pre_loop_sync,
+                # so the one failing artifact could not be diagnosed)
                 violations.append(
                     f"warm startup {warm_s:.1f}s not better than cold "
                     f"{cold_s:.1f}s — compile cache not hitting "
-                    f"(preflight {preflight}; cold phases "
-                    f"{summary.get('startup_phases')}, warm phases "
-                    f"{summary_warm.get('startup_phases')}, warm cache "
-                    f"{summary_warm.get('compile_cache')})"
+                    f"(preflight {preflight}; attempts "
+                    f"{len(warm_attempts)}; cold summary {summary}; warm "
+                    f"summaries {warm_attempts})"
                 )
         elif not warm_error.startswith("in-process fallback"):
             # the subprocess path worked for cold but warm produced no
             # summary: the feature this gate validates is silently broken
             violations.append(f"warm run missing: {warm_error or 'unknown'}")
+    flash_numerics = None
+    if on_tpu:
+        try:
+            flash_numerics = bench_flash_numerics(True)
+            if not flash_numerics.get("ok"):
+                violations.append(
+                    "fused vs split flash backward disagree on chip: "
+                    f"{flash_numerics}"
+                )
+        except Exception as e:  # infra failure in the check: report, not gate
+            flash_numerics = {"error": str(e)}
     if violations:
         print(
             json.dumps({"error": "bench sanity gates failed",
@@ -594,8 +679,21 @@ def main() -> int:
                         summary_warm.get("compile_cache")
                         if summary_warm else None
                     ),
+                    # every warm attempt (a stall + recorded retry shows
+                    # up here as two entries, not a silent best-of-N)
+                    "warm_attempts": [
+                        {
+                            "startup_to_first_step_s": round(
+                                a.get("_startup_to_first_step", 0.0), 2
+                            ),
+                            "startup_phases": a.get("startup_phases"),
+                            "compile_cache": a.get("compile_cache"),
+                        }
+                        for a in warm_attempts
+                    ] or None,
                     "warm_gate_skipped": warm_gate_skipped or None,
                     "warm_unavailable": warm_error or None,
+                    "flash_numerics": flash_numerics,
                     "step_time_ms": round(summary["step_time_ms"], 2),
                     "hbm_floor_ms": round(summary.get("hbm_floor_ms", 0.0), 2),
                     "first_loss": round(summary.get("first_loss") or 0.0, 4),

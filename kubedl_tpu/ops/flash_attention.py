@@ -43,33 +43,74 @@ from jax import lax
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
+#: softmax runs in the exp2 domain: the TPU VPU's transcendental unit is a
+#: 2^x evaluator (e^x lowers to 2^(x·log2e)), so folding log2(e) into the
+#: score scale turns every exp into a bare exp2 — one fewer VPU pass over
+#: each [bq, bk] tile. lse crosses the kernel boundary in natural-log
+#: units (ring attention and the split/fused backward all agree on it).
+LOG2E = math.log2(math.e)
+LN2 = math.log(2.0)
+
+
+def _tile_preds(causal: bool, qi, kj, block_q: int, block_k: int):
+    """(run, on_diag) for the (q-block ``qi``, k-block ``kj``) tile of a
+    causal grid. ``run``: the tile has any unmasked element (tiles
+    strictly above the diagonal are skipped outright). ``on_diag``: the
+    tile STRADDLES the diagonal and must pay the masking passes (iota +
+    compare + select are three VPU sweeps over [bq, bk]); tiles fully
+    below the diagonal — every full tile at long S — skip them. Returns
+    (None, None) for non-causal grids, which run every tile unmasked."""
+    if not causal:
+        return None, None
+    run = kj * block_k <= qi * block_q + block_q - 1
+    on_diag = qi * block_q < kj * block_k + block_k - 1
+    return run, on_diag
+
+
+def _dispatch_tiles(causal: bool, run, on_diag, step) -> None:
+    """Invoke ``step(apply_mask)`` under the shared causal predication
+    (one definition for all four kernels — fwd, fused bwd, split dq,
+    split dk/dv — so the boundary conditions cannot drift apart)."""
+    if not causal:
+        step(False)
+        return
+
+    @pl.when(jnp.logical_and(run, jnp.logical_not(on_diag)))
+    def _full_tile():
+        step(False)
+
+    @pl.when(jnp.logical_and(run, on_diag))
+    def _diag_tile():
+        step(True)
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, scale: float, causal: bool, block_q: int, block_k: int, n_k: int,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, *l_scratch,
+    scale: float, causal: bool, block_q: int, block_k: int, n_k: int,
+    aug_v: bool,
 ):
     i = pl.program_id(2)
     j = pl.program_id(3)
+    hd = q_ref.shape[-1]
+    l_ref = l_scratch[0] if l_scratch else None
 
     @pl.when(j == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
+        if l_ref is not None:
+            l_ref[:] = jnp.zeros_like(l_ref)
 
-    # causal: skip k-blocks strictly above the diagonal
-    run = (j * block_k <= i * block_q + block_q - 1) if causal else (j <= n_k)
+    run, on_diag = _tile_preds(causal, i, j, block_q, block_k)
 
-    @pl.when(run)
-    def _step():
+    def _step(apply_mask):
         q = q_ref[0, 0]  # [bq, hd]
         k = k_ref[0, 0]  # [bk, hd]
         v = v_ref[0, 0]
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [bq, bk]
-        if causal:
+        ) * (scale * LOG2E)  # [bq, bk], base-2 domain
+        if apply_mask:
             rows = i * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
@@ -79,24 +120,44 @@ def _fwd_kernel(
             s = jnp.where(rows >= cols, s, NEG_INF)
         m_prev = m_ref[:, :1]  # [bq, 1]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
-        l_new = l_ref[:, :1] * corr + p.sum(axis=-1, keepdims=True)
-        pv = lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_ref[:] = acc_ref[:] * corr + pv
+        p = jnp.exp2(s - m_new)
+        corr = jnp.exp2(m_prev - m_new)
+        if aug_v:
+            # V carries a ones column: the softmax denominator comes out
+            # of the SAME MXU matmul as P·V (the lane padding at
+            # hd % 128 != 0 makes the extra column free) and the l-update
+            # VPU reduce over [bq, bk] disappears — acc's last column IS l
+            v_aug = jnp.concatenate(
+                [v, jnp.ones((v.shape[0], 1), v.dtype)], axis=-1
+            )
+            pv = lax.dot_general(
+                p.astype(v.dtype), v_aug, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[:] = acc_ref[:] * corr + pv
+        else:
+            l_new = l_ref[:, :1] * corr + p.sum(axis=-1, keepdims=True)
+            pv = lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[:] = acc_ref[:] * corr + pv
+            l_ref[:, :1] = l_new
         m_ref[:, :1] = m_new
-        l_ref[:, :1] = l_new
+
+    _dispatch_tiles(causal, run, on_diag, _step)
 
     @pl.when(j == n_k - 1)
     def _finalize():
-        l = jnp.maximum(l_ref[:, :1], 1e-30)
-        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        if aug_v:
+            l = jnp.maximum(acc_ref[:, hd:hd + 1], 1e-30)
+        else:
+            l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[:, :hd] / l).astype(o_ref.dtype)
         # lse is [B, H, Sq, 1] (trailing singleton keeps the block shape
-        # legal for mosaic's (8, 128) tiling rule); squeezed by _fwd
-        lse_ref[0, 0] = m_ref[:, :1] + jnp.log(l)
+        # legal for mosaic's (8, 128) tiling rule); squeezed by _fwd.
+        # m is base-2: convert back to natural log at the boundary.
+        lse_ref[0, 0] = (m_ref[:, :1] + jnp.log2(l)) * LN2
 
 
 def _fwd(
@@ -120,10 +181,20 @@ def _fwd(
     n_q, n_k = Sq // bq, Sk // bk
     scale = 1.0 / math.sqrt(hd)
 
+    # ones-augmented V only pays when hd leaves lane-padding slack (the
+    # [bq, hd+1] MXU output tile costs the same passes as [bq, hd] iff
+    # hd % 128 != 0); at hd=128k it would DOUBLE the P·V matmul instead
+    aug_v = (hd % 128) != 0
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=bq, block_k=bk, n_k=n_k,
+        block_q=bq, block_k=bk, n_k=n_k, aug_v=aug_v,
     )
+    scratch = [
+        pltpu.VMEM((bq, hd + 1 if aug_v else hd), jnp.float32),
+        pltpu.VMEM((bq, 128), jnp.float32),
+    ]
+    if not aug_v:
+        scratch.append(pltpu.VMEM((bq, 128), jnp.float32))
     out, lse = pl.pallas_call(
         kernel,
         grid=(B, H, n_q, n_k),
@@ -140,11 +211,7 @@ def _fwd(
             jax.ShapeDtypeStruct((B, H, Sq, hd), q.dtype),
             jax.ShapeDtypeStruct((B, H, Sq, 1), jnp.float32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((bq, hd), jnp.float32),
-            pltpu.VMEM((bq, 128), jnp.float32),
-            pltpu.VMEM((bq, 128), jnp.float32),
-        ],
+        scratch_shapes=scratch,
         interpret=interpret,
     )(q, k, v)
     return out, lse[..., 0]
@@ -164,24 +231,23 @@ def _bwd_dq_kernel(
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    run = (j * block_k <= i * block_q + block_q - 1) if causal else (j <= n_k)
+    run, on_diag = _tile_preds(causal, i, j, block_q, block_k)
 
-    @pl.when(run)
-    def _step():
+    def _step(apply_mask):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]  # [bq, 1]
+        lse = lse_ref[0, 0]  # [bq, 1], base-2 (pre-scaled by LOG2E)
         d = d_ref[0, 0]  # [bq, 1]
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
+        ) * (scale * LOG2E)
+        if apply_mask:
             rows = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)
+        p = jnp.exp2(s - lse)
         dp = lax.dot_general(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -191,6 +257,8 @@ def _bwd_dq_kernel(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
+
+    _dispatch_tiles(causal, run, on_diag, _step)
 
     @pl.when(j == n_k - 1)
     def _finalize():
@@ -212,24 +280,23 @@ def _bwd_dkdv_kernel(
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    run = (j * block_k <= i * block_q + block_q - 1) if causal else (i <= n_q)
+    run, on_diag = _tile_preds(causal, i, j, block_q, block_k)
 
-    @pl.when(run)
-    def _step():
+    def _step(apply_mask):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
+        lse = lse_ref[0, 0]  # base-2 (pre-scaled by LOG2E)
         d = d_ref[0, 0]
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
+        ) * (scale * LOG2E)
+        if apply_mask:
             rows = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)  # [bq, bk]
+        p = jnp.exp2(s - lse)  # [bq, bk]
         dv_acc[:] += lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0, 0],
             (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
@@ -242,6 +309,8 @@ def _bwd_dkdv_kernel(
         dk_acc[:] += lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
         ) * scale
+
+    _dispatch_tiles(causal, run, on_diag, _step)
 
     @pl.when(i == n_q - 1)
     def _finalize():
@@ -289,21 +358,20 @@ def _bwd_fused_kernel(
     def _init_q():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    run = (j * block_k <= i * block_q + block_q - 1) if causal else (j <= n_k)
+    run, on_diag = _tile_preds(causal, i, j, block_q, block_k)
 
-    @pl.when(run)
-    def _step():
+    def _step(apply_mask):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
         do32 = do.astype(jnp.float32)
-        lse = lse_ref[0, 0]
+        lse = lse_ref[0, 0]  # base-2 (pre-scaled by LOG2E)
         d = d_ref[0, 0]
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
+        ) * (scale * LOG2E)
+        if apply_mask:
             rows = i * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, 1), 0
             )
@@ -311,7 +379,7 @@ def _bwd_fused_kernel(
                 jnp.int32, (1, block_k), 1
             )
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)  # [bq, bk]
+        p = jnp.exp2(s - lse)  # [bq, bk]
         dv_acc[pl.ds(j * block_k, block_k), :] += lax.dot_general(
             p.astype(do.dtype), do,
             (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
@@ -330,6 +398,8 @@ def _bwd_fused_kernel(
             ds_c, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
+
+    _dispatch_tiles(causal, run, on_diag, _step)
 
     @pl.when(j == n_k - 1)
     def _fin_q():
@@ -380,7 +450,10 @@ def _bwd_pallas(
 
     # D_i = rowsum(dO * O): tiny elementwise pre-pass, XLA fuses it
     d = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)[..., None]
-    lse4 = lse[..., None]  # [B, H, Sq, 1]
+    # lse enters the kernels pre-scaled to the exp2 domain (see LOG2E):
+    # p = 2^(s·scale·log2e − lse·log2e) = e^(s·scale − lse), one VPU mul
+    # here on [B,H,Sq] instead of an exp→exp2 conversion on every tile
+    lse4 = (lse * LOG2E)[..., None]  # [B, H, Sq, 1]
 
     scratch_bytes = Sk * hd * 8
     fused_ok = scratch_bytes <= _FUSED_BWD_SCRATCH_BYTES
@@ -426,6 +499,18 @@ def _bwd_pallas(
                 pltpu.VMEM((Sk, hd), jnp.float32),
                 pltpu.VMEM((Sk, hd), jnp.float32),
             ],
+            # PIN fully-sequential grid semantics: the dk/dv output blocks
+            # (index map ignores j) are revisited non-consecutively across
+            # (h, i) passes, and correctness relies on the final in-order
+            # copy-out at (last q-head of the group, i=n_q-1) overwriting
+            # every earlier flush. That only holds under 'arbitrary'
+            # (sequential) dimension semantics — a parallel/Mosaic-
+            # pipelined grid would silently corrupt gradients, so the
+            # assumption is made explicit rather than inherited as a
+            # default (ADVICE r4).
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",) * 4
+            ),
             interpret=interpret,
         )(q, k, v, do, lse4, d)
         return dq, dk, dv

@@ -28,6 +28,12 @@ from typing import Optional
 
 log = logging.getLogger("kubedl_tpu.remote.server")
 
+#: process umask, read once at import (single-threaded moment): os.umask
+#: can only be read by writing it, which is unsafe per-request under
+#: ThreadingHTTPServer
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
 #: persist methods callable over RPC (both backend roles)
 _PERSIST_METHODS = frozenset({
     "save_job", "get_job", "list_jobs", "mark_job_deleted",
@@ -100,8 +106,14 @@ class RemoteStoreServer:
                             # mkstemp creates 0600; blobs may be read
                             # directly off a shared filesystem by other
                             # uids (workers mounting the storage root), so
-                            # restore the pre-mkstemp world-readable mode
-                            os.fchmod(f.fileno(), 0o644)
+                            # restore what a plain open() would have
+                            # created: 0666 filtered by the process umask
+                            # (a deployment running umask 027 keeps its
+                            # tighter permissions). _UMASK is read once at
+                            # import: os.umask() is process-global and
+                            # this handler runs on ThreadingHTTPServer
+                            # threads — a get/restore here would race.
+                            os.fchmod(f.fileno(), 0o666 & ~_UMASK)
                         os.replace(tmp_name, dest)
                     except BaseException:
                         with contextlib.suppress(OSError):

@@ -203,6 +203,17 @@ class Trainer:
         #: background AOT compile of the train step (see warm_compile_async)
         self._warm_thread: Optional[Any] = None
         self._warm_compiled: Optional[Any] = None
+        #: wall seconds the background thread spent in lower().compile()
+        #: (None until it finishes) — rides the fit summary so a stalled
+        #: warm compile is attributable from the pod log alone
+        self._warm_compile_s: Optional[float] = None
+        #: wall seconds fit spent joining the thread + whether it gave up
+        self._warm_join_s: float = 0.0
+        self._warm_join_timed_out: bool = False
+        #: True iff dispatch actually went through the AOT executable —
+        #: decided at resolve time (a timed-out thread finishing late, or
+        #: the first-step sharding-drift fallback, must not claim credit)
+        self._aot_used: bool = False
         self.state_shardings = self._state_shardings()
         self._build_fns()
 
@@ -235,12 +246,22 @@ class Trainer:
         ]
 
         def match(path, leaf):
+            # longest suffix wins: a param whose full path happens to equal
+            # the TAIL of another param's path (same shape) must not steal
+            # the shorter match — ties are impossible since param paths are
+            # unique and suffixes of equal length are equal paths
             strs = tuple(str(k) for k in path)
+            best, best_n = rep, 0
             for ppath, pshape, sh in entries:
                 n = len(ppath)
-                if len(strs) >= n and strs[-n:] == ppath and leaf.shape == pshape:
-                    return sh
-            return rep
+                if (
+                    n > best_n
+                    and len(strs) >= n
+                    and strs[-n:] == ppath
+                    and leaf.shape == pshape
+                ):
+                    best, best_n = sh, n
+            return best
 
         opt_sds = jax.eval_shape(self.tx.init, params_sds)
         o_leaves, o_def = jax.tree_util.tree_flatten_with_path(opt_sds)
@@ -508,6 +529,7 @@ class Trainer:
         import threading
 
         def work():
+            t0 = time.perf_counter()
             try:
                 sds_state = self._state_sds
                 sds_batch = jax.ShapeDtypeStruct(
@@ -517,7 +539,9 @@ class Trainer:
                     self._warm_compiled = self.train_step.lower(
                         sds_state, sds_batch
                     ).compile()
+                self._warm_compile_s = time.perf_counter() - t0
             except Exception:  # never let a warm-up kill the job
+                self._warm_compile_s = time.perf_counter() - t0
                 import logging
 
                 logging.getLogger("kubedl_tpu.training.trainer").warning(
@@ -528,11 +552,30 @@ class Trainer:
                                              name="kubedl-warm-compile")
         self._warm_thread.start()
 
-    def _resolve_step_fn(self):
-        """Join the warm compile (if started) and pick the step callable."""
+    def _resolve_step_fn(self, timeout: Optional[float] = None):
+        """Join the warm compile (if started) and pick the step callable.
+
+        ``timeout`` bounds the join: a warm restart whose persistent
+        compilation cache already holds the train step should never wait
+        long for the AOT thread — if that thread is stalled (round-4
+        BENCH: a flaky ~55s warm stall on the tunnel's compile path), the
+        plain jit dispatch deserializes the on-disk entry in seconds. On
+        timeout the thread is abandoned (daemon; its late result is
+        ignored) and dispatch goes through ``self.train_step``.
+        """
+        self._warm_join_s = 0.0
+        self._warm_join_timed_out = False
         if self._warm_thread is not None:
-            self._warm_thread.join()
+            t0 = time.perf_counter()
+            self._warm_thread.join(timeout)
+            self._warm_join_s = time.perf_counter() - t0
+            if self._warm_thread.is_alive():
+                self._warm_join_timed_out = True
+                self._warm_thread = None
+                self._aot_used = False
+                return self.train_step
             self._warm_thread = None
+        self._aot_used = self._warm_compiled is not None
         return self._warm_compiled or self.train_step
 
     def shard_batch(self, batch) -> jax.Array:
@@ -549,6 +592,7 @@ class Trainer:
         on_step: Optional[Callable[[int, Dict[str, Any]], None]] = None,
         ckpt_dir: Optional[str] = None,
         ckpt_every: Optional[int] = None,
+        warm_join_timeout: Optional[float] = None,
     ) -> Tuple[Dict[str, Any], Dict[str, float]]:
         """Run the loop; returns (state, summary) with the north-star
         metrics (first-step latency, tokens/sec/chip, MFU) measured under
@@ -562,6 +606,11 @@ class Trainer:
         steps = steps or self.cfg.steps
         state = state or self.init_state()
         ckpt_every = self.cfg.ckpt_every if ckpt_every is None else ckpt_every
+        # join the warm AOT compile FIRST (timed separately, bounded by
+        # warm_join_timeout): the compile wait overlaps init's async device
+        # work, and a stalled compile thread attributes to its own phase
+        # instead of hiding inside first_step_seconds (round-4 BENCH hole)
+        step_fn = self._resolve_step_fn(warm_join_timeout)
         # this scalar fetch is a true barrier on init/restore execution AND
         # on any concurrent AOT executable load sharing the device link —
         # timed so startup attribution can see it (it precedes the
@@ -576,7 +625,6 @@ class Trainer:
         first_loss = None
         t_run = t0
         ckpt_overhead = 0.0
-        step_fn = self._resolve_step_fn()
         with self.mesh:
             for i in range(start, steps):
                 batch = self.shard_batch(next(data))
@@ -595,6 +643,7 @@ class Trainer:
                         # real error.
                         step_fn = self.train_step
                         self._warm_compiled = None  # don't re-pick it
+                        self._aot_used = False
                         state, metrics = step_fn(state, batch)
                 else:
                     state, metrics = step_fn(state, batch)
@@ -629,6 +678,9 @@ class Trainer:
         steady_steps = len(losses) - 1
         tps = tokens_per_step * steady_steps / total if total > 0 and steady_steps > 0 else 0.0
         summary = {
+            "warm_compile_join_s": self._warm_join_s,
+            "warm_compile_s": self._warm_compile_s,
+            "warm_join_timed_out": self._warm_join_timed_out,
             "pre_loop_sync_s": pre_loop_sync_s,
             "first_step_seconds": first_step_s,
             "steps": len(losses),
