@@ -244,7 +244,7 @@ def bench_flash_numerics(on_tpu: bool) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from kubedl_tpu.ops import flash_attention as fa
+    from kubedl_tpu.ops import flash_attention_module as fa
 
     B, S, H, KV, hd = 1, 1024, 4, 2, 64  # GQA group of 2, one full k-tile +
     ks = jax.random.split(jax.random.PRNGKey(7), 3)
@@ -274,6 +274,36 @@ def bench_flash_numerics(on_tpu: bool) -> dict:
         out[f"{name}_max_abs_diff"] = round(diff, 6)
         # both paths accumulate in f32 and emit bf16: disagreement beyond
         # a couple of bf16 ulps of the largest gradient means a real bug
+        ok = ok and diff <= 0.03 * max(ref, 1.0)
+
+    # fused-rope leg: in-kernel rotation (+ inverse rotation in backward)
+    # vs explicit apply_rope outside the kernel — the production hot path
+    from kubedl_tpu.models import llama
+
+    cos, sin = llama.rope_table(hd, 10000.0, S)
+
+    def loss_rope(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=True, block_q=256,
+                               block_k=256, rope_cos=cos, rope_sin=sin)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def loss_explicit(q, k, v):
+        o = fa.flash_attention(
+            llama.apply_rope(q, cos, sin), llama.apply_rope(k, cos, sin),
+            v, causal=True, block_q=256, block_k=256,
+        )
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    g_rope = jax.jit(jax.grad(loss_rope, argnums=(0, 1, 2)))(q, k, v)
+    g_exp = jax.jit(jax.grad(loss_explicit, argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b in zip(("dq", "dk", "dv"), g_rope, g_exp):
+        a32 = jax.device_get(a).astype("float32")
+        b32 = jax.device_get(b).astype("float32")
+        diff = float(abs(a32 - b32).max())
+        ref = float(abs(b32).max())
+        out[f"rope_{name}_max_abs_diff"] = round(diff, 6)
+        # the two paths round q/k to bf16 at different points (pre- vs
+        # post-rotation), so agreement is to bf16 ulps, not bitwise
         ok = ok and diff <= 0.03 * max(ref, 1.0)
     out["ok"] = ok
     return out

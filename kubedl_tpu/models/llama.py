@@ -433,10 +433,22 @@ def _block(
     # projections + rope just to reconstruct the custom-vjp residuals
     # (the kernel's q/k/v) — measured 601 -> 582 ms/step on the bench
     # model for ~3.2GB of saved activations
-    q = checkpoint_name(apply_rope(q, cos, sin), "rope_out")
-    k = checkpoint_name(apply_rope(k, cos, sin), "rope_out")
-    v = checkpoint_name(v, "attn_v")
-    attn = (attn_fn or attention)(q, k, v).reshape(B, S, n_heads * hd)
+    if getattr(attn_fn, "fused_rope", False):
+        # rotary fused into the pallas kernel (rotation on VMEM tiles;
+        # backward emits pre-rope grads): q/k go in UN-rotated, and the
+        # saved kernel inputs are the raw projection outputs — the
+        # XLA-side rope (rotate + concat + relayouts over [B,S,H,hd],
+        # again in backward) profiled at ~37ms/step on the bench model
+        q = checkpoint_name(q, "rope_out")
+        k = checkpoint_name(k, "rope_out")
+        v = checkpoint_name(v, "attn_v")
+        attn = attn_fn(q, k, v, rope_cos=cos, rope_sin=sin)
+    else:
+        q = checkpoint_name(apply_rope(q, cos, sin), "rope_out")
+        k = checkpoint_name(apply_rope(k, cos, sin), "rope_out")
+        v = checkpoint_name(v, "attn_v")
+        attn = (attn_fn or attention)(q, k, v)
+    attn = attn.reshape(B, S, n_heads * hd)
     # named for remat_policy="attn": save the attention output so backward
     # never re-runs the (flash) attention kernel, recompute everything else
     attn = checkpoint_name(attn, "attn_out")

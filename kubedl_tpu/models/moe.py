@@ -239,9 +239,14 @@ def _block(x, lp, cfg: MoEConfig, cos, sin, attn_fn=None,
     q = (h @ lp["wq"]).reshape(B, S, n_heads, hd)
     k = (h @ lp["wk"]).reshape(B, S, n_kv, hd)
     v = (h @ lp["wv"]).reshape(B, S, n_kv, hd)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
-    attn = (attn_fn or attention)(q, k, v).reshape(B, S, n_heads * hd)
+    if getattr(attn_fn, "fused_rope", False):
+        # rotary fused into the pallas kernel — see models.llama._block
+        attn = attn_fn(q, k, v, rope_cos=cos, rope_sin=sin)
+    else:
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        attn = (attn_fn or attention)(q, k, v)
+    attn = attn.reshape(B, S, n_heads * hd)
     attn_out = attn @ lp["wo"]
     if tp_axis:
         attn_out = lax.psum(attn_out, tp_axis)

@@ -46,10 +46,9 @@ NEG_INF = -1e30
 #: softmax runs in the exp2 domain: the TPU VPU's transcendental unit is a
 #: 2^x evaluator (e^x lowers to 2^(x·log2e)), so folding log2(e) into the
 #: score scale turns every exp into a bare exp2 — one fewer VPU pass over
-#: each [bq, bk] tile. lse crosses the kernel boundary in natural-log
-#: units (ring attention and the split/fused backward all agree on it).
+#: each [bq, bk] tile. lse is internal to _flash and stays in BASE-2
+#: units end to end (fwd emits m2 + log2(l), bwd exponentiates with exp2).
 LOG2E = math.log2(math.e)
-LN2 = math.log(2.0)
 
 
 def _tile_preds(causal: bool, qi, kj, block_q: int, block_k: int):
@@ -84,11 +83,35 @@ def _dispatch_tiles(causal: bool, run, on_diag, step) -> None:
         step(True)
 
 
+def _rope_rotate(x, cos, sin, inverse: bool = False):
+    """Rotate the split-halves pairs of ``x`` [rows, hd] by the per-row
+    angles (``cos``/``sin`` [rows, hd/2]) — the models.llama.apply_rope
+    convention, executed on a VMEM tile instead of a whole [B,S,H,hd]
+    array in HBM. f32 math, result cast back to x.dtype. ``inverse``
+    applies the transpose rotation (rotation matrices are orthogonal:
+    R^-1 = R^T = rotation by -θ) — how the backward kernels emit
+    gradients w.r.t. the PRE-rope q/k."""
+    h2 = x.shape[-1] // 2
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[:, :h2], x32[:, h2:]
+    if inverse:
+        sin = -sin
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, *l_scratch,
+    q_ref, k_ref, v_ref, *rest,
     scale: float, causal: bool, block_q: int, block_k: int, n_k: int,
-    aug_v: bool,
+    aug_v: bool, rope: bool, group: int,
 ):
+    if rope:
+        (cos_q_ref, sin_q_ref, cos_k_ref, sin_k_ref,
+         o_ref, lse_ref, acc_ref, m_ref, q_rot_ref, k_rot_ref,
+         *l_scratch) = rest
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, *l_scratch = rest
+    h = pl.program_id(1)
     i = pl.program_id(2)
     j = pl.program_id(3)
     hd = q_ref.shape[-1]
@@ -100,12 +123,40 @@ def _fwd_kernel(
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         if l_ref is not None:
             l_ref[:] = jnp.zeros_like(l_ref)
+        if rope:
+            # rotary fused into the kernel (the XLA-side rope — f32
+            # rotate + concat + relayouts over whole [B,S,H,hd] arrays —
+            # profiled at ~37ms/step on the bench model). Each rotation
+            # happens ONCE per position: q per q-block here (j==0 runs
+            # for every i); k into a whole-sequence scratch below (naive
+            # per-tile rotation re-rotated K n_q times — measured +88ms
+            # at S=8192 where n_q=8).
+            q_rot_ref[:] = _rope_rotate(
+                q_ref[0, 0], cos_q_ref[...], sin_q_ref[...]
+            )
+
+    if rope:
+        # k-block j's first causal visit is at q-block (j*bk)//bq; the
+        # scratch then serves every later i AND the rest of the GQA group
+        # (the grid walks a kv-head's q-heads consecutively; sequential
+        # grid semantics are pinned on this pallas_call)
+        i_first = (j * block_k) // block_q if causal else 0
+
+        @pl.when(jnp.logical_and(h % group == 0, i == i_first))
+        def _load_k_rot():
+            k_rot_ref[pl.ds(j * block_k, block_k), :] = _rope_rotate(
+                k_ref[0, 0], cos_k_ref[...], sin_k_ref[...]
+            )
 
     run, on_diag = _tile_preds(causal, i, j, block_q, block_k)
 
     def _step(apply_mask):
-        q = q_ref[0, 0]  # [bq, hd]
-        k = k_ref[0, 0]  # [bk, hd]
+        if rope:
+            q = q_rot_ref[:]
+            k = k_rot_ref[pl.ds(j * block_k, block_k), :]
+        else:
+            q = q_ref[0, 0]  # [bq, hd]
+            k = k_ref[0, 0]  # [bk, hd]
         v = v_ref[0, 0]
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -155,9 +206,12 @@ def _fwd_kernel(
             l = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0, 0] = (acc_ref[:, :hd] / l).astype(o_ref.dtype)
         # lse is [B, H, Sq, 1] (trailing singleton keeps the block shape
-        # legal for mosaic's (8, 128) tiling rule); squeezed by _fwd.
-        # m is base-2: convert back to natural log at the boundary.
-        lse_ref[0, 0] = (m_ref[:, :1] + jnp.log2(l)) * LN2
+        # legal for mosaic's (8, 128) tiling rule) and stays in BASE-2
+        # units (m is the base-2 running max): lse never leaves _flash,
+        # and any XLA-side op on a [B,H,S,1] tensor is layout-pathological
+        # (a single *LOG2E multiply profiled at 9.6ms/step) — so the
+        # backward consumes these units directly.
+        lse_ref[0, 0] = m_ref[:, :1] + jnp.log2(l)
 
 
 def _fwd(
@@ -168,6 +222,8 @@ def _fwd(
     block_q: int,
     block_k: int,
     interpret: bool,
+    cos: Optional[jax.Array] = None,  # [Sq, hd/2] f32 — fused rope
+    sin: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     from jax.experimental.pallas import tpu as pltpu
 
@@ -180,6 +236,7 @@ def _fwd(
         raise ValueError(f"seq lengths ({Sq},{Sk}) must divide blocks ({bq},{bk})")
     n_q, n_k = Sq // bq, Sk // bk
     scale = 1.0 / math.sqrt(hd)
+    rope = cos is not None
 
     # ones-augmented V only pays when hd leaves lane-padding slack (the
     # [bq, hd+1] MXU output tile costs the same passes as [bq, hd] iff
@@ -187,22 +244,35 @@ def _fwd(
     aug_v = (hd % 128) != 0
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=bq, block_k=bk, n_k=n_k, aug_v=aug_v,
+        block_q=bq, block_k=bk, n_k=n_k, aug_v=aug_v, rope=rope,
+        group=group,
     )
     scratch = [
         pltpu.VMEM((bq, hd + 1 if aug_v else hd), jnp.float32),
         pltpu.VMEM((bq, 128), jnp.float32),
     ]
+    if rope:
+        # once-per-position rotation caches (see _fwd_kernel)
+        scratch.append(pltpu.VMEM((bq, hd), q.dtype))
+        scratch.append(pltpu.VMEM((Sk, hd), k.dtype))
     if not aug_v:
         scratch.append(pltpu.VMEM((bq, 128), jnp.float32))
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // group, j, 0)),
+        pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // group, j, 0)),
+    ]
+    args = [q, k, v]
+    if rope:
+        h2 = hd // 2
+        cq_spec = pl.BlockSpec((bq, h2), lambda b, h, i, j: (i, 0))
+        ck_spec = pl.BlockSpec((bk, h2), lambda b, h, i, j: (j, 0))
+        in_specs += [cq_spec, cq_spec, ck_spec, ck_spec]
+        args += [cos, sin, cos, sin]
     out, lse = pl.pallas_call(
         kernel,
         grid=(B, H, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // group, j, 0)),
-            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // group, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
@@ -212,34 +282,72 @@ def _fwd(
             jax.ShapeDtypeStruct((B, H, Sq, 1), jnp.float32),
         ],
         scratch_shapes=scratch,
+        # sequential grid semantics (also the mosaic default): the rope
+        # k-cache persists across the h and i grid dims, not just the
+        # innermost j — pin the assumption explicitly
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",) * 4
+        ),
         interpret=interpret,
-    )(q, k, v)
-    return out, lse[..., 0]
+    )(*args)
+    # lse keeps its kernel-native [B, H, Sq, 1] shape all the way into the
+    # backward: squeezing to [B, H, Sq] here made the residual-save /
+    # re-expand round trip materialize a sublane-granularity relayout copy
+    # (profiled at 13ms/step on the bench model)
+    return out, lse
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref, acc_ref,
-    *, scale: float, causal: bool, block_q: int, block_k: int, n_k: int,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, *rest,
+    scale: float, causal: bool, block_q: int, block_k: int, n_k: int,
+    rope: bool, group: int,
 ):
     """dQ kernel: grid (B, H, n_q, n_k), k innermost — the dq tile for one
     q-block accumulates across k-blocks in VMEM scratch (same pattern as
-    the forward, with p recomputed from the saved lse)."""
+    the forward, with p recomputed from the saved lse; D = rowsum(dO·O)
+    computed per q-block in VMEM)."""
+    if rope:
+        (cos_q_ref, sin_q_ref, cos_k_ref, sin_k_ref,
+         dq_ref, acc_ref, d_acc, q_rot_ref, k_rot_ref) = rest
+    else:
+        dq_ref, acc_ref, d_acc = rest
+    h = pl.program_id(1)
     i = pl.program_id(2)
     j = pl.program_id(3)
 
     @pl.when(j == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
+        d_acc[:, :1] = (
+            do_ref[0, 0].astype(jnp.float32) * o_ref[0, 0].astype(jnp.float32)
+        ).sum(axis=-1, keepdims=True)
+        if rope:
+            q_rot_ref[:] = _rope_rotate(
+                q_ref[0, 0], cos_q_ref[...], sin_q_ref[...]
+            )
+
+    if rope:
+        i_first = (j * block_k) // block_q if causal else 0
+
+        @pl.when(jnp.logical_and(h % group == 0, i == i_first))
+        def _load_k_rot():
+            k_rot_ref[pl.ds(j * block_k, block_k), :] = _rope_rotate(
+                k_ref[0, 0], cos_k_ref[...], sin_k_ref[...]
+            )
 
     run, on_diag = _tile_preds(causal, i, j, block_q, block_k)
 
     def _step(apply_mask):
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
+        if rope:
+            q = q_rot_ref[:]
+            k = k_rot_ref[pl.ds(j * block_k, block_k), :]
+        else:
+            q = q_ref[0, 0]
+            k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0].astype(jnp.float32)
         lse = lse_ref[0, 0]  # [bq, 1], base-2 (pre-scaled by LOG2E)
-        d = d_ref[0, 0]  # [bq, 1]
+        d = d_acc[:, :1]  # [bq, 1]
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * (scale * LOG2E)
@@ -262,18 +370,48 @@ def _bwd_dq_kernel(
 
     @pl.when(j == n_k - 1)
     def _finalize():
-        dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
+        dq = acc_ref[:]
+        if rope:
+            dq = _rope_rotate(dq, cos_q_ref[...], sin_q_ref[...], inverse=True)
+        dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkdv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc,
-    *, scale: float, causal: bool, block_q: int, block_k: int, n_q: int,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, *rest,
+    scale: float, causal: bool, block_q: int, block_k: int, n_q: int,
+    rope: bool,
 ):
     """dK/dV kernel: grid (B, H, n_k, n_q), q innermost — each k-block's
-    gradient accumulates across the q-blocks that attend to it."""
+    gradient accumulates across the q-blocks that attend to it. D is
+    recomputed per tile here (q-blocks are the INNER axis, so there is no
+    per-q-block init point to cache it at — the [bq, hd] mul+reduce is
+    noise next to the [bq, bk] tile work)."""
+    if rope:
+        (cos_q_ref, sin_q_ref, cos_k_ref, sin_k_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc, q_rot_ref, k_rot_ref) = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
     j = pl.program_id(2)
     i = pl.program_id(3)
+
+    if rope:
+        # q-block i's first visit (j outer here) is at j==0, which runs
+        # for every i under causality — the whole-sequence q cache then
+        # serves all later j; k is fixed per (h, j): rotate at its first
+        # running i
+        @pl.when(j == 0)
+        def _load_q_rot():
+            q_rot_ref[pl.ds(i * block_q, block_q), :] = _rope_rotate(
+                q_ref[0, 0], cos_q_ref[...], sin_q_ref[...]
+            )
+
+        i_first = (j * block_k) // block_q if causal else 0
+
+        @pl.when(i == i_first)
+        def _load_k_rot():
+            k_rot_ref[:] = _rope_rotate(
+                k_ref[0, 0], cos_k_ref[...], sin_k_ref[...]
+            )
 
     @pl.when(i == 0)
     def _init():
@@ -283,12 +421,16 @@ def _bwd_dkdv_kernel(
     run, on_diag = _tile_preds(causal, i, j, block_q, block_k)
 
     def _step(apply_mask):
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
+        if rope:
+            q = q_rot_ref[pl.ds(i * block_q, block_q), :]
+            k = k_rot_ref[:]
+        else:
+            q = q_ref[0, 0]
+            k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0].astype(jnp.float32)
         lse = lse_ref[0, 0]  # base-2 (pre-scaled by LOG2E)
-        d = d_ref[0, 0]
+        d = (do * o_ref[0, 0].astype(jnp.float32)).sum(axis=-1, keepdims=True)
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * (scale * LOG2E)
@@ -314,15 +456,17 @@ def _bwd_dkdv_kernel(
 
     @pl.when(i == n_q - 1)
     def _finalize():
-        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dk = dk_acc[:]
+        if rope:
+            dk = _rope_rotate(dk, cos_k_ref[...], sin_k_ref[...], inverse=True)
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _bwd_fused_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref, dk_ref, dv_ref,
-    dq_acc, dk_acc, dv_acc,
-    *, scale: float, causal: bool, block_q: int, block_k: int,
-    n_q: int, n_k: int, group: int,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, *rest,
+    scale: float, causal: bool, block_q: int, block_k: int,
+    n_q: int, n_k: int, group: int, rope: bool,
 ):
     """Single-pass flash backward: dq, dk AND dv from one traversal.
 
@@ -341,7 +485,19 @@ def _bwd_fused_kernel(
     across them (init on the group's first head, write-out on its last)
     and the kernel emits [B, KV, Sk, hd] directly — no per-q-head dk/dv
     arrays in HBM and no group-sum pass afterwards.
+
+    With ``rope`` the kernel takes PRE-rope q/k, rotates tiles in VMEM
+    (identically to the forward), and inverse-rotates dq/dk at write-out
+    so the emitted gradients are w.r.t. the pre-rope inputs — summing the
+    GQA group's rotated dk first and inverse-rotating once is valid
+    because the rotation is linear and per-position.
     """
+    if rope:
+        (cos_q_ref, sin_q_ref, cos_k_ref, sin_k_ref,
+         dq_ref, dk_ref, dv_ref,
+         dq_acc, dk_acc, dv_acc, d_acc, q_rot_ref, k_rot_ref) = rest
+    else:
+        dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc, d_acc = rest
     h = pl.program_id(1)
     i = pl.program_id(2)
     j = pl.program_id(3)
@@ -357,17 +513,42 @@ def _bwd_fused_kernel(
     @pl.when(j == 0)
     def _init_q():
         dq_acc[:] = jnp.zeros_like(dq_acc)
+        # D_i = rowsum(dO·O) for this q-block, once per (h, i) — in VMEM,
+        # instead of an XLA pre-pass that materialized an f32 relayout of
+        # the whole dO/O pair in HBM
+        d_acc[:, :1] = (
+            do_ref[0, 0].astype(jnp.float32) * o_ref[0, 0].astype(jnp.float32)
+        ).sum(axis=-1, keepdims=True)
+        if rope:
+            q_rot_ref[:] = _rope_rotate(
+                q_ref[0, 0], cos_q_ref[...], sin_q_ref[...]
+            )
+
+    if rope:
+        # once-per-position k rotation (see _fwd_kernel: per-tile
+        # re-rotation cost n_q re-runs — measured +88ms at S=8192)
+        i_first = (j * block_k) // block_q if causal else 0
+
+        @pl.when(jnp.logical_and(first_in_group, i == i_first))
+        def _load_k_rot():
+            k_rot_ref[pl.ds(j * block_k, block_k), :] = _rope_rotate(
+                k_ref[0, 0], cos_k_ref[...], sin_k_ref[...]
+            )
 
     run, on_diag = _tile_preds(causal, i, j, block_q, block_k)
 
     def _step(apply_mask):
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
+        if rope:
+            q = q_rot_ref[:]
+            k = k_rot_ref[pl.ds(j * block_k, block_k), :]
+        else:
+            q = q_ref[0, 0]
+            k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
         do32 = do.astype(jnp.float32)
         lse = lse_ref[0, 0]  # base-2 (pre-scaled by LOG2E)
-        d = d_ref[0, 0]
+        d = d_acc[:, :1]
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * (scale * LOG2E)
@@ -403,13 +584,17 @@ def _bwd_fused_kernel(
 
     @pl.when(j == n_k - 1)
     def _fin_q():
-        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+        dq = dq_acc[:]
+        if rope:
+            dq = _rope_rotate(dq, cos_q_ref[...], sin_q_ref[...], inverse=True)
+        dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
     @pl.when(jnp.logical_and(last_in_group, i == n_q - 1))
     def _fin_kv():
-        dk_ref[0, 0] = dk_acc[pl.ds(j * block_k, block_k), :].astype(
-            dk_ref.dtype
-        )
+        dk = dk_acc[pl.ds(j * block_k, block_k), :]
+        if rope:
+            dk = _rope_rotate(dk, cos_k_ref[...], sin_k_ref[...], inverse=True)
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[pl.ds(j * block_k, block_k), :].astype(
             dv_ref.dtype
         )
@@ -423,6 +608,12 @@ _FUSED_BWD_SCRATCH_BYTES = 8 << 20
 #: S=8192: 1024x512 fused = 850ms/grad vs 950ms split, vs compile-OOM at
 #: 1024x1024)
 _FUSED_BWD_SMALL_TILE_BYTES = 2 << 20
+#: per-kernel scoped-VMEM ceiling for the backward kernels: the fused
+#: backward at S=8192 (whole-seq dk/dv f32 + rope caches + [bq,bk] f32
+#: score intermediates) needs 16.2MB against mosaic's default 16MB —
+#: v5e cores have far more physical VMEM; raise the soft limit rather
+#: than shrinking the measured-optimal tiles
+_BWD_VMEM_LIMIT_BYTES = 24 << 20
 
 
 def _bwd_pallas(
@@ -439,7 +630,13 @@ def _bwd_pallas(
     keep the forward's O(S·hd) memory profile."""
     from jax.experimental.pallas import tpu as pltpu
 
-    q, k, v, out, lse = res
+    if len(res) == 7:  # fused-rope variant: pre-rope q/k + the tables
+        q, k, v, cos, sin, out, lse = res
+        rope = True
+    else:
+        q, k, v, out, lse = res
+        cos = sin = None
+        rope = False
     B, H, Sq, hd = q.shape
     KV, Sk = k.shape[1], k.shape[2]
     group = H // KV
@@ -448,12 +645,14 @@ def _bwd_pallas(
     n_q, n_k = Sq // bq, Sk // bk
     scale = 1.0 / math.sqrt(hd)
 
-    # D_i = rowsum(dO * O): tiny elementwise pre-pass, XLA fuses it
-    d = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)[..., None]
-    # lse enters the kernels pre-scaled to the exp2 domain (see LOG2E):
-    # p = 2^(s·scale·log2e − lse·log2e) = e^(s·scale − lse), one VPU mul
-    # here on [B,H,Sq] instead of an exp→exp2 conversion on every tile
-    lse4 = (lse * LOG2E)[..., None]  # [B, H, Sq, 1]
+    # lse arrives from the forward ALREADY in base-2 units ([B,H,Sq,1]):
+    # p = 2^(s·scale·log2e − lse2) = e^(s·scale − lse). No XLA-side op
+    # may touch it — anything on a [B,H,S,1] tensor is layout-pathological
+    # (a single multiply profiled at 9.6ms/step on the bench model).
+    # D_i = rowsum(dO·O) is computed INSIDE the kernels (per q-block, in
+    # VMEM): as an XLA pre-pass it materialized an f32 relayout of the
+    # whole dO (profiled at ~7ms/step).
+    lse4 = lse  # [B, H, Sq, 1], base-2
 
     scratch_bytes = Sk * hd * 8
     fused_ok = scratch_bytes <= _FUSED_BWD_SCRATCH_BYTES
@@ -481,24 +680,39 @@ def _bwd_pallas(
         dkv_spec = pl.BlockSpec(
             (1, 1, bk, hd), lambda b, h, i, j: (b, h // group, j, 0)
         )
+        in_specs = [q_spec, kv_spec, kv_spec, q_spec, row_spec, q_spec]
+        args = [q, k, v, do, lse4, out]
+        scratch = [
+            pltpu.VMEM((bq, hd), jnp.float32),
+            pltpu.VMEM((Sk, hd), jnp.float32),
+            pltpu.VMEM((Sk, hd), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ]
+        if rope:
+            h2 = hd // 2
+            cq = pl.BlockSpec((bq, h2), lambda b, h, i, j: (i, 0))
+            ck = pl.BlockSpec((bk, h2), lambda b, h, i, j: (j, 0))
+            in_specs += [cq, cq, ck, ck]
+            args += [cos, sin, cos, sin]
+            scratch += [
+                pltpu.VMEM((bq, hd), q.dtype),
+                pltpu.VMEM((Sk, hd), k.dtype),
+            ]
         dq, dk, dv = pl.pallas_call(
             functools.partial(
                 _bwd_fused_kernel, scale=scale, causal=causal,
                 block_q=bq, block_k=bk, n_q=n_q, n_k=n_k, group=group,
+                rope=rope,
             ),
             grid=(B, H, n_q, n_k),
-            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+            in_specs=in_specs,
             out_specs=[q_spec, dkv_spec, dkv_spec],
             out_shape=[
                 jax.ShapeDtypeStruct((B, H, Sq, hd), q.dtype),
                 jax.ShapeDtypeStruct((B, KV, Sk, hd), k.dtype),
                 jax.ShapeDtypeStruct((B, KV, Sk, hd), v.dtype),
             ],
-            scratch_shapes=[
-                pltpu.VMEM((bq, hd), jnp.float32),
-                pltpu.VMEM((Sk, hd), jnp.float32),
-                pltpu.VMEM((Sk, hd), jnp.float32),
-            ],
+            scratch_shapes=scratch,
             # PIN fully-sequential grid semantics: the dk/dv output blocks
             # (index map ignores j) are revisited non-consecutively across
             # (h, i) passes, and correctness relies on the final in-order
@@ -509,28 +723,49 @@ def _bwd_pallas(
             # assumption is made explicit rather than inherited as a
             # default (ADVICE r4).
             compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("arbitrary",) * 4
+                dimension_semantics=("arbitrary",) * 4,
+                vmem_limit_bytes=_BWD_VMEM_LIMIT_BYTES,
             ),
             interpret=interpret,
-        )(q, k, v, do, lse4, d)
+        )(*args)
         return dq, dk, dv
 
     q_spec = pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0))
     kv_spec = pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // group, j, 0))
     row_spec = pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0))
 
+    in_specs = [q_spec, kv_spec, kv_spec, q_spec, row_spec, q_spec]
+    args = [q, k, v, do, lse4, out]
+    scratch = [
+        pltpu.VMEM((bq, hd), jnp.float32),
+        pltpu.VMEM((bq, 128), jnp.float32),
+    ]
+    if rope:
+        h2 = hd // 2
+        cq = pl.BlockSpec((bq, h2), lambda b, h, i, j: (i, 0))
+        ck = pl.BlockSpec((bk, h2), lambda b, h, i, j: (j, 0))
+        in_specs += [cq, cq, ck, ck]
+        args += [cos, sin, cos, sin]
+        scratch += [
+            pltpu.VMEM((bq, hd), q.dtype),
+            pltpu.VMEM((Sk, hd), k.dtype),
+        ]
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal,
-            block_q=bq, block_k=bk, n_k=n_k,
+            block_q=bq, block_k=bk, n_k=n_k, rope=rope, group=group,
         ),
         grid=(B, H, n_q, n_k),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        in_specs=in_specs,
         out_specs=[q_spec],
         out_shape=[jax.ShapeDtypeStruct((B, H, Sq, hd), q.dtype)],
-        scratch_shapes=[pltpu.VMEM((bq, hd), jnp.float32)],
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",) * 4,
+            vmem_limit_bytes=_BWD_VMEM_LIMIT_BYTES,
+        ),
         interpret=interpret,
-    )(q, k, v, do, lse4, d)[0]
+    )(*args)[0]
 
     # dk/dv at q-head granularity (grid swaps the two inner axes)
     q_spec2 = pl.BlockSpec((1, 1, bq, hd), lambda b, h, j, i: (b, h, i, 0))
@@ -538,24 +773,40 @@ def _bwd_pallas(
     row_spec2 = pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0))
     dkv_spec = pl.BlockSpec((1, 1, bk, hd), lambda b, h, j, i: (b, h, j, 0))
 
+    in_specs2 = [q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, q_spec2]
+    args2 = [q, k, v, do, lse4, out]
+    scratch2 = [
+        pltpu.VMEM((bk, hd), jnp.float32),
+        pltpu.VMEM((bk, hd), jnp.float32),
+    ]
+    if rope:
+        cq2 = pl.BlockSpec((bq, h2), lambda b, h, j, i: (i, 0))
+        ck2 = pl.BlockSpec((bk, h2), lambda b, h, j, i: (j, 0))
+        in_specs2 += [cq2, cq2, ck2, ck2]
+        args2 += [cos, sin, cos, sin]
+        scratch2 += [
+            pltpu.VMEM((Sq, hd), q.dtype),
+            pltpu.VMEM((bk, hd), k.dtype),
+        ]
     dk_h, dv_h = pl.pallas_call(
         functools.partial(
             _bwd_dkdv_kernel, scale=scale, causal=causal,
-            block_q=bq, block_k=bk, n_q=n_q,
+            block_q=bq, block_k=bk, n_q=n_q, rope=rope,
         ),
         grid=(B, H, n_k, n_q),
-        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        in_specs=in_specs2,
         out_specs=[dkv_spec, dkv_spec],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Sk, hd), k.dtype),
             jax.ShapeDtypeStruct((B, H, Sk, hd), v.dtype),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((bk, hd), jnp.float32),
-            pltpu.VMEM((bk, hd), jnp.float32),
-        ],
+        scratch_shapes=scratch2,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",) * 4,
+            vmem_limit_bytes=_BWD_VMEM_LIMIT_BYTES,
+        ),
         interpret=interpret,
-    )(q, k, v, do, lse4, d)
+    )(*args2)
     dk = dk_h.reshape(B, KV, group, Sk, hd).sum(axis=2).astype(k.dtype)
     dv = dv_h.reshape(B, KV, group, Sk, hd).sum(axis=2).astype(v.dtype)
     return dq, dk, dv
@@ -582,6 +833,46 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, bwd_block_q, bwd_block_k, inte
 
 def _flash_bwd(causal, block_q, block_k, bwd_block_q, bwd_block_k, interpret, res, do):
     return _bwd_pallas(res, do, causal, bwd_block_q, bwd_block_k, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_rope(
+    q, k, v, cos, sin,
+    causal, block_q, block_k, bwd_block_q, bwd_block_k, interpret,
+):
+    """Fused-rope variant: takes PRE-rope q/k plus the rope tables; the
+    kernels rotate tiles in VMEM (fwd and bwd), and the backward emits
+    gradients w.r.t. the pre-rope inputs via the inverse rotation. The
+    XLA-side rope (rotate + concat + relayout over whole [B,S,H,hd]
+    arrays, fwd and again in bwd) profiled at ~37ms/step on the bench
+    model; in-kernel it is a [rows, hd] VPU epilogue."""
+    out, _ = _fwd(q, k, v, causal, block_q, block_k, interpret, cos=cos, sin=sin)
+    return out
+
+
+def _flash_rope_fwd(
+    q, k, v, cos, sin,
+    causal, block_q, block_k, bwd_block_q, bwd_block_k, interpret,
+):
+    from jax.ad_checkpoint import checkpoint_name
+
+    out, lse = _fwd(q, k, v, causal, block_q, block_k, interpret, cos=cos, sin=sin)
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return out, (q, k, v, cos, sin, out, lse)
+
+
+def _flash_rope_bwd(
+    causal, block_q, block_k, bwd_block_q, bwd_block_k, interpret, res, do
+):
+    dq, dk, dv = _bwd_pallas(res, do, causal, bwd_block_q, bwd_block_k, interpret)
+    # the rope tables are iota-derived constants, not trainable state:
+    # symbolic zeros would be ideal but custom_vjp wants real arrays; XLA
+    # DCEs these
+    return dq, dk, dv, jnp.zeros_like(res[3]), jnp.zeros_like(res[4])
+
+
+_flash_rope.defvjp(_flash_rope_fwd, _flash_rope_bwd)
 
 
 # optimize_remat must stay OFF: its remat_opt machinery re-runs the
@@ -617,6 +908,8 @@ def flash_attention(
     bwd_block_q: int = 1024,
     bwd_block_k: int = 1024,
     interpret: Optional[bool] = None,
+    rope_cos: Optional[jax.Array] = None,  # [S, hd/2]: fuse rotary into
+    rope_sin: Optional[jax.Array] = None,  # the kernel (q/k arrive PRE-rope)
 ) -> jax.Array:
     """Drop-in for `kubedl_tpu.models.llama.attention` (same signature, so
     it slots into `llama_forward(..., attn_fn=flash_attention)`). Arbitrary
@@ -626,10 +919,16 @@ def flash_attention(
     in-model (S=2048, hd=64: 649ms fwd+bwd for the 24-layer bench model vs
     974ms at 256-tiles, 1673ms for the stock jax pallas TPU kernel; 2048
     tiles exceed VMEM). Small sequences clamp blocks to S automatically."""
-    if mask is not None:
-        from kubedl_tpu.models.llama import attention
+    def _dense_fallback(q, k, v, mask=None):
+        from kubedl_tpu.models.llama import apply_rope, attention
 
+        if rope_cos is not None:  # fallbacks must still apply the rotary
+            q = apply_rope(q, rope_cos, rope_sin)
+            k = apply_rope(k, rope_cos, rope_sin)
         return attention(q, k, v, causal=causal, mask=mask)
+
+    if mask is not None:
+        return _dense_fallback(q, k, v, mask=mask)
     if interpret is None:
         interpret = _default_interpret()
     qt = q.transpose(0, 2, 1, 3)  # [B, H, S, hd]
@@ -643,14 +942,19 @@ def flash_attention(
     bwd_q = fit_block(S, bwd_block_q)
     bwd_k = fit_block(S, bwd_block_k)
     if not (bq and bk and bwd_q and bwd_k):
-        from kubedl_tpu.models.llama import attention
-
-        return attention(q, k, v, causal=causal)
+        return _dense_fallback(q, k, v)
     # counted only on the actual kernel path — a dense-oracle fallback must
     # not satisfy the bench's "pallas kernel really traced" gate
     global TRACE_COUNT
     TRACE_COUNT += 1
-    out = _flash(qt, kt, vt, causal, bq, bk, bwd_q, bwd_k, interpret)
+    if rope_cos is not None:
+        cos32 = rope_cos.astype(jnp.float32)
+        sin32 = rope_sin.astype(jnp.float32)
+        out = _flash_rope(
+            qt, kt, vt, cos32, sin32, causal, bq, bk, bwd_q, bwd_k, interpret
+        )
+    else:
+        out = _flash(qt, kt, vt, causal, bq, bk, bwd_q, bwd_k, interpret)
     return out.transpose(0, 2, 1, 3)
 
 
@@ -703,44 +1007,63 @@ def make_flash_attention(
 
     if not bt and ht is None:
 
-        def direct(q, k, v, causal=True, mask=None):
+        def direct(q, k, v, causal=True, mask=None, rope_cos=None,
+                   rope_sin=None):
             return flash_attention(
                 q, k, v, causal=causal, mask=mask,
                 block_q=block_q, block_k=block_k, interpret=interpret,
+                rope_cos=rope_cos, rope_sin=rope_sin,
             )
 
+        direct.fused_rope = True  # callers may pass q/k PRE-rope + tables
         return direct
 
-    def build(head):
+    def build(head, rope):
         spec = P(bt if bt else None, None, head, None)  # [B, S, H, hd]
+        rope_spec = P(None, None)  # [S, hd/2], replicated (S not sharded)
+        fn = functools.partial(
+            flash_attention, causal=True,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+        if rope:
+            body = lambda q, k, v, cos, sin: fn(q, k, v, rope_cos=cos,
+                                                rope_sin=sin)
+            in_specs = (spec, spec, spec, rope_spec, rope_spec)
+        else:
+            body = fn
+            in_specs = (spec, spec, spec)
         inner = shard_map(
-            functools.partial(
-                flash_attention, causal=True,
-                block_q=block_q, block_k=block_k, interpret=interpret,
-            ),
-            mesh=mesh,
-            in_specs=(spec, spec, spec),
-            out_specs=spec,
+            body, mesh=mesh, in_specs=in_specs, out_specs=spec,
             check_vma=False,
         )
         return NamedSharding(mesh, spec), inner
 
-    variants = {None: build(None)}
-    if ht is not None:
-        variants[ht] = build(ht)
+    variants = {
+        (key, rope): build(key, rope)
+        for key in ({None, ht} if ht is not None else {None})
+        for rope in (False, True)
+    }
 
-    def attn_fn(q, k, v, causal=True, mask=None):
+    def attn_fn(q, k, v, causal=True, mask=None, rope_cos=None,
+                rope_sin=None):
         if mask is not None or not causal:
-            from kubedl_tpu.models.llama import attention
+            from kubedl_tpu.models.llama import apply_rope, attention
 
+            if rope_cos is not None:
+                q = apply_rope(q, rope_cos, rope_sin)
+                k = apply_rope(k, rope_cos, rope_sin)
             return attention(q, k, v, causal=causal, mask=mask)
         # head sharding needs every head count divisible by the axis
         t = mesh.shape[ht] if ht is not None else 1
         key = ht if ht is not None and q.shape[2] % t == 0 and k.shape[2] % t == 0 else None
-        sharding, inner = variants[key]
+        sharding, inner = variants[(key, rope_cos is not None)]
         q = jax.lax.with_sharding_constraint(q, sharding)
         k = jax.lax.with_sharding_constraint(k, sharding)
         v = jax.lax.with_sharding_constraint(v, sharding)
+        if rope_cos is not None:
+            return inner(q, k, v, rope_cos.astype(jnp.float32),
+                         rope_sin.astype(jnp.float32))
         return inner(q, k, v)
 
+    attn_fn.fused_rope = True
     return attn_fn

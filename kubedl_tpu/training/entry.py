@@ -207,18 +207,28 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
                 f.write("fired")
             raise SystemExit(137)
 
-    # a warm restart (persistent cache already populated) never waits long
-    # for the background AOT compile: the plain jit deserializes the
-    # on-disk entry in seconds, so a stalled compile thread (round-4
-    # BENCH: flaky ~55s tunnel stall) is abandoned, not waited out. A cold
-    # start keeps the unbounded join — the join IS the compile there.
+    # a warm restart never waits long for the background AOT compile: the
+    # plain jit deserializes the on-disk entry in seconds, so a stalled
+    # compile thread (round-4 BENCH: flaky ~55s tunnel stall) is
+    # abandoned, not waited out. A cold start keeps the unbounded join —
+    # the join IS the compile there. Warm is classified by THIS process's
+    # cache events at decision time (init has compiled by now: a cold run
+    # has already missed; entries_before>0 would misclassify whenever the
+    # dir holds unrelated programs, e.g. the bench preflight probe's).
     # KUBEDL_WARM_JOIN_TIMEOUT: seconds; 0 = don't wait at all; negative
-    # = unbounded (the pre-round-5 behavior).
+    # or malformed = unbounded.
     warm_join_timeout: Optional[float] = None
-    if cache_before > 0:
-        warm_join_timeout = float(
-            os.environ.get("KUBEDL_WARM_JOIN_TIMEOUT", "30")
-        )
+    if (
+        _CACHE_EVENTS["available"]
+        and _CACHE_EVENTS["hits"] - events_at_start["hits"] > 0
+        and _CACHE_EVENTS["misses"] - events_at_start["misses"] == 0
+    ):
+        try:
+            warm_join_timeout = float(
+                os.environ.get("KUBEDL_WARM_JOIN_TIMEOUT", "30")
+            )
+        except ValueError:
+            warm_join_timeout = 30.0  # never let a bad env kill the job
         if warm_join_timeout < 0:
             warm_join_timeout = None
     state, summary = trainer.fit(

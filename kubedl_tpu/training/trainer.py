@@ -374,7 +374,17 @@ class Trainer:
             if self.pipe_size > 1:
                 # inside the pipeline's shard_map the stage body is local:
                 # call the kernel directly, not mesh-wrapped
-                return partial(fa.flash_attention, interpret=not on_tpu)
+
+                def stage_attn(q, k, v, causal=True, mask=None,
+                               rope_cos=None, rope_sin=None):
+                    return fa.flash_attention(
+                        q, k, v, causal=causal, mask=mask,
+                        rope_cos=rope_cos, rope_sin=rope_sin,
+                        interpret=not on_tpu,
+                    )
+
+                stage_attn.fused_rope = True
+                return stage_attn
             return fa.make_flash_attention(self.mesh, interpret=not on_tpu)
         self.attn_impl = "dense"
         return None

@@ -68,8 +68,12 @@ with Operator(opts, runtime=SubprocessRuntime(logs)) as op:
     check("warm job SUCCEEDED", got2.status.phase == JobConditionType.SUCCEEDED)
     n2 = cache_entry_count(cache)
     check("warm run added no cache entries", n2 == n1, f"{n1} -> {n2}")
-    check("warm first-step faster",
-          s2["first_step_seconds"] < s1["first_step_seconds"],
+    # tolerance: on the tiny CPU model both first steps are ~0.1s and the
+    # comparison is scheduler noise (0.09 vs 0.10 observed on a loaded
+    # 1-core box); the structural proof is the zero-new-entries check
+    # above — this one only guards against gross recompiles
+    check("warm first-step not slower (50ms tolerance)",
+          s2["first_step_seconds"] < s1["first_step_seconds"] + 0.05,
           f"{s1['first_step_seconds']:.2f}s -> {s2['first_step_seconds']:.2f}s")
     bad = check_invariants(op)
     check("invariants green", not bad, str(bad))
