@@ -40,11 +40,16 @@ def bench_control_plane() -> dict:
       held-out accuracy, consuming the injected TF_CONFIG
       (examples/mnist_convnet.py --require-tf-config). Forced onto CPU
       JAX so the pods never contend for the chip the headline holds.
-    - PyTorchJob: master + 3 workers running real torch-DDP over the
-      injected MASTER_ADDR/RANK env — gloo process group, allreduced
-      grads, bit-identical replicas asserted in-job.
-    - MPIJob: launcher verifying the materialized hostfile, workers idle
-      (the hostfile + rsh-agent contract is the product here).
+    - PyTorchJob: master + 3 workers training a REAL ResNet-class conv
+      net under torch DistributedDataParallel (gloo) — loss-decrease and
+      bit-identical-replica assertions in-job
+      (examples/torch_ddp_resnet.py; BASELINE target 2's shape).
+    - MPIJob: the launcher does what mpirun would — parses the
+      materialized hostfile, fans one process per slot out through the
+      rsh agent, and a REAL gloo allreduce runs across them with the
+      reduced value asserted (examples/mpi_allreduce.py; BASELINE
+      target 3's Horovod-shape contract). Workers idle as the rsh
+      targets, exactly like the reference's sshd-style worker pods.
     """
     import tempfile
 
@@ -79,7 +84,8 @@ def bench_control_plane() -> dict:
             artifact_registry_root=os.path.join(tmp, "reg"),
         )
         mnist = os.path.join(repo, "examples", "mnist_convnet.py")
-        ddp_py = os.path.join(repo, "examples", "torch_ddp_min.py")
+        ddp_py = os.path.join(repo, "examples", "torch_ddp_resnet.py")
+        mpi_py = os.path.join(repo, "examples", "mpi_allreduce.py")
         import importlib.util
 
         have_torch = importlib.util.find_spec("torch") is not None
@@ -99,7 +105,7 @@ def bench_control_plane() -> dict:
                      "json.loads(os.environ['TF_CONFIG'])['cluster']['worker']"])
             pt = PyTorchJob(); pt.metadata.name = "b-pt"
             if have_torch and os.path.exists(ddp_py):
-                workloads["PyTorchJob"] = "torch-ddp-gloo"
+                workloads["PyTorchJob"] = "torch-ddp-resnet loss-decrease"
                 ddp = [py, ddp_py]
             else:
                 workloads["PyTorchJob"] = "env-assert (torch/examples absent)"
@@ -107,10 +113,14 @@ def bench_control_plane() -> dict:
                        "import os; os.environ['MASTER_ADDR']; os.environ['RANK']"]
             add(pt, ReplicaType.MASTER, 1, ddp)
             add(pt, ReplicaType.WORKER, 3, ddp)
-            workloads["MPIJob"] = "hostfile-contract"
             mpi = MPIJob(); mpi.metadata.name = "b-mpi"
-            add(mpi, ReplicaType.LAUNCHER, 1,
-                ["bash", "-c", 'test -s "$OMPI_MCA_orte_default_hostfile"'])
+            if have_torch and os.path.exists(mpi_py):
+                workloads["MPIJob"] = "rsh-fanout gloo-allreduce"
+                add(mpi, ReplicaType.LAUNCHER, 1, [py, mpi_py])
+            else:
+                workloads["MPIJob"] = "hostfile-contract (torch absent)"
+                add(mpi, ReplicaType.LAUNCHER, 1,
+                    ["bash", "-c", 'test -s "$OMPI_MCA_orte_default_hostfile"'])
             add(mpi, ReplicaType.WORKER, 2, ["sleep", "30"])
             for job in (tf, pt, mpi):
                 op.submit(job)

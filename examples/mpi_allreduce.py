@@ -1,0 +1,147 @@
+#!/usr/bin/env python3
+"""REAL MPI-shaped allreduce through the MPIJob hostfile + rsh-agent
+contract (BASELINE.md target 3; reference: controllers/mpi/mpi_config.go
+48-123 materializes exactly these two artifacts for mpirun to consume).
+
+Runs as the LAUNCHER command of an MPIJob:
+
+    python examples/mpi_allreduce.py
+
+and does what mpirun/horovodrun would do with the same inputs:
+
+1. read the hostfile from $OMPI_MCA_orte_default_hostfile (OpenMPI
+   `host slots=N` and IntelMPI/MPICH `host:N` formats both parse),
+2. fan one process out PER SLOT through $OMPI_MCA_plm_rsh_agent
+   (`<agent> <host> <cmd...>` — the operator's stand-in for ssh, the
+   reference's kubectl-exec wrapper),
+3. each spawned worker joins a gloo process group and allreduces
+   tensor([rank+1]); every rank checks the sum equals W(W+1)/2 itself,
+4. the launcher asserts every remote process exited 0 and that rank 0
+   printed the verified sum.
+
+So the thing being proven is the actual Horovod-shape contract: the
+operator's hostfile names the worker fleet, the rsh agent can reach it,
+and a real collective runs across what it launches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def parse_hostfile(path: str) -> list[tuple[str, int]]:
+    """[(host, slots)] from OpenMPI (`host slots=N`) or IntelMPI/MPICH
+    (`host:N`) syntax; bare hostnames mean one slot."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if " slots=" in line:
+                host, _, n = line.partition(" slots=")
+                out.append((host.strip(), int(n)))
+            elif ":" in line:
+                host, _, n = line.rpartition(":")
+                out.append((host, int(n)))
+            else:
+                out.append((line, 1))
+    return out
+
+
+def worker(args) -> int:
+    import torch
+    import torch.distributed as dist
+
+    rank = int(os.environ["RANK"])
+    world = int(os.environ["WORLD_SIZE"])
+    dist.init_process_group(
+        "gloo", init_method="env://", rank=rank, world_size=world
+    )
+    try:
+        t = torch.tensor([float(rank + 1)])
+        dist.all_reduce(t)  # SUM
+        want = world * (world + 1) / 2
+        if abs(t.item() - want) > 1e-6:
+            print(f"rank {rank}: allreduce got {t.item()}, want {want}",
+                  file=sys.stderr)
+            return 1
+        if rank == 0:
+            print(f"mpi-allreduce-ok world={world} sum={t.item():.1f}",
+                  flush=True)
+        return 0
+    finally:
+        dist.destroy_process_group()
+
+
+def launcher(args) -> int:
+    hostfile = os.environ.get("OMPI_MCA_orte_default_hostfile", "")
+    agent = os.environ.get("OMPI_MCA_plm_rsh_agent", "")
+    if not hostfile or not os.path.exists(hostfile):
+        print("no hostfile (OMPI_MCA_orte_default_hostfile)", file=sys.stderr)
+        return 2
+    if not agent or not os.path.exists(agent):
+        print("no rsh agent (OMPI_MCA_plm_rsh_agent)", file=sys.stderr)
+        return 2
+    hosts = parse_hostfile(hostfile)
+    world = sum(n for _, n in hosts)
+    if world == 0:
+        print("hostfile names zero slots", file=sys.stderr)
+        return 2
+    # any free port on this launcher works: every fan-out in this runtime
+    # lands on reachable hosts (the agent execs locally for 127.0.0.1)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    rank = 0
+    for host, slots in hosts:
+        for _ in range(slots):
+            env = dict(os.environ)
+            env.update(
+                RANK=str(rank),
+                WORLD_SIZE=str(world),
+                MASTER_ADDR="127.0.0.1",
+                MASTER_PORT=str(port),
+            )
+            procs.append((rank, host, subprocess.Popen(
+                [agent, host, sys.executable, os.path.abspath(__file__),
+                 "--worker"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            )))
+            rank += 1
+    ok = True
+    saw_sum = False
+    want = f"mpi-allreduce-ok world={world} sum={world * (world + 1) / 2:.1f}"
+    for rank, host, p in procs:
+        out, _ = p.communicate(timeout=args.timeout)
+        if p.returncode != 0:
+            print(f"rank {rank} on {host} exited {p.returncode}: "
+                  f"{out.strip()[-400:]}", file=sys.stderr)
+            ok = False
+        if want in (out or ""):
+            saw_sum = True
+    if ok and not saw_sum:
+        print(f"rank 0 never printed the verified sum ({want!r})",
+              file=sys.stderr)
+        ok = False
+    if ok:
+        print(f"mpi-launcher-ok ranks={world} hosts={len(hosts)}", flush=True)
+    return 0 if ok else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--timeout", type=float, default=120.0)
+    args = ap.parse_args()
+    return worker(args) if args.worker else launcher(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

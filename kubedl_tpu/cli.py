@@ -48,6 +48,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--console-host", default="127.0.0.1")
     p.add_argument("--local-addresses", action="store_true",
                    help="emit loopback addresses (process runtime on one host)")
+    # HA flags, mirrored by the rendered Deployment (deploy/templates/
+    # operator-deployment.yaml runs replicas: 2 with --leader-elect=true;
+    # reference: main.go:76-84 enable-leader-elect). The boot test
+    # (tests/test_deploy_boot.py) launches the manifest's exact argv, so
+    # a flag present there but missing here fails CI — which is how the
+    # round-5 audit found --leader-elect was never wired at all.
+    p.add_argument("--leader-elect", default="false",
+                   type=lambda s: s.lower() in ("1", "true", "yes"),
+                   help="lease-based leader election across replicas")
+    p.add_argument("--leader-identity", default="",
+                   help="identity for the leader lease (default: pid@host)")
+    p.add_argument("--leader-lease-ttl", type=float, default=5.0)
     p.add_argument("--log-level", default="info",
                    choices=["debug", "info", "warning", "error"])
     p.add_argument("--version", action="store_true", help="print version and exit")
@@ -80,6 +92,9 @@ def main(argv=None) -> int:
         event_storage=args.event_storage,
         storage_db_path=args.storage_db_path,
         region=args.region,
+        leader_elect=args.leader_elect,
+        leader_identity=args.leader_identity,
+        leader_lease_ttl=args.leader_lease_ttl,
     )
     op = Operator(opts)
     op.start()

@@ -83,6 +83,23 @@ def _dispatch_tiles(causal: bool, run, on_diag, step) -> None:
         step(True)
 
 
+def _rope_operands(bq: int, bk: int, hd: int, cos, sin, q_major: bool):
+    """(extra in_specs, extra args) for one pallas_call's fused-rope
+    cos/sin operands — [cos_q, sin_q, cos_k, sin_k], the q table sliced by
+    the q-block index and the k table by the k-block index. One definition
+    for all four call sites (same protection _tile_preds gives the causal
+    predication). ``q_major``: True for (b,h,i,j) grids (fwd, fused bwd,
+    split dq), False for the transposed (b,h,j,i) dk/dv grid."""
+    h2 = hd // 2
+    if q_major:
+        cq = pl.BlockSpec((bq, h2), lambda b, h, i, j: (i, 0))
+        ck = pl.BlockSpec((bk, h2), lambda b, h, i, j: (j, 0))
+    else:
+        cq = pl.BlockSpec((bq, h2), lambda b, h, j, i: (i, 0))
+        ck = pl.BlockSpec((bk, h2), lambda b, h, j, i: (j, 0))
+    return [cq, cq, ck, ck], [cos, sin, cos, sin]
+
+
 def _rope_rotate(x, cos, sin, inverse: bool = False):
     """Rotate the split-halves pairs of ``x`` [rows, hd] by the per-row
     angles (``cos``/``sin`` [rows, hd/2]) — the models.llama.apply_rope
@@ -264,11 +281,9 @@ def _fwd(
     ]
     args = [q, k, v]
     if rope:
-        h2 = hd // 2
-        cq_spec = pl.BlockSpec((bq, h2), lambda b, h, i, j: (i, 0))
-        ck_spec = pl.BlockSpec((bk, h2), lambda b, h, i, j: (j, 0))
-        in_specs += [cq_spec, cq_spec, ck_spec, ck_spec]
-        args += [cos, sin, cos, sin]
+        specs, extra = _rope_operands(bq, bk, hd, cos, sin, q_major=True)
+        in_specs += specs
+        args += extra
     out, lse = pl.pallas_call(
         kernel,
         grid=(B, H, n_q, n_k),
@@ -689,11 +704,9 @@ def _bwd_pallas(
             pltpu.VMEM((bq, 128), jnp.float32),
         ]
         if rope:
-            h2 = hd // 2
-            cq = pl.BlockSpec((bq, h2), lambda b, h, i, j: (i, 0))
-            ck = pl.BlockSpec((bk, h2), lambda b, h, i, j: (j, 0))
-            in_specs += [cq, cq, ck, ck]
-            args += [cos, sin, cos, sin]
+            specs, extra = _rope_operands(bq, bk, hd, cos, sin, q_major=True)
+            in_specs += specs
+            args += extra
             scratch += [
                 pltpu.VMEM((bq, hd), q.dtype),
                 pltpu.VMEM((Sk, hd), k.dtype),
@@ -741,11 +754,9 @@ def _bwd_pallas(
         pltpu.VMEM((bq, 128), jnp.float32),
     ]
     if rope:
-        h2 = hd // 2
-        cq = pl.BlockSpec((bq, h2), lambda b, h, i, j: (i, 0))
-        ck = pl.BlockSpec((bk, h2), lambda b, h, i, j: (j, 0))
-        in_specs += [cq, cq, ck, ck]
-        args += [cos, sin, cos, sin]
+        specs, extra = _rope_operands(bq, bk, hd, cos, sin, q_major=True)
+        in_specs += specs
+        args += extra
         scratch += [
             pltpu.VMEM((bq, hd), q.dtype),
             pltpu.VMEM((Sk, hd), k.dtype),
@@ -780,10 +791,9 @@ def _bwd_pallas(
         pltpu.VMEM((bk, hd), jnp.float32),
     ]
     if rope:
-        cq2 = pl.BlockSpec((bq, h2), lambda b, h, j, i: (i, 0))
-        ck2 = pl.BlockSpec((bk, h2), lambda b, h, j, i: (j, 0))
-        in_specs2 += [cq2, cq2, ck2, ck2]
-        args2 += [cos, sin, cos, sin]
+        specs, extra = _rope_operands(bq, bk, hd, cos, sin, q_major=False)
+        in_specs2 += specs
+        args2 += extra
         scratch2 += [
             pltpu.VMEM((Sq, hd), q.dtype),
             pltpu.VMEM((bk, hd), k.dtype),

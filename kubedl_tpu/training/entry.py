@@ -218,11 +218,18 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
     # KUBEDL_WARM_JOIN_TIMEOUT: seconds; 0 = don't wait at all; negative
     # or malformed = unbounded.
     warm_join_timeout: Optional[float] = None
-    if (
-        _CACHE_EVENTS["available"]
-        and _CACHE_EVENTS["hits"] - events_at_start["hits"] > 0
-        and _CACHE_EVENTS["misses"] - events_at_start["misses"] == 0
-    ):
+    if _CACHE_EVENTS["available"]:
+        looks_warm = (
+            _CACHE_EVENTS["hits"] - events_at_start["hits"] > 0
+            and _CACHE_EVENTS["misses"] - events_at_start["misses"] == 0
+        )
+    else:
+        # private monitoring API gone: fall back to the coarse on-disk
+        # heuristic (can misclassify when the dir holds unrelated
+        # programs, but keeps the stall bound alive rather than silently
+        # reverting every warm restart to an unbounded join)
+        looks_warm = cache_before > 0
+    if looks_warm:
         try:
             warm_join_timeout = float(
                 os.environ.get("KUBEDL_WARM_JOIN_TIMEOUT", "30")

@@ -1,0 +1,69 @@
+#!/usr/bin/env python3
+"""Post-cluster half of the e2e recipe, shared between the kind lane
+(scripts/kind-e2e.sh) and the always-on boot test
+(tests/test_deploy_boot.py).
+
+Mirrors the reference's CI job body (/root/reference/.github/workflows/
+ci.yaml e2e-tests + scripts/run_tf_test_job.sh): against an ALREADY
+RUNNING operator console, submit a small distributed TFJob and wait for
+a terminal phase. The caller decides what the operator runs on — a kind
+cluster behind a port-forward, or the subprocess operator booted from
+the rendered Deployment's own argv.
+
+Usage: python scripts/e2e_smoke.py [base_url] [timeout_s]
+Exits 0 on Succeeded, 1 on Failed, 2 on timeout/transport errors.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.request
+
+
+SMOKE_JOB = {
+    "kind": "TFJob",
+    "metadata": {"name": "e2e-smoke", "namespace": "default"},
+    "spec": {"replica_specs": {"Worker": {
+        "replicas": 2,
+        "template": {"spec": {"containers": [{
+            "name": "main",
+            "command": [sys.executable, "-c",
+                        "import os, json; json.loads(os.environ['TF_CONFIG'])"],
+        }]}},
+    }}},
+}
+
+
+def run_smoke(base_url: str, timeout: float = 120.0) -> int:
+    req = urllib.request.Request(
+        f"{base_url}/api/v1/job/submit",
+        data=json.dumps(SMOKE_JOB).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        if r.status != 200:
+            print(f"submit: HTTP {r.status}", file=sys.stderr)
+            return 2
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        with urllib.request.urlopen(
+            f"{base_url}/api/v1/job/list?kind=TFJob", timeout=10
+        ) as r:
+            jobs = json.loads(r.read())["data"]["jobInfos"]
+        phase = next(
+            (j["phase"] for j in jobs if j["name"] == "e2e-smoke"), ""
+        )
+        if phase in ("Succeeded", "Failed"):
+            print("terminal phase:", phase)
+            return 0 if phase == "Succeeded" else 1
+        time.sleep(1)
+    print("timeout waiting for e2e-smoke", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    base = sys.argv[1] if len(sys.argv) > 1 else "http://127.0.0.1:9090"
+    t = float(sys.argv[2]) if len(sys.argv) > 2 else 120.0
+    sys.exit(run_smoke(base, t))

@@ -1,0 +1,133 @@
+"""Boot the operator from the rendered Deployment's OWN argv/env/ports.
+
+The kind-cluster e2e (scripts/kind-e2e.sh) exits 2 where docker/kind are
+absent, so until round 5 nothing ever executed the control flow it
+encodes — and the rendered manifest had drifted from the CLI (it passed
+--leader-elect=true, which `kubedl-tpu-operator` did not accept: the
+deployed image would have crash-looped). This test closes that hole
+without a cluster (reference recipe: /root/reference/.github/workflows/
+ci.yaml e2e-tests + scripts/run_tf_test_job.sh):
+
+1. parse deploy/rendered/operator-deployment.yaml — container args, env,
+   ports, readiness probe, volume mounts;
+2. stand the volumeMounts up as tmpdirs (what the kubelet does) and
+   remap path-valued args/env under them;
+3. launch the manifest's EXACT argv through the image's entrypoint
+   (pyproject console script kubedl-tpu-operator -> kubedl_tpu.cli:main
+   — asserted, so the Dockerfile ENTRYPOINT stays honest);
+4. wait for the manifest's readiness probe (same path, same port);
+5. run the SAME submit-TFJob-and-wait-Succeeded smoke the kind lane runs
+   (scripts/e2e_smoke.py).
+
+A flag the CLI does not accept, a dead console port, a wrong probe path,
+or a console that cannot actually run a job all fail here, on every run.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+import yaml
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _operator_container():
+    doc = yaml.safe_load(
+        (REPO / "deploy" / "rendered" / "operator-deployment.yaml").read_text()
+    )
+    assert doc["kind"] == "Deployment"
+    spec = doc["spec"]["template"]["spec"]
+    return spec["containers"][0]
+
+
+def test_console_script_matches_image_entrypoint():
+    """Dockerfile ENTRYPOINT is the console script; pyproject must bind it
+    to the module this test boots, or the test would validate the wrong
+    program."""
+    py = (REPO / "pyproject.toml").read_text()
+    assert 'kubedl-tpu-operator = "kubedl_tpu.cli:main"' in py
+    docker = (REPO / "Dockerfile").read_text()
+    assert 'ENTRYPOINT ["kubedl-tpu-operator"]' in docker
+
+
+def test_rendered_deployment_boots_and_runs_a_job(tmp_path):
+    c = _operator_container()
+    # --- kubelet-style volume materialization -------------------------
+    mounts = {m["mountPath"]: tmp_path / m["name"] for m in c["volumeMounts"]}
+    for d in mounts.values():
+        d.mkdir(parents=True, exist_ok=True)
+
+    def remap(value: str) -> str:
+        for mp, real in sorted(mounts.items(), key=lambda kv: -len(kv[0])):
+            if value == mp or value.startswith(mp + "/"):
+                return str(real) + value[len(mp):]
+        return value
+
+    args = []
+    for a in c["args"]:
+        if "=" in a:
+            flag, _, val = a.partition("=")
+            args.append(f"{flag}={remap(val)}")
+        else:
+            args.append(a)
+    env = dict(os.environ)
+    env.update({e["name"]: remap(e.get("value", "")) for e in c.get("env", [])})
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO)
+    # subprocess pods must resolve each other on this one host
+    args.append("--local-addresses")
+
+    port = next(p["containerPort"] for p in c["ports"] if p["name"] == "console")
+    probe = c["readinessProbe"]["httpGet"]
+    assert probe["port"] == port
+    base = f"http://127.0.0.1:{port}"
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubedl_tpu.cli", *args],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=str(tmp_path),
+    )
+    try:
+        # --- readiness: the manifest's own probe ----------------------
+        deadline = time.time() + 90
+        ready = False
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                out = proc.stdout.read() if proc.stdout else ""
+                pytest.fail(
+                    f"operator exited {proc.returncode} before ready "
+                    f"(argv drift?):\n{out[-2000:]}"
+                )
+            try:
+                with urllib.request.urlopen(
+                    base + probe["path"], timeout=5
+                ) as r:
+                    if r.status == 200:
+                        ready = True
+                        break
+            except (urllib.error.URLError, ConnectionError, OSError):
+                time.sleep(0.5)
+        assert ready, f"readiness probe {probe['path']} never went 200"
+
+        # --- the kind lane's own smoke, verbatim ----------------------
+        sys.path.insert(0, str(REPO / "scripts"))
+        try:
+            from e2e_smoke import run_smoke
+        finally:
+            sys.path.pop(0)
+        rc = run_smoke(base, timeout=120)
+        assert rc == 0, f"e2e smoke exited {rc}"
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
