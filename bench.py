@@ -242,6 +242,125 @@ def bench_long_context(on_tpu: bool) -> dict:
     }
 
 
+def bench_serving_engine(on_tpu: bool, raw: dict) -> dict:
+    """BASELINE.md target 5 through the PRODUCTION path (VERDICT r4
+    missing #3): the raw-decode microbench never exercised the
+    continuous-batching engine loop, its slot admission, or the HTTP
+    handler — the reference's inference numbers would come through the
+    deployed predictor (controllers/serving/predictor.go:37-115). Drives
+    `LlamaEngine.generate` and the real HTTP server for b1/b8 decode and
+    TTFT, reports engine overhead vs the raw jitted decode, and measures
+    slot churn under mixed-length concurrent requests."""
+    import threading
+
+    from kubedl_tpu.serving.server import LlamaEngine, make_handler
+
+    preset = "gemma-2b" if on_tpu else "tiny"
+    n = 128 if on_tpu else 8
+    eng = LlamaEngine(preset=preset, max_seq=512 if on_tpu else 64,
+                      max_batch=8)
+    out = {"model": preset, "max_batch": 8}
+    try:
+        # warm every segment bucket + the prefill buckets the runs below
+        # touch, so timed numbers measure the loop, not XLA compiles
+        for mt in (1, 5, 37):
+            eng.generate([1, 2, 3], max_tokens=mt)
+        eng.generate(list(range(1, 65)), max_tokens=1)
+
+        t0 = time.perf_counter()
+        r = eng.generate([1], max_tokens=n)
+        dt = time.perf_counter() - t0
+        got = len(r.get("token_ids", []))
+        out["engine_decode_tokens_per_sec_b1"] = round(got / dt, 1)
+        out["engine_decode_ms_per_token_b1"] = round(dt / max(got, 1) * 1e3, 3)
+
+        t0 = time.perf_counter()
+        eng.generate(list(range(1, 65)), max_tokens=1)
+        out["engine_ttft_64_prompt_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1
+        )
+
+        def one(tokens: int, results: list):
+            t = time.perf_counter()
+            rr = eng.generate([1, 2], max_tokens=tokens)
+            results.append((len(rr.get("token_ids", [])),
+                            time.perf_counter() - t))
+
+        # b8: saturate every slot with equal-length requests
+        results: list = []
+        threads = [
+            threading.Thread(target=one, args=(n, results)) for _ in range(8)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        total = sum(g for g, _ in results)
+        out["engine_decode_tokens_per_sec_b8"] = round(total / wall, 1)
+
+        # mixed-length churn: 16 requests over 8 slots, lengths cycling —
+        # short requests finish, vacate, and waiting ones must be admitted
+        # mid-flight (the continuous-batching property itself)
+        lengths = [4, 8, 16, 48] * 4
+        results = []
+        threads = [
+            threading.Thread(target=one, args=(ln, results))
+            for ln in lengths
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        total = sum(g for g, _ in results)
+        out["mixed_requests"] = len(lengths)
+        out["mixed_tokens_per_sec"] = round(total / wall, 1)
+        out["mixed_all_completed"] = (
+            sorted(g for g, _ in results) == sorted(lengths)
+        )
+
+        # HTTP handler on top of the same engine (the deployed surface)
+        import http.server
+        import json as _json
+        import urllib.request
+
+        handler = make_handler(eng, preset)
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        port = srv.server_address[1]
+        st = threading.Thread(target=srv.serve_forever, daemon=True)
+        st.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/generate",
+                data=_json.dumps(
+                    {"prompt_ids": [1], "max_tokens": n}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                body = _json.loads(resp.read())
+            dt = time.perf_counter() - t0
+            got = len(body.get("token_ids") or body.get("data", {}).get(
+                "token_ids", []
+            ))
+            out["http_decode_tokens_per_sec_b1"] = round(got / dt, 1)
+        finally:
+            srv.shutdown()
+
+        raw_b1 = raw.get("decode_ms_per_token_b1")
+        if raw_b1:
+            out["engine_overhead_vs_raw_b1_pct"] = round(
+                (out["engine_decode_ms_per_token_b1"] / raw_b1 - 1) * 100, 1
+            )
+    finally:
+        eng.close()
+    return out
+
+
 def bench_flash_numerics(on_tpu: bool) -> dict:
     """Numerics gate (ADVICE r4): the fused single-pass flash backward and
     the classic split two-kernel backward must agree ON CHIP. The fused
@@ -674,6 +793,12 @@ def main() -> int:
         targets["serving"] = bench_serving(on_tpu)
     except Exception as e:
         targets["serving"] = {"error": str(e)}
+    try:
+        targets["serving_engine"] = bench_serving_engine(
+            on_tpu, targets.get("serving") or {}
+        )
+    except Exception as e:
+        targets["serving_engine"] = {"error": str(e)}
     try:
         targets["long_context"] = bench_long_context(on_tpu)
     except Exception as e:

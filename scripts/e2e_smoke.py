@@ -29,7 +29,10 @@ SMOKE_JOB = {
         "replicas": 2,
         "template": {"spec": {"containers": [{
             "name": "main",
-            "command": [sys.executable, "-c",
+            # bare "python": must resolve inside the kind-deployed image
+            # AND on the host subprocess runtime — the submitting host's
+            # sys.executable would not exist in the container
+            "command": ["python", "-c",
                         "import os, json; json.loads(os.environ['TF_CONFIG'])"],
         }]}},
     }}},
