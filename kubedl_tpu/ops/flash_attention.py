@@ -299,10 +299,9 @@ def _fwd(
         scratch_shapes=scratch,
         # sequential grid semantics (also the mosaic default): the rope
         # k-cache persists across the h and i grid dims, not just the
-        # innermost j — pin the assumption explicitly
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",) * 4
-        ),
+        # innermost j — pin the assumption explicitly. Same raised VMEM
+        # ceiling as the backward (large-tile experiments at long S).
+        compiler_params=_compiler_params(),
         interpret=interpret,
     )(*args)
     # lse keeps its kernel-native [B, H, Sq, 1] shape all the way into the
@@ -623,12 +622,27 @@ _FUSED_BWD_SCRATCH_BYTES = 8 << 20
 #: S=8192: 1024x512 fused = 850ms/grad vs 950ms split, vs compile-OOM at
 #: 1024x1024)
 _FUSED_BWD_SMALL_TILE_BYTES = 2 << 20
-#: per-kernel scoped-VMEM ceiling for the backward kernels: the fused
-#: backward at S=8192 (whole-seq dk/dv f32 + rope caches + [bq,bk] f32
-#: score intermediates) needs 16.2MB against mosaic's default 16MB —
-#: v5e cores have far more physical VMEM; raise the soft limit rather
-#: than shrinking the measured-optimal tiles
-_BWD_VMEM_LIMIT_BYTES = 24 << 20
+#: per-kernel scoped-VMEM ceiling for ALL four kernels (fwd + the three
+#: backward variants): the fused backward at S=8192 (whole-seq dk/dv f32
+#: + rope caches + [bq,bk] f32 score intermediates) needs 16.2MB against
+#: mosaic's default 16MB, and the forward shares the ceiling for
+#: large-tile experiments at long S — v5e cores have far more physical
+#: VMEM; raise the soft limit rather than shrinking the measured-optimal
+#: tiles
+_VMEM_LIMIT_BYTES = 24 << 20
+
+
+def _compiler_params():
+    """The pinned mosaic assumptions, in ONE place for all four
+    pallas_call sites: fully-sequential grid semantics (scratch
+    accumulators and the rope rotation caches persist across non-inner
+    grid dims) + the raised VMEM ceiling."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.CompilerParams(
+        dimension_semantics=("arbitrary",) * 4,
+        vmem_limit_bytes=_VMEM_LIMIT_BYTES,
+    )
 
 
 def _bwd_pallas(
@@ -735,10 +749,7 @@ def _bwd_pallas(
             # pipelined grid would silently corrupt gradients, so the
             # assumption is made explicit rather than inherited as a
             # default (ADVICE r4).
-            compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("arbitrary",) * 4,
-                vmem_limit_bytes=_BWD_VMEM_LIMIT_BYTES,
-            ),
+            compiler_params=_compiler_params(),
             interpret=interpret,
         )(*args)
         return dq, dk, dv
@@ -771,10 +782,7 @@ def _bwd_pallas(
         out_specs=[q_spec],
         out_shape=[jax.ShapeDtypeStruct((B, H, Sq, hd), q.dtype)],
         scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",) * 4,
-            vmem_limit_bytes=_BWD_VMEM_LIMIT_BYTES,
-        ),
+        compiler_params=_compiler_params(),
         interpret=interpret,
     )(*args)[0]
 
@@ -811,10 +819,7 @@ def _bwd_pallas(
             jax.ShapeDtypeStruct((B, H, Sk, hd), v.dtype),
         ],
         scratch_shapes=scratch2,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",) * 4,
-            vmem_limit_bytes=_BWD_VMEM_LIMIT_BYTES,
-        ),
+        compiler_params=_compiler_params(),
         interpret=interpret,
     )(*args2)
     dk = dk_h.reshape(B, KV, group, Sk, hd).sum(axis=2).astype(k.dtype)
