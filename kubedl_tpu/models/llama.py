@@ -779,7 +779,7 @@ def decode_segment(
     cfg: LlamaConfig,
     n_steps: int,
     greedy: bool = False,  # static: all rows argmax — skips the gumbel
-) -> Tuple[jax.Array, Params]:
+) -> Tuple[jax.Array, jax.Array, jax.Array, Params]:
     """``n_steps`` decode steps with ON-DEVICE sampling, one dispatch.
 
     The serving engine's per-token tick paid a full-logits device_get
@@ -791,8 +791,14 @@ def decode_segment(
     segment. Completion in the engine is token-COUNT based, so the
     scheduler can size segments to the earliest completion without
     seeing any token value. One compile per distinct n_steps (the engine
-    buckets to powers of two)."""
-    gumbel_keys = jax.random.split(key, n_steps)
+    buckets to powers of two).
+
+    Returns ``(toks [B, n_steps], last [B, 1], next_key, cache)``:
+    ``last`` and ``next_key`` stay on device, so the engine chains
+    straight into the next segment with zero host->device transfers and
+    no extra split dispatch while the slot set is unchanged."""
+    keys = jax.random.split(key, n_steps + 1)
+    next_key, gumbel_keys = keys[0], keys[1:]
 
     def body(carry, step_key):
         cache, toks = carry
@@ -810,8 +816,8 @@ def decode_segment(
         nxt = jnp.argmax(z, axis=-1).astype(jnp.int32)[:, None]  # [B, 1]
         return (cache, nxt), nxt[:, 0]
 
-    (cache, _), toks = lax.scan(body, (cache, tokens), gumbel_keys)
-    return toks.T, cache  # [B, n_steps]
+    (cache, last), toks = lax.scan(body, (cache, tokens), gumbel_keys)
+    return toks.T, last, next_key, cache  # [B, n_steps], [B, 1]
 
 
 def prefill_batched(

@@ -140,6 +140,15 @@ class LlamaEngine:
         #: + the PRNG chain for on-device sampling — llama.decode_segment
         self._segments: Dict[tuple, object] = {}
         self._key = jax.random.PRNGKey(0)
+        #: device-chained feed between segments: (prefill_gen, rows,
+        #: last-token device array). While the decoding slot set is
+        #: unchanged (steady state of a long generation), the next
+        #: segment's input tokens never leave the device.
+        self._chain: Optional[tuple] = None
+        self._prefill_gen = 0
+        #: device copy of the per-row temperatures, re-uploaded only when
+        #: they actually change
+        self._temps_cache: Optional[tuple] = None
         self._stats = {"requests": 0, "tokens_out": 0, "tokens_in": 0,
                        "started_at": time.time()}
         from collections import deque
@@ -244,10 +253,19 @@ class LlamaEngine:
                             s.done.set()
                     # the cache is DONATED to prefill/decode: a call that
                     # raised after donation leaves self._cache pointing at
-                    # deleted buffers — rebuild or every later tick dies
+                    # deleted buffers — rebuild or every later tick dies.
+                    # The PRNG key and token chain are segment OUTPUTS
+                    # too: a segment that failed after the assignment
+                    # leaves them referencing poisoned buffers, which
+                    # would wedge every later request — re-seed/clear.
                     self._cache = self._llama.init_batched_cache(
                         self.cfg, self.max_batch, self.max_seq
                     )
+                    self._key = self._jax.random.PRNGKey(
+                        int(time.time()) & 0x7FFFFFFF
+                    )
+                    self._chain = None
+                    self._temps_cache = None
 
     def _append_first_locked(self, i: int, s: _Slot, token: int) -> None:
         """Record the (device-sampled) first token of a freshly prefilled
@@ -331,6 +349,7 @@ class LlamaEngine:
             logits, self._cache = self._prefill(
                 self.params, self._cache, jnp.asarray(toks), jnp.asarray(lens)
             )
+            self._prefill_gen += 1  # freshly filled rows need host tokens
             temps0 = np.zeros((self.max_batch,), np.float32)
             for i, s in pre:
                 temps0[i] = max(float(s.temperature), 0.0)
@@ -382,17 +401,34 @@ class LlamaEngine:
             k = up
         else:
             k = next((b for b in self.SEGMENT_BUCKETS if b <= need), 1)
-        tokens = np.zeros((self.max_batch, 1), np.int32)
+        row_ids = tuple(i for i, _ in decoding)
         temps = np.zeros((self.max_batch,), np.float32)
         for i, s in decoding:
-            tokens[i, 0] = s.next_input()
             temps[i] = max(float(s.temperature), 0.0)
         greedy = not np.any(temps > 0.0)
-        self._key, seg_key = self._jax.random.split(self._key)
-        toks, self._cache = self._segment_fn(k, greedy)(
-            self.params, self._cache, jnp.asarray(tokens),
-            jnp.asarray(temps), seg_key,
+        # feed tokens from the DEVICE chain when the slot set is the same
+        # as the previous segment's (no prefill in between): long
+        # generations then never ship tokens host->device at all
+        chain_ok = (
+            self._chain is not None
+            and self._chain[0] == self._prefill_gen
+            and set(row_ids) <= set(self._chain[1])
         )
+        if chain_ok:
+            tokens_dev = self._chain[2]
+        else:
+            tokens = np.zeros((self.max_batch, 1), np.int32)
+            for i, s in decoding:
+                tokens[i, 0] = s.next_input()
+            tokens_dev = jnp.asarray(tokens)
+        fp = temps.tobytes()
+        if self._temps_cache is None or self._temps_cache[0] != fp:
+            self._temps_cache = (fp, jnp.asarray(temps))
+        toks, last, self._key, self._cache = self._segment_fn(k, greedy)(
+            self.params, self._cache, tokens_dev,
+            self._temps_cache[1], self._key,
+        )
+        self._chain = (self._prefill_gen, row_ids, last)
         rows = np.asarray(self._jax.device_get(toks))  # [B, k] int32
         with self._cv:
             for i, s in decoding:
