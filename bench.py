@@ -267,18 +267,31 @@ def bench_serving_engine(on_tpu: bool, raw: dict) -> dict:
             eng.generate([1, 2, 3], max_tokens=mt)
         eng.generate(list(range(1, 65)), max_tokens=1)
 
-        t0 = time.perf_counter()
-        r = eng.generate([1], max_tokens=n)
-        dt = time.perf_counter() - t0
-        got = len(r.get("token_ids", []))
-        out["engine_decode_tokens_per_sec_b1"] = round(got / dt, 1)
-        out["engine_decode_ms_per_token_b1"] = round(dt / max(got, 1) * 1e3, 3)
+        # b1 ms/token as the MEDIAN of 5 runs: the overhead acceptance bar
+        # (<= 15% of raw decode) is too tight for a single sample to be
+        # trustworthy against scheduler-thread jitter
+        import statistics
 
-        t0 = time.perf_counter()
-        eng.generate(list(range(1, 65)), max_tokens=1)
-        out["engine_ttft_64_prompt_ms"] = round(
-            (time.perf_counter() - t0) * 1e3, 1
-        )
+        b1_ms = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            r = eng.generate([1], max_tokens=n)
+            dt = time.perf_counter() - t0
+            got = len(r.get("token_ids", []))
+            b1_ms.append(dt / max(got, 1) * 1e3)
+        med = statistics.median(b1_ms)
+        out["engine_decode_ms_per_token_b1"] = round(med, 3)
+        out["engine_decode_ms_per_token_b1_runs"] = [
+            round(v, 3) for v in b1_ms
+        ]
+        out["engine_decode_tokens_per_sec_b1"] = round(1e3 / med, 1)
+
+        ttft = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            eng.generate(list(range(1, 65)), max_tokens=1)
+            ttft.append((time.perf_counter() - t0) * 1e3)
+        out["engine_ttft_64_prompt_ms"] = round(statistics.median(ttft), 1)
 
         def one(tokens: int, results: list):
             t = time.perf_counter()
@@ -356,6 +369,33 @@ def bench_serving_engine(on_tpu: bool, raw: dict) -> dict:
             out["engine_overhead_vs_raw_b1_pct"] = round(
                 (out["engine_decode_ms_per_token_b1"] / raw_b1 - 1) * 100, 1
             )
+
+        # per-tick pipeline medians from the engine's own accounting
+        # (LlamaEngine.pipeline_stats): how much of the tick the
+        # double-buffered scheduler spent enqueueing vs blocked vs on
+        # host bookkeeping, and the fraction overlapped with device time
+        pipe = eng.pipeline_stats()
+        out["pipeline"] = {
+            k: pipe[k] for k in (
+                "ticks", "segments", "deferred_harvests", "flushes",
+                "chain_rebuilds", "overlap_ratio", "dispatch_ms_p50",
+                "harvest_ms_p50", "host_ms_p50", "tick_ms_p50",
+            ) if k in pipe
+        }
+        # the headline medians in one place (acceptance: engine b1/b8/
+        # TTFT/overhead must be present in the committed summary)
+        out["engine_summary"] = {
+            "decode_ms_per_token_b1_median": out[
+                "engine_decode_ms_per_token_b1"
+            ],
+            "decode_tokens_per_sec_b8": out[
+                "engine_decode_tokens_per_sec_b8"
+            ],
+            "ttft_64_prompt_ms_median": out["engine_ttft_64_prompt_ms"],
+            "overhead_vs_raw_b1_pct": out.get(
+                "engine_overhead_vs_raw_b1_pct"
+            ),
+        }
     finally:
         eng.close()
     return out

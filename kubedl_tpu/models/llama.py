@@ -796,7 +796,11 @@ def decode_segment(
     Returns ``(toks [B, n_steps], last [B, 1], next_key, cache)``:
     ``last`` and ``next_key`` stay on device, so the engine chains
     straight into the next segment with zero host->device transfers and
-    no extra split dispatch while the slot set is unchanged."""
+    no extra split dispatch while the slot set is unchanged. ``toks`` is
+    shaped for DEFERRED harvest: the engine dispatches segment N+1
+    against ``last`` before calling `device_get` on segment N's ``toks``,
+    so the copy-out (and all host bookkeeping behind it) overlaps the
+    next segment's device compute instead of idling the chip."""
     keys = jax.random.split(key, n_steps + 1)
     next_key, gumbel_keys = keys[0], keys[1:]
 
@@ -818,6 +822,24 @@ def decode_segment(
 
     (cache, last), toks = lax.scan(body, (cache, tokens), gumbel_keys)
     return toks.T, last, next_key, cache  # [B, n_steps], [B, 1]
+
+
+def merge_chain_tokens(
+    last: jax.Array,  # [B, 1] device token chain (prior segment's output)
+    ids: jax.Array,  # [B] freshly sampled first tokens (prefill output)
+    mask: jax.Array,  # [B] bool: True where a row was just prefilled
+) -> jax.Array:
+    """Graft prefill-sampled first tokens into the device token chain.
+
+    An interleaved prefill used to invalidate the WHOLE chain, forcing
+    the next segment's feed back through the host for every row. The
+    prefill's first tokens are already on device (`_sample_logits` keeps
+    the [B, V] logits there and returns [B] int32 ids), so scattering
+    them into ``last`` keeps the chain device-resident across admissions:
+    rows untouched by the prefill keep their in-flight segment's output,
+    prefilled rows pick up their sampled id — zero host->device traffic
+    either way."""
+    return jnp.where(mask[:, None], ids[:, None], last)
 
 
 def prefill_batched(

@@ -237,5 +237,63 @@ class JobMetrics:
         )
 
 
+#: ms-scale buckets for the decode pipeline's per-tick timings (the
+#: default seconds-scale buckets would dump every tick into the first one)
+_TICK_MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                    100.0, 250.0, 500.0)
+
+
+class ServingMetrics:
+    """The serving-engine metric family: decode-pipeline accounting
+    (dispatch/harvest/host per-tick timings, segment + deferred-harvest
+    counters, overlap ratio) plus queue depth — what `/metrics` on a
+    predictor pod exports and what `LlamaEngine.stats()` summarizes."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry or MetricsRegistry()
+        r = self.registry
+        self.segments = r.counter(
+            "kubedl_tpu_serving_segments", "Decode segments dispatched"
+        )
+        self.deferred_harvests = r.counter(
+            "kubedl_tpu_serving_deferred_harvests",
+            "Segment harvests that overlapped the next in-flight segment",
+        )
+        self.pipeline_flushes = r.counter(
+            "kubedl_tpu_serving_pipeline_flushes",
+            "Segment harvests with nothing left in flight (pipeline drains)",
+        )
+        self.chain_rebuilds = r.counter(
+            "kubedl_tpu_serving_chain_rebuilds",
+            "Device token chain rebuilt from host tokens",
+        )
+        self.scheduler_errors = r.counter(
+            "kubedl_tpu_serving_scheduler_errors",
+            "Scheduler ticks that failed and were recovered",
+        )
+        self.dispatch_ms = r.histogram(
+            "kubedl_tpu_serving_dispatch_ms",
+            "Per-tick host time enqueueing prefill/segment work (ms)",
+            buckets=_TICK_MS_BUCKETS,
+        )
+        self.harvest_ms = r.histogram(
+            "kubedl_tpu_serving_harvest_ms",
+            "Per-tick time blocked in device_get for sampled ids (ms)",
+            buckets=_TICK_MS_BUCKETS,
+        )
+        self.host_ms = r.histogram(
+            "kubedl_tpu_serving_host_ms",
+            "Per-tick host bookkeeping time (slots/finalize/admission, ms)",
+            buckets=_TICK_MS_BUCKETS,
+        )
+        self.overlap_ratio = r.gauge(
+            "kubedl_tpu_serving_overlap_ratio",
+            "Fraction of scheduler wall time overlapped with device compute",
+        )
+        self.queue_depth = r.gauge(
+            "kubedl_tpu_serving_queue_depth", "Requests waiting for a slot"
+        )
+
+
 #: Process-wide default, mirroring the reference's promauto default registry.
 DEFAULT_JOB_METRICS = JobMetrics()

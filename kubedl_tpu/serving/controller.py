@@ -45,17 +45,20 @@ GRPC_PORT = 9000
 
 
 def http_qps_probe(port: int = 8080, timeout: float = 2.0):
-    """Default QPS probe for real deployments: GET the engine's /v1/stats
-    on the pod's IP (falls back to loopback for process pods)."""
+    """Default load probe for real deployments: GET the engine's /v1/stats
+    on the pod's IP (falls back to loopback for process pods). Returns the
+    full stats dict so the autoscaler sees queue depth alongside QPS —
+    a replica with requests WAITING for a batch slot must never be judged
+    idle just because its completion rate is momentarily low."""
     import json as _json
     import urllib.request
 
-    def probe(pod) -> Optional[float]:
+    def probe(pod) -> Optional[Dict]:
         host = getattr(pod.status, "pod_ip", "") or "127.0.0.1"
         with urllib.request.urlopen(
             f"http://{host}:{port}/v1/stats", timeout=timeout
         ) as r:
-            return float(_json.loads(r.read()).get("qps", 0.0))
+            return _json.loads(r.read())
 
     return probe
 
@@ -258,9 +261,16 @@ class InferenceController:
         from concurrent.futures import ThreadPoolExecutor
 
         def safe_probe(p):
+            # probes may return a bare QPS float (legacy) or the engine's
+            # full /v1/stats dict (qps + queued queue depth)
             try:
                 v = self.qps_probe(p)
-                return float(v) if v is not None else None
+                if v is None:
+                    return None
+                if isinstance(v, dict):
+                    return (float(v.get("qps", 0.0)),
+                            int(v.get("queued", 0)))
+                return (float(v), 0)
             except Exception:
                 return None
 
@@ -269,7 +279,8 @@ class InferenceController:
         healthy = [v for v in readings if v is not None]
         if not healthy:
             return current  # no signal: never act blind
-        qps = sum(healthy)
+        qps = sum(v[0] for v in healthy)
+        queued = sum(v[1] for v in healthy)
         desired = max(1, math.ceil(qps / a.target_qps))
         desired = min(max(desired, a.min_replicas), a.max_replicas)
         key = (inf.metadata.namespace, inf.metadata.name, pred.name)
@@ -280,6 +291,11 @@ class InferenceController:
             # HPA rule: missing metrics never justify a scale-DOWN — an
             # overloaded replica that can't answer its probe is the worst
             # moment to delete capacity
+            return current
+        if desired < current and queued > 0:
+            # requests are waiting for batch slots somewhere in the fleet:
+            # completion-rate QPS understates offered load exactly when
+            # replicas saturate, so backlog vetoes the scale-down
             return current
         if desired < current and (
             now - self._last_scale.get(key, 0.0) < self.AUTOSCALE_COOLDOWN

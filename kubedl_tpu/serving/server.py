@@ -36,6 +36,7 @@ class _Slot:
         self.max_tokens = max_tokens
         self.temperature = temperature
         self.fed = 0  # inputs consumed (prompt + generated)
+        self.pending = 0  # tokens dispatched on device, not yet harvested
         self.out_ids: list = []
         self.done = threading.Event()
         self.result: Optional[Dict] = None
@@ -65,7 +66,8 @@ class LlamaEngine:
 
     def __init__(self, preset: str = "tiny", ckpt_dir: str = "",
                  batch: int = 0, max_seq: int = 0, max_batch: int = 4,
-                 quantize: str = "", mesh_axes: Optional[Dict] = None) -> None:
+                 quantize: str = "", mesh_axes: Optional[Dict] = None,
+                 metrics=None) -> None:
         import jax
 
         from kubedl_tpu.models import llama
@@ -129,6 +131,10 @@ class LlamaEngine:
             return _jnp.argmax(z, axis=-1).astype(_jnp.int32)
 
         self._sample_logits = jax.jit(_pick)
+        #: grafts prefill-sampled first tokens into the device token chain
+        #: (llama.merge_chain_tokens) so interleaved admissions never force
+        #: the chain back through the host
+        self._merge_chain = jax.jit(llama.merge_chain_tokens)
         self._cache = llama.init_batched_cache(
             self.cfg, self.max_batch, self.max_seq
         )
@@ -141,18 +147,39 @@ class LlamaEngine:
         self._segments: Dict[tuple, object] = {}
         self._key = jax.random.PRNGKey(0)
         #: device-chained feed between segments: (prefill_gen, rows,
-        #: last-token device array). While the decoding slot set is
-        #: unchanged (steady state of a long generation), the next
-        #: segment's input tokens never leave the device.
+        #: last-token device array) where ``rows`` are the rows whose
+        #: device token is current. Segment outputs cover the segment's
+        #: rows; an interleaved prefill MERGES its sampled first tokens in
+        #: (per-row validity) instead of invalidating the whole chain, so
+        #: the next segment's input tokens never leave the device even
+        #: across admissions.
         self._chain: Optional[tuple] = None
         self._prefill_gen = 0
         #: device copy of the per-row temperatures, re-uploaded only when
         #: they actually change
         self._temps_cache: Optional[tuple] = None
+        #: the deferred in-flight decode segment (double buffering):
+        #: {"toks": [B, k] device array, "sched": [(row, slot, take)]}.
+        #: Dispatched one tick, harvested the next — the device_get and
+        #: all host bookkeeping behind it overlap the NEXT segment's
+        #: device compute instead of idling the chip between segments.
+        self._pending: Optional[Dict] = None
         self._stats = {"requests": 0, "tokens_out": 0, "tokens_in": 0,
                        "started_at": time.time()}
         from collections import deque
 
+        from kubedl_tpu.observability.metrics import ServingMetrics
+
+        self.metrics = metrics or ServingMetrics()
+        #: per-tick pipeline accounting (sums + lifetime counters); the
+        #: recent deque feeds median reporting in stats()/bench
+        self._pipe = {
+            "ticks": 0, "segments": 0, "deferred_harvests": 0,
+            "flushes": 0, "chain_rebuilds": 0, "errors": 0, "inflight": 0,
+            "dispatch_ms_sum": 0.0, "harvest_ms_sum": 0.0,
+            "host_ms_sum": 0.0, "tick_ms_sum": 0.0, "overlap_ms_sum": 0.0,
+        }
+        self._pipe_recent: "deque[tuple]" = deque(maxlen=2048)
         #: completion timestamps for windowed QPS (autoscale signal must
         #: track LIVE load, not a lifetime average)
         self._recent: "deque[float]" = deque(maxlen=100_000)
@@ -225,6 +252,48 @@ class LlamaEngine:
         out["lifetime_qps"] = round(out["requests"] / up, 3)
         out["active_slots"] = sum(1 for s in self._slots if s is not None)
         out["max_batch"] = self.max_batch
+        with self._cv:
+            out["queued"] = len(self._waiting)
+        out["pipeline"] = self.pipeline_stats()
+        return out
+
+    def pipeline_stats(self) -> Dict:
+        """Decode-pipeline accounting: per-tick dispatch/harvest/host
+        timings (avg + p50 over the recent window), overlap ratio, and
+        lifetime segment/harvest counters. Feeds `/v1/stats`, the
+        Prometheus family (`observability.metrics.ServingMetrics`), and
+        bench.py's serving_engine medians."""
+        import statistics
+
+        with self._cv:
+            p = dict(self._pipe)
+            recent = list(self._pipe_recent)
+            queued = len(self._waiting)
+        out = {
+            "ticks": p["ticks"],
+            "segments": p["segments"],
+            "deferred_harvests": p["deferred_harvests"],
+            "flushes": p["flushes"],
+            "chain_rebuilds": p["chain_rebuilds"],
+            "errors": p["errors"],
+            "inflight": p["inflight"],
+            "queued": queued,
+        }
+        if p["ticks"]:
+            n = p["ticks"]
+            out["dispatch_ms_avg"] = round(p["dispatch_ms_sum"] / n, 4)
+            out["harvest_ms_avg"] = round(p["harvest_ms_sum"] / n, 4)
+            out["host_ms_avg"] = round(p["host_ms_sum"] / n, 4)
+            out["tick_ms_avg"] = round(p["tick_ms_sum"] / n, 4)
+            out["overlap_ratio"] = round(
+                p["overlap_ms_sum"] / max(p["tick_ms_sum"], 1e-9), 4
+            )
+        if recent:
+            med = statistics.median
+            out["dispatch_ms_p50"] = round(med([r[0] for r in recent]), 4)
+            out["harvest_ms_p50"] = round(med([r[1] for r in recent]), 4)
+            out["host_ms_p50"] = round(med([r[2] for r in recent]), 4)
+            out["tick_ms_p50"] = round(med([r[3] for r in recent]), 4)
         return out
 
     # -- scheduler loop ----------------------------------------------------
@@ -264,20 +333,47 @@ class LlamaEngine:
                     self._key = self._jax.random.PRNGKey(
                         int(time.time()) & 0x7FFFFFFF
                     )
-                    self._chain = None
-                    self._temps_cache = None
+                    self._reset_pipeline_locked()
 
-    def _append_first_locked(self, i: int, s: _Slot, token: int) -> None:
-        """Record the (device-sampled) first token of a freshly prefilled
-        row and finalize if the budget is already met. Caller holds cv."""
-        total = len(s.prompt) + len(s.out_ids)
-        if len(s.out_ids) < s.max_tokens and total < self.max_seq - 1:
-            s.out_ids.append(token)
-        self._maybe_finalize_locked(i, s)
+    def _reset_pipeline_locked(self) -> None:
+        """Drop every piece of pipeline state that may reference poisoned
+        device buffers or failed slots. The deferred in-flight segment is
+        POISONED too (its outputs chain from the donated cache the failed
+        call consumed) — discard it UNHARVESTED; its slots were already
+        failed above, so no tokens are owed. Latency/queue accounting is
+        reset alongside (r5 stats()/error-path drift: the old handler
+        left counters describing the crashed pipeline), so post-recovery
+        stats describe the recovered engine. Caller holds cv."""
+        self._chain = None
+        self._temps_cache = None
+        self._pending = None
+        p = self._pipe
+        p["errors"] += 1
+        p["inflight"] = 0
+        p["ticks"] = 0
+        for k in ("dispatch_ms_sum", "harvest_ms_sum", "host_ms_sum",
+                  "tick_ms_sum", "overlap_ms_sum"):
+            p[k] = 0.0
+        self._pipe_recent.clear()
+        self.metrics.scheduler_errors.inc()
+        self.metrics.queue_depth.set(float(len(self._waiting)))
+
+    def _rem(self, s: _Slot) -> int:
+        """Remaining token budget for a slot, counting tokens already
+        DISPATCHED on device but not yet harvested (``s.pending``): the
+        pipeline schedules purely from counts — values arrive a tick
+        later."""
+        done = len(s.out_ids) + s.pending
+        return min(s.max_tokens - done,
+                   (self.max_seq - 1) - (len(s.prompt) + done))
 
     def _maybe_finalize_locked(self, i: int, s: _Slot) -> None:
         """Completion is token-COUNT based (what lets the scheduler size
-        decode segments without seeing token values). Caller holds cv."""
+        decode segments without seeing token values). A slot with tokens
+        still in flight on device can never finalize — its values arrive
+        at the next harvest. Caller holds cv."""
+        if s.pending:
+            return
         if (
             len(s.out_ids) >= s.max_tokens
             or len(s.prompt) + len(s.out_ids) >= self.max_seq - 1
@@ -319,128 +415,324 @@ class LlamaEngine:
             b <<= 1
         return min(b, self.max_seq)
 
+    @staticmethod
+    def segment_size(need: int, cap: int,
+                     buckets: tuple = SEGMENT_BUCKETS) -> int:
+        """Pure host-side bucket policy (unit-testable without a device):
+        pick the segment size for a remaining budget of ``need`` tokens.
+        Rounds UP to the smallest covering bucket only when the overshoot
+        is small (<= a quarter of the bucket: rem=31 runs one 32-segment
+        discarding 1), else steps DOWN to the largest bucket below
+        (rem=7 runs a 4-segment instead of burning 25 wasted decodes).
+        ``cap`` (4 while requests wait) bounds admission latency."""
+        need = max(1, min(int(need), int(cap)))
+        up = next((b for b in reversed(buckets) if b >= need), buckets[0])
+        if up - need <= up // 4:
+            return up
+        return next((b for b in buckets if b <= need), 1)
+
+    # -- pipeline stages ---------------------------------------------------
+
+    def _harvest_segment(self):
+        """Harvest the deferred in-flight decode segment: `device_get` its
+        sampled ids (blocks until the device finishes the segment), append
+        the values to each slot, finalize completed requests, and admit
+        waiters. No-op when nothing is in flight. Returns
+        ``(blocked_ms, host_ms)`` for the tick accounting."""
+        import numpy as np
+
+        pend, self._pending = self._pending, None
+        if pend is None:
+            return 0.0, 0.0
+        t0 = time.perf_counter()
+        rows = np.asarray(self._jax.device_get(pend["toks"]))  # [B, k]
+        t1 = time.perf_counter()
+        with self._cv:
+            self._pipe["inflight"] = 0
+            for i, s, take in pend["sched"]:
+                s.pending -= take
+                if self._slots[i] is not s:
+                    continue  # vacated (request timeout) mid-segment
+                s.out_ids.extend(int(t) for t in rows[i][:take])
+                self._maybe_finalize_locked(i, s)
+            self._admit_locked()
+            self._cv.notify_all()
+        return (t1 - t0) * 1e3, (time.perf_counter() - t1) * 1e3
+
+    def _harvest_prefill(self, pre, ids_dev):
+        """Harvest prefill's device-sampled first tokens ([B] int32 — the
+        logits never left the device) and record them. Runs AFTER the next
+        decode segment is dispatched, so the copy-out overlaps device
+        compute. Returns ``(blocked_ms, host_ms)``."""
+        import numpy as np
+
+        t0 = time.perf_counter()
+        ids = np.asarray(self._jax.device_get(ids_dev))
+        t1 = time.perf_counter()
+        with self._cv:
+            for i, s, budgeted in pre:
+                if budgeted:
+                    s.pending -= 1
+                if self._slots[i] is not s:
+                    continue  # vacated (request timeout) mid-prefill
+                if budgeted:
+                    s.out_ids.append(int(ids[i]))
+                self._maybe_finalize_locked(i, s)
+            self._admit_locked()
+            self._cv.notify_all()
+        return (t1 - t0) * 1e3, (time.perf_counter() - t1) * 1e3
+
+    def _commit_tick(self, acct: Dict, tick_ms: float) -> None:
+        """Fold one tick's accounting into the pipeline stats + metrics."""
+        overlap_ms = (
+            acct["dispatch_ms"] + acct["host_ms"] if acct["overlapped"]
+            else 0.0
+        )
+        with self._cv:
+            p = self._pipe
+            p["ticks"] += 1
+            p["segments"] += acct["segments"]
+            p["deferred_harvests"] += acct["deferred"]
+            p["flushes"] += acct["flushes"]
+            p["chain_rebuilds"] += acct["rebuilds"]
+            p["dispatch_ms_sum"] += acct["dispatch_ms"]
+            p["harvest_ms_sum"] += acct["harvest_ms"]
+            p["host_ms_sum"] += acct["host_ms"]
+            p["tick_ms_sum"] += tick_ms
+            p["overlap_ms_sum"] += overlap_ms
+            self._pipe_recent.append(
+                (acct["dispatch_ms"], acct["harvest_ms"], acct["host_ms"],
+                 tick_ms)
+            )
+            queued = len(self._waiting)
+            ratio = p["overlap_ms_sum"] / max(p["tick_ms_sum"], 1e-9)
+        m = self.metrics
+        if acct["segments"]:
+            m.segments.inc(acct["segments"])
+        if acct["deferred"]:
+            m.deferred_harvests.inc(acct["deferred"])
+        if acct["flushes"]:
+            m.pipeline_flushes.inc(acct["flushes"])
+        if acct["rebuilds"]:
+            m.chain_rebuilds.inc(acct["rebuilds"])
+        m.dispatch_ms.observe(acct["dispatch_ms"])
+        m.harvest_ms.observe(acct["harvest_ms"])
+        m.host_ms.observe(acct["host_ms"])
+        m.overlap_ratio.set(ratio)
+        m.queue_depth.set(float(queued))
+
     def _loop_once(self) -> bool:
-        """One scheduler tick; returns True when the engine is stopping."""
+        """One tick of the DOUBLE-BUFFERED decode pipeline; returns True
+        when the engine is stopping.
+
+        The old tick was synchronous — dispatch segment, block in
+        `device_get` for its tokens, do host bookkeeping, dispatch the
+        next — so the chip idled through every copy-out + host round trip
+        (~4 ms/token of the r5 b1 engine overhead). Now a tick in steady
+        state (segment N-1 already in flight on device):
+
+            dispatch prefill (new rows)        } async: queue behind N-1,
+            dispatch decode segment N          } tokens chained ON DEVICE
+            harvest segment N-1 (device_get)   — blocks until N-1 done...
+            bookkeeping/finalize/admission     } ...then everything here
+            harvest prefill first tokens       } overlaps N's device time
+
+        Freshly prefilled rows join segment N in the SAME tick: their
+        first sampled ids are grafted into the device chain
+        (`llama.merge_chain_tokens`) before the segment is dispatched, so
+        TTFT never serializes behind an in-flight segment's harvest.
+        Scheduling is count-based (``_rem`` includes in-flight tokens);
+        values land one tick later and completed slots finalize at
+        harvest, when their token values exist host-side."""
         import numpy as np
         import jax.numpy as jnp
 
         with self._cv:
             self._admit_locked()
-            while not self._stop and not any(
+            while not self._stop and self._pending is None and not any(
                 s is not None for s in self._slots
             ):
                 self._cv.wait(timeout=0.2)
                 self._admit_locked()
-            if self._stop:
-                return True
+            stop = self._stop
+            waiting = bool(self._waiting)
+        if stop:
+            self._harvest_segment()  # flush: deliver in-flight tokens
+            return True
+
+        t_tick = time.perf_counter()
+        acct = {"dispatch_ms": 0.0, "harvest_ms": 0.0, "host_ms": 0.0,
+                "overlapped": False, "segments": 0, "deferred": 0,
+                "flushes": 0, "rebuilds": 0}
+
+        if waiting and self._pending is not None:
+            # requests queued: harvest FIRST so finished rows free up and
+            # admission waits for at most ONE (small) segment instead of
+            # queueing behind a freshly dispatched one — trades this
+            # tick's overlap for bounded admission latency
+            h, b = self._harvest_segment()
+            acct["harvest_ms"] += h
+            acct["host_ms"] += b
+            acct["flushes"] += 1
+
+        with self._cv:
+            self._admit_locked()
             active = list(self._slots)
 
-        # ---- prefill: newly admitted rows consume their WHOLE prompt in
-        # one batched forward (TTFT = one forward, not prompt_len decode
-        # steps) and sample their first token from its logits
-        pre = [(i, s) for i, s in enumerate(active) if s is not None and s.fed == 0]
-        if pre:
-            bucket = self._prefill_bucket(max(len(s.prompt) for _, s in pre))
+        # ---- prefill DISPATCH: newly admitted rows consume their WHOLE
+        # prompt in one batched forward (TTFT = one forward, not
+        # prompt_len decode steps); the first token is sampled on device
+        # and its copy-out DEFERRED until after the next segment dispatch
+        pre: list = []
+        prefill_ids = None
+        todo = [(i, s) for i, s in enumerate(active)
+                if s is not None and s.fed == 0]
+        if todo:
+            bucket = self._prefill_bucket(max(len(s.prompt) for _, s in todo))
             toks = np.zeros((self.max_batch, bucket), np.int32)
             lens = np.zeros((self.max_batch,), np.int32)
-            for i, s in pre:
+            temps0 = np.zeros((self.max_batch,), np.float32)
+            for i, s in todo:
                 toks[i, : len(s.prompt)] = s.prompt
                 lens[i] = len(s.prompt)
+                temps0[i] = max(float(s.temperature), 0.0)
+            self._key, pick_key = self._jax.random.split(self._key)
+            t0 = time.perf_counter()
             logits, self._cache = self._prefill(
                 self.params, self._cache, jnp.asarray(toks), jnp.asarray(lens)
             )
-            self._prefill_gen += 1  # freshly filled rows need host tokens
-            temps0 = np.zeros((self.max_batch,), np.float32)
-            for i, s in pre:
-                temps0[i] = max(float(s.temperature), 0.0)
-            self._key, pick_key = self._jax.random.split(self._key)
-            ids = np.asarray(self._jax.device_get(
-                self._sample_logits(logits, jnp.asarray(temps0), pick_key)
-            ))  # [B] int32 — the logits themselves never leave the device
+            prefill_ids = self._sample_logits(
+                logits, jnp.asarray(temps0), pick_key
+            )  # [B] int32, stays on device until after the next dispatch
+            self._prefill_gen += 1
+            # graft the sampled first tokens into the device chain so the
+            # new rows can join THIS tick's decode segment with zero
+            # host->device traffic (per-row chain validity: untouched
+            # rows keep the in-flight segment's output tokens)
+            rows = tuple(i for i, _ in todo)
+            mask = np.zeros((self.max_batch,), bool)
+            mask[list(rows)] = True
+            if self._chain is not None:
+                merged = self._merge_chain(
+                    self._chain[2], prefill_ids, jnp.asarray(mask)
+                )
+                self._chain = (
+                    self._prefill_gen,
+                    tuple(sorted(set(self._chain[1]) | set(rows))),
+                    merged,
+                )
+            else:
+                self._chain = (self._prefill_gen, rows, prefill_ids[:, None])
+            acct["dispatch_ms"] += (time.perf_counter() - t0) * 1e3
             with self._cv:
-                for i, s in pre:
+                for i, s in todo:
                     if self._slots[i] is not s:
                         continue  # vacated (request timeout) mid-prefill
                     s.fed = len(s.prompt)
-                    self._append_first_locked(i, s, int(ids[i]))
-                self._admit_locked()
+                    budgeted = (
+                        s.max_tokens > 0
+                        and len(s.prompt) + len(s.out_ids)
+                        < self.max_seq - 1
+                    )
+                    if budgeted:
+                        s.pending += 1
+                    pre.append((i, s, budgeted))
                 active = list(self._slots)
 
+        # ---- decode segment DISPATCH: K steps in one jitted call with
+        # on-device sampling (llama.decode_segment); rows whose budget
+        # ends mid-segment discard the overshoot — they are finished and
+        # re-prefilled (pos reset) on slot reuse, so the garbage the
+        # extra steps wrote to their cache rows is dead
         decoding = [
             (i, s) for i, s in enumerate(active)
-            if s is not None and s.fed >= len(s.prompt)
+            if s is not None and s.fed >= len(s.prompt) and self._rem(s) > 0
         ]
-        if not decoding:
-            return False
-        # ---- decode SEGMENT: run K steps in one dispatch with on-device
-        # sampling (llama.decode_segment). The old per-token tick fetched
-        # full [B, V] logits every step — 8MB + a tunnel round trip per
-        # token, dwarfing the decode itself. K is the smallest bucket
-        # covering the LONGEST remaining budget (capped to 4 while
-        # requests wait, bounding admission latency); rows whose budget
-        # ends mid-segment simply discard the overshoot — they are
-        # finished and will be re-prefilled (pos reset) on slot reuse, so
-        # the garbage the extra steps wrote to their cache rows is dead.
-        def rem(s):
-            return min(s.max_tokens - len(s.out_ids),
-                       (self.max_seq - 1) - (len(s.prompt) + len(s.out_ids)))
+        new_pending = None
+        if decoding:
+            need = max(self._rem(s) for _, s in decoding)
+            with self._cv:
+                cap = 4 if self._waiting else self.SEGMENT_BUCKETS[0]
+            k = self.segment_size(need, cap)
+            temps = np.zeros((self.max_batch,), np.float32)
+            for i, s in decoding:
+                temps[i] = max(float(s.temperature), 0.0)
+            greedy = not np.any(temps > 0.0)
+            # feed from the DEVICE chain whenever it covers the decoding
+            # rows: long generations never ship tokens host->device
+            chain_ok = (
+                self._chain is not None
+                and self._chain[0] == self._prefill_gen
+                and {i for i, _ in decoding} <= set(self._chain[1])
+            )
+            if chain_ok:
+                tokens_dev = self._chain[2]
+            else:
+                # stale/absent chain (post-error recovery): rebuild the
+                # feed from HOST tokens. In-flight values must land
+                # first — s.next_input() indexes into out_ids the
+                # deferred segment has not delivered yet.
+                h, b = self._harvest_segment()
+                acct["harvest_ms"] += h
+                acct["host_ms"] += b
+                if pre:
+                    h, b = self._harvest_prefill(pre, prefill_ids)
+                    acct["harvest_ms"] += h
+                    acct["host_ms"] += b
+                    pre = []
+                acct["flushes"] += 1
+                acct["rebuilds"] += 1
+                decoding = [
+                    (i, s) for i, s in decoding
+                    if self._slots[i] is s and self._rem(s) > 0
+                ]
+                tokens = np.zeros((self.max_batch, 1), np.int32)
+                for i, s in decoding:
+                    tokens[i, 0] = s.next_input()
+                tokens_dev = jnp.asarray(tokens)
+        if decoding:
+            fp = temps.tobytes()
+            if self._temps_cache is None or self._temps_cache[0] != fp:
+                self._temps_cache = (fp, jnp.asarray(temps))
+            t0 = time.perf_counter()
+            toks, last, self._key, self._cache = self._segment_fn(k, greedy)(
+                self.params, self._cache, tokens_dev,
+                self._temps_cache[1], self._key,
+            )
+            acct["dispatch_ms"] += (time.perf_counter() - t0) * 1e3
+            self._chain = (
+                self._prefill_gen, tuple(i for i, _ in decoding), last
+            )
+            sched = []
+            with self._cv:
+                for i, s in decoding:
+                    take = min(k, self._rem(s))
+                    s.pending += take
+                    s.fed += take
+                    sched.append((i, s, take))
+                self._pipe["inflight"] = 1
+            new_pending = {"toks": toks, "sched": sched, "k": k}
+            acct["segments"] += 1
 
-        need = max(rem(s) for _, s in decoding)
-        with self._cv:
-            cap = 4 if self._waiting else self.SEGMENT_BUCKETS[0]
-        need = min(need, cap)
-        # round UP only when the overshoot is small (<= a quarter of the
-        # bucket): rem=31 runs one 32-segment discarding 1, while rem=7
-        # steps down to a 4-segment instead of burning 25 wasted decodes
-        # (and inflating the engine's own latency numbers)
-        up = next(
-            (b for b in reversed(self.SEGMENT_BUCKETS) if b >= need),
-            self.SEGMENT_BUCKETS[0],
-        )
-        if up - need <= up // 4:
-            k = up
-        else:
-            k = next((b for b in self.SEGMENT_BUCKETS if b <= need), 1)
-        row_ids = tuple(i for i, _ in decoding)
-        temps = np.zeros((self.max_batch,), np.float32)
-        for i, s in decoding:
-            temps[i] = max(float(s.temperature), 0.0)
-        greedy = not np.any(temps > 0.0)
-        # feed tokens from the DEVICE chain when the slot set is the same
-        # as the previous segment's (no prefill in between): long
-        # generations then never ship tokens host->device at all
-        chain_ok = (
-            self._chain is not None
-            and self._chain[0] == self._prefill_gen
-            and set(row_ids) <= set(self._chain[1])
-        )
-        if chain_ok:
-            tokens_dev = self._chain[2]
-        else:
-            tokens = np.zeros((self.max_batch, 1), np.int32)
-            for i, s in decoding:
-                tokens[i, 0] = s.next_input()
-            tokens_dev = jnp.asarray(tokens)
-        fp = temps.tobytes()
-        if self._temps_cache is None or self._temps_cache[0] != fp:
-            self._temps_cache = (fp, jnp.asarray(temps))
-        toks, last, self._key, self._cache = self._segment_fn(k, greedy)(
-            self.params, self._cache, tokens_dev,
-            self._temps_cache[1], self._key,
-        )
-        self._chain = (self._prefill_gen, row_ids, last)
-        rows = np.asarray(self._jax.device_get(toks))  # [B, k] int32
-        with self._cv:
-            for i, s in decoding:
-                if self._slots[i] is not s:
-                    continue  # vacated (request timeout) mid-segment
-                take = min(k, rem(s))
-                s.fed += take
-                s.out_ids.extend(int(t) for t in rows[i][:take])
-                self._maybe_finalize_locked(i, s)
-            self._admit_locked()
-            self._cv.notify_all()
+        # ---- harvest: segment N-1's ids (then prefill's first tokens)
+        # while segment N runs on device — the overlap window
+        if self._pending is not None:
+            if new_pending is not None:
+                acct["overlapped"] = True
+                acct["deferred"] += 1
+            else:
+                acct["flushes"] += 1  # pipeline drains this tick
+            h, b = self._harvest_segment()
+            acct["harvest_ms"] += h
+            acct["host_ms"] += b
+        if pre:
+            h, b = self._harvest_prefill(pre, prefill_ids)
+            acct["harvest_ms"] += h
+            acct["host_ms"] += b
+        self._pending = new_pending
+        self._commit_tick(acct, (time.perf_counter() - t_tick) * 1e3)
         return False
+
 
 def make_handler(engine: LlamaEngine, model_name: str):
     class Handler(BaseHTTPRequestHandler):
@@ -460,6 +752,15 @@ def make_handler(engine: LlamaEngine, model_name: str):
                 self._json(200, {"status": "ok"})
             elif self.path == "/v1/stats":
                 self._json(200, engine.stats())
+            elif self.path == "/metrics":
+                body = engine.metrics.registry.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif self.path == "/v1/models":
                 self._json(200, {
                     "models": [{
