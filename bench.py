@@ -825,6 +825,40 @@ def main() -> int:
 
     # ---- secondary BASELINE.md targets (never fail the headline) ------
     targets: dict = {}
+    # kind-e2e verdict rides EVERY artifact (VERDICT #8: the real-cluster
+    # e2e has never executed — keep that gap visible instead of implicit).
+    # attempted=True only when a kind binary AND an e2e driver both exist.
+    import shutil as _shutil
+
+    kind_bin = _shutil.which("kind")
+    e2e_driver = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "kind_e2e.sh")
+    if kind_bin is None:
+        targets["kind_e2e"] = {
+            "attempted": False, "verdict": "skipped",
+            "reason": "no `kind` binary on PATH in this environment",
+        }
+    elif not os.path.exists(e2e_driver):
+        targets["kind_e2e"] = {
+            "attempted": False, "verdict": "skipped",
+            "reason": f"kind present at {kind_bin} but no e2e driver "
+                      "(scripts/kind_e2e.sh) exists in the repo yet",
+        }
+    else:
+        import subprocess as _sp
+
+        try:
+            proc = _sp.run([e2e_driver], capture_output=True, text=True,
+                           timeout=1800)
+            targets["kind_e2e"] = {
+                "attempted": True,
+                "verdict": "passed" if proc.returncode == 0 else "failed",
+                "reason": (proc.stderr or proc.stdout or "")[-2000:],
+            }
+        except Exception as e:
+            targets["kind_e2e"] = {
+                "attempted": True, "verdict": "failed", "reason": str(e),
+            }
     try:
         targets["control_plane"] = bench_control_plane()
     except Exception as e:

@@ -100,6 +100,11 @@ class JobConditionType(str, enum.Enum):
     SUSPENDED = "Suspended"
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
+    #: TPU addition (poison-pill protection): reconcile raised repeatedly,
+    #: the job is parked — pods torn down, slices freed — instead of
+    #: hot-looping the workqueue. NOT terminal: the job is neither
+    #: succeeded nor failed, it is awaiting operator intervention.
+    QUARANTINED = "Quarantined"
 
 
 TERMINAL_CONDITIONS = (JobConditionType.SUCCEEDED, JobConditionType.FAILED)

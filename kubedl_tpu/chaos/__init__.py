@@ -1,0 +1,33 @@
+"""Deterministic chaos layer: seeded fault injection + unified retry policy.
+
+See :mod:`kubedl_tpu.chaos.plan` for injection sites and schedules, and
+:mod:`kubedl_tpu.chaos.retry` for the shared backoff/budget policy.
+``docs/robustness.md`` documents the contract.
+"""
+
+from kubedl_tpu.chaos.plan import (
+    FaultInjected,
+    FaultPlan,
+    FaultSpec,
+    TraceEntry,
+    active,
+    arm,
+    check,
+    disarm,
+    should_fail,
+)
+from kubedl_tpu.chaos.retry import RetryBudgetExhausted, RetryPolicy
+
+__all__ = [
+    "FaultInjected",
+    "FaultPlan",
+    "FaultSpec",
+    "TraceEntry",
+    "RetryBudgetExhausted",
+    "RetryPolicy",
+    "active",
+    "arm",
+    "check",
+    "disarm",
+    "should_fail",
+]

@@ -10,6 +10,7 @@ import urllib.parse
 import urllib.request
 from typing import Any, Dict, List, Optional
 
+from kubedl_tpu import chaos
 from kubedl_tpu.api import codec
 from kubedl_tpu.client.base import ApiException, BaseClient
 
@@ -23,7 +24,8 @@ class KubeDLClient(BaseClient):
 
     # -- transport ---------------------------------------------------------
 
-    def _call(self, method: str, path: str, body: Optional[dict] = None) -> Any:
+    def _call_once(self, method: str, path: str, body: Optional[dict] = None) -> Any:
+        chaos.check("client.http")
         headers = {"Content-Type": "application/json"}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
@@ -44,6 +46,17 @@ class KubeDLClient(BaseClient):
                 msg = str(e)
             raise ApiException(e.code, str(msg)) from None
         return payload.get("data", payload)
+
+    def _call(self, method: str, path: str, body: Optional[dict] = None) -> Any:
+        """Transport with the shared retry policy: transient failures (5xx,
+        connection refused mid-restart, injected chaos) retry with jittered
+        backoff; 4xx API errors are permanent and surface immediately."""
+        policy = chaos.RetryPolicy(max_attempts=4, base_delay=0.05, max_delay=0.5)
+        return policy.call(
+            lambda: self._call_once(method, path, body),
+            retry_on=(ApiException, urllib.error.URLError, chaos.FaultInjected),
+            giveup=lambda e: isinstance(e, ApiException) and e.status < 500,
+        )
 
     def login(self, username: str, password: str) -> str:
         """Session login; stores and returns the bearer token."""

@@ -25,6 +25,7 @@ import threading
 import time
 from typing import List, Optional
 
+from kubedl_tpu import chaos
 from kubedl_tpu.core.manager import ControllerManager, EventRecorder
 from kubedl_tpu.core.objects import ContainerStatus, Node, Pod, PodPhase
 from kubedl_tpu.core.store import AlreadyExists, Conflict, NotFound, ObjectStore
@@ -62,6 +63,8 @@ class NodeHeartbeater:
     def beat_once(self) -> None:
         now = self.clock()
         for name in self.node_names:
+            if chaos.should_fail("node.heartbeat"):
+                continue  # injected missed beat → lifecycle eviction path
             try:
                 def mutate(obj: Node) -> None:
                     obj.last_heartbeat = now

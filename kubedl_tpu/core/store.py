@@ -16,6 +16,7 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+from kubedl_tpu import chaos
 from kubedl_tpu.core.objects import BaseObject, match_labels
 
 WatchCallback = Callable[[str, BaseObject, Optional[BaseObject]], None]
@@ -51,6 +52,7 @@ class ObjectStore:
     # ---- CRUD ------------------------------------------------------------
 
     def create(self, obj: BaseObject) -> BaseObject:
+        chaos.check("store.create")
         with self._lock:
             bucket = self._objects.setdefault(obj.kind, {})
             if obj.key in bucket:
@@ -82,6 +84,7 @@ class ObjectStore:
     def update(self, obj: BaseObject) -> BaseObject:
         """Optimistic update: fails with Conflict on stale resource_version
         (the reference requeues on conflict, job.go:298-306)."""
+        chaos.check("store.update")
         with self._lock:
             bucket = self._objects.get(obj.kind, {})
             cur = bucket.get(obj.key)
@@ -105,18 +108,24 @@ class ObjectStore:
         self, kind: str, name: str, namespace: str, mutate: Callable[[BaseObject], None],
         attempts: int = 5,
     ) -> BaseObject:
-        """Read-modify-write loop, the client-go `retry.RetryOnConflict` idiom."""
-        last: Optional[Exception] = None
-        for _ in range(attempts):
+        """Read-modify-write loop, the client-go `retry.RetryOnConflict` idiom.
+
+        Retries ride the shared :class:`~kubedl_tpu.chaos.RetryPolicy`
+        (in-process conflicts are cheap, so the backoff floor is tiny —
+        jitter only matters when many workers contend on one object)."""
+        policy = chaos.RetryPolicy(
+            max_attempts=attempts, base_delay=0.001, max_delay=0.02
+        )
+
+        def attempt() -> BaseObject:
             obj = self.get(kind, name, namespace)
             mutate(obj)
-            try:
-                return self.update(obj)
-            except Conflict as e:  # refetch and retry
-                last = e
-        raise last  # type: ignore[misc]
+            return self.update(obj)
+
+        return policy.call(attempt, retry_on=(Conflict,))
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        chaos.check("store.delete")
         with self._lock:
             bucket = self._objects.get(kind, {})
             obj = bucket.pop((namespace, name), None)

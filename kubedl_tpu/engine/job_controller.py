@@ -103,6 +103,11 @@ class JobEngine:
         self.cluster_domain = cluster_domain
         self.compile_cache_dir = compile_cache_dir
         self.expectations = ControllerExpectations()
+        #: poison-pill protection: consecutive reconcile exceptions per job
+        #: before it is parked with a Quarantined condition instead of
+        #: hot-looping the workqueue forever (docs/robustness.md)
+        self.quarantine_budget = 5
+        self._reconcile_failures: Dict[str, int] = {}
         # per-job TensorBoard lifecycle (reference: tfjob_controller.go:171-177
         # calls ReconcileTensorBoard each pass; generic here — any kind may
         # carry the annotation)
@@ -139,11 +144,64 @@ class JobEngine:
         assert isinstance(job, JobObject)
         if not self.expectations.all_satisfied(job_key(job)):
             return None  # watch events will re-trigger once caches settle
+        if job.status.phase == JobConditionType.QUARANTINED:
+            return None  # parked: wait for operator intervention, not CPU
         self.controller.apply_defaults(job)
-        with TRACER.span(
-            "reconcile", kind=self.controller.KIND, job=f"{namespace}/{name}"
-        ):
-            return self.reconcile_job(job)
+        try:
+            with TRACER.span(
+                "reconcile", kind=self.controller.KIND, job=f"{namespace}/{name}"
+            ):
+                out = self.reconcile_job(job)
+        except Exception as e:
+            key = job_key(job)
+            n = self._reconcile_failures.get(key, 0) + 1
+            self._reconcile_failures[key] = n
+            if n >= self.quarantine_budget:
+                self._quarantine(job, e, n)
+                return None  # swallow: the workqueue must forget this key
+            raise  # manager rate-limits the requeue (backoff between tries)
+        self._reconcile_failures.pop(job_key(job), None)
+        return out
+
+    def _quarantine(self, job: JobObject, exc: BaseException, failures: int) -> None:
+        """Park a poison-pill job: tear down its pods, free its slices, and
+        stamp the Quarantined condition so the hot loop ends while the
+        evidence (job object + condition + event) stays inspectable."""
+        log.error(
+            "quarantining %s %s after %d consecutive reconcile failures: %s",
+            self.controller.KIND, job_key(job), failures, exc,
+        )
+        self.metrics.quarantined.inc(kind=self.controller.KIND)
+        self.recorder.event(
+            job, "Warning", "Quarantined",
+            f"reconcile failed {failures}x consecutively: {exc}",
+        )
+        try:
+            self._delete_pods(job, self.get_pods_for_job(job), CleanPodPolicy.ALL)
+        except Exception:
+            log.exception("quarantine pod cleanup failed for %s", job_key(job))
+        if self.gang is not None:
+            try:
+                self.gang.delete_gang(job)
+            except Exception:
+                log.exception("quarantine gang release failed for %s", job_key(job))
+
+        def mutate(obj: JobObject) -> None:  # type: ignore[type-arg]
+            obj.status.set_condition(
+                JobConditionType.QUARANTINED, "ReconcileBudgetExhausted",
+                f"reconcile failed {failures}x consecutively: {exc}",
+            )
+
+        try:
+            self.store.update_with_retry(
+                self.controller.KIND, job.metadata.name, job.metadata.namespace,
+                mutate,
+            )
+            self._reconcile_failures.pop(job_key(job), None)
+        except Exception:
+            # the status write itself may be the poisoned path; keep the
+            # failure count so the next trigger re-quarantines immediately
+            log.exception("quarantine status write failed for %s", job_key(job))
 
     # ----------------------------------------------------------- main loop
 

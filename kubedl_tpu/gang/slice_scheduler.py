@@ -15,6 +15,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from kubedl_tpu import chaos
 from kubedl_tpu.api.interface import JobObject
 from kubedl_tpu.api.topology import SliceTopology, get_slice
 from kubedl_tpu.core.objects import Pod, PodGroup
@@ -188,6 +189,8 @@ class SliceGangScheduler(GangScheduler):
     def try_admit(self, gang: PodGroup) -> bool:
         if gang.phase == "Running" and (gang.assigned_slices or not gang.slice_type):
             return True
+        if chaos.should_fail("gang.bind"):
+            return False  # injected bind rejection → job waits, re-admits
         owner = f"{gang.metadata.namespace}/{gang.metadata.name}"
         if not gang.slice_type:
             assigned: List[str] = []  # CPU-pool job: nothing to reserve

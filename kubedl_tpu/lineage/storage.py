@@ -59,6 +59,13 @@ class StorageProvider:
         StorageError when the artifact isn't reachable from here."""
         return mv.storage_root
 
+    def serving_root(self, mv) -> str:
+        """What a predictor pod receives as KUBEDL_MODEL_PATH. Resolved
+        through the provider (not raw `mv.storage_root`) so a mis-shaped
+        root fails at pod creation instead of crash-looping the predictor.
+        Base contract: the root is a directory readable in place."""
+        return mv.storage_root
+
 
 class SharedDirProvider(StorageProvider):
     NAME = "shared"
@@ -140,6 +147,19 @@ class RemoteBlobProvider(StorageProvider):
         if n == 0:
             raise StorageError(f"no artifact blobs under {remote_root}")
         return str(cache)
+
+    def serving_root(self, mv) -> str:
+        from kubedl_tpu.remote.client import is_remote_root
+
+        if not is_remote_root(mv.storage_root):
+            raise StorageError(
+                f"http ModelVersion {mv.metadata.name!r} has a non-remote "
+                f"storage_root {mv.storage_root!r} — predictors would treat "
+                "the URL as a local directory"
+            )
+        # the URL stays a URL: serve_main mirrors the blob prefix into a
+        # local cache on startup (predictors may run on any host)
+        return mv.storage_root
 
 
 _PROVIDERS: Dict[str, StorageProvider] = {}

@@ -235,6 +235,11 @@ class JobMetrics:
         self.tokens_per_sec_per_chip = r.gauge(
             "kubedl_tpu_tokens_per_sec_per_chip", "Training throughput per chip"
         )
+        self.quarantined = r.counter(
+            "kubedl_tpu_jobs_quarantined",
+            "Jobs parked with a Quarantined condition after their reconcile "
+            "retry budget (poison-pill protection for the workqueue)",
+        )
 
 
 #: ms-scale buckets for the decode pipeline's per-tick timings (the
@@ -292,6 +297,11 @@ class ServingMetrics:
         )
         self.queue_depth = r.gauge(
             "kubedl_tpu_serving_queue_depth", "Requests waiting for a slot"
+        )
+        self.shed_requests = r.counter(
+            "kubedl_tpu_serving_shed_requests",
+            "Requests rejected 503 by the queue-depth/age load-shedding "
+            "budget (the autoscaler treats shed load as backlog)",
         )
 
 

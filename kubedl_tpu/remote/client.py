@@ -14,9 +14,14 @@ import urllib.request
 from pathlib import Path
 from typing import List
 
+from kubedl_tpu import chaos
+
 
 class RemoteError(Exception):
-    pass
+    def __init__(self, msg: str, transient: bool = False) -> None:
+        super().__init__(msg)
+        #: True for 5xx / connection errors — safe to retry; 4xx is not
+        self.transient = transient
 
 
 def is_remote_root(root: str) -> bool:
@@ -29,15 +34,31 @@ def _split(root: str) -> tuple:
     return base, prefix.strip("/")
 
 
-def _request(url: str, data: bytes = None, method: str = "GET") -> bytes:
+def _request_once(url: str, data: bytes = None, method: str = "GET") -> bytes:
+    chaos.check("remote.request")
     req = urllib.request.Request(url, data=data, method=method)
     try:
         with urllib.request.urlopen(req, timeout=30) as resp:
             return resp.read()
     except urllib.error.HTTPError as e:
-        raise RemoteError(f"{method} {url}: HTTP {e.code}: {e.read()[:200]}") from e
+        raise RemoteError(
+            f"{method} {url}: HTTP {e.code}: {e.read()[:200]}",
+            transient=e.code >= 500,
+        ) from e
     except urllib.error.URLError as e:
-        raise RemoteError(f"{method} {url}: {e.reason}") from e
+        raise RemoteError(f"{method} {url}: {e.reason}", transient=True) from e
+
+
+def _request(url: str, data: bytes = None, method: str = "GET") -> bytes:
+    """One blob-server round trip; transient failures (5xx, connection
+    reset, injected chaos) retry under the shared policy, permanent 4xx
+    surface immediately."""
+    policy = chaos.RetryPolicy(max_attempts=4, base_delay=0.05, max_delay=0.5)
+    return policy.call(
+        lambda: _request_once(url, data=data, method=method),
+        retry_on=(RemoteError, chaos.FaultInjected),
+        giveup=lambda e: isinstance(e, RemoteError) and not e.transient,
+    )
 
 
 def _quote_key(key: str) -> str:

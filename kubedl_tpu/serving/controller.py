@@ -262,14 +262,17 @@ class InferenceController:
 
         def safe_probe(p):
             # probes may return a bare QPS float (legacy) or the engine's
-            # full /v1/stats dict (qps + queued queue depth)
+            # full /v1/stats dict (qps + queued queue depth). Shed requests
+            # count as backlog: a replica rejecting 503s is saturated even
+            # when its queue reads shallow (it never let the demand in)
             try:
                 v = self.qps_probe(p)
                 if v is None:
                     return None
                 if isinstance(v, dict):
                     return (float(v.get("qps", 0.0)),
-                            int(v.get("queued", 0)))
+                            int(v.get("queued", 0))
+                            + int(v.get("shed_recent", 0)))
                 return (float(v), 0)
             except Exception:
                 return None

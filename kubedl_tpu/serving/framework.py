@@ -53,7 +53,13 @@ def _jax_setter(
     main = pod.spec.main_container()
     if not main.command and not main.entrypoint:
         main.entrypoint = "kubedl_tpu.serving.server:serve_main"
-    main.set_env(constants.ENV_MODEL_PATH, mv.storage_root)
+    # resolve through the storage provider instead of injecting the raw
+    # storage_root: a remote (http) root stays a URL for serve_main's
+    # fetch-on-load path, anything mis-shaped fails HERE, at pod creation
+    from kubedl_tpu.lineage.storage import get_storage_provider
+
+    root = get_storage_provider(mv.storage_provider).serving_root(mv)
+    main.set_env(constants.ENV_MODEL_PATH, root)
     serve_cfg = {
         "model_name": mv.model_name,
         "artifact": mv.image,

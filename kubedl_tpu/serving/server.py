@@ -25,7 +25,19 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
+from kubedl_tpu import chaos
+
 log = logging.getLogger("kubedl_tpu.serving.server")
+
+
+class EngineOverloaded(Exception):
+    """Queue-depth/age budget exceeded — callers get 503 + Retry-After
+    instead of joining a queue that can no longer meet its latency budget
+    (docs/robustness.md: shedding early keeps the served fraction fast)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 class _Slot:
@@ -67,7 +79,8 @@ class LlamaEngine:
     def __init__(self, preset: str = "tiny", ckpt_dir: str = "",
                  batch: int = 0, max_seq: int = 0, max_batch: int = 4,
                  quantize: str = "", mesh_axes: Optional[Dict] = None,
-                 metrics=None) -> None:
+                 metrics=None, max_queue_depth: int = 64,
+                 max_queue_age_s: float = 30.0) -> None:
         import jax
 
         from kubedl_tpu.models import llama
@@ -165,7 +178,12 @@ class LlamaEngine:
         #: device compute instead of idling the chip between segments.
         self._pending: Optional[Dict] = None
         self._stats = {"requests": 0, "tokens_out": 0, "tokens_in": 0,
-                       "started_at": time.time()}
+                       "shed": 0, "started_at": time.time()}
+        #: load-shedding budget: reject (503) instead of queueing once the
+        #: queue is deeper than max_queue_depth or its head has waited
+        #: longer than max_queue_age_s (the queue is not draining)
+        self.max_queue_depth = max(1, int(max_queue_depth))
+        self.max_queue_age_s = float(max_queue_age_s)
         from collections import deque
 
         from kubedl_tpu.observability.metrics import ServingMetrics
@@ -183,6 +201,9 @@ class LlamaEngine:
         #: completion timestamps for windowed QPS (autoscale signal must
         #: track LIVE load, not a lifetime average)
         self._recent: "deque[float]" = deque(maxlen=100_000)
+        #: shed timestamps, same window: the autoscaler folds recent sheds
+        #: into its backlog signal (rejected demand is still demand)
+        self._shed_recent: "deque[float]" = deque(maxlen=100_000)
         self.qps_window_s = 60.0
         self._warmup()
         self._thread = threading.Thread(
@@ -217,6 +238,23 @@ class LlamaEngine:
         max_tokens = max(0, min(int(max_tokens), budget - len(prompt)))
         slot = _Slot(prompt, max_tokens, float(temperature))
         with self._cv:
+            depth = len(self._waiting)
+            head_age = (
+                time.perf_counter() - self._waiting[0].t0 if self._waiting else 0.0
+            )
+            if depth >= self.max_queue_depth or head_age > self.max_queue_age_s:
+                # shed instead of queueing: an over-budget queue serves
+                # nobody well — tell the client when to come back and let
+                # the autoscaler see the rejected demand as backlog
+                self._stats["shed"] += 1
+                self._shed_recent.append(time.time())
+                self.metrics.shed_requests.inc()
+                retry = max(1.0, min(self.max_queue_age_s, 0.25 * depth))
+                raise EngineOverloaded(
+                    f"queue depth {depth} (budget {self.max_queue_depth}), "
+                    f"head age {head_age:.1f}s (budget {self.max_queue_age_s}s)",
+                    retry_after_s=retry,
+                )
             self._waiting.append(slot)
             self._cv.notify_all()
         if not slot.done.wait(timeout=timeout_s):
@@ -254,6 +292,9 @@ class LlamaEngine:
         out["max_batch"] = self.max_batch
         with self._cv:
             out["queued"] = len(self._waiting)
+            out["shed_recent"] = sum(
+                1 for t in self._shed_recent if t > now - self.qps_window_s
+            )
         out["pipeline"] = self.pipeline_stats()
         return out
 
@@ -691,6 +732,10 @@ class LlamaEngine:
                     tokens[i, 0] = s.next_input()
                 tokens_dev = jnp.asarray(tokens)
         if decoding:
+            # injected device fault mid-flight: raising here exercises the
+            # _loop recovery contract (fail in-flight slots, rebuild the
+            # donated cache, reset the pipeline, keep serving)
+            chaos.check("serving.dispatch")
             fp = temps.tobytes()
             if self._temps_cache is None or self._temps_cache[0] != fp:
                 self._temps_cache = (fp, jnp.asarray(temps))
@@ -739,11 +784,14 @@ def make_handler(engine: LlamaEngine, model_name: str):
         def log_message(self, fmt, *args):  # quiet
             log.debug(fmt, *args)
 
-        def _json(self, code: int, payload: dict) -> None:
+        def _json(self, code: int, payload: dict,
+                  headers: Optional[Dict[str, str]] = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -785,6 +833,11 @@ def make_handler(engine: LlamaEngine, model_name: str):
                     float(req.get("temperature", 0.0)),
                 )
                 self._json(200, result)
+            except EngineOverloaded as e:
+                self._json(
+                    503, {"error": str(e), "shed": True},
+                    headers={"Retry-After": str(int(e.retry_after_s + 0.999))},
+                )
             except Exception as e:  # serving must not die on a bad request
                 self._json(400, {"error": str(e)})
 
@@ -804,6 +857,8 @@ def engine_kwargs(cfg: Dict, ckpt_dir: str) -> Dict:
             "quantize", os.environ.get("KUBEDL_SERVE_QUANTIZE", "")
         ),
         "mesh_axes": cfg.get("mesh") or None,
+        "max_queue_depth": int(cfg.get("max_queue_depth", 64)),
+        "max_queue_age_s": float(cfg.get("max_queue_age_s", 30.0)),
     }
 
 

@@ -37,6 +37,8 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+from kubedl_tpu import chaos
+
 
 def _leaf_items(state):
     for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
@@ -87,6 +89,9 @@ def save_checkpoint(
     os.close(fd)
     np.savez(tmp, **shards)
     os.replace(tmp, d / f"shards-p{pid}.npz")
+    # torn-write injection point: dying here leaves shards without a
+    # manifest/marker — restore must fall back to the previous good step
+    chaos.check("checkpoint.torn")
     if pid == 0:
         (d / "meta.json").write_text(
             json.dumps(

@@ -155,7 +155,21 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
     out = os.environ.get(constants.ENV_MODEL_PATH, "")
     ckpt_dir = os.environ.get(constants.ENV_CKPT_DIR, "")
     if not ckpt_dir and out and cfg.ckpt_every:
-        ckpt_dir = os.path.join(out, "checkpoints")
+        from kubedl_tpu.remote.client import is_remote_root as _remote
+
+        if _remote(out):
+            # a remote model root is a URL: deriving checkpoints/ under it
+            # would write a literal `http:/...` tree into the cwd. Keep
+            # periodic saves on fast local disk; the final publish uploads.
+            import hashlib
+            import tempfile
+
+            ckpt_dir = os.path.join(
+                tempfile.gettempdir(),
+                "kubedl-ckpt-" + hashlib.sha256(out.encode()).hexdigest()[:16],
+            )
+        else:
+            ckpt_dir = os.path.join(out, "checkpoints")
 
     # restore-from-latest: a gang restart resumes instead of retraining.
     # The fresh init doubles as the restore template (shardings/structure)
@@ -283,12 +297,27 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
     LAST_SUMMARY = summary
     print(json.dumps({"worker_summary": summary}), flush=True)
 
-    if out and os.path.abspath(ckpt_dir or "") != os.path.abspath(out):
-        # publish the final state at the model-path root — serving and the
-        # ModelVersion build read `latest` from there, not from checkpoints/
+    if out:
+        from kubedl_tpu.remote.client import is_remote_root, upload_tree
         from kubedl_tpu.training.checkpoint import save_checkpoint
 
-        save_checkpoint(out, state, int(jax.device_get(state["step"])))
+        step = int(jax.device_get(state["step"]))
+        if is_remote_root(out):
+            # a remote model root is a URL, not a directory: saving onto it
+            # directly would create a literal `http:/host/...` tree in the
+            # cwd (the r5 junk-tree bug). Save to a scratch dir and push
+            # through the blob client instead.
+            import tempfile
+
+            with tempfile.TemporaryDirectory(prefix="kubedl-publish-") as tmp:
+                save_checkpoint(tmp, state, step)
+                n = upload_tree(tmp, out)
+                print(f"published {n} blobs to {out}", flush=True)
+        elif os.path.abspath(ckpt_dir or "") != os.path.abspath(out):
+            # publish the final state at the model-path root — serving and
+            # the ModelVersion build read `latest` from there, not from
+            # checkpoints/
+            save_checkpoint(out, state, step)
     return 0
 
 

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Cross-check README headline performance numbers against the committed
+bench artifact (VERDICT r5 #4: the table quoted driver-capture numbers no
+artifact in the repo could reproduce).
+
+Every number in the README performance table must be recomputable from
+`BENCH_r05_builder.json` — the script derives the expected display strings
+from the artifact's `summary{}` (and per-run `targets` medians for the
+serving/long-context rows) and fails if the README does not contain them.
+Run directly (`python scripts/check_readme_numbers.py`) or via tier-1
+(`tests/test_chaos.py::TestReadmeNumbers`).
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+ARTIFACT = "BENCH_r05_builder.json"
+
+
+def _runs_median(runs, *path) -> float:
+    vals = []
+    for r in runs:
+        v = r.get("detail", {})
+        for k in path:
+            v = v.get(k) if isinstance(v, dict) else None
+            if v is None:
+                break
+        if v is not None:
+            vals.append(float(v))
+    if not vals:
+        raise KeyError(f"no run carries {'.'.join(path)}")
+    return statistics.median(vals)
+
+
+def expected_strings(artifact: dict) -> dict:
+    """README display string -> how it was derived (for error messages)."""
+    s = artifact["summary"]
+    runs = artifact["runs"]
+    tgt = ("targets",)
+    out = {
+        f"{round(s['tokens_per_sec_per_chip']['median']):,} tokens/s/chip":
+            "summary.tokens_per_sec_per_chip.median",
+        f"MFU median {s['mfu']['median'] * 100:.1f}%":
+            "summary.mfu.median",
+        f"{_runs_median(runs, 'step_time_ms'):.1f} ms/step":
+            "median of runs[].detail.step_time_ms",
+        f"{s['startup_cold_s']['median']:.1f} s / {s['startup_warm_s']['median']:.1f} s":
+            "summary.startup_cold_s/startup_warm_s medians",
+        f"MFU median {s['long_context_mfu']['median'] * 100:.1f}%":
+            "summary.long_context_mfu.median",
+        f"{_runs_median(runs, *tgt, 'long_context', 'tokens_per_sec_per_chip') / 1000:.1f}k tokens/s/chip":
+            "median of runs[].targets.long_context.tokens_per_sec_per_chip",
+        # serving decode medians (bf16 -> int8), tokens/s
+        "{:.0f}/{:.0f} -> {:.0f}/{:.0f}".format(
+            _runs_median(runs, *tgt, "serving", "decode_tokens_per_sec_b1"),
+            _runs_median(runs, *tgt, "serving", "decode_tokens_per_sec_b8"),
+            _runs_median(runs, *tgt, "serving", "decode_tokens_per_sec_b1_int8"),
+            _runs_median(runs, *tgt, "serving", "decode_tokens_per_sec_b8_int8"),
+        ): "medians of runs[].targets.serving.decode_tokens_per_sec_*",
+        # serving-engine medians (only runs that carry the target)
+        f"{_runs_median(runs, *tgt, 'serving_engine', 'engine_decode_ms_per_token_b1'):.2f} ms/token":
+            "median of runs[].targets.serving_engine.engine_decode_ms_per_token_b1",
+        f"+{_runs_median(runs, *tgt, 'serving_engine', 'engine_overhead_vs_raw_b1_pct'):.1f}% over raw":
+            "median of runs[].targets.serving_engine.engine_overhead_vs_raw_b1_pct",
+        f"TTFT median {_runs_median(runs, *tgt, 'serving_engine', 'engine_ttft_64_prompt_ms'):.1f} ms":
+            "median of runs[].targets.serving_engine.engine_ttft_64_prompt_ms",
+    }
+    return out
+
+
+def check(repo: Path = REPO) -> list:
+    """Returns a list of mismatch descriptions (empty = README is clean)."""
+    artifact = json.loads((repo / ARTIFACT).read_text())
+    readme = (repo / "README.md").read_text()
+    problems = []
+    for text, derivation in expected_strings(artifact).items():
+        if text not in readme:
+            problems.append(
+                f"README.md is missing {text!r} (derived from {derivation})"
+            )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"MISMATCH: {p}", file=sys.stderr)
+    if problems:
+        print(
+            f"{len(problems)} README number(s) not derivable from {ARTIFACT}; "
+            "update the table or the derivation",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"README headline numbers match {ARTIFACT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,756 @@
+"""Chaos suite (tier-1): deterministic seeded fault injection across the
+control plane + the unified retry/degradation policy (docs/robustness.md).
+
+Invariants asserted here:
+- same seed => identical fault trace (schedules are deterministic);
+- a disarmed `chaos.check()` is unmeasurable overhead on hot paths;
+- injected faults at every wired site degrade along the designed path
+  (retry, requeue, eviction, gang restart, shed) — never a wedged job:
+  anything submitted reaches a terminal phase and restart counts match
+  the plan's injected fault count;
+- a poison-pill job quarantines exactly once (condition + metric + event)
+  instead of hot-looping the workqueue;
+- serving under overload sheds boundedly (503 + counter) and stays live;
+- a torn checkpoint save falls back to the previous good step;
+- the README performance table stays derivable from the committed bench
+  artifact, and the r5 `http:/` junk tree never reappears.
+"""
+
+import json
+import os
+import time
+from pathlib import Path
+
+import pytest
+
+from kubedl_tpu import chaos
+from kubedl_tpu.chaos import (
+    FaultInjected,
+    FaultPlan,
+    FaultSpec,
+    RetryBudgetExhausted,
+    RetryPolicy,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """No plan leaks across tests — chaos is process-global state."""
+    chaos.disarm()
+    yield
+    chaos.disarm()
+
+
+# --------------------------------------------------------------------------
+# FaultPlan schedules
+# --------------------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_nth_fails_exactly_the_nth_call(self):
+        with FaultPlan(1, sites={"s": [FaultSpec.nth(3)]}) as plan:
+            chaos.check("s")
+            chaos.check("s")
+            with pytest.raises(FaultInjected):
+                chaos.check("s")
+            chaos.check("s")
+        assert plan.faults("s") == 1
+        assert plan.calls("s") == 4
+
+    def test_first_k_then_clean(self):
+        with FaultPlan(1, sites={"s": [FaultSpec.first(2)]}) as plan:
+            for _ in range(2):
+                with pytest.raises(FaultInjected):
+                    chaos.check("s")
+            chaos.check("s")
+        assert plan.faults("s") == 2
+
+    def test_always_is_a_poison_pill(self):
+        with FaultPlan(1, sites={"s": [FaultSpec.always()]}):
+            for _ in range(5):
+                with pytest.raises(FaultInjected):
+                    chaos.check("s")
+
+    def test_prob_fails_a_seeded_subset(self):
+        with FaultPlan(42, sites={"s": [FaultSpec.prob(0.5, 40)]}) as plan:
+            for _ in range(40):
+                try:
+                    chaos.check("s")
+                except FaultInjected:
+                    pass
+        assert 0 < plan.faults("s") < 40
+
+    def test_latency_spike_sleeps_instead_of_raising(self):
+        naps = []
+        plan = FaultPlan(1, sites={"s": [FaultSpec.latency(50.0, every=2)]},
+                         sleep=naps.append)
+        with plan:
+            chaos.check("s")       # call 1: pass
+            chaos.check("s")       # call 2: spike, no exception
+            assert chaos.should_fail("s") is False  # call 3: pass
+        assert naps == [0.05]
+
+    def test_custom_exception_factory(self):
+        class Boom(Exception):
+            pass
+
+        with FaultPlan(1, sites={"s": [FaultSpec.nth(1, exc=Boom)]}):
+            with pytest.raises(Boom):
+                chaos.check("s")
+
+    def test_unknown_site_passes_and_is_counted(self):
+        with FaultPlan(1, sites={"s": [FaultSpec.always()]}) as plan:
+            chaos.check("other")
+        assert plan.calls("other") == 1
+        assert plan.faults("other") == 0
+
+    def test_context_manager_disarms(self):
+        plan = FaultPlan(1)
+        with plan:
+            assert chaos.active() is plan
+        assert chaos.active() is None
+        chaos.check("anything")  # disarmed: no-op
+
+
+class TestDeterminism:
+    SITES = {
+        "a.site": [FaultSpec.prob(0.5, 30)],
+        "b.site": [FaultSpec.prob(0.3, 40), FaultSpec.latency(1.0, every=7)],
+    }
+
+    def _drive(self, plan):
+        with plan:
+            for _ in range(40):
+                for site in ("a.site", "b.site"):
+                    try:
+                        chaos.check(site)
+                    except FaultInjected:
+                        pass
+        return plan.trace_tuples()
+
+    def test_same_seed_identical_trace(self):
+        naps = lambda _: None
+        t1 = self._drive(FaultPlan(7, sites=self.SITES, sleep=naps))
+        t2 = self._drive(FaultPlan(7, sites=self.SITES, sleep=naps))
+        assert t1 == t2
+        assert any(a == "fault" for _, _, a in t1)
+
+    def test_different_seed_different_trace(self):
+        naps = lambda _: None
+        t1 = self._drive(FaultPlan(7, sites=self.SITES, sleep=naps))
+        t2 = self._drive(FaultPlan(8, sites=self.SITES, sleep=naps))
+        assert t1 != t2
+
+    def test_per_site_rng_isolated(self):
+        """Adding a site must not perturb another site's schedule — the
+        RNG is derived from (seed, site), not shared."""
+        base = FaultPlan(7, sites={"a.site": [FaultSpec.prob(0.5, 30)]})
+        with base:
+            for _ in range(30):
+                try:
+                    chaos.check("a.site")
+                except FaultInjected:
+                    pass
+        grown = FaultPlan(7, sites={"a.site": [FaultSpec.prob(0.5, 30)],
+                                    "z.site": [FaultSpec.prob(0.9, 30)]})
+        with grown:
+            for _ in range(30):
+                try:
+                    chaos.check("z.site")
+                except FaultInjected:
+                    pass
+                try:
+                    chaos.check("a.site")
+                except FaultInjected:
+                    pass
+        a_of = lambda t: [x for x in t if x[0] == "a.site"]
+        assert a_of(base.trace_tuples()) == a_of(grown.trace_tuples())
+
+    def test_disarmed_check_overhead_unmeasurable(self):
+        """The default-off fast path is one global load + None test; a
+        generous absolute bound (5us/call — ~50x the expected cost) keeps
+        this stable on slow CI while still catching an accidental lock,
+        dict lookup, or allocation on the disarmed path."""
+        n = 200_000
+        check = chaos.check
+        t0 = time.perf_counter()
+        for _ in range(n):
+            check("store.update")
+        per_call = (time.perf_counter() - t0) / n
+        assert per_call < 5e-6, f"disarmed chaos.check costs {per_call * 1e9:.0f}ns/call"
+
+
+# --------------------------------------------------------------------------
+# RetryPolicy
+# --------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_backoff_full_jitter_bounds(self):
+        p = RetryPolicy(base_delay=0.1, max_delay=1.0)
+        for attempt in range(8):
+            cap = min(1.0, 0.1 * 2 ** attempt)
+            for _ in range(20):
+                d = p.backoff(attempt)
+                assert 0.0 <= d <= cap
+
+    def test_retries_then_succeeds(self):
+        naps = []
+        p = RetryPolicy(max_attempts=5, base_delay=0.01, sleep=naps.append)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ValueError("transient")
+            return "ok"
+
+        assert p.call(flaky, retry_on=(ValueError,)) == "ok"
+        assert calls["n"] == 3
+        assert p.retries == 2
+        assert len(naps) <= 2  # zero-jitter draws skip the sleep
+
+    def test_giveup_surfaces_immediately(self):
+        p = RetryPolicy(max_attempts=5, sleep=lambda _: None)
+        calls = {"n": 0}
+
+        def permanent():
+            calls["n"] += 1
+            raise ValueError("permanent")
+
+        with pytest.raises(ValueError):
+            p.call(permanent, retry_on=(ValueError,), giveup=lambda e: True)
+        assert calls["n"] == 1
+
+    def test_exhausted_attempts_raise_last_error(self):
+        p = RetryPolicy(max_attempts=3, sleep=lambda _: None)
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise ValueError(f"try {calls['n']}")
+
+        with pytest.raises(ValueError, match="try 3"):
+            p.call(always, retry_on=(ValueError,))
+        assert calls["n"] == 3
+
+    def test_unlisted_exception_not_retried(self):
+        p = RetryPolicy(max_attempts=5, sleep=lambda _: None)
+        calls = {"n": 0}
+
+        def wrong():
+            calls["n"] += 1
+            raise KeyError("not transient")
+
+        with pytest.raises(KeyError):
+            p.call(wrong, retry_on=(ValueError,))
+        assert calls["n"] == 1
+
+    def test_budget_exhaustion_chains_last_error(self):
+        # rng pinned to the cap so every retry spends a full base_delay
+        p = RetryPolicy(max_attempts=50, base_delay=1.0, max_delay=1.0,
+                        budget_s=2.5, rng=lambda a, b: b, sleep=lambda _: None)
+
+        def always():
+            raise ValueError("still down")
+
+        with pytest.raises(RetryBudgetExhausted) as ei:
+            p.call(always, retry_on=(ValueError,))
+        assert isinstance(ei.value.__cause__, ValueError)
+        assert p.budget_remaining() == 0.0
+
+
+# --------------------------------------------------------------------------
+# Wired sites: store, heartbeat, gang bind, client, remote blobs
+# --------------------------------------------------------------------------
+
+
+class TestStoreSite:
+    def test_injected_create_fault_then_clean(self):
+        from kubedl_tpu.core.store import ObjectStore
+
+        from tests.helpers import make_tpujob
+
+        store = ObjectStore()
+        with FaultPlan(1, sites={"store.create": [FaultSpec.nth(1)]}) as plan:
+            with pytest.raises(FaultInjected):
+                store.create(make_tpujob("x"))
+            store.create(make_tpujob("x"))
+        assert plan.faults("store.create") == 1
+        assert store.get("TPUJob", "x") is not None
+
+    def test_update_with_retry_rides_policy_over_injected_conflicts(self):
+        from kubedl_tpu.core.store import Conflict, ObjectStore
+
+        from tests.helpers import make_tpujob
+
+        store = ObjectStore()
+        store.create(make_tpujob("x"))
+        spec = FaultSpec.first(2, exc=lambda s: Conflict(f"injected at {s}"))
+        with FaultPlan(1, sites={"store.update": [spec]}) as plan:
+            got = store.update_with_retry(
+                "TPUJob", "x", "default",
+                lambda o: o.metadata.labels.update({"touched": "yes"}),
+            )
+        assert got.metadata.labels["touched"] == "yes"
+        assert plan.faults("store.update") == 2
+
+    def test_update_with_retry_gives_up_after_attempts(self):
+        from kubedl_tpu.core.store import Conflict, ObjectStore
+
+        from tests.helpers import make_tpujob
+
+        store = ObjectStore()
+        store.create(make_tpujob("x"))
+        spec = FaultSpec.always(exc=lambda s: Conflict(s))
+        with FaultPlan(1, sites={"store.update": [spec]}):
+            with pytest.raises(Conflict):
+                store.update_with_retry("TPUJob", "x", "default",
+                                        lambda o: None, attempts=3)
+
+
+class TestHeartbeatSite:
+    def test_injected_heartbeat_loss_evicts_then_recovers(self):
+        from kubedl_tpu.core.nodes import (
+            EVICT_EXIT_CODE, NODE_NAMESPACE, NodeHeartbeater,
+            NodeLifecycleController,
+        )
+        from kubedl_tpu.core.objects import Container, Pod, PodPhase
+        from kubedl_tpu.core.store import ObjectStore
+
+        store = ObjectStore()
+        t = {"now": 1000.0}
+        hb = NodeHeartbeater(store, ["nodeA"], clock=lambda: t["now"])
+        ctrl = NodeLifecycleController(store, grace=10.0, clock=lambda: t["now"])
+        hb.beat_once()
+        p = Pod()
+        p.metadata.name = "p1"
+        p.spec.containers.append(Container())
+        p.spec.node_name = "nodeA"
+        p.status.phase = PodPhase.RUNNING
+        store.create(p)
+        ctrl.reconcile(NODE_NAMESPACE, "nodeA")  # observe the heartbeat
+
+        with FaultPlan(5, sites={"node.heartbeat": [FaultSpec.first(2)]}) as plan:
+            t["now"] = 1005.0
+            hb.beat_once()  # skipped (injected miss 1)
+            t["now"] = 1011.0
+            hb.beat_once()  # skipped (injected miss 2) — now past grace
+            ctrl.reconcile(NODE_NAMESPACE, "nodeA")
+            node = store.get("Node", "nodeA", NODE_NAMESPACE)
+            assert not node.ready
+            got = store.get("Pod", "p1")
+            assert got.status.phase == PodPhase.FAILED
+            assert got.status.container_statuses[0].exit_code == EVICT_EXIT_CODE
+            assert plan.faults("node.heartbeat") == 2
+            hb.beat_once()  # plan spent: the kubelet comes back
+            assert store.get("Node", "nodeA", NODE_NAMESPACE).ready
+
+
+class TestGangBindSite:
+    def test_injected_bind_rejection_queues_then_admits(self):
+        from kubedl_tpu.api.types import JobConditionType
+
+        from tests.helpers import make_tpujob
+        from tests.test_engine import make_engine
+
+        engine, store, _ = make_engine()
+        job = make_tpujob("gangy", workers=1)
+        store.create(job)
+        with FaultPlan(3, sites={"gang.bind": [FaultSpec.first(2)]}) as plan:
+            engine.reconcile("default", "gangy")
+            assert store.list("Pod") == []
+            assert (store.get("TPUJob", "gangy").status.phase
+                    == JobConditionType.QUEUED)
+            for _ in range(4):  # requeues re-admit until the plan is spent
+                engine.reconcile("default", "gangy")
+                if store.list("Pod"):
+                    break
+            assert store.list("Pod"), "bind never recovered after injected rejections"
+            assert plan.faults("gang.bind") == 2
+
+
+class TestClientTransportSite:
+    def _client(self, once):
+        from kubedl_tpu.client.http import KubeDLClient
+
+        c = KubeDLClient("http://127.0.0.1:1")  # never actually dialed
+        c._call_once = once
+        return c
+
+    def test_injected_transport_fault_is_retried(self):
+        calls = []
+
+        def once(method, path, body=None):
+            calls.append(path)
+            chaos.check("client.http")
+            return {"ok": True}
+
+        c = self._client(once)
+        with FaultPlan(1, sites={"client.http": [FaultSpec.nth(1)]}):
+            assert c._call("GET", "/x") == {"ok": True}
+        assert len(calls) == 2
+
+    def test_4xx_is_permanent_no_retry(self):
+        from kubedl_tpu.client.base import ApiException
+
+        calls = []
+
+        def once(method, path, body=None):
+            calls.append(path)
+            raise ApiException(404, "nope")
+
+        c = self._client(once)
+        with pytest.raises(ApiException):
+            c._call("GET", "/x")
+        assert len(calls) == 1
+
+    def test_5xx_retries_to_attempt_cap(self):
+        from kubedl_tpu.client.base import ApiException
+
+        calls = []
+
+        def once(method, path, body=None):
+            calls.append(path)
+            raise ApiException(503, "overloaded")
+
+        c = self._client(once)
+        with pytest.raises(ApiException):
+            c._call("GET", "/x")
+        assert len(calls) == 4  # the transport policy's max_attempts
+
+
+class TestRemoteBlobSite:
+    def test_blob_fetch_retries_through_injected_faults(self, tmp_path):
+        from kubedl_tpu.remote import RemoteStoreServer, get_blob, put_blob
+
+        with RemoteStoreServer(str(tmp_path / "root")) as srv:
+            put_blob(srv.base_url, "m/w.bin", b"weights")
+            with FaultPlan(2, sites={"remote.request": [FaultSpec.first(2)]}) as plan:
+                assert get_blob(srv.base_url, "m/w.bin") == b"weights"
+            assert plan.faults("remote.request") == 2
+
+    def test_blob_fetch_gives_up_on_poison(self, tmp_path):
+        from kubedl_tpu.remote import RemoteStoreServer, get_blob
+
+        with RemoteStoreServer(str(tmp_path / "root")) as srv:
+            with FaultPlan(2, sites={"remote.request": [FaultSpec.always()]}):
+                with pytest.raises(FaultInjected):
+                    get_blob(srv.base_url, "m/w.bin")
+
+
+# --------------------------------------------------------------------------
+# Poison-pill quarantine
+# --------------------------------------------------------------------------
+
+
+class TestQuarantine:
+    def test_poison_job_quarantines_exactly_once(self):
+        from kubedl_tpu.api.types import JobConditionType
+
+        from tests.helpers import make_tpujob
+        from tests.test_engine import make_engine
+
+        engine, store, metrics = make_engine()
+        job = make_tpujob("poison", workers=1)
+        store.create(job)
+        engine.reconcile("default", "poison")  # healthy pass creates pods
+        assert store.list("Pod")
+
+        def bad(job):
+            raise RuntimeError("poison pill")
+
+        engine.reconcile_job = bad
+        engine.quarantine_budget = 3
+        # under budget: the exception propagates (workqueue requeues it)
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                engine.reconcile("default", "poison")
+        # at budget: swallowed, parked — the workqueue forgets the key
+        assert engine.reconcile("default", "poison") is None
+
+        got = store.get("TPUJob", "poison")
+        assert got.status.phase == JobConditionType.QUARANTINED
+        cond = got.status.conditions[-1]
+        assert cond.reason == "ReconcileBudgetExhausted"
+        assert store.list("Pod") == []  # torn down, slices freed
+        assert metrics.quarantined.value(kind="TPUJob") == 1.0
+        assert any(e.reason == "Quarantined" for e in store.list("Event", None))
+        assert "kubedl_tpu_jobs_quarantined" in metrics.registry.render()
+        # parked means parked: further triggers no-op, the counter stays 1
+        assert engine.reconcile("default", "poison") is None
+        assert metrics.quarantined.value(kind="TPUJob") == 1.0
+
+    def test_transient_failures_below_budget_never_quarantine(self):
+        from tests.helpers import make_tpujob
+        from tests.test_engine import make_engine
+
+        engine, store, metrics = make_engine()
+        job = make_tpujob("flaky", workers=1)
+        store.create(job)
+        engine.quarantine_budget = 3
+        real = engine.reconcile_job
+        state = {"n": 0}
+
+        def sometimes(job):
+            state["n"] += 1
+            if state["n"] % 2 == 1:  # never 3 consecutive failures
+                raise RuntimeError("transient")
+            return real(job)
+
+        engine.reconcile_job = sometimes
+        for _ in range(6):
+            try:
+                engine.reconcile("default", "flaky")
+            except RuntimeError:
+                pass
+        assert metrics.quarantined.value(kind="TPUJob") == 0.0
+        assert store.list("Pod")  # the healthy passes did their work
+
+
+# --------------------------------------------------------------------------
+# Serving: load shedding + injected device fault recovery
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="class")
+def tiny_engine():
+    from kubedl_tpu.serving.server import LlamaEngine
+
+    eng = LlamaEngine(preset="tiny", max_batch=2, max_seq=64,
+                      max_queue_depth=2)
+    yield eng
+    eng.close()
+
+
+class TestServingChaos:
+    def test_load_shedding_bounded_and_observable(self, tiny_engine):
+        import threading
+
+        from kubedl_tpu.serving.server import EngineOverloaded
+
+        eng = tiny_engine
+        n = 12
+        results = [None] * n
+        barrier = threading.Barrier(n)
+
+        def worker(i):
+            barrier.wait()
+            try:
+                results[i] = eng.generate([i + 1], max_tokens=40)
+            except EngineOverloaded as e:
+                results[i] = ("shed", e.retry_after_s)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        completed = [r for r in results if isinstance(r, dict)]
+        sheds = [r for r in results if isinstance(r, tuple)]
+        assert all(r is not None for r in results)
+        # conservation: every request either served or shed, nothing lost
+        assert len(completed) + len(sheds) == n
+        assert len(completed) >= 1  # shedding is bounded: the engine serves
+        assert sheds, "burst of 12 against depth budget 2 never shed"
+        assert all(retry >= 1.0 for _, retry in sheds)
+        stats = eng.stats()
+        assert stats["shed"] == len(sheds)
+        assert stats["shed_recent"] == len(sheds)
+        # the counter is on /metrics (predictor pods export this registry)
+        rendered = eng.metrics.registry.render()
+        assert "kubedl_tpu_serving_shed_requests" in rendered
+        assert eng.metrics.shed_requests.value() == float(len(sheds))
+        # still live after the storm
+        again = eng.generate([7], max_tokens=3)
+        assert len(again["token_ids"]) == 3
+
+    def test_autoscaler_folds_shed_into_backlog(self):
+        """A replica answering 503s is saturated even when its queue reads
+        shallow — shed_recent must veto scale-down exactly like queued."""
+        from kubedl_tpu.core.objects import PodPhase
+        from kubedl_tpu.core.store import ObjectStore
+        from kubedl_tpu.lineage.types import ModelVersion, ModelVersionPhase
+        from kubedl_tpu.serving.controller import InferenceController
+        from kubedl_tpu.serving.types import AutoScaleSpec, Inference, Predictor
+
+        store = ObjectStore()
+        mv = ModelVersion(model_name="m", phase=ModelVersionPhase.SUCCEEDED)
+        mv.metadata.name = "m-v1"
+        store.create(mv)
+        load = {"qps": 35.0, "queued": 0, "shed_recent": 0}
+        t = {"now": 0.0}
+        ctrl = InferenceController(store, local_addresses=True,
+                                   qps_probe=lambda pod: dict(load),
+                                   clock=lambda: t["now"])
+        inf = Inference()
+        inf.metadata.name = "shedsvc"
+        inf.predictors.append(Predictor(
+            name="main", model_version="m-v1", replicas=1,
+            autoscale=AutoScaleSpec(min_replicas=1, max_replicas=4,
+                                    target_qps=10.0)))
+        store.create(inf)
+
+        def run_pods():
+            for p in store.list("Pod"):
+                if p.status.phase != PodPhase.RUNNING:
+                    def mut(o):
+                        o.status.phase = PodPhase.RUNNING
+                    store.update_with_retry("Pod", p.metadata.name,
+                                            "default", mut)
+
+        ctrl.reconcile("default", "shedsvc")
+        run_pods()
+        ctrl.reconcile("default", "shedsvc")
+        assert len(store.list("Pod")) == 4  # scale-up on load
+        run_pods()
+        # completion QPS collapses because requests are being SHED, not
+        # served — the shed count must veto the scale-down
+        load.update(qps=1.0, shed_recent=6)
+        t["now"] += 120.0
+        ctrl.reconcile("default", "shedsvc")
+        assert len(store.list("Pod")) == 4
+        # shedding stops -> scale-down proceeds
+        load.update(shed_recent=0)
+        t["now"] += 120.0
+        ctrl.reconcile("default", "shedsvc")
+        assert len(store.list("Pod")) == 1
+
+    def test_injected_dispatch_fault_fails_fast_and_recovers(self, tiny_engine):
+        eng = tiny_engine
+        before = eng.metrics.scheduler_errors.value()
+        with FaultPlan(4, sites={"serving.dispatch": [FaultSpec.nth(1)]}) as plan:
+            hit = eng.generate([3, 1], max_tokens=6)
+        assert plan.faults("serving.dispatch") == 1
+        assert "error" in hit  # the in-flight request failed loudly...
+        assert eng.metrics.scheduler_errors.value() == before + 1
+        # ...and the engine rebuilt its donated cache and kept serving
+        ok = eng.generate([3, 1], max_tokens=6)
+        assert len(ok["token_ids"]) == 6
+
+
+# --------------------------------------------------------------------------
+# Torn checkpoint save
+# --------------------------------------------------------------------------
+
+
+class TestTornCheckpoint:
+    def test_torn_save_falls_back_to_previous_good_step(self, tmp_path):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kubedl_tpu.training.checkpoint import (
+            latest_step, restore_checkpoint, save_checkpoint,
+        )
+
+        d = str(tmp_path / "ckpt")
+        good = {"step": jnp.asarray(1), "w": jnp.arange(8.0)}
+        save_checkpoint(d, good, 1)
+        newer = {"step": jnp.asarray(2), "w": jnp.arange(8.0) * 2}
+        with FaultPlan(9, sites={"checkpoint.torn": [FaultSpec.nth(1)]}):
+            with pytest.raises(FaultInjected):
+                save_checkpoint(d, newer, 2)  # dies after shards, before meta
+        # the torn step-2 dir exists but is not the latest good save
+        assert (tmp_path / "ckpt" / "step-00000002").is_dir()
+        assert latest_step(d) == 1
+        like = {"step": jnp.asarray(0), "w": jnp.zeros(8)}
+        restored = restore_checkpoint(d, like)
+        assert restored is not None
+        assert int(restored["step"]) == 1
+        np.testing.assert_allclose(np.asarray(restored["w"]), np.arange(8.0))
+
+
+# --------------------------------------------------------------------------
+# End-to-end: chaos plan through the full operator
+# --------------------------------------------------------------------------
+
+
+def _flaky_worker(env):
+    """ThreadRuntime entrypoint that crashes retryably when the armed plan
+    schedules a fault at the test-local ``worker.crash`` site."""
+    from kubedl_tpu import chaos as _chaos
+
+    if _chaos.should_fail("worker.crash"):
+        raise SystemExit(137)  # retryable: gang restart
+    return 0
+
+
+class TestChaosE2E:
+    def test_restart_count_matches_plan_and_job_terminates(self, tmp_path):
+        from kubedl_tpu.api.types import JobConditionType
+        from kubedl_tpu.operator import Operator, OperatorOptions
+        from kubedl_tpu.runtime.executor import ThreadRuntime
+
+        from tests.helpers import make_tpujob
+
+        opts = OperatorOptions(
+            local_addresses=True,
+            artifact_registry_root=str(tmp_path / "reg"),
+        )
+        plan = FaultPlan(11, sites={"worker.crash": [FaultSpec.first(2)]})
+        with plan, Operator(opts, runtime=ThreadRuntime()) as op:
+            job = make_tpujob("chaosjob", workers=1,
+                              entrypoint=f"{__name__}:_flaky_worker")
+            op.submit(job)
+            got = op.wait_for_phase(
+                "TPUJob", "chaosjob",
+                [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+                timeout=60,
+            )
+            # invariant: the job is terminal, not wedged mid-restart
+            assert got.status.phase == JobConditionType.SUCCEEDED
+            # invariant: observed restarts == the plan's injected crashes
+            assert plan.faults("worker.crash") == 2
+            assert got.status.restart_count == 2
+
+
+# --------------------------------------------------------------------------
+# Repo hygiene riders (r5 VERDICT satellites)
+# --------------------------------------------------------------------------
+
+
+class TestRepoHygiene:
+    def test_no_http_junk_tree_in_repo(self):
+        """r5 regression (commit 8a8bcf5): the remote e2e's unguarded final
+        publish wrote a literal `http:/host/...` tree into the repo cwd and
+        it got committed. The entry publish is now guarded
+        (training/entry.py) — the tree must never exist again."""
+        junk = [p.name for p in REPO.iterdir()
+                if p.name.startswith("http:") or p.name.startswith("https:")]
+        assert junk == [], f"committed URL-as-path junk tree resurfaced: {junk}"
+
+    def test_remote_publish_guard_uploads_instead_of_mkdir(self, tmp_path,
+                                                          monkeypatch):
+        """The guard itself: train_main with a REMOTE model root must push
+        the final checkpoint through the blob client — never save onto the
+        URL as if it were a directory (which recreates the junk tree)."""
+        from kubedl_tpu.remote import RemoteStoreServer, list_blobs
+        from kubedl_tpu.training.entry import train_main
+
+        monkeypatch.chdir(tmp_path)  # any junk tree would land here
+        with RemoteStoreServer(str(tmp_path / "blob-root")) as srv:
+            remote_root = f"{srv.base_url}/blobs/models/guard"
+            monkeypatch.setenv("KUBEDL_MODEL_PATH", remote_root)
+            monkeypatch.setenv("KUBEDL_TRAIN_CONFIG", json.dumps(
+                {"model": "tiny", "steps": 2, "global_batch": 8,
+                 "seq_len": 16, "ckpt_every": 1}
+            ))
+            assert train_main() == 0
+            blobs = list_blobs(srv.base_url, "models/guard")
+            assert any(b.endswith("latest") for b in blobs), blobs
+            assert any("shards-p0" in b for b in blobs), blobs
+        junk = [p for p in os.listdir(tmp_path) if p.startswith("http:")]
+        assert junk == [], f"publish created URL-as-path dirs: {junk}"
+
+    def test_readme_numbers_derivable_from_bench_artifact(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "check_readme_numbers",
+            REPO / "scripts" / "check_readme_numbers.py",
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.check() == []

@@ -9,6 +9,7 @@ event rows through the same server.
 """
 
 import json
+import os
 import time
 
 import pytest
@@ -212,6 +213,15 @@ class TestRemoteE2E:
                 assert pods and pods[0].name == "rtrain-worker-0"
                 evs = srv.backend.list_events("TPUJob", "rtrain")
                 assert any(e.reason for e in evs)
+
+        # r5 regression (commit 8a8bcf5): this exact flow once wrote a
+        # literal `http:/host/...` tree into the process cwd because the
+        # final publish treated the remote model root as a directory. The
+        # entry publish is now guarded (training/entry.py is_remote_root);
+        # assert the junk tree can never come back.
+        junk = [p for p in os.listdir(".")
+                if p.startswith("http:") or p.startswith("https:")]
+        assert junk == [], f"remote e2e recreated URL-as-path dirs in cwd: {junk}"
 
 
 class TestBlobEdgeCases:

@@ -727,13 +727,18 @@ class TestShardedServing:
 
         kw = engine_kwargs(
             {"preset": "tiny", "mesh": {"tensor": 2}, "quantize": "int8",
-             "max_batch": 3},
+             "max_batch": 3, "max_queue_depth": 8, "max_queue_age_s": 5.0},
             "/ckpts/m",
         )
         assert kw == {"preset": "tiny", "ckpt_dir": "/ckpts/m",
                       "max_batch": 3, "quantize": "int8",
-                      "mesh_axes": {"tensor": 2}}
-        assert engine_kwargs({}, "")["mesh_axes"] is None
+                      "mesh_axes": {"tensor": 2},
+                      "max_queue_depth": 8, "max_queue_age_s": 5.0}
+        defaults = engine_kwargs({}, "")
+        assert defaults["mesh_axes"] is None
+        # load-shedding budget defaults ride the config too
+        assert defaults["max_queue_depth"] == 64
+        assert defaults["max_queue_age_s"] == 30.0
 
 
 class TestSegmentPolicy:
