@@ -31,6 +31,10 @@ ANNOTATION_NETWORK_MODE = API_GROUP + "/network-mode"
 ANNOTATION_TENANCY = API_GROUP + "/tenancy"
 ANNOTATION_OWNER = API_GROUP + "/owner"  # reference: tenancy.go:25-43 user field
 ANNOTATION_PROFILER_CONFIG = API_GROUP + "/profiler-config"  # TPU addition
+#: world size (total processes) the job was SUBMITTED with — stamped once
+#: at first defaulting and stable across elastic resizes, so workers can
+#: rescale gradient accumulation to preserve the effective global batch
+ANNOTATION_ELASTIC_BASE_WORLD = API_GROUP + "/elastic-base-world"
 
 NETWORK_MODE_HOST = "host"
 
@@ -47,6 +51,15 @@ ENV_MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"  # multislice DCN
 ENV_MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
 ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
 ENV_MESH_AXES = "KUBEDL_MESH_AXES"  # logical mesh hint, e.g. "data=4,model=8"
+
+# Elastic slice scaling (kubedl_tpu/elastic/): the base world size rides
+# every elastic worker's env so entry.py can rescale grad accumulation
+# (effective global batch is preserved across resizes); min/max ride the
+# ElasticDLJob master's env (the reference's master scales its own workers).
+ENV_ELASTIC_BASE_WORLD = "KUBEDL_ELASTIC_BASE_WORLD"
+ENV_ELASTIC_MIN_SLICES = "KUBEDL_ELASTIC_MIN_SLICES"
+ENV_ELASTIC_MAX_SLICES = "KUBEDL_ELASTIC_MAX_SLICES"
+ENV_ELASTIC_NUM_SLICES = "KUBEDL_ELASTIC_NUM_SLICES"
 
 # Model-output convention (reference: apis/model/v1alpha1/
 # modelversion_types.go:23-33 — KUBEDL_MODEL_PATH + /kubedl-model):

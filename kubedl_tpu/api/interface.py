@@ -117,6 +117,31 @@ class WorkloadController:
             errs.append("runPolicy.ttlSecondsAfterFinished must be >= 0")
         return errs
 
+    # ---- elastic slice scaling (kubedl_tpu/elastic/) ---------------------
+
+    def elastic_range(self, job: JobObject) -> Optional[tuple]:
+        """``(min_slices, max_slices)`` when this job opted into elastic
+        scaling; None (the default) = fixed-size, the ElasticPolicy leaves
+        it alone."""
+        return None
+
+    def get_num_slices(self, job: JobObject) -> int:
+        """Current desired slice count in the job's spec."""
+        return 1
+
+    def elastic_cooldown(self, job: JobObject) -> Optional[float]:
+        """Per-job override of the grow-cooldown window (seconds); None
+        uses the operator-wide default (OperatorOptions
+        .elastic_cooldown_seconds)."""
+        return None
+
+    def set_num_slices(self, job: JobObject, n: int) -> None:
+        """Write a new desired slice count onto the job's spec (the engine
+        detects the demand change and executes the resize protocol)."""
+        raise NotImplementedError(
+            f"{self.KIND} does not support elastic resize"
+        )
+
     # ---- topology / ordering --------------------------------------------
 
     def reconcile_orders(self) -> List[ReplicaType]:

@@ -95,6 +95,11 @@ class JobConditionType(str, enum.Enum):
     QUEUED = "Queued"  # TPU addition: gang admitted, waiting for slice
     RUNNING = "Running"
     RESTARTING = "Restarting"
+    #: TPU addition (elastic slice scaling): the gang was resized IN PLACE
+    #: (partial slice release/reserve, kubedl_tpu/elastic/) and replicas
+    #: are restarting from checkpoint at the new world size — unlike
+    #: RESTARTING, the job never released its remaining slices.
+    RESIZING = "Resizing"
     #: TPU addition (kueue-style): pods torn down, slices FREED, progress
     #: kept via checkpoints; unsuspending re-admits and resumes
     SUSPENDED = "Suspended"
@@ -142,6 +147,36 @@ class SchedulingPolicy:
     min_available: Optional[int] = None
     queue: str = "default"
     priority: int = 0
+
+
+@dataclass
+class ElasticSpec:
+    """Elastic slice-scaling bounds (kubedl_tpu/elastic/): the gang size
+    becomes a runtime variable in ``[min_slices, max_slices]``. The
+    ElasticPolicy controller shrinks jobs off draining (preemption-noticed)
+    slices and grows them back into free capacity, with ``cooldown_seconds``
+    of hysteresis between voluntary grows (shrinks are urgent and bypass
+    it). Reference analogue: ElasticDL's master-driven worker scaling
+    (controllers/elasticdl/) — TPU-native semantics are whole-gang
+    restart-from-checkpoint at the new shape."""
+
+    min_slices: int = 1
+    max_slices: int = 1
+    #: minimum seconds between voluntary (grow) resizes of one job
+    cooldown_seconds: float = 30.0
+
+    def validate(self, prefix: str = "elastic") -> List[str]:
+        errs: List[str] = []
+        if self.min_slices < 1:
+            errs.append(f"{prefix}.minSlices must be >= 1")
+        if self.max_slices < self.min_slices:
+            errs.append(f"{prefix}.maxSlices must be >= minSlices")
+        if self.cooldown_seconds < 0:
+            errs.append(f"{prefix}.cooldownSeconds must be >= 0")
+        return errs
+
+    def clamp(self, n: int) -> int:
+        return max(self.min_slices, min(n, self.max_slices))
 
 
 @dataclass

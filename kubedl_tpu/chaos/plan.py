@@ -14,6 +14,7 @@ Injection sites wired in this repo::
 
     store.create / store.update / store.delete   ObjectStore writes
     node.heartbeat                               skip a kubelet beat
+    elastic.preempt                              preemption notice on a node
     gang.bind                                    reject a slice reservation
     client.http                                  console client transport
     remote.request                               blob-server transport

@@ -23,7 +23,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from kubedl_tpu import chaos
 from kubedl_tpu.core.manager import ControllerManager, EventRecorder
@@ -59,30 +59,60 @@ class NodeHeartbeater:
         #: (e.g. blocked in a stalled remote-store write) exits on its
         #: next wakeup instead of running beside a newer loop forever
         self._gen = 0
+        #: pending preemption notices, applied by the next beat:
+        #: node name -> reason string, or None for a pending clear
+        #: (a real preemption signal arrives on the HOST, so it is the
+        #: kubelet's heartbeat that publishes it — elastic/preemption.py)
+        self._notices: Dict[str, Optional[str]] = {}
+
+    # -- preemption notices (elastic slice scaling) ---------------------
+
+    def announce_preemption(
+        self, node_name: str, reason: str = "preemption notice"
+    ) -> None:
+        """Queue a preemption/maintenance notice for ``node_name``; the
+        next beat stamps it on the Node object (sticky until cleared)."""
+        self._notices[node_name] = reason
+
+    def clear_preemption(self, node_name: str) -> None:
+        """Queue withdrawal of the notice (capacity returns to service)."""
+        self._notices[node_name] = None
 
     def beat_once(self) -> None:
         now = self.clock()
         for name in self.node_names:
+            if chaos.should_fail("elastic.preempt"):
+                # injected preemption notice → slice drains, job shrinks
+                self._notices[name] = "injected preemption notice"
             if chaos.should_fail("node.heartbeat"):
                 continue  # injected missed beat → lifecycle eviction path
+            notice = self._notices.pop(name, False)
             try:
                 def mutate(obj: Node) -> None:
                     obj.last_heartbeat = now
                     if not obj.ready:
                         obj.ready = True
                         obj.reason = "heartbeat resumed"
+                    if notice is not False:
+                        obj.preempt_at = now if notice is not None else 0.0
+                        obj.preempt_reason = notice or ""
 
                 self.store.update_with_retry("Node", name, NODE_NAMESPACE, mutate)
             except NotFound:
                 node = Node(ready=True, last_heartbeat=now)
+                if notice not in (False, None):
+                    node.preempt_at = now
+                    node.preempt_reason = notice  # type: ignore[assignment]
                 node.metadata.name = name
                 node.metadata.namespace = NODE_NAMESPACE
                 try:
                     self.store.create(node)
                 except AlreadyExists:
-                    pass
+                    if notice is not False:
+                        self._notices.setdefault(name, notice)  # retry next beat
             except Conflict:
-                pass  # next beat wins
+                if notice is not False:
+                    self._notices.setdefault(name, notice)  # next beat wins
 
     def start(self) -> None:
         if not self.node_names:

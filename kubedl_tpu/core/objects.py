@@ -265,6 +265,13 @@ class Node(BaseObject):
     last_heartbeat: float = 0.0
     #: human-readable reason for the current readiness state
     reason: str = ""
+    #: preemption/maintenance notice (elastic slice scaling): nonzero =
+    #: the host has been told it will be reclaimed; published through the
+    #: heartbeat path and sticky until cleared. The node keeps heartbeating
+    #: — a notice is advance warning, not death — but the PreemptionController
+    #: marks its slice draining so jobs vacate before the reclaim lands.
+    preempt_at: float = 0.0
+    preempt_reason: str = ""
 
 
 @dataclass

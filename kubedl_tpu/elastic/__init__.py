@@ -1,0 +1,32 @@
+"""Elastic slice scaling: preemption-aware grow/shrink of training gangs.
+
+Gang size becomes a runtime variable within a job's declared
+``[min_slices, max_slices]``:
+
+- :mod:`kubedl_tpu.elastic.preemption` — nodes publish preemption/
+  maintenance notices through the heartbeat path; the PreemptionController
+  marks victim slices draining in the inventory.
+- :mod:`kubedl_tpu.elastic.policy` — the ElasticPolicy controller watches
+  free capacity + draining notices and writes the desired gang size onto
+  elastic jobs (cooldown/hysteresis on voluntary grows).
+- :mod:`kubedl_tpu.elastic.resize` — resize-protocol helpers: gradient-
+  accumulation rescaling so the effective global batch (and thus the loss
+  trajectory) is preserved across world sizes, and goodput accounting.
+
+The engine executes the resize itself (`engine/job_controller.py`): on a
+slice-demand change it tries :meth:`SliceGangScheduler.resize_gang`
+(partial release/grow in place), stamps a ``Resizing`` condition, restarts
+replicas at the new world size, and the training entry resumes from the
+latest checkpoint via the cross-sharding assembler. See docs/elasticity.md.
+"""
+
+from kubedl_tpu.elastic.policy import ElasticPolicy
+from kubedl_tpu.elastic.preemption import PreemptionController
+from kubedl_tpu.elastic.resize import goodput, grad_accum_for_world
+
+__all__ = [
+    "ElasticPolicy",
+    "PreemptionController",
+    "goodput",
+    "grad_accum_for_world",
+]

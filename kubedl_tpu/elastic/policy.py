@@ -1,0 +1,162 @@
+"""ElasticPolicy: compute each elastic job's desired gang size.
+
+One controller instance watches the whole fleet (Nodes for notice/capacity
+churn, PodGroups for slice frees, every elastic job kind for spec/status
+changes) and, per elastic job, decides a desired ``num_slices`` within the
+kind-declared ``[min_slices, max_slices]``:
+
+- **shrink** (urgent, bypasses cooldown): the job's gang holds draining
+  slices — vacate them before the reclaim lands, down to at most
+  ``min_slices``. At the floor the job keeps running on the draining
+  slice; if the reclaim arrives, the ordinary eviction/gang-restart path
+  recovers it.
+- **grow** (voluntary, flap-damped): free healthy slices exist, the job is
+  RUNNING below ``max_slices``, and at least ``cooldown`` seconds passed
+  since its last resize — the same cooldown-stamp idiom as the serving
+  autoscaler (serving/controller.py AUTOSCALE_COOLDOWN). Shrinks stamp the
+  cooldown too, so a drain-shrink is not immediately undone by a grow into
+  the very capacity that is about to vanish.
+
+The policy only WRITES the desired size onto the job spec (through the
+kind's ``set_num_slices`` hook); the engine executes the actual resize
+protocol (in-place ``resize_gang`` + ``Resizing`` condition + checkpoint
+restart) on its next reconcile of that job.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional, Tuple
+
+from kubedl_tpu.api.interface import JobObject, WorkloadController
+from kubedl_tpu.api.types import JobConditionType
+from kubedl_tpu.core.manager import ControllerManager, EventRecorder
+from kubedl_tpu.core.store import Conflict, NotFound, ObjectStore
+from kubedl_tpu.gang.interface import GangScheduler
+from kubedl_tpu.gang.slice_scheduler import SliceInventory, owner_key
+
+log = logging.getLogger("kubedl_tpu.elastic")
+
+#: phases in which the policy leaves a job alone entirely
+_HANDS_OFF = (
+    JobConditionType.SUCCEEDED,
+    JobConditionType.FAILED,
+    JobConditionType.SUSPENDED,
+    JobConditionType.QUARANTINED,
+)
+
+
+class ElasticPolicy:
+    """Fleet-wide desired-gang-size controller with grow hysteresis."""
+
+    NAME = "elastic-policy"
+    #: single synthetic workqueue key: every trigger rescans the (small)
+    #: elastic-job population, so concurrent per-job keys can't race
+    KEY = ("kubedl-system", "elastic-policy")
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        inventory: SliceInventory,
+        gang: GangScheduler,
+        controllers: Dict[str, WorkloadController],
+        recorder: Optional[EventRecorder] = None,
+        cooldown: float = 30.0,
+        clock=time.time,
+    ) -> None:
+        self.store = store
+        self.inventory = inventory
+        self.gang = gang
+        self.controllers = controllers
+        self.recorder = recorder or EventRecorder(store)
+        self.cooldown = cooldown
+        self.clock = clock
+        #: (ns, name) -> our clock at the job's last policy-driven resize
+        self._last_resize: Dict[Tuple[str, str], float] = {}
+
+    def setup(self, manager: ControllerManager) -> None:
+        manager.register(
+            self.NAME,
+            self.reconcile,
+            watch_kinds=["Node", "PodGroup"] + sorted(self.controllers),
+            mapper=lambda e, obj, old: [self.KEY],
+        )
+
+    # ------------------------------------------------------------------
+
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        requeue: Optional[float] = None
+        for kind in sorted(self.controllers):
+            controller = self.controllers[kind]
+            for job in self.store.list(kind, namespace=None):
+                assert isinstance(job, JobObject)
+                r = self._reconcile_job(kind, controller, job)
+                if r is not None:
+                    requeue = r if requeue is None else min(requeue, r)
+        return requeue
+
+    def _reconcile_job(
+        self, kind: str, controller: WorkloadController, job: JobObject
+    ) -> Optional[float]:
+        rng = controller.elastic_range(job)
+        if rng is None:
+            return None
+        mn, mx = rng
+        phase = job.status.phase
+        if phase is None or phase in _HANDS_OFF:
+            return None
+        try:
+            demand = self.gang.slice_demand(job)
+        except ValueError:
+            return None  # malformed spec: validation's problem, not ours
+        if not demand or not demand[0]:
+            return None  # no slice-pinned replicas: nothing to scale
+        slice_type = demand[0]
+        current = controller.get_num_slices(job)
+        owner = owner_key(job.metadata.namespace, job.metadata.name)
+        key = (job.metadata.namespace, job.metadata.name)
+        now = self.clock()
+        draining_held = self.inventory.draining_slices(owner)
+        desired, reason = current, ""
+        if draining_held:
+            desired = max(current - len(draining_held), mn)
+            reason = (
+                f"vacating {len(draining_held)} draining slice(s): "
+                + ", ".join(draining_held)
+            )
+        elif phase == JobConditionType.RUNNING and current < mx:
+            cd = controller.elastic_cooldown(job)
+            cooldown = self.cooldown if cd is None else cd
+            since = now - self._last_resize.get(key, 0.0)
+            if since < cooldown:
+                # capacity may be free but the job resized recently:
+                # re-check once the cooldown window closes
+                return max(cooldown - since, 0.05)
+            free = len(self.inventory.free_slices(slice_type))
+            if free > 0:
+                desired = min(current + free, mx)
+                reason = f"{free} free {slice_type} slice(s)"
+        if desired == current:
+            return None
+        self._last_resize[key] = now
+
+        def mutate(obj: JobObject) -> None:  # type: ignore[type-arg]
+            controller.set_num_slices(obj, desired)
+
+        try:
+            self.store.update_with_retry(
+                kind, job.metadata.name, job.metadata.namespace, mutate
+            )
+        except (NotFound, Conflict):
+            return 0.5
+        log.info(
+            "%s %s/%s: %d -> %d slices (%s)",
+            kind, job.metadata.namespace, job.metadata.name,
+            current, desired, reason,
+        )
+        self.recorder.event(
+            job, "Normal", "ElasticResize",
+            f"desired slices {current} -> {desired}: {reason}",
+        )
+        return None

@@ -1,0 +1,97 @@
+"""PreemptionController: turn per-host notices into per-slice drains.
+
+Preemptible/maintenance-scheduled TPU capacity announces reclaim ahead of
+time on the HOST (the cloud metadata server's preemption notice). The
+kubelet publishes that through its heartbeat (`core/nodes.py`:
+``NodeHeartbeater.announce_preemption`` / the ``elastic.preempt`` chaos
+site), stamping ``Node.preempt_at``/``preempt_reason``. This controller
+watches Nodes and translates: any noticed host marks its WHOLE slice
+draining in the inventory — an ICI domain dies whole, so one reclaimed
+host takes the slice with it. Draining slices are skipped by
+``SliceInventory.try_reserve`` and shrink elastic jobs off themselves via
+the ElasticPolicy. A withdrawn notice (all hosts clear) returns the slice
+to service.
+
+The notice is advance warning, not death: the node keeps heartbeating. If
+the reclaim actually lands, the ordinary NodeLifecycleController eviction
+path takes over (retryable whole-gang restart) — drains just make that
+the rare case instead of the common one.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from kubedl_tpu.core.manager import ControllerManager, EventRecorder
+from kubedl_tpu.core.objects import Node
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.gang.slice_scheduler import SliceInventory
+from kubedl_tpu.observability.metrics import DEFAULT_JOB_METRICS, JobMetrics
+
+log = logging.getLogger("kubedl_tpu.elastic")
+
+
+class PreemptionController:
+    """Watch Node preemption notices; mark/clear slice drains."""
+
+    NAME = "preemption"
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        inventory: SliceInventory,
+        recorder: Optional[EventRecorder] = None,
+        metrics: Optional[JobMetrics] = None,
+    ) -> None:
+        self.store = store
+        self.inventory = inventory
+        self.recorder = recorder or EventRecorder(store)
+        self.metrics = metrics or DEFAULT_JOB_METRICS
+
+    def setup(self, manager: ControllerManager) -> None:
+        manager.register(
+            self.NAME,
+            self.reconcile,
+            watch_kinds=["Node"],
+            mapper=lambda e, obj, old: [
+                (obj.metadata.namespace, obj.metadata.name)
+            ],
+        )
+
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        node = self.store.try_get("Node", name, namespace)
+        if not isinstance(node, Node):
+            return None
+        slice_name = self.inventory.slice_of_host(name)
+        if slice_name is None:
+            return None  # host outside the slice fleet (CPU pool)
+        if node.preempt_at > 0:
+            reason = node.preempt_reason or f"preemption notice on {name}"
+            if self.inventory.mark_draining(slice_name, reason):
+                self.metrics.preemption_notices.inc()
+                log.warning("slice %s draining: %s", slice_name, reason)
+                self.recorder.event(
+                    node, "Warning", "PreemptionNotice",
+                    f"slice {slice_name} draining: {reason}",
+                )
+        elif not self._any_notice(slice_name):
+            # every host's notice withdrawn: capacity back in service
+            if self.inventory.clear_draining(slice_name):
+                log.info("slice %s back in service", slice_name)
+                self.recorder.event(
+                    node, "Normal", "PreemptionCleared",
+                    f"slice {slice_name} back in service",
+                )
+        return None
+
+    def _any_notice(self, slice_name: str) -> bool:
+        """True while ANY host of the slice still carries a notice — a
+        multi-host slice must not clear on the first host's withdrawal."""
+        from kubedl_tpu.core.nodes import NODE_NAMESPACE
+
+        for host in self.inventory.slice_hosts(slice_name):
+            n = self.store.try_get("Node", host, NODE_NAMESPACE)
+            if isinstance(n, Node) and n.preempt_at > 0:
+                return True
+        return False

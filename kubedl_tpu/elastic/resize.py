@@ -1,0 +1,59 @@
+"""Resize-protocol helpers: batch-semantics preservation + goodput.
+
+The resize protocol itself is distributed across the layers that own each
+step (docs/elasticity.md): the engine checkpoints implicitly (replicas are
+killed retryably, losing at most one save interval), ``resize_gang``
+re-shapes the reservation, restarted replicas restore through the
+cross-sharding checkpoint assembler (`training/checkpoint.py`
+``_ShardStore.region``) onto the new mesh. What lives here is the math
+that must agree between the operator and every worker:
+
+**Batch semantics.** The trainer's ``global_batch`` is the per-optimizer-
+step batch regardless of world size, and ``grad_accum`` only splits it
+into sequential microbatches (scan-accumulated, mean-of-means — see
+``training/trainer.py``). So the LOSS TRAJECTORY is already world-size
+invariant; what a shrink changes is per-device memory pressure: half the
+processes means each device holds twice the per-step tokens. A job tuned
+at its base world would OOM after shrinking. :func:`grad_accum_for_world`
+rescales accumulation inversely with world size so the per-device
+*microbatch* stays at its tuned size while the effective global batch —
+and the loss trajectory — is preserved exactly.
+
+**Goodput.** :func:`goodput` is the step-time-weighted fraction of wall
+clock spent training during a window — the bench artifact's
+``goodput_under_preemption`` headline (time lost to checkpoints, restarts
+and re-admission is exactly ``1 - goodput``).
+"""
+
+from __future__ import annotations
+
+
+def grad_accum_for_world(
+    base_grad_accum: int, base_world: int, world: int, global_batch: int
+) -> int:
+    """Gradient-accumulation factor for ``world`` processes such that the
+    per-device microbatch matches the one tuned at ``base_world`` with
+    ``base_grad_accum``, while the effective global batch is unchanged.
+
+    Target is ``base_grad_accum * base_world / world`` (shrink => more
+    accumulation, grow => less), rounded to the nearest feasible value:
+    ``grad_accum`` must divide ``global_batch``, so we walk down from the
+    target to the largest divisor (never below 1, never above
+    ``global_batch``).
+    """
+    base_grad_accum = max(int(base_grad_accum), 1)
+    base_world = max(int(base_world), 1)
+    world = max(int(world), 1)
+    global_batch = max(int(global_batch), 1)
+    target = max((base_grad_accum * base_world) // world, 1)
+    target = min(target, global_batch)
+    while target > 1 and global_batch % target != 0:
+        target -= 1
+    return target
+
+
+def goodput(step_seconds: float, wall_seconds: float) -> float:
+    """Fraction of ``wall_seconds`` spent in training steps, in [0, 1]."""
+    if wall_seconds <= 0:
+        return 0.0
+    return max(0.0, min(step_seconds / wall_seconds, 1.0))

@@ -281,22 +281,48 @@ class JobEngine:
                 and gang.phase == "Running"
                 and (gang.slice_type, gang.num_slices) != demand
             ):
-                job.status.restart_count += 1
-                status.set_condition(
-                    JobConditionType.RESTARTING,
-                    "SliceResize",
-                    f"resizing {gang.num_slices}x{gang.slice_type or 'cpu'} -> "
-                    f"{demand[1]}x{demand[0] or 'cpu'}; gang restarts from checkpoint",
+                old_slices = gang.num_slices
+                # Soft path first (kubedl_tpu/elastic/): same slice type =>
+                # partial release/grow IN PLACE. Surviving slices keep
+                # their assignments (stable mesh coordinates), nothing is
+                # re-admitted, and the job never risks losing its capacity
+                # to another queued job between release and re-reserve.
+                resized = (
+                    demand[0] == gang.slice_type
+                    and demand[1] >= 1
+                    and self.gang.resize_gang(job, gang, demand[1])
                 )
+                job.status.restart_count += 1
+                if resized:
+                    status.set_condition(
+                        JobConditionType.RESIZING,
+                        "ElasticResize",
+                        f"resized in place {old_slices}x{gang.slice_type or 'cpu'}"
+                        f" -> {demand[1]}x{demand[0] or 'cpu'}; replicas restart"
+                        " from checkpoint at the new world size",
+                    )
+                    self.metrics.resizes.inc(kind=self.controller.KIND)
+                else:
+                    # coarse fallback: release everything, re-admit at the
+                    # new shape (slice-type change, impossible grow, or a
+                    # gang scheduler without resize support)
+                    status.set_condition(
+                        JobConditionType.RESTARTING,
+                        "SliceResize",
+                        f"resizing {old_slices}x{gang.slice_type or 'cpu'} -> "
+                        f"{demand[1]}x{demand[0] or 'cpu'}; gang restarts from checkpoint",
+                    )
                 self.recorder.event(
                     job, "Normal", "SliceResize",
-                    f"slice demand changed {gang.num_slices} -> {demand[1]}",
+                    f"slice demand changed {old_slices} -> {demand[1]}"
+                    + (" (in-place)" if resized else ""),
                 )
                 self._delete_pods(job, ctx.pods, CleanPodPolicy.ALL)
                 ctx.pods = []
-                self.gang.delete_gang(job)
+                if not resized:
+                    self.gang.delete_gang(job)
                 self._update_status(job)
-                return 0.1  # next pass admits a fresh gang at the new shape
+                return 0.1  # next pass restarts replicas at the new shape
             if not self.gang.try_admit(gang):
                 if status.set_condition(
                     JobConditionType.QUEUED,

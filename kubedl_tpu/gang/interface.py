@@ -50,3 +50,9 @@ class GangScheduler:
         resize (grow/shrink => coordinated restart-from-checkpoint).
         None = this scheduler doesn't support resize detection."""
         return None
+
+    def resize_gang(self, job: JobObject, gang: PodGroup, count: int) -> bool:
+        """In-place partial release/grow to ``count`` slices, keeping the
+        surviving assignments. False = unsupported or the shape can't be
+        met; the engine falls back to delete_gang + re-admission."""
+        return False

@@ -31,6 +31,11 @@ class SliceInfo:
     topology: SliceTopology
     hosts: List[str] = field(default_factory=list)  # node names, ICI order
     allocated_to: str = ""  # "<ns>/<gang-name>" or ""
+    #: preemption/maintenance notice on one of its hosts: a draining slice
+    #: is never reserved (try_reserve skips it) and elastic jobs shrink
+    #: off it before the reclaim lands (kubedl_tpu/elastic/)
+    draining: bool = False
+    drain_reason: str = ""
 
     def __post_init__(self) -> None:
         if not self.hosts:
@@ -58,13 +63,16 @@ class SliceInventory:
             return [
                 s
                 for s in self._slices.values()
-                if s.topology.name == slice_type and not s.allocated_to
+                if s.topology.name == slice_type
+                and not s.allocated_to
+                and not s.draining
             ]
 
     def try_reserve(self, slice_type: str, count: int, owner: str) -> List[str]:
         """Atomically reserve `count` free slices of `slice_type` for
         `owner`; returns [] (reserving nothing) if fewer are free —
-        all-or-nothing is the whole point."""
+        all-or-nothing is the whole point. Draining slices (preemption
+        notice pending) are never handed out."""
         with self._lock:
             already = [
                 s.name for s in self._slices.values() if s.allocated_to == owner
@@ -74,7 +82,9 @@ class SliceInventory:
             free = [
                 s
                 for s in self._slices.values()
-                if s.topology.name == slice_type and not s.allocated_to
+                if s.topology.name == slice_type
+                and not s.allocated_to
+                and not s.draining
             ]
             need = count - len(already)
             if len(free) < need:
@@ -90,6 +100,69 @@ class SliceInventory:
                 if s.allocated_to == owner:
                     s.allocated_to = ""
 
+    def shrink_owner(self, owner: str, count: int) -> List[str]:
+        """Partial release for an elastic shrink: drop the owner's held
+        slices down to ``count``, releasing DRAINING slices first (the
+        whole point of shrinking is vacating the preemption victim), then
+        highest names. Returns the sorted kept slice names, or [] if the
+        owner holds fewer than ``count`` (nothing released)."""
+        with self._lock:
+            held = [s for s in self._slices.values() if s.allocated_to == owner]
+            if len(held) < count or count < 0:
+                return []
+            # keep preference: healthy slices, lowest names (stable mesh
+            # coordinates for the survivors)
+            held.sort(key=lambda s: (s.draining, s.name))
+            for s in held[count:]:
+                s.allocated_to = ""
+            return sorted(s.name for s in held[:count])
+
+    def owned_slices(self, owner: str) -> List[str]:
+        with self._lock:
+            return sorted(
+                s.name for s in self._slices.values() if s.allocated_to == owner
+            )
+
+    # -- draining (preemption notices; kubedl_tpu/elastic/) ----------------
+
+    def mark_draining(self, name: str, reason: str = "") -> bool:
+        """Flag a slice draining. Returns True only on the False->True
+        transition (callers emit the notice event/metric exactly once)."""
+        with self._lock:
+            s = self._slices.get(name)
+            if s is None or s.draining:
+                return False
+            s.draining = True
+            s.drain_reason = reason
+            return True
+
+    def clear_draining(self, name: str) -> bool:
+        with self._lock:
+            s = self._slices.get(name)
+            if s is None or not s.draining:
+                return False
+            s.draining = False
+            s.drain_reason = ""
+            return True
+
+    def draining_slices(self, owner: Optional[str] = None) -> List[str]:
+        """Names of draining slices, optionally only those held by owner."""
+        with self._lock:
+            return sorted(
+                s.name
+                for s in self._slices.values()
+                if s.draining and (owner is None or s.allocated_to == owner)
+            )
+
+    def slice_of_host(self, host: str) -> Optional[str]:
+        """The slice a node belongs to (preemption notices arrive per
+        HOST; draining is per SLICE — the ICI domain dies whole)."""
+        with self._lock:
+            for s in self._slices.values():
+                if host in s.hosts:
+                    return s.name
+            return None
+
     def slice_hosts(self, name: str) -> List[str]:
         with self._lock:
             return list(self._slices[name].hosts)
@@ -99,7 +172,8 @@ class SliceInventory:
             return {s.name: (s.allocated_to or "<free>") for s in self._slices.values()}
 
     def detail(self) -> List[Dict]:
-        """Full fleet view for the console (name/type/chips/hosts/holder)."""
+        """Full fleet view for the console (name/type/chips/hosts/holder/
+        drain state)."""
         with self._lock:
             return sorted(
                 (
@@ -109,6 +183,8 @@ class SliceInventory:
                         "chips": s.topology.chips,
                         "hosts": list(s.hosts),
                         "allocated_to": s.allocated_to,
+                        "draining": s.draining,
+                        "drain_reason": s.drain_reason,
                     }
                     for s in self._slices.values()
                 ),
@@ -234,6 +310,41 @@ class SliceGangScheduler(GangScheduler):
         slice_name = gang.assigned_slices[s_idx]
         pod.spec.node_name = self.inventory.slice_hosts(slice_name)[h_idx]
         pod.spec.slice_assignment = slice_name
+
+    def resize_gang(self, job: JobObject, gang: PodGroup, count: int) -> bool:
+        """In-place elastic resize: partially release (shrink, draining
+        slices dropped first) or reserve more (grow) WITHOUT tearing the
+        gang down — surviving slices keep their assignments, so replica
+        indices / mesh coordinates on them are stable across the resize.
+        Returns False (gang untouched) when the new shape can't be met;
+        the caller falls back to the coarse release-everything path."""
+        if count < 1 or not gang.slice_type:
+            return False
+        owner = f"{gang.metadata.namespace}/{gang.metadata.name}"
+        held = self.inventory.owned_slices(owner)
+        if count >= len(held):
+            assigned = self.inventory.try_reserve(gang.slice_type, count, owner)
+        else:
+            assigned = self.inventory.shrink_owner(owner, count)
+        if not assigned:
+            return False
+
+        def mutate(obj: PodGroup) -> None:  # type: ignore[type-arg]
+            obj.num_slices = count
+            obj.assigned_slices = assigned
+            obj.min_member = job.spec.min_available()
+
+        try:
+            updated = self.store.update_with_retry(
+                "PodGroup", gang.metadata.name, gang.metadata.namespace, mutate
+            )
+        except NotFound:
+            self.inventory.release(owner)
+            return False
+        gang.num_slices = updated.num_slices  # type: ignore[attr-defined]
+        gang.assigned_slices = updated.assigned_slices  # type: ignore[attr-defined]
+        gang.min_member = updated.min_member  # type: ignore[attr-defined]
+        return True
 
     def delete_gang(self, job: JobObject) -> None:
         self.inventory.release(_owner_key(job))

@@ -240,6 +240,27 @@ class JobMetrics:
             "Jobs parked with a Quarantined condition after their reconcile "
             "retry budget (poison-pill protection for the workqueue)",
         )
+        # Elastic slice scaling (kubedl_tpu/elastic/):
+        self.resizes = r.counter(
+            "kubedl_tpu_jobs_resized",
+            "In-place elastic gang resizes (grow or shrink) executed by "
+            "the engine; coarse tear-down resizes count as restarts",
+        )
+        self.preemption_notices = r.counter(
+            "kubedl_tpu_preemption_notices",
+            "Node preemption/maintenance notices that marked a slice "
+            "draining",
+        )
+        self.slices_draining = r.gauge(
+            "kubedl_tpu_slices_draining",
+            "Slices currently draining under a preemption notice",
+        )
+        self.goodput = r.gauge(
+            "kubedl_tpu_training_goodput",
+            "Step-time-weighted fraction of wall clock spent training "
+            "over the last measured window (1 - overhead of checkpoints, "
+            "restarts and resizes)",
+        )
 
 
 #: ms-scale buckets for the decode pipeline's per-tick timings (the

@@ -89,6 +89,10 @@ class OperatorOptions:
     #: node names THIS process's kubelet heartbeats (opt-in; defaults to
     #: [node_name] when node_name is set)
     heartbeat_nodes: List[str] = field(default_factory=list)
+    #: elastic slice scaling: minimum seconds between GROW resizes per job
+    #: (shrinks away from draining slices bypass the cooldown). See
+    #: kubedl_tpu/elastic/policy.py and docs/elasticity.md.
+    elastic_cooldown_seconds: float = 30.0
 
 
 class ValidationError(ValueError):
@@ -178,6 +182,25 @@ class Operator:
         self.node_heartbeater = NodeHeartbeater(
             self.store, beat_names,
             interval=max(self.options.node_grace_seconds / 3.0, 0.5),
+        )
+
+        # elastic slice scaling: preemption notices -> draining slices ->
+        # policy-driven grow/shrink (kubedl_tpu/elastic/, docs/elasticity.md)
+        from kubedl_tpu.elastic import ElasticPolicy, PreemptionController
+
+        self.preemption = PreemptionController(
+            self.store, self.inventory, self.manager.recorder,
+            metrics=self.metrics,
+        )
+        self.preemption.setup(self.manager)
+        self.elastic_policy = ElasticPolicy(
+            self.store, self.inventory, self.gang, self.controllers,
+            self.manager.recorder,
+            cooldown=self.options.elastic_cooldown_seconds,
+        )
+        self.elastic_policy.setup(self.manager)
+        self.metrics.slices_draining.set_function(
+            lambda: float(len(self.inventory.draining_slices()))
         )
 
         # model lineage

@@ -181,6 +181,10 @@ class Kubelet:
         self.pod_ip = pod_ip
         self._lock = threading.Lock()
         self._running: Dict[str, ProcHandle] = {}
+        #: pod uid each running handle belongs to — a same-name replacement
+        #: pod (elastic resize deletes RUNNING pods and recreates them)
+        #: must not be mistaken for the pod whose process is still alive
+        self._running_uid: Dict[str, str] = {}
         #: (ns, pod, volume) -> (pod uid, ConfigMap resource version) last
         #: materialized; cleared when the pod is deleted
         self._materialized: Dict[tuple, tuple] = {}
@@ -230,6 +234,7 @@ class Kubelet:
         if pod is None:
             with self._lock:
                 handle = self._running.pop(key, None)
+                self._running_uid.pop(key, None)
                 for sk in [k for k in self._materialized
                            if (k[0], k[1]) == (namespace, name)]:
                     del self._materialized[sk]
@@ -252,12 +257,29 @@ class Kubelet:
                 handle.kill()
             return None
         with self._lock:
-            already_running = key in self._running
-            if not already_running:
+            recorded_uid = self._running_uid.get(key)
+            stale = (
+                key in self._running
+                and recorded_uid is not None
+                and recorded_uid != pod.metadata.uid
+            )
+            handle = self._running.get(key)
+            already_running = key in self._running and not stale
+            if not already_running and not stale:
                 if pod.status.phase != PodPhase.PENDING:
                     return None
                 # reserve the slot before leaving the lock
                 self._running[key] = _PlaceholderHandle()
+                self._running_uid[key] = pod.metadata.uid
+        if stale:
+            # the live process belongs to a same-name pod that was deleted
+            # and already replaced before its DELETED event was processed
+            # (workqueue coalescing collapses DELETED+ADDED into one key).
+            # Cancel it; the reap thread frees the slot and relaunches the
+            # replacement.
+            if handle is not None:
+                handle.kill()
+            return None
         if already_running:
             # keep mounted ConfigMap volumes fresh (outside self._lock —
             # materialization takes it internally)
@@ -272,6 +294,7 @@ class Kubelet:
             log.error("launch %s failed: %s", key, e)
             with self._lock:
                 self._running.pop(key, None)
+                self._running_uid.pop(key, None)
             self._set_phase(pod, PodPhase.FAILED, reason=f"LaunchError: {e}", exit_code=1)
         return None
 
@@ -303,6 +326,7 @@ class Kubelet:
             code = handle.wait()
             with self._lock:
                 self._running.pop(key, None)
+                self._running_uid.pop(key, None)
             phase = PodPhase.SUCCEEDED if code == 0 else PodPhase.FAILED
             self._set_phase(pod, phase, exit_code=code)
             # a same-name replacement pod may have been created while this
@@ -396,6 +420,7 @@ class Kubelet:
         with self._lock:
             handles = list(self._running.values())
             self._running.clear()
+            self._running_uid.clear()
         for h in handles:
             h.kill()
 

@@ -144,6 +144,25 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
         ckpt_every=int(opts.get("ckpt_every", 0)),
         opt_moment_dtype=opts.get("opt_moment_dtype", "float32"),
     )
+    # elastic resize (docs/elasticity.md): when the gang restarted at a
+    # world size different from the one the job was tuned at, rescale
+    # grad accumulation so the per-device microbatch stays at its tuned
+    # size — global_batch (and the loss trajectory) is unchanged
+    base_world = int(os.environ.get(constants.ENV_ELASTIC_BASE_WORLD, "0") or 0)
+    world = int(os.environ.get(constants.ENV_NUM_PROCESSES, "1") or 1)
+    if base_world > 0 and world != base_world:
+        from kubedl_tpu.elastic.resize import grad_accum_for_world
+
+        accum = grad_accum_for_world(
+            cfg.grad_accum, base_world, world, cfg.global_batch
+        )
+        if accum != cfg.grad_accum:
+            print(
+                json.dumps({"elastic_grad_accum": accum, "world": world,
+                            "base_world": base_world}),
+                flush=True,
+            )
+            cfg = dataclasses.replace(cfg, grad_accum=accum)
     t0 = time.time()
     mesh = mesh_from_env()
     trainer = Trainer(cfg, mesh)

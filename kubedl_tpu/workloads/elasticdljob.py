@@ -1,24 +1,27 @@
-"""ElasticDLJob: master-only elastic training.
+"""ElasticDLJob: master-driven elastic training.
 
 Capability parity with the reference's ElasticDL controller
 (controllers/elasticdl/): the CRD declares ONLY a Master replica type
 (apis/training/v1alpha1/elasticdljob_types.go:62-65) — the master process
 itself elastically spawns and scales its workers/PS. The engine creates no
-Services for it (pkg/job_controller/job.go:253-257), and the master pod is
-named `elasticdl-<job>-master` for compatibility with ElasticDL's own
-discovery (pkg/job_controller/pod.go:412-415) — here the master receives
-its canonical name via env instead, since naming is store-internal.
+Services for it (pkg/job_controller/job.go:253-257).
 
-TPU mapping: elasticity becomes slice grow/shrink — the master asks the
-operator for more/fewer slice gangs (SURVEY.md §2.5 elastic DP row); the
-env below hands it the operator's coordinator address for that.
+TPU mapping: elasticity becomes slice grow/shrink (SURVEY.md §2.5 elastic
+DP row). The spec carries a real elastic range — ``min_slices`` /
+``max_slices`` — and a current ``num_slices``; the ElasticPolicy
+(kubedl_tpu/elastic/policy.py) moves ``num_slices`` inside the range as
+preemption notices land and free capacity appears, and the engine executes
+the in-place resize protocol (docs/elasticity.md). The master pod group
+spans ``num_slices`` slices when its topology is pinned, exactly like
+TPUJob workers.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List
+from typing import List, Optional
 
+from kubedl_tpu.api import constants
 from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadController
 from kubedl_tpu.api.types import ReplicaType
 from kubedl_tpu.core.objects import Pod
@@ -27,6 +30,11 @@ from kubedl_tpu.core.objects import Pod
 @dataclass
 class ElasticDLJob(JobObject):
     KIND = "ElasticDLJob"
+    #: Elastic range: the policy keeps num_slices in [min_slices, max_slices].
+    min_slices: int = 1
+    max_slices: int = 1
+    #: Current desired slice count; 0 (unset) defaults to min_slices.
+    num_slices: int = 0
 
 
 class ElasticDLJobController(WorkloadController):
@@ -39,6 +47,50 @@ class ElasticDLJobController(WorkloadController):
 
     # ALLOWED_REPLICA_TYPES: only Master is legal (reference:
     # elasticdljob_types.go:62-65); base defaulting prunes the rest.
+
+    def validate(self, job: JobObject) -> List[str]:
+        errs = super().validate(job)
+        assert isinstance(job, ElasticDLJob)
+        if job.min_slices < 1:
+            errs.append("spec.minSlices must be >= 1")
+        if job.max_slices < job.min_slices:
+            errs.append("spec.maxSlices must be >= spec.minSlices")
+        if job.num_slices < 0:
+            errs.append("spec.numSlices must not be negative")
+        return errs
+
+    def apply_defaults(self, job: JobObject) -> None:
+        """num_slices defaults to min_slices and is clamped into range;
+        a topology-pinned Master group spans the full gang (one process
+        per host, like TPUJob workers). The base world size is stamped
+        once so workers can rescale grad accumulation after resizes."""
+        super().apply_defaults(job)
+        assert isinstance(job, ElasticDLJob)
+        if job.num_slices <= 0:
+            job.num_slices = job.min_slices
+        job.num_slices = min(max(job.num_slices, job.min_slices), job.max_slices)
+        spec = job.spec.replica_specs.get(ReplicaType.MASTER)
+        if spec is not None and spec.topology is not None:
+            spec.replicas = spec.topology.hosts * job.num_slices
+            job.metadata.annotations.setdefault(
+                constants.ANNOTATION_ELASTIC_BASE_WORLD, str(spec.replicas)
+            )
+
+    # ---- elastic hooks (kubedl_tpu/elastic/policy.py) ----------------
+
+    def elastic_range(self, job: JobObject) -> Optional[tuple]:
+        assert isinstance(job, ElasticDLJob)
+        if job.min_slices == job.max_slices == 1:
+            return None  # fixed-size single-slice job: nothing to scale
+        return (job.min_slices, job.max_slices)
+
+    def get_num_slices(self, job: JobObject) -> int:
+        assert isinstance(job, ElasticDLJob)
+        return max(job.num_slices, 1)
+
+    def set_num_slices(self, job: JobObject, n: int) -> None:
+        assert isinstance(job, ElasticDLJob)
+        job.num_slices = min(max(n, job.min_slices), job.max_slices)
 
     def reconcile_orders(self) -> List[ReplicaType]:
         return [ReplicaType.MASTER]
@@ -57,7 +109,18 @@ class ElasticDLJobController(WorkloadController):
         index: int,
         ctx: ReconcileContext,
     ) -> None:
+        assert isinstance(job, ElasticDLJob)
         main = pod.spec.main_container()
         main.set_env("ELASTICDL_JOB_NAME", job.metadata.name)
         main.set_env("ELASTICDL_MASTER_POD", f"elasticdl-{job.metadata.name}-master")
         main.set_env("ELASTICDL_NAMESPACE", job.metadata.namespace)
+        # the elastic range + current world, so the master can size its
+        # data pipeline and rescale grad accumulation (elastic/resize.py)
+        main.set_env(constants.ENV_ELASTIC_MIN_SLICES, str(job.min_slices))
+        main.set_env(constants.ENV_ELASTIC_MAX_SLICES, str(job.max_slices))
+        main.set_env(constants.ENV_ELASTIC_NUM_SLICES, str(max(job.num_slices, 1)))
+        base = job.metadata.annotations.get(constants.ANNOTATION_ELASTIC_BASE_WORLD)
+        if base:
+            main.set_env(constants.ENV_ELASTIC_BASE_WORLD, base)
+        if main.get_env(constants.ENV_MODEL_PATH) is None:
+            main.set_env(constants.ENV_MODEL_PATH, constants.DEFAULT_MODEL_PATH)

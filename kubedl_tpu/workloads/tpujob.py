@@ -26,7 +26,7 @@ from typing import List, Optional
 from kubedl_tpu.api import constants
 from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadController
 from kubedl_tpu.api.topology import MeshSpec
-from kubedl_tpu.api.types import ReplicaType
+from kubedl_tpu.api.types import ElasticSpec, ReplicaType
 from kubedl_tpu.core.objects import Pod
 from kubedl_tpu.engine.job_controller import replica_name
 
@@ -39,6 +39,10 @@ class TPUJob(JobObject):
     #: Logical mesh requested by the user; defaults to pure data-parallel
     #: over all chips.
     mesh: Optional[MeshSpec] = None
+    #: Opt-in elastic slice scaling: num_slices becomes a runtime variable
+    #: in [elastic.min_slices, elastic.max_slices] managed by the
+    #: ElasticPolicy (kubedl_tpu/elastic/, docs/elasticity.md).
+    elastic: Optional[ElasticSpec] = None
 
 
 class TPUJobController(WorkloadController):
@@ -49,14 +53,50 @@ class TPUJobController(WorkloadController):
     def object_factory(self) -> TPUJob:
         return TPUJob()
 
+    def validate(self, job: JobObject) -> List[str]:
+        errs = super().validate(job)
+        assert isinstance(job, TPUJob)
+        if job.elastic is not None:
+            errs.extend(job.elastic.validate("spec.elastic"))
+        return errs
+
     def apply_defaults(self, job: JobObject) -> None:
         """Workers span num_slices full slices: replicas = hosts*num_slices
-        (one process per TPU host, multislice over DCN)."""
+        (one process per TPU host, multislice over DCN). Elastic jobs get
+        num_slices clamped into [min, max] and the base world size stamped
+        once (stable across resizes — workers rescale grad accumulation
+        against it, elastic/resize.py)."""
         super().apply_defaults(job)
         assert isinstance(job, TPUJob)
+        if job.elastic is not None:
+            job.num_slices = job.elastic.clamp(max(job.num_slices, 1))
         spec = job.spec.replica_specs.get(ReplicaType.WORKER)
         if spec is not None and spec.topology is not None:
             spec.replicas = spec.topology.hosts * max(job.num_slices, 1)
+        if job.elastic is not None and spec is not None:
+            job.metadata.annotations.setdefault(
+                constants.ANNOTATION_ELASTIC_BASE_WORLD, str(spec.replicas)
+            )
+
+    # ---- elastic hooks (kubedl_tpu/elastic/policy.py) ----------------
+
+    def elastic_range(self, job: JobObject) -> Optional[tuple]:
+        assert isinstance(job, TPUJob)
+        if job.elastic is None:
+            return None
+        return (job.elastic.min_slices, job.elastic.max_slices)
+
+    def get_num_slices(self, job: JobObject) -> int:
+        assert isinstance(job, TPUJob)
+        return max(job.num_slices, 1)
+
+    def elastic_cooldown(self, job: JobObject) -> Optional[float]:
+        assert isinstance(job, TPUJob)
+        return None if job.elastic is None else job.elastic.cooldown_seconds
+
+    def set_num_slices(self, job: JobObject, n: int) -> None:
+        assert isinstance(job, TPUJob)
+        job.num_slices = job.elastic.clamp(n) if job.elastic else max(n, 1)
 
     def reconcile_orders(self) -> List[ReplicaType]:
         return [ReplicaType.WORKER, ReplicaType.EVALUATOR]
@@ -120,6 +160,14 @@ class TPUJobController(WorkloadController):
             main.set_env(constants.ENV_MESH_AXES, mesh.to_env())
         elif job.mesh is not None:
             main.set_env(constants.ENV_MESH_AXES, job.mesh.to_env())
+        if job.elastic is not None:
+            base = job.metadata.annotations.get(
+                constants.ANNOTATION_ELASTIC_BASE_WORLD
+            )
+            if base:
+                # workers rescale grad accumulation against the world size
+                # the job was tuned at (training/entry.py, elastic/resize.py)
+                main.set_env(constants.ENV_ELASTIC_BASE_WORLD, base)
         if job.num_slices > 1:
             main.set_env(constants.ENV_MEGASCALE_COORDINATOR, self._coordinator(job))
             main.set_env(constants.ENV_MEGASCALE_NUM_SLICES, str(job.num_slices))

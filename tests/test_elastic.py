@@ -1,0 +1,526 @@
+"""Elastic slice scaling (tier-1): preemption-aware grow/shrink with
+reshard-resume (kubedl_tpu/elastic/, docs/elasticity.md).
+
+Invariants asserted here:
+- draining slices are never reserved and the console detail exposes the
+  drain state; an elastic shrink releases the draining slice first;
+- the ElasticSpec range is schema-validated (min >= 1, max >= min) and
+  defaulted, for both TPUJob's ``elastic:`` block and ElasticDLJob's
+  first-class min/max/num fields;
+- ``grad_accum_for_world`` preserves the effective global batch while
+  keeping the per-device microbatch at its tuned size;
+- a seeded ``elastic.preempt`` fault drives the full loop end to end —
+  notice -> drain -> shrink -> clear -> grow — with restart counts and
+  the final world size matching the fault plan exactly;
+- the grow path is flap-damped (per-job cooldown; shrinks bypass it);
+- resize failures count against the reconcile quarantine budget (a
+  poisoned resize parks the job, never hot-loops the workqueue);
+- a 4 -> 2 -> 4 reshard-resume reproduces the fixed-size loss trajectory
+  (checkpoint assembly across shardings + grad-accum rescaling).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from kubedl_tpu import chaos
+from kubedl_tpu.api.topology import get_slice
+from kubedl_tpu.api.types import ElasticSpec, JobConditionType
+from kubedl_tpu.chaos import FaultPlan, FaultSpec
+from kubedl_tpu.elastic.resize import goodput, grad_accum_for_world
+from kubedl_tpu.gang.slice_scheduler import (
+    SliceGangScheduler,
+    SliceInventory,
+    owner_key,
+)
+
+from tests.helpers import PodDriver, make_tpujob, pod_names
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    chaos.disarm()
+    yield
+    chaos.disarm()
+
+
+# --------------------------------------------------------------------------
+# Inventory: draining semantics
+# --------------------------------------------------------------------------
+
+
+class TestInventoryDraining:
+    def _inv(self):
+        inv = SliceInventory()
+        inv.add_slice("sa", "cpu-1")
+        inv.add_slice("sb", "cpu-1")
+        return inv
+
+    def test_try_reserve_skips_draining(self):
+        inv = self._inv()
+        assert inv.mark_draining("sb", "maintenance")
+        assert inv.try_reserve("cpu-1", 2, "ns/j-gang") == []  # all-or-nothing
+        assert inv.try_reserve("cpu-1", 1, "ns/j-gang") == ["sa"]
+
+    def test_mark_and_clear_are_edge_triggered(self):
+        inv = self._inv()
+        assert inv.mark_draining("sb") is True
+        assert inv.mark_draining("sb") is False  # already draining
+        assert inv.clear_draining("sb") is True
+        assert inv.clear_draining("sb") is False
+        assert inv.mark_draining("nope") is False  # unknown slice
+
+    def test_detail_exposes_drain_state(self):
+        inv = self._inv()
+        inv.mark_draining("sb", "preempt notice on sb-host-0")
+        by_name = {d["name"]: d for d in inv.detail()}
+        assert by_name["sb"]["draining"] is True
+        assert by_name["sb"]["drain_reason"] == "preempt notice on sb-host-0"
+        assert by_name["sa"]["draining"] is False
+
+    def test_shrink_owner_releases_draining_first(self):
+        inv = self._inv()
+        owner = owner_key("default", "j")
+        assert inv.try_reserve("cpu-1", 2, owner) == ["sa", "sb"]
+        inv.mark_draining("sa", "victim")  # lowest name, but draining
+        assert inv.shrink_owner(owner, 1) == ["sb"]  # healthy one kept
+        assert inv.owned_slices(owner) == ["sb"]
+        # the draining slice is free again (for after its notice clears)
+        assert inv.draining_slices() == ["sa"]
+        assert inv.free_slices("cpu-1") == []  # but not reservable yet
+
+    def test_slice_of_host_maps_notice_to_slice(self):
+        inv = self._inv()
+        assert inv.slice_of_host("sb-host-0") == "sb"
+        assert inv.slice_of_host("unknown-host") is None
+
+
+# --------------------------------------------------------------------------
+# Spec validation + defaulting (TPUJob elastic block, ElasticDLJob fields)
+# --------------------------------------------------------------------------
+
+
+class TestElasticSpecValidation:
+    def test_elastic_spec_rules(self):
+        assert ElasticSpec(min_slices=1, max_slices=2).validate() == []
+        assert any("minSlices" in e for e in ElasticSpec(min_slices=0).validate())
+        assert any(
+            "maxSlices" in e
+            for e in ElasticSpec(min_slices=3, max_slices=2).validate()
+        )
+        assert any(
+            "cooldown" in e
+            for e in ElasticSpec(cooldown_seconds=-1.0).validate()
+        )
+
+    def test_clamp(self):
+        spec = ElasticSpec(min_slices=2, max_slices=4)
+        assert spec.clamp(1) == 2
+        assert spec.clamp(3) == 3
+        assert spec.clamp(9) == 4
+
+    def test_tpujob_submit_rejects_bad_range(self, tmp_path):
+        from kubedl_tpu.operator import Operator, OperatorOptions, ValidationError
+
+        op = Operator(OperatorOptions(
+            local_addresses=True, artifact_registry_root=str(tmp_path / "r")))
+        try:
+            job = make_tpujob("badel", workers=1, topology=get_slice("cpu-1"))
+            job.elastic = ElasticSpec(min_slices=2, max_slices=1)
+            with pytest.raises(ValidationError, match="maxSlices"):
+                op.submit(job)
+        finally:
+            op.stop()
+
+    def test_tpujob_defaults_clamp_and_stamp_base_world(self):
+        from kubedl_tpu.api import constants
+        from kubedl_tpu.workloads.tpujob import TPUJobController
+
+        ctrl = TPUJobController(local_addresses=True)
+        job = make_tpujob("el", workers=2, topology=get_slice("cpu-1"))
+        job.num_slices = 5  # above the elastic ceiling
+        job.elastic = ElasticSpec(min_slices=1, max_slices=2)
+        ctrl.apply_defaults(job)
+        assert job.num_slices == 2
+        assert (
+            job.metadata.annotations[constants.ANNOTATION_ELASTIC_BASE_WORLD]
+            == "2"  # cpu-1: 1 host/slice x 2 slices
+        )
+        # the stamp is sticky across resizes: base world never re-derives
+        ctrl.set_num_slices(job, 1)
+        ctrl.apply_defaults(job)
+        assert (
+            job.metadata.annotations[constants.ANNOTATION_ELASTIC_BASE_WORLD]
+            == "2"
+        )
+        assert ctrl.elastic_range(job) == (1, 2)
+
+    def test_elasticdljob_validation_and_defaults(self):
+        from kubedl_tpu.api.types import ReplicaSpec, ReplicaType
+        from kubedl_tpu.core.objects import Container
+        from kubedl_tpu.workloads.elasticdljob import (
+            ElasticDLJob,
+            ElasticDLJobController,
+        )
+
+        ctrl = ElasticDLJobController(local_addresses=True)
+        job = ElasticDLJob(min_slices=0, max_slices=2)
+        job.metadata.name = "edl"
+        spec = ReplicaSpec(replicas=1, topology=get_slice("cpu-1"))
+        spec.template.spec.containers.append(Container())
+        job.spec.replica_specs[ReplicaType.MASTER] = spec
+        assert any("minSlices" in e for e in ctrl.validate(job))
+        job.min_slices, job.max_slices = 3, 1
+        assert any("maxSlices" in e for e in ctrl.validate(job))
+        job.min_slices, job.max_slices = 2, 3
+        assert ctrl.validate(job) == []
+        ctrl.apply_defaults(job)  # num_slices unset -> min_slices
+        assert job.num_slices == 2
+        assert spec.replicas == 2  # 1 host/slice x 2 slices
+        assert ctrl.elastic_range(job) == (2, 3)
+
+    def test_schemas_carry_the_elastic_fields(self):
+        import json
+        from pathlib import Path
+
+        schemas = Path(__file__).resolve().parent.parent / "deploy" / "rendered" / "schemas"
+        tpu = json.loads((schemas / "TPUJob.json").read_text())
+        assert "elastic" in tpu["properties"]
+        edl = json.loads((schemas / "ElasticDLJob.json").read_text())
+        for f in ("min_slices", "max_slices", "num_slices"):
+            assert f in edl["properties"]
+
+
+# --------------------------------------------------------------------------
+# Batch-semantics math
+# --------------------------------------------------------------------------
+
+
+class TestGradAccumForWorld:
+    def test_shrink_raises_accum_inversely(self):
+        assert grad_accum_for_world(1, 4, 2, 8) == 2  # half the world -> 2x
+        assert grad_accum_for_world(2, 4, 1, 8) == 8
+        assert grad_accum_for_world(1, 4, 4, 8) == 1  # no change
+
+    def test_grow_lowers_accum(self):
+        assert grad_accum_for_world(4, 2, 4, 8) == 2
+        assert grad_accum_for_world(1, 4, 8, 8) == 1  # never below 1
+
+    def test_walks_down_to_a_divisor(self):
+        # target 8*3//4=6 does not divide 8 -> walk to 4
+        assert grad_accum_for_world(8, 3, 4, 8) == 4
+        # never above global_batch
+        assert grad_accum_for_world(64, 8, 1, 16) == 16
+
+    def test_goodput_clamped(self):
+        assert goodput(8.0, 10.0) == 0.8
+        assert goodput(12.0, 10.0) == 1.0
+        assert goodput(1.0, 0.0) == 0.0
+
+
+# --------------------------------------------------------------------------
+# Policy: hysteresis + drain-shrink priority
+# --------------------------------------------------------------------------
+
+
+class TestPolicyHysteresis:
+    def _policy(self, cooldown=30.0, slices=3):
+        from kubedl_tpu.core.store import ObjectStore
+        from kubedl_tpu.elastic.policy import ElasticPolicy
+        from kubedl_tpu.workloads.tpujob import TPUJobController
+
+        store = ObjectStore()
+        inv = SliceInventory()
+        for i in range(slices):
+            inv.add_slice(f"s{i}", "cpu-1")
+        gang = SliceGangScheduler(store, inv)
+        ctrl = TPUJobController(local_addresses=True)
+        t = {"now": 1000.0}
+        policy = ElasticPolicy(
+            store, inv, gang, {"TPUJob": ctrl},
+            cooldown=cooldown, clock=lambda: t["now"],
+        )
+        job = make_tpujob("hj", workers=1, topology=get_slice("cpu-1"))
+        job.elastic = ElasticSpec(min_slices=1, max_slices=3)
+        ctrl.apply_defaults(job)
+        job.status.set_condition(JobConditionType.RUNNING, "test")
+        store.create(job)
+        return policy, store, inv, t
+
+    def _slices(self, store):
+        return store.get("TPUJob", "hj").num_slices
+
+    def test_at_most_one_grow_per_cooldown_window(self):
+        policy, store, inv, t = self._policy(cooldown=30.0, slices=3)
+        # hold s0 so only 1 slice is free: the first grow takes 1 -> 2
+        owner = owner_key("default", "hj")
+        assert inv.try_reserve("cpu-1", 1, owner) == ["s0"]
+        inv.try_reserve("cpu-1", 1, "default/other-gang")  # s1 parked
+        assert policy.reconcile(*policy.KEY) is None
+        assert self._slices(store) == 2
+        # capacity oscillates: other job frees its slice inside the window
+        inv.release("default/other-gang")
+        requeue = policy.reconcile(*policy.KEY)
+        assert self._slices(store) == 2  # damped: no second grow yet
+        assert requeue is not None and requeue > 0
+        t["now"] += 31.0  # window closes
+        assert policy.reconcile(*policy.KEY) is None
+        assert self._slices(store) == 3
+
+    def test_shrink_bypasses_cooldown(self):
+        policy, store, inv, t = self._policy(cooldown=30.0, slices=2)
+        owner = owner_key("default", "hj")
+        assert inv.try_reserve("cpu-1", 2, owner) == ["s0", "s1"]
+        store.update_with_retry(
+            "TPUJob", "hj", "default", lambda j: setattr(j, "num_slices", 2)
+        )
+        policy.reconcile(*policy.KEY)  # stamp the cooldown via a no-op scan
+        inv.mark_draining("s1", "reclaim in 60s")
+        policy.reconcile(*policy.KEY)  # immediately, no window wait
+        assert self._slices(store) == 1
+        assert any(
+            e.reason == "ElasticResize" for e in store.list("Event", None)
+        )
+
+    def test_no_shrink_without_draining_and_floor_respected(self):
+        policy, store, inv, t = self._policy(cooldown=0.0, slices=1)
+        owner = owner_key("default", "hj")
+        assert inv.try_reserve("cpu-1", 1, owner) == ["s0"]
+        policy.reconcile(*policy.KEY)
+        assert self._slices(store) == 1  # nothing free, nothing draining
+        inv.mark_draining("s0", "victim")
+        policy.reconcile(*policy.KEY)
+        # at min_slices the job stays put (eviction path is the fallback)
+        assert self._slices(store) == 1
+
+    def test_hands_off_terminal_and_fixed_size_jobs(self):
+        policy, store, inv, t = self._policy(cooldown=0.0, slices=3)
+        store.update_with_retry(
+            "TPUJob", "hj", "default",
+            lambda j: j.status.set_condition(JobConditionType.SUCCEEDED, "done"),
+        )
+        assert policy.reconcile(*policy.KEY) is None
+        assert self._slices(store) == 1
+        # fixed-size job (no elastic block): untouched even while RUNNING
+        fixed = make_tpujob("fx", workers=1, topology=get_slice("cpu-1"))
+        fixed.status.set_condition(JobConditionType.RUNNING, "test")
+        store.create(fixed)
+        policy.reconcile(*policy.KEY)
+        assert store.get("TPUJob", "fx").num_slices == 1
+
+
+# --------------------------------------------------------------------------
+# Engine: in-place resize + quarantine interaction
+# --------------------------------------------------------------------------
+
+
+class TestResizeQuarantine:
+    def test_resize_failures_count_against_reconcile_budget(self):
+        from tests.test_engine import make_engine
+
+        inv = SliceInventory()
+        inv.add_slice("qa", "cpu-1")
+        inv.add_slice("qb", "cpu-1")
+        engine, store, metrics = make_engine(inventory=inv)
+        job = make_tpujob("qz", workers=1, topology=get_slice("cpu-1"))
+        job.elastic = ElasticSpec(min_slices=1, max_slices=2)
+        engine.controller.apply_defaults(job)
+        store.create(job)
+        engine.reconcile("default", "qz")
+        PodDriver(store).run_all(store)
+        engine.reconcile("default", "qz")
+        assert store.get("TPUJob", "qz").status.phase == JobConditionType.RUNNING
+
+        def boom(job, gang, count):
+            raise RuntimeError("resize blew up")
+
+        engine.gang.resize_gang = boom
+        engine.quarantine_budget = 3
+        store.update_with_retry(
+            "TPUJob", "qz", "default", lambda j: setattr(j, "num_slices", 2)
+        )
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                engine.reconcile("default", "qz")
+        assert engine.reconcile("default", "qz") is None  # parked
+        got = store.get("TPUJob", "qz")
+        assert got.status.phase == JobConditionType.QUARANTINED
+        assert got.status.conditions[-1].reason == "ReconcileBudgetExhausted"
+        assert metrics.quarantined.value(kind="TPUJob") == 1.0
+
+
+# --------------------------------------------------------------------------
+# E2E: seeded preemption notice -> drain -> shrink -> clear -> grow
+# --------------------------------------------------------------------------
+
+_STOP = {"path": ""}
+
+
+def _gated_worker(env):
+    """ThreadRuntime entrypoint: runs until the test touches the stop file;
+    resize/restart cancellation exits retryably (the SIGKILL class)."""
+    cancel = (env or {}).get("_KUBEDL_CANCEL")
+    while not (_STOP["path"] and os.path.exists(_STOP["path"])):
+        if cancel is not None and getattr(cancel, "is_set", lambda: False)():
+            raise SystemExit(137)
+        time.sleep(0.02)
+    return 0
+
+
+class TestElasticE2E:
+    def test_preempt_shrink_clear_grow_under_seeded_chaos(self, tmp_path):
+        from kubedl_tpu.operator import Operator, OperatorOptions
+        from kubedl_tpu.runtime.executor import ThreadRuntime
+
+        _STOP["path"] = str(tmp_path / "stop")
+        inv = SliceInventory()
+        inv.add_slice("sa", "cpu-1")  # hosts: sa-host-0
+        inv.add_slice("sb", "cpu-1")  # hosts: sb-host-0
+        opts = OperatorOptions(
+            local_addresses=True,
+            artifact_registry_root=str(tmp_path / "reg"),
+            heartbeat_nodes=["sa-host-0", "sb-host-0"],
+            node_grace_seconds=2.0,  # beat interval ~0.67s
+        )
+        plan = FaultPlan(23, sites={"elastic.preempt": [FaultSpec.nth(2)]})
+        with Operator(opts, runtime=ThreadRuntime(), inventory=inv) as op:
+            job = make_tpujob(
+                "ejob", workers=2, topology=get_slice("cpu-1"),
+                entrypoint=f"{__name__}:_gated_worker",
+            )
+            job.elastic = ElasticSpec(
+                min_slices=1, max_slices=2, cooldown_seconds=0.2
+            )
+            job.num_slices = 2  # start at the ceiling: no startup grow
+            op.submit(job)
+            op.wait_for_phase("TPUJob", "ejob", JobConditionType.RUNNING,
+                              timeout=60)
+
+            with plan:
+                # beats visit nodes in heartbeat_nodes order, so nth(2)
+                # deterministically notices sb-host-0 -> slice sb drains
+                def shrunk():
+                    got = op.store.try_get("TPUJob", "ejob")
+                    return (
+                        got is not None
+                        and got.num_slices == 1
+                        and got.status.restart_count >= 1
+                        and len(pod_names(op.store)) == 1
+                    )
+
+                assert op.manager.wait(shrunk, timeout=60), \
+                    "job never shrank off the draining slice"
+                detail = {d["name"]: d for d in inv.detail()}
+                assert detail["sb"]["draining"] is True
+                assert detail["sa"]["allocated_to"] == "default/ejob-gang"
+                got = op.store.get("TPUJob", "ejob")
+                assert any(
+                    c.type == JobConditionType.RESIZING
+                    for c in got.status.conditions
+                )
+
+                # notice withdrawn: capacity returns, the policy grows back
+                op.node_heartbeater.clear_preemption("sb-host-0")
+
+                def grown():
+                    got = op.store.try_get("TPUJob", "ejob")
+                    return (
+                        got is not None
+                        and got.num_slices == 2
+                        and got.status.restart_count >= 2
+                        and len(pod_names(op.store)) == 2
+                    )
+
+                assert op.manager.wait(grown, timeout=60), \
+                    "job never grew back after the notice cleared"
+                assert not inv.draining_slices()
+
+                with open(_STOP["path"], "w") as f:
+                    f.write("done")
+                got = op.wait_for_phase(
+                    "TPUJob", "ejob",
+                    [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+                    timeout=60,
+                )
+            # deterministic: exactly the planned single injected notice
+            assert plan.faults("elastic.preempt") == 1
+            assert got.status.phase == JobConditionType.SUCCEEDED
+            assert got.num_slices == 2  # final world matches the fault plan
+            assert got.status.restart_count == 2  # shrink + grow, no extras
+            assert op.metrics.resizes.value(kind="TPUJob") == 2.0
+            assert op.metrics.preemption_notices.value() == 1.0
+            assert op.metrics.slices_draining.value() == 0.0
+            reasons = {e.reason for e in op.store.list("Event", None)}
+            assert "PreemptionNotice" in reasons
+            assert "PreemptionCleared" in reasons
+            assert "ElasticResize" in reasons
+
+
+# --------------------------------------------------------------------------
+# Reshard-resume equivalence: 4 -> 2 -> 4 matches fixed-size
+# --------------------------------------------------------------------------
+
+
+class TestReshardResume:
+    @pytest.mark.slow
+    def test_4_2_4_loss_trajectory_matches_fixed_size(self, tmp_path):
+        import jax
+
+        from kubedl_tpu.api.topology import MeshSpec
+        from kubedl_tpu.models import llama
+        from kubedl_tpu.parallel.mesh import build_mesh
+        from kubedl_tpu.training.checkpoint import restore_checkpoint
+        from kubedl_tpu.training.data import SyntheticTokens
+        from kubedl_tpu.training.trainer import TrainConfig, Trainer
+
+        assert jax.device_count() >= 4
+        model = llama.TINY
+        GB, SL, STEPS = 8, 16, 9
+
+        def cfg(accum):
+            return TrainConfig(model=model, global_batch=GB, seq_len=SL,
+                               steps=STEPS, grad_accum=accum)
+
+        def data_at(step):
+            it = iter(SyntheticTokens(GB, SL, model.vocab_size, seed=5))
+            for _ in range(step):
+                next(it)  # fit consumes one batch per step
+            return it
+
+        def run(trainer, start, stop, ckpt):
+            state = trainer.init_state()
+            if start > 0:
+                state = restore_checkpoint(ckpt, state)
+                assert state is not None
+                assert int(jax.device_get(state["step"])) == start
+            losses = []
+            state, _ = trainer.fit(
+                data_at(start), state=state, steps=stop,
+                on_step=lambda i, m: losses.append(m["loss"]),
+                ckpt_dir=ckpt,
+            )
+            return [float(jax.device_get(l)) for l in losses]
+
+        mesh4 = build_mesh(MeshSpec({"data": 4}), jax.devices()[:4])
+        mesh2 = build_mesh(MeshSpec({"data": 2}), jax.devices()[:2])
+
+        baseline = run(Trainer(cfg(1), mesh4), 0, STEPS,
+                       str(tmp_path / "base"))
+        assert len(baseline) == STEPS
+
+        # elastic: 4 devices for steps 0-2, shrink to 2 (grad_accum
+        # rescaled by the same helper the worker entrypoint uses), grow
+        # back to 4 — resuming through the cross-sharding assembler
+        ck = str(tmp_path / "elastic")
+        accum2 = grad_accum_for_world(1, 4, 2, GB)
+        assert accum2 == 2
+        losses = run(Trainer(cfg(1), mesh4), 0, 3, ck)
+        losses += run(Trainer(cfg(accum2), mesh2), 3, 6, ck)
+        losses += run(Trainer(cfg(1), mesh4), 6, STEPS, ck)
+        assert len(losses) == STEPS
+
+        # the effective global batch never changed, so the trajectory is
+        # the fixed-size one (modulo reduction-order float noise)
+        np.testing.assert_allclose(losses, baseline, rtol=2e-3, atol=2e-3)

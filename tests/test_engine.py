@@ -499,9 +499,9 @@ class TestElasticSliceResize:
             j.num_slices = 2
 
         store.update_with_retry("TPUJob", "el", "default", grow)
-        engine.reconcile("default", "el")  # detects drift: nukes gang+pods
+        engine.reconcile("default", "el")  # detects drift: in-place resize
         got = store.get("TPUJob", "el")
-        assert got.status.phase == JobConditionType.RESTARTING
+        assert got.status.phase == JobConditionType.RESIZING
         assert got.status.restart_count == 1
         assert pod_names(store) == []
         engine.reconcile("default", "el")  # re-admits at 2 slices
