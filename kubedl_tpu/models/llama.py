@@ -903,6 +903,139 @@ def prefill_batched(
     return logits, {"k": new_k, "v": new_v, "pos": pos.astype(jnp.int32)}
 
 
+# ---- prefix KV reuse (serving path) ----------------------------------------
+
+def copy_prefix_into_row(
+    cache: Params,
+    k: jax.Array,  # [L, P, KV, hd] cached prefix keys (P = padded bucket)
+    v: jax.Array,  # [L, P, KV, hd] cached prefix values
+    row,  # scalar int: batch row to graft into
+    length,  # scalar int: true prefix length (<= P)
+) -> Params:
+    """Graft a cached prefix's K/V into one batch row at offset 0.
+
+    The serving prefix cache stores device-resident per-layer K/V for
+    shared prompt prefixes; on a trie hit the engine copies them into the
+    freshly admitted row instead of recomputing them, and prefill then
+    consumes only the uncached SUFFIX. A per-row `dynamic_update_slice`
+    keeps this O(prefix) HBM traffic (the same idiom as `_row_update`);
+    under donation it is an in-place write. The entry is bucket-padded
+    (P >= length): the pad tail lands at positions >= pos and is masked
+    by the per-row validity until decode overwrites it — the exact
+    garbage-beyond-pos contract batched prefill already relies on.
+    ``pos`` is set to ``length`` so a decode step between graft and
+    suffix prefill cannot write inside the protected prefix span."""
+    ck = lax.dynamic_update_slice(cache["k"], k[:, None], (0, row, 0, 0, 0))
+    cv = lax.dynamic_update_slice(cache["v"], v[:, None], (0, row, 0, 0, 0))
+    length = jnp.asarray(length, jnp.int32)
+    pos = lax.dynamic_update_slice(cache["pos"], length[None], (row,))
+    return {"k": ck, "v": cv, "pos": pos}
+
+
+def extract_prefix_from_row(
+    cache: Params, row, p_len: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Read the first ``p_len`` cached K/V positions of one batch row
+    (a new prefix-cache entry, taken after that row's prefill filled
+    them). ``p_len`` is STATIC (the engine buckets entry lengths to
+    powers of two, so compiles stay bounded); ``row`` is traced. NOT
+    donated — the live batched cache must survive the copy."""
+    L, _, _, KV, hd = cache["k"].shape
+    k = lax.dynamic_slice(
+        cache["k"], (0, row, 0, 0, 0), (L, 1, p_len, KV, hd)
+    )[:, 0]
+    v = lax.dynamic_slice(
+        cache["v"], (0, row, 0, 0, 0), (L, 1, p_len, KV, hd)
+    )[:, 0]
+    return k, v
+
+
+def prefill_batched_from(
+    params: Params,
+    cache: Params,
+    tokens: jax.Array,  # [B, S] right-padded SUFFIX tokens
+    lengths: jax.Array,  # [B] suffix lengths; 0 = row untouched
+    starts: jax.Array,  # [B] per-row start offset (cached prefix length)
+    cfg: LlamaConfig,
+) -> Tuple[jax.Array, Params]:
+    """Suffix-only prefill: like :func:`prefill_batched`, but each row's
+    prompt tokens occupy GLOBAL positions [starts[b], starts[b]+lengths[b])
+    and attend over the K/V already resident in the cache below
+    ``starts[b]`` (a prefix grafted by :func:`copy_prefix_into_row`).
+    With ``starts == 0`` this is exactly whole-prompt prefill; with a
+    cached prefix the prompt cost drops from O(prompt) to O(suffix) —
+    the prefix-reuse win for shared-system-prompt serving traffic.
+
+    Differences from the root-prefill path, all per-row:
+    - rope runs at global positions ``starts[b] + s`` (gathered tables);
+    - K/V write via vmapped `dynamic_update_slice` at ``starts[b]``
+      (the `_row_update` idiom decode uses);
+    - attention queries the FULL cache row with an offset causal mask
+      ``t <= starts[b] + s``, so suffix queries see the grafted prefix.
+
+    Callers must keep ``starts[b] + S <= T`` for active rows (the engine
+    drops a graft rather than let the padded write clamp out of place).
+    Inactive rows (``lengths[b] == 0``) keep cache and pos untouched.
+    """
+    B, S = tokens.shape
+    hd = cfg.head_dim
+    max_s = cache["k"].shape[2]
+    active = lengths > 0
+    x = gather_embed(params["embed"], tokens).astype(cfg.dtype)  # [B, S, D]
+    if cfg.embed_scale:
+        x = x * math.sqrt(cfg.dim)
+    cos_full, sin_full = rope_freqs(cfg, max_s)  # [T, hd/2]
+    # global query positions, clamped so padded rows stay in-table
+    posq = jnp.minimum(
+        starts[:, None] + jnp.arange(S)[None, :], max_s - 1
+    )  # [B, S]
+    cos_t = cos_full[posq][:, :, None, :]  # [B, S, 1, hd/2]
+    sin_t = sin_full[posq][:, :, None, :]
+    # offset causal mask: suffix query s sees cache positions t <= start+s
+    mask = (
+        jnp.arange(max_s)[None, None, :] <= posq[:, :, None]
+    )[:, None, None]  # [B, 1, 1, S, T]
+    sel = active[:, None, None, None]
+
+    def rot(t):  # apply_rope with per-row-position tables
+        t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate(
+            [t1 * cos_t - t2 * sin_t, t1 * sin_t + t2 * cos_t], axis=-1
+        ).astype(t.dtype)
+
+    def body(x, inp):
+        lp, ck, cv = inp  # ck/cv: [B, T, KV, hd] this layer's cache
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps, cfg.norm_plus_one)
+        q = rot((h @ deq(lp["wq"])).reshape(B, S, cfg.n_heads, hd))
+        k = rot((h @ deq(lp["wk"])).reshape(B, S, cfg.n_kv_heads, hd))
+        v = (h @ deq(lp["wv"])).reshape(B, S, cfg.n_kv_heads, hd)
+        # write the suffix K/V at each row's start (inactive rows keep
+        # their cache bit-identical: mid-decode neighbours are sacred)
+        ck = jnp.where(sel, _row_update(ck, k, starts), ck)
+        cv = jnp.where(sel, _row_update(cv, v, starts), cv)
+        attn = attention(q, ck, cv, causal=False, mask=mask)
+        x = x + attn.reshape(B, S, cfg.n_heads * hd) @ deq(lp["wo"])
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps, cfg.norm_plus_one)
+        gate = _act(cfg)((h @ deq(lp["w_gate"])).astype(jnp.float32)).astype(h.dtype)
+        x = x + (gate * (h @ deq(lp["w_up"]))) @ deq(lp["w_down"])
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.norm_plus_one)
+    # head matmul only at each row's LAST suffix token (V is large)
+    idx = jnp.maximum(lengths - 1, 0)
+    x_last = jnp.take_along_axis(
+        x, idx[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]  # [B, D]
+    logits = (x_last @ lm_head_of(params, cfg)).astype(jnp.float32)
+    pos = jnp.where(
+        active, jnp.minimum(starts + lengths, max_s - 1), cache["pos"]
+    )
+    return logits, {"k": new_k, "v": new_v, "pos": pos.astype(jnp.int32)}
+
+
 def decode_step(
     params: Params, cache: Params, tokens: jax.Array, cfg: LlamaConfig
 ) -> Tuple[jax.Array, Params]:

@@ -268,6 +268,10 @@ class JobMetrics:
 _TICK_MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
                     100.0, 250.0, 500.0)
 
+#: TTFT spans queue wait + prefill + one harvest — ms to seconds scale
+_TTFT_MS_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                    1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
+
 
 class ServingMetrics:
     """The serving-engine metric family: decode-pipeline accounting
@@ -323,6 +327,44 @@ class ServingMetrics:
             "kubedl_tpu_serving_shed_requests",
             "Requests rejected 503 by the queue-depth/age load-shedding "
             "budget (the autoscaler treats shed load as backlog)",
+        )
+        # prefix KV cache family (kubedl_tpu/serving/prefix_cache.py):
+        # suffix-only prefill for shared-prompt traffic
+        self.prefix_hits = r.counter(
+            "kubedl_tpu_serving_prefix_cache_hits",
+            "Admissions whose prompt matched a cached prefix (grafted KV)",
+        )
+        self.prefix_misses = r.counter(
+            "kubedl_tpu_serving_prefix_cache_misses",
+            "Admissions with no usable cached prefix",
+        )
+        self.prefix_inserts = r.counter(
+            "kubedl_tpu_serving_prefix_cache_inserts",
+            "Prefix entries stored after prefill (shared >= min_seen "
+            "times, or request-tagged cacheable)",
+        )
+        self.prefix_evictions = r.counter(
+            "kubedl_tpu_serving_prefix_cache_evictions",
+            "Prefix entries LRU-evicted to stay under the byte budget",
+        )
+        self.prefix_tokens_saved = r.counter(
+            "kubedl_tpu_serving_prefix_cache_tokens_saved",
+            "Prompt tokens NOT prefilled because their KV came from the "
+            "prefix cache (counted at suffix-prefill dispatch)",
+        )
+        self.prefix_bytes = r.gauge(
+            "kubedl_tpu_serving_prefix_cache_bytes",
+            "Device bytes held by prefix-cache entries (k+v payloads)",
+        )
+        self.prefix_entries = r.gauge(
+            "kubedl_tpu_serving_prefix_cache_entries",
+            "Prefix entries currently resident",
+        )
+        self.ttft_ms = r.histogram(
+            "kubedl_tpu_serving_ttft_ms",
+            "Per-request time to first token (admission queue + prefill "
+            "+ first sampled id harvested), ms",
+            buckets=_TTFT_MS_BUCKETS,
         )
 
 

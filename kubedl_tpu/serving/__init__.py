@@ -9,4 +9,5 @@ router/console).
 """
 
 from kubedl_tpu.serving.controller import InferenceController  # noqa: F401
+from kubedl_tpu.serving.prefix_cache import PrefixCache, PrefixEntry  # noqa: F401
 from kubedl_tpu.serving.types import Inference, Predictor, TrafficPolicy  # noqa: F401

@@ -43,12 +43,17 @@ class EngineOverloaded(Exception):
 class _Slot:
     """One in-flight sequence occupying a batch row."""
 
-    def __init__(self, prompt, max_tokens: int, temperature: float) -> None:
+    def __init__(self, prompt, max_tokens: int, temperature: float,
+                 cache_prefix: bool = False) -> None:
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.temperature = temperature
+        self.cache_prefix = cache_prefix  # request opted into insertion
         self.fed = 0  # inputs consumed (prompt + generated)
         self.pending = 0  # tokens dispatched on device, not yet harvested
+        self.cached_len = 0  # prompt tokens grafted from the prefix cache
+        self.pinned = None  # PrefixEntry pinned while this row uses it
+        self.ttft_ms: Optional[float] = None
         self.out_ids: list = []
         self.done = threading.Event()
         self.result: Optional[Dict] = None
@@ -80,7 +85,9 @@ class LlamaEngine:
                  batch: int = 0, max_seq: int = 0, max_batch: int = 4,
                  quantize: str = "", mesh_axes: Optional[Dict] = None,
                  metrics=None, max_queue_depth: int = 64,
-                 max_queue_age_s: float = 30.0) -> None:
+                 max_queue_age_s: float = 30.0,
+                 prefix_cache_mb: float = 64.0,
+                 prefix_min_len: int = 8) -> None:
         import jax
 
         from kubedl_tpu.models import llama
@@ -128,6 +135,24 @@ class LlamaEngine:
             lambda p, c, t, l: llama.prefill_batched(p, c, t, l, self.cfg),
             donate_argnums=(1,),
         )
+        #: suffix-only prefill (per-row start offsets): newly admitted
+        #: rows with a grafted prefix consume only their uncached tail.
+        #: Same power-of-2 bucketing as _prefill, so compile count stays
+        #: bounded (<= one per bucket per path).
+        self._prefill_from = jax.jit(
+            lambda p, c, t, l, st: llama.prefill_batched_from(
+                p, c, t, l, st, self.cfg
+            ),
+            donate_argnums=(1,),
+        )
+        #: prefix-cache device ops: graft writes a cached entry's K/V
+        #: into a row (donated: in-place in HBM), extract copies a row's
+        #: prefix span out as a new entry (NOT donated — the live cache
+        #: survives). One compile per entry bucket length.
+        self._graft = jax.jit(llama.copy_prefix_into_row, donate_argnums=(0,))
+        self._extract = jax.jit(
+            llama.extract_prefix_from_row, static_argnums=(2,)
+        )
         # first-token sampler, ON DEVICE: fetching the prefill logits to
         # sample on the host moved the full [B, V] array over the wire —
         # 8MB for Gemma-2B at B=8, measured ~0.8s of the engine's TTFT on
@@ -151,9 +176,24 @@ class LlamaEngine:
         self._cache = llama.init_batched_cache(
             self.cfg, self.max_batch, self.max_seq
         )
+        from collections import deque as _deque
+
         self._slots: list = [None] * self.max_batch
-        self._waiting: list = []
+        # deque: admission pops the HEAD (popleft) and shedding peeks head
+        # age on every generate() — a plain list made both O(n) in queue
+        # depth, which showed up in the scheduler microbench under bursts
+        self._waiting: "_deque[_Slot]" = _deque()
         self._cv = threading.Condition()
+        #: device-resident prefix KV cache (docs/serving.md "Prefix
+        #: cache"): admission grafts the longest cached prefix into the
+        #: row and prefills only the suffix. 0 MB disables it.
+        from kubedl_tpu.serving.prefix_cache import PrefixCache
+
+        self._pcache: Optional[PrefixCache] = (
+            PrefixCache(int(prefix_cache_mb * 1e6), min_len=prefix_min_len)
+            if prefix_cache_mb > 0 else None
+        )
+        self._prefix_evictions_seen = 0  # metric delta vs pcache stats
         self._stop = False
         #: jitted multi-step decode segments keyed by (n_steps, greedy)
         #: + the PRNG chain for on-device sampling — llama.decode_segment
@@ -204,6 +244,8 @@ class LlamaEngine:
         #: shed timestamps, same window: the autoscaler folds recent sheds
         #: into its backlog signal (rejected demand is still demand)
         self._shed_recent: "deque[float]" = deque(maxlen=100_000)
+        #: per-request time-to-first-token samples (ms) for p50/p95
+        self._ttft_recent: "deque[float]" = deque(maxlen=4096)
         self.qps_window_s = 60.0
         self._warmup()
         self._thread = threading.Thread(
@@ -230,13 +272,14 @@ class LlamaEngine:
     # -- request path ------------------------------------------------------
 
     def generate(self, prompt_ids, max_tokens: int = 16,
-                 temperature: float = 0.0, timeout_s: float = 600.0) -> Dict:
+                 temperature: float = 0.0, timeout_s: float = 600.0,
+                 cache_prefix: bool = False) -> Dict:
         budget = self.max_seq - 1
         prompt = [int(t) for t in list(prompt_ids)[:budget]]
         if not prompt:
             prompt = [0]
         max_tokens = max(0, min(int(max_tokens), budget - len(prompt)))
-        slot = _Slot(prompt, max_tokens, float(temperature))
+        slot = _Slot(prompt, max_tokens, float(temperature), cache_prefix)
         with self._cv:
             depth = len(self._waiting)
             head_age = (
@@ -266,6 +309,9 @@ class LlamaEngine:
                 for i, s in enumerate(self._slots):
                     if s is slot:
                         self._slots[i] = None
+                # a vacated row must not keep its prefix-cache entry
+                # pinned forever — the pin would block eviction for good
+                self._release_prefix_locked(slot)
         result = slot.result or {"error": "timed out"}
         with self._cv:
             self._stats["requests"] += 1
@@ -275,26 +321,41 @@ class LlamaEngine:
         return result
 
     def stats(self) -> Dict:
-        """Live serving counters (feeds autoscaling signals + /v1/stats)."""
+        """Live serving counters (feeds autoscaling signals + /v1/stats).
+
+        One snapshot under ONE cv acquisition: the old code re-took the
+        lock three times, so counters, the qps window, and the queue
+        depth could describe three different moments of a moving engine.
+        Derived values are computed outside the lock from the snapshot."""
+        now = time.time()
         with self._cv:
             out = dict(self._stats)
-        now = time.time()
+            recent = sum(1 for t in self._recent if t > now - self.qps_window_s)
+            shed_recent = sum(
+                1 for t in self._shed_recent if t > now - self.qps_window_s
+            )
+            queued = len(self._waiting)
+            active = sum(1 for s in self._slots if s is not None)
+            ttft = list(self._ttft_recent)
         up = max(now - out["started_at"], 1e-9)
         out["uptime_s"] = round(up, 1)
         # windowed rate over min(window, uptime): a fresh engine under a
         # burst reports the burst, a long-idle engine reports ~0
-        with self._cv:
-            recent = sum(1 for t in self._recent if t > now - self.qps_window_s)
         span = min(self.qps_window_s, up)
         out["qps"] = round(recent / max(span, 1e-9), 3)
         out["lifetime_qps"] = round(out["requests"] / up, 3)
-        out["active_slots"] = sum(1 for s in self._slots if s is not None)
+        out["active_slots"] = active
         out["max_batch"] = self.max_batch
-        with self._cv:
-            out["queued"] = len(self._waiting)
-            out["shed_recent"] = sum(
-                1 for t in self._shed_recent if t > now - self.qps_window_s
+        out["queued"] = queued
+        out["shed_recent"] = shed_recent
+        if ttft:
+            srt = sorted(ttft)
+            out["ttft_ms_p50"] = round(srt[len(srt) // 2], 3)
+            out["ttft_ms_p95"] = round(
+                srt[min(len(srt) - 1, int(len(srt) * 0.95))], 3
             )
+        if self._pcache is not None:
+            out["prefix_cache"] = self._pcache.stats()
         out["pipeline"] = self.pipeline_stats()
         return out
 
@@ -339,13 +400,67 @@ class LlamaEngine:
 
     # -- scheduler loop ----------------------------------------------------
 
+    def _release_prefix_locked(self, slot: _Slot) -> None:
+        """Drop a slot's pin on its grafted prefix entry (finalize /
+        vacation / error recovery). Idempotent; caller holds cv."""
+        if slot.pinned is not None and self._pcache is not None:
+            self._pcache.unpin(slot.pinned)
+        slot.pinned = None
+
+    def _maybe_insert_prefix_locked(self, i: int, s: _Slot) -> None:
+        """After row ``i``'s prefill completes, store its prompt prefix
+        when traffic says it is shared (observation trie: >= min_seen
+        requests walked it) or the request tagged itself cacheable.
+        Extraction is an async device copy dispatched BEFORE any later
+        graft into the same row, so the copied span is this prefill's
+        output even if the row turns over immediately. Caller holds cv."""
+        if self._pcache is None:
+            return
+        cand = self._pcache.insert_candidate(s.prompt, s.cache_prefix)
+        # cap at len-1: a full-prompt entry can never match (the engine
+        # always needs >= 1 suffix token for last-token logits), while
+        # len-1 serves exact-repeat traffic too
+        cand = min(cand, len(s.prompt) - 1)
+        if cand <= s.cached_len or cand < self._pcache.min_len:
+            return  # nothing new beyond what the matched entry covers
+        k, v = self._extract(self._cache, i, self._prefill_bucket(cand))
+        if self._pcache.insert(s.prompt[:cand], k, v, cand):
+            st = self._pcache.stats()
+            m = self.metrics
+            m.prefix_inserts.inc()
+            m.prefix_bytes.set(float(st["bytes"]))
+            m.prefix_entries.set(float(st["entries"]))
+            ev = st["evictions"] - self._prefix_evictions_seen
+            if ev > 0:
+                m.prefix_evictions.inc(ev)
+            self._prefix_evictions_seen = st["evictions"]
+
     def _admit_locked(self) -> None:
         for i in range(self.max_batch):
             if self._slots[i] is None and self._waiting:
-                slot = self._waiting.pop(0)
+                slot = self._waiting.popleft()
                 self._slots[i] = slot
                 # reset this row's position; stale KV is masked by pos
                 self._cache["pos"] = self._cache["pos"].at[i].set(0)
+                if self._pcache is None:
+                    continue
+                # prefix reuse: graft the longest cached prefix into the
+                # row NOW (its K/V land in HBM, pos := prefix len) so the
+                # prefill dispatch only consumes the suffix. Ordering is
+                # safe: within a tick, prefill dispatch precedes decode
+                # dispatch, and pos = prefix_len keeps decode writes out
+                # of the grafted span.
+                self._pcache.observe(slot.prompt)
+                entry, mlen = self._pcache.match(slot.prompt)
+                if entry is None:
+                    self.metrics.prefix_misses.inc()
+                    continue
+                self.metrics.prefix_hits.inc()
+                self._cache = self._graft(
+                    self._cache, entry.k, entry.v, i, mlen
+                )
+                slot.cached_len = mlen
+                slot.pinned = entry
 
     def _loop(self) -> None:
         while True:
@@ -360,6 +475,7 @@ class LlamaEngine:
                         if s is not None:
                             s.result = {"error": str(e)}
                             self._slots[i] = None
+                            self._release_prefix_locked(s)
                             s.done.set()
                     # the cache is DONATED to prefill/decode: a call that
                     # raised after donation leaves self._cache pointing at
@@ -427,8 +543,12 @@ class LlamaEngine:
                 "tokens_per_sec": round(
                     len(s.out_ids) / (ms / 1e3), 2
                 ) if ms > 0 else 0.0,
+                "cached_prefix_len": s.cached_len,
             }
+            if s.ttft_ms is not None:
+                s.result["ttft_ms"] = round(s.ttft_ms, 3)
             self._slots[i] = None
+            self._release_prefix_locked(s)
             s.done.set()
 
     def _segment_fn(self, n_steps: int, greedy: bool):
@@ -510,14 +630,25 @@ class LlamaEngine:
         t0 = time.perf_counter()
         ids = np.asarray(self._jax.device_get(ids_dev))
         t1 = time.perf_counter()
+        now = time.perf_counter()
         with self._cv:
             for i, s, budgeted in pre:
                 if budgeted:
                     s.pending -= 1
                 if self._slots[i] is not s:
-                    continue  # vacated (request timeout) mid-prefill
+                    # vacated (request timeout) mid-prefill; the vacate
+                    # path already released any prefix pin
+                    continue
+                if budgeted and s.ttft_ms is None:
+                    s.ttft_ms = (now - s.t0) * 1e3
+                    self._ttft_recent.append(s.ttft_ms)
+                    self.metrics.ttft_ms.observe(s.ttft_ms)
                 if budgeted:
                     s.out_ids.append(int(ids[i]))
+                # the row's prefix KV is now self-contained (prefill has
+                # completed) — the grafted entry no longer needs its pin
+                self._release_prefix_locked(s)
+                self._maybe_insert_prefix_locked(i, s)
                 self._maybe_finalize_locked(i, s)
             self._admit_locked()
             self._cv.notify_all()
@@ -629,19 +760,50 @@ class LlamaEngine:
         todo = [(i, s) for i, s in enumerate(active)
                 if s is not None and s.fed == 0]
         if todo:
-            bucket = self._prefill_bucket(max(len(s.prompt) for _, s in todo))
+            # suffix-only prefill: rows with a grafted prefix consume only
+            # prompt[cached_len:]. The bucket is sized by the LONGEST
+            # suffix; `lax.dynamic_update_slice` CLAMPS out-of-bounds
+            # starts, so any graft whose start + bucket would spill past
+            # max_seq is dropped (full prefill for that row) and the
+            # bucket recomputed — terminates because starts=0 always fits.
+            while True:
+                bucket = self._prefill_bucket(
+                    max(len(s.prompt) - s.cached_len for _, s in todo)
+                )
+                bad = [(i, s) for i, s in todo
+                       if s.cached_len and s.cached_len + bucket > self.max_seq]
+                if not bad:
+                    break
+                with self._cv:
+                    for _, s in bad:
+                        s.cached_len = 0
+                        self._release_prefix_locked(s)
             toks = np.zeros((self.max_batch, bucket), np.int32)
             lens = np.zeros((self.max_batch,), np.int32)
+            starts = np.zeros((self.max_batch,), np.int32)
             temps0 = np.zeros((self.max_batch,), np.float32)
             for i, s in todo:
-                toks[i, : len(s.prompt)] = s.prompt
-                lens[i] = len(s.prompt)
+                suffix = s.prompt[s.cached_len:]
+                toks[i, : len(suffix)] = suffix
+                lens[i] = len(suffix)
+                starts[i] = s.cached_len
                 temps0[i] = max(float(s.temperature), 0.0)
             self._key, pick_key = self._jax.random.split(self._key)
             t0 = time.perf_counter()
-            logits, self._cache = self._prefill(
-                self.params, self._cache, jnp.asarray(toks), jnp.asarray(lens)
-            )
+            if np.any(starts > 0):
+                logits, self._cache = self._prefill_from(
+                    self.params, self._cache, jnp.asarray(toks),
+                    jnp.asarray(lens), jnp.asarray(starts),
+                )
+                saved = int(starts.sum())
+                if self._pcache is not None:
+                    self._pcache.add_tokens_saved(saved)
+                self.metrics.prefix_tokens_saved.inc(saved)
+            else:
+                logits, self._cache = self._prefill(
+                    self.params, self._cache, jnp.asarray(toks),
+                    jnp.asarray(lens),
+                )
             prefill_ids = self._sample_logits(
                 logits, jnp.asarray(temps0), pick_key
             )  # [B] int32, stays on device until after the next dispatch
@@ -831,6 +993,7 @@ def make_handler(engine: LlamaEngine, model_name: str):
                     req.get("prompt_ids", []),
                     int(req.get("max_tokens", 16)),
                     float(req.get("temperature", 0.0)),
+                    cache_prefix=bool(req.get("cache_prefix", False)),
                 )
                 self._json(200, result)
             except EngineOverloaded as e:
@@ -859,6 +1022,7 @@ def engine_kwargs(cfg: Dict, ckpt_dir: str) -> Dict:
         "mesh_axes": cfg.get("mesh") or None,
         "max_queue_depth": int(cfg.get("max_queue_depth", 64)),
         "max_queue_age_s": float(cfg.get("max_queue_age_s", 30.0)),
+        "prefix_cache_mb": float(cfg.get("prefix_cache_mb", 64.0)),
     }
 
 

@@ -20,6 +20,8 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 ARTIFACT = "BENCH_r05_builder.json"
+#: prefix-cache serving row (r6): separate artifact, same runs[] shape
+PREFIX_ARTIFACT = "BENCH_r06_prefix.json"
 
 
 def _runs_median(runs, *path) -> float:
@@ -73,12 +75,35 @@ def expected_strings(artifact: dict) -> dict:
     return out
 
 
+def expected_prefix_strings(artifact: dict) -> dict:
+    """README prefix-cache row strings derived from BENCH_r06_prefix.json."""
+    runs = artifact["runs"]
+    tgt = ("targets", "prefix_reuse")
+    off = _runs_median(runs, *tgt, "ttft_ms_p50_cache_off")
+    on = _runs_median(runs, *tgt, "ttft_ms_p50_cache_on")
+    saved = _runs_median(runs, *tgt, "tokens_saved")
+    return {
+        f"TTFT p50 **{off:.2f} -> {on:.2f} ms**":
+            "medians of runs[].targets.prefix_reuse.ttft_ms_p50_cache_*",
+        f"{off / on:.2f}x":
+            "ratio of the ttft_ms_p50_cache_off/_on medians",
+        f"{saved:,.0f} prefill tokens saved":
+            "median of runs[].targets.prefix_reuse.tokens_saved",
+    }
+
+
 def check(repo: Path = REPO) -> list:
     """Returns a list of mismatch descriptions (empty = README is clean)."""
     artifact = json.loads((repo / ARTIFACT).read_text())
     readme = (repo / "README.md").read_text()
+    expected = expected_strings(artifact)
+    expected.update(
+        expected_prefix_strings(
+            json.loads((repo / PREFIX_ARTIFACT).read_text())
+        )
+    )
     problems = []
-    for text, derivation in expected_strings(artifact).items():
+    for text, derivation in expected.items():
         if text not in readme:
             problems.append(
                 f"README.md is missing {text!r} (derived from {derivation})"

@@ -36,6 +36,13 @@ sys.path.insert(
 #: loop (the r5 overhead bug class this guards against).
 TICK_BUDGET_MS = 5.0
 
+#: p50 per-tick budget (ms) for ticks that ride the PREFIX-CACHE path:
+#: admission additionally walks the observation trie, matches the
+#: prompt, and dispatches a graft. All host-side trie work on prompts of
+#: a few hundred tokens — the same 5 ms envelope must hold, or prefix
+#: reuse would pay back its prefill savings as scheduler overhead.
+PREFIX_BUDGET_MS = 5.0
+
 
 def build_stub_engine(max_batch: int = 4, max_seq: int = 128):
     """A real LlamaEngine whose device calls are instant stubs: the
@@ -131,10 +138,86 @@ def run_microbench(requests: int = 32, max_tokens: int = 32,
         eng.close()
 
 
+def run_prefix_microbench(requests: int = 32, max_tokens: int = 8,
+                          max_batch: int = 4, prefix_len: int = 64) -> dict:
+    """Host overhead of the prefix-cache admission path: every request
+    shares a ``prefix_len``-token prefix already stored in the cache, so
+    each admission walks the observation trie, longest-prefix-matches,
+    pins, and dispatches a (stubbed) graft + suffix prefill. Reports the
+    engine's tick accounting plus an isolated match+graft microtiming."""
+    import numpy as np
+
+    from kubedl_tpu.serving.server import _Slot
+
+    eng = build_stub_engine(max_batch=max_batch)
+    try:
+        eng._graft = lambda c, k, v, row, n: c
+        eng._extract = lambda c, i, p: (None, None)
+        eng._prefill_from = lambda p, c, t, l, st: (
+            eng._prefill(p, c, t, l)
+        )
+        prefix = list(range(3, 3 + prefix_len))
+        payload = np.zeros((1,), np.float32)
+        assert eng._pcache is not None, "stub engine must enable the cache"
+        assert eng._pcache.insert(prefix, payload, payload, prefix_len)
+        # isolated host cost of one match (trie walk + pin) + graft
+        # dispatch, without the rest of the tick around it
+        probe = prefix + [999]
+        iters = 2000
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            e, n = eng._pcache.match(probe)
+            eng._graft(eng._cache, e.k, e.v, 0, n)
+            eng._pcache.unpin(e)
+        match_graft_ms = (time.perf_counter() - t0) * 1e3 / iters
+        hits0 = eng._pcache.stats()["hits"]
+
+        slots = [
+            _Slot(prefix + [1000 + j], max_tokens, 0.0)
+            for j in range(requests)
+        ]
+        with eng._cv:
+            eng._waiting.extend(slots)
+            eng._cv.notify_all()
+        eng._loop_once()  # warm tick, then reset counters
+        with eng._cv:
+            for k in eng._pipe:
+                eng._pipe[k] = 0.0 if isinstance(
+                    eng._pipe[k], float
+                ) else 0
+            eng._pipe_recent.clear()
+        ticks = 0
+        while not all(s.done.is_set() for s in slots):
+            eng._loop_once()
+            ticks += 1
+            if ticks > requests * max_tokens + 100:
+                raise RuntimeError("prefix microbench did not converge")
+        st = eng._pcache.stats()
+        pipe = eng.pipeline_stats()
+        tick_p50 = pipe.get("tick_ms_p50", 0.0)
+        return {
+            "requests": requests,
+            "prefix_len": prefix_len,
+            "hits": st["hits"] - hits0,
+            "tokens_saved": st["tokens_saved"],
+            "ticks": pipe["ticks"],
+            "tick_ms_p50": tick_p50,
+            "match_graft_ms": round(match_graft_ms, 4),
+            "budget_ms": PREFIX_BUDGET_MS,
+            "within_budget": (
+                tick_p50 <= PREFIX_BUDGET_MS
+                and match_graft_ms <= PREFIX_BUDGET_MS
+            ),
+        }
+    finally:
+        eng.close()
+
+
 def main() -> int:
     out = run_microbench()
+    out["prefix"] = run_prefix_microbench()
     print(json.dumps(out, indent=2))
-    return 0 if out["within_budget"] else 1
+    return 0 if out["within_budget"] and out["prefix"]["within_budget"] else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,167 @@
+"""Host-side tests for the prefix KV cache (kubedl_tpu.serving.prefix_cache).
+
+Pure data-structure behavior: trie matching, LRU + byte budget, refcount
+pinning, the observation trie's shared-prefix candidates. Payloads are
+numpy arrays (the cache only reads ``.nbytes``) — no device work.
+"""
+
+import numpy as np
+
+from kubedl_tpu.serving.prefix_cache import PrefixCache
+
+
+def _kv(n_bytes: int = 1024):
+    half = max(1, n_bytes // 8 // 2)
+    return np.zeros((half,), np.float64), np.zeros((half,), np.float64)
+
+
+def _insert(pc, tokens, n_bytes: int = 1024):
+    k, v = _kv(n_bytes)
+    return pc.insert(tokens, k, v, len(tokens))
+
+
+class TestMatch:
+    def test_longest_stored_prefix_wins(self):
+        pc = PrefixCache(1 << 20, min_len=1)
+        _insert(pc, [1, 2])
+        _insert(pc, [1, 2, 3, 4])
+        entry, n = pc.match([1, 2, 3, 4, 9, 9])
+        assert n == 4 and entry.tokens == (1, 2, 3, 4)
+        pc.unpin(entry)
+
+    def test_match_must_leave_a_suffix_token(self):
+        # the engine needs >= 1 uncached token for last-token logits: a
+        # full-prompt entry is unusable for that exact prompt
+        pc = PrefixCache(1 << 20, min_len=1)
+        _insert(pc, [1, 2, 3])
+        entry, n = pc.match([1, 2, 3])
+        assert entry is None and n == 0
+        entry, n = pc.match([1, 2, 3, 4])
+        assert n == 3
+        pc.unpin(entry)
+
+    def test_miss_on_divergent_prompt(self):
+        pc = PrefixCache(1 << 20, min_len=1)
+        _insert(pc, [1, 2, 3])
+        assert pc.match([7, 8, 9, 10]) == (None, 0)
+        assert pc.stats()["misses"] == 1
+
+    def test_match_pins_and_caller_unpins(self):
+        pc = PrefixCache(1 << 20, min_len=1)
+        _insert(pc, [1, 2])
+        entry, _ = pc.match([1, 2, 3])
+        assert entry.refs == 1
+        pc.match([1, 2, 4])
+        assert entry.refs == 2
+        pc.unpin(entry)
+        pc.unpin(entry)
+        assert entry.refs == 0
+
+
+class TestEviction:
+    def test_lru_order(self):
+        pc = PrefixCache(3 * 1024, min_len=1)
+        _insert(pc, [1], 1024)
+        _insert(pc, [2], 1024)
+        _insert(pc, [3], 1024)
+        # touch [1]: oldest unused is now [2]
+        e, _ = pc.match([1, 99])
+        pc.unpin(e)
+        _insert(pc, [4], 1024)  # evicts [2]
+        assert pc.match([2, 99]) == (None, 0)
+        e, _ = pc.match([1, 99])
+        assert e is not None
+        pc.unpin(e)
+        assert pc.stats()["evictions"] == 1
+
+    def test_pinned_entries_never_evicted(self):
+        pc = PrefixCache(2 * 1024, min_len=1)
+        _insert(pc, [1], 1024)
+        _insert(pc, [2], 1024)
+        pinned, _ = pc.match([1, 99])  # pin the LRU candidate
+        assert _insert(pc, [3], 2048) is False  # would need BOTH evicted
+        assert pc.match([1, 99])[0] is not None  # survived
+        st = pc.stats()
+        assert st["insert_rejects"] == 1 and st["pinned"] == 1
+
+    def test_oversized_entry_rejected(self):
+        pc = PrefixCache(1024, min_len=1)
+        assert _insert(pc, [1], 4096) is False
+        assert len(pc) == 0 and pc.stats()["insert_rejects"] == 1
+
+    def test_byte_accounting_across_evictions(self):
+        pc = PrefixCache(4 * 1024, min_len=1)
+        for t in range(8):
+            _insert(pc, [t], 1024)
+        st = pc.stats()
+        assert st["bytes"] <= pc.budget_bytes
+        assert st["entries"] == 4 and st["evictions"] == 4
+
+    def test_duplicate_insert_refreshes_not_duplicates(self):
+        pc = PrefixCache(1 << 20, min_len=1)
+        assert _insert(pc, [1, 2]) is True
+        assert _insert(pc, [1, 2]) is False  # dedup: LRU refresh only
+        st = pc.stats()
+        assert st["entries"] == 1 and st["inserts"] == 1
+
+    def test_eviction_prunes_trie(self):
+        pc = PrefixCache(1 << 20, min_len=1)
+        _insert(pc, [1, 2, 3])
+        _insert(pc, [1, 9])
+        pc._remove_locked(pc._entries[(1, 2, 3)])
+        # sibling branch intact, removed branch gone
+        assert pc.match([1, 2, 3, 4]) == (None, 0)
+        e, n = pc.match([1, 9, 5])
+        assert n == 2
+        pc.unpin(e)
+
+
+class TestObservation:
+    def test_shared_prefix_becomes_candidate_after_min_seen(self):
+        pc = PrefixCache(1 << 20, min_len=4, min_seen=2)
+        sys_prompt = [5, 6, 7, 8, 9, 10]
+        a = sys_prompt + [100, 101]
+        b = sys_prompt + [200, 201]
+        pc.observe(a)
+        assert pc.insert_candidate(a) == 0  # seen once: nothing shared yet
+        pc.observe(b)
+        # the LCP of the two requests — exactly the system prompt
+        assert pc.insert_candidate(b) == len(sys_prompt)
+
+    def test_min_len_floor(self):
+        pc = PrefixCache(1 << 20, min_len=8, min_seen=2)
+        short = [1, 2, 3]
+        pc.observe(short)
+        pc.observe(short)
+        assert pc.insert_candidate(short) == 0  # shared but too short
+
+    def test_tagged_request_skips_observation(self):
+        pc = PrefixCache(1 << 20, min_len=4, min_seen=2)
+        p = [1, 2, 3, 4, 5]
+        assert pc.insert_candidate(p, tagged=True) == len(p)
+        assert pc.insert_candidate([1, 2], tagged=True) == 0  # < min_len
+
+    def test_observation_node_bound_respected(self):
+        pc = PrefixCache(1 << 20, min_len=1, min_seen=1, max_obs_nodes=10)
+        for t in range(50):
+            pc.observe([t, t + 1000])
+        assert pc._obs_nodes <= 10
+
+
+class TestAccounting:
+    def test_tokens_saved_counter(self):
+        pc = PrefixCache(1 << 20)
+        pc.add_tokens_saved(12)
+        pc.add_tokens_saved(0)
+        pc.add_tokens_saved(-3)  # dropped grafts never subtract
+        assert pc.stats()["tokens_saved"] == 12
+
+    def test_hit_rate(self):
+        pc = PrefixCache(1 << 20, min_len=1)
+        _insert(pc, [1, 2])
+        e, _ = pc.match([1, 2, 3])
+        pc.unpin(e)
+        pc.match([9, 9, 9])
+        st = pc.stats()
+        assert st["hits"] == 1 and st["misses"] == 1
+        assert st["hit_rate"] == 0.5

@@ -562,13 +562,13 @@ def test_generate_timeout_frees_slot():
         eng._thread.join(timeout=10)
         out = eng.generate([1, 2], max_tokens=4, timeout_s=0.2)
         assert out["error"] == "timed out"
-        assert eng._waiting == []  # queue entry released
+        assert list(eng._waiting) == []  # queue entry released
         # row-occupying case: simulate a slot stuck mid-decode
         stuck = _Slot([1], 4, 0.0)
         eng._slots[0] = stuck
         out2 = eng.generate([3], max_tokens=1, timeout_s=0.2)
         assert out2["error"] == "timed out"
-        assert eng._waiting == []
+        assert list(eng._waiting) == []
     finally:
         eng._thread.join(timeout=1)
 
@@ -733,12 +733,16 @@ class TestShardedServing:
         assert kw == {"preset": "tiny", "ckpt_dir": "/ckpts/m",
                       "max_batch": 3, "quantize": "int8",
                       "mesh_axes": {"tensor": 2},
-                      "max_queue_depth": 8, "max_queue_age_s": 5.0}
+                      "max_queue_depth": 8, "max_queue_age_s": 5.0,
+                      "prefix_cache_mb": 64.0}
         defaults = engine_kwargs({}, "")
         assert defaults["mesh_axes"] is None
         # load-shedding budget defaults ride the config too
         assert defaults["max_queue_depth"] == 64
         assert defaults["max_queue_age_s"] == 30.0
+        # prefix cache rides the config (0 disables it)
+        assert defaults["prefix_cache_mb"] == 64.0
+        assert engine_kwargs({"prefix_cache_mb": 0}, "")["prefix_cache_mb"] == 0.0
 
 
 class TestSegmentPolicy:
@@ -1010,3 +1014,194 @@ class TestSchedulerMicrobench:
         assert out["tokens"] == 8 * 16
         assert out["tick_ms_p50"] <= TICK_BUDGET_MS, out
         assert out["within_budget"], out
+
+    def test_prefix_match_graft_within_budget(self):
+        """The prefix-cache admission path (observe + longest-prefix
+        match + graft dispatch) is pure host work — it must fit the same
+        per-tick envelope or reuse pays its savings back as overhead."""
+        from scripts.scheduler_microbench import (
+            PREFIX_BUDGET_MS,
+            run_prefix_microbench,
+        )
+
+        out = run_prefix_microbench(requests=8, max_tokens=8, max_batch=4)
+        assert out["hits"] == 8, out  # every request rode the cache
+        assert out["tokens_saved"] >= 8 * out["prefix_len"]
+        assert out["tick_ms_p50"] <= PREFIX_BUDGET_MS, out
+        assert out["match_graft_ms"] <= PREFIX_BUDGET_MS, out
+        assert out["within_budget"], out
+
+
+class TestPrefixReuse:
+    """Device-resident prefix KV cache (docs/serving.md "Prefix cache"):
+    suffix-only prefill must be EXACTLY equivalent to full prefill for
+    greedy decoding — causal attention's KV at position p depends only
+    on tokens <= p, so a grafted cached prefix changes nothing."""
+
+    def _freeze(self, eng):
+        with eng._cv:
+            eng._stop = True
+            eng._cv.notify_all()
+        eng._thread.join(timeout=10)
+        eng._stop = False
+
+    def test_suffix_prefill_matches_full_prefill(self):
+        """Model-level equivalence: extract a row's prefix KV, graft it
+        into a fresh cache, prefill only the suffix — same last-token
+        logits, same cache contents over the valid span, same pos."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kubedl_tpu.models import llama
+
+        cfg = llama.TINY
+        params = llama.llama_init(jax.random.PRNGKey(0), cfg)
+        B, T = 2, 64
+        prompt = list(range(1, 21))  # 20 tokens: prefix 12 + suffix 8
+        toks = np.zeros((B, 32), np.int32)
+        toks[0, :20] = prompt
+        lens = jnp.asarray(np.array([20, 0], np.int32))
+        cache = llama.init_batched_cache(cfg, B, T)
+        full_logits, full_cache = llama.prefill_batched(
+            params, cache, jnp.asarray(toks), lens, cfg
+        )
+        # entry payload: first 16 positions of row 0 (12 valid + pad)
+        k, v = llama.extract_prefix_from_row(full_cache, 0, 16)
+        cache2 = llama.init_batched_cache(cfg, B, T)
+        cache2 = llama.copy_prefix_into_row(cache2, k, v, 0, 12)
+        assert int(cache2["pos"][0]) == 12
+        suf = np.zeros((B, 16), np.int32)
+        suf[0, :8] = prompt[12:]
+        suf_logits, suf_cache = llama.prefill_batched_from(
+            params, cache2, jnp.asarray(suf),
+            jnp.asarray(np.array([8, 0], np.int32)),
+            jnp.asarray(np.array([12, 0], np.int32)), cfg,
+        )
+        np.testing.assert_allclose(
+            np.asarray(suf_logits[0]), np.asarray(full_logits[0]),
+            rtol=2e-4, atol=2e-4,
+        )
+        assert int(suf_cache["pos"][0]) == 20
+        np.testing.assert_allclose(
+            np.asarray(suf_cache["k"][:, 0, :20]),
+            np.asarray(full_cache["k"][:, 0, :20]),
+            rtol=2e-4, atol=2e-4,
+        )
+
+    def test_greedy_equivalence_cache_on_vs_off(self):
+        """Acceptance bar: with a shared >=8-token prefix, cache-on
+        greedy token ids are bit-identical to cache-off, and the cache
+        actually engaged (hits + tokens saved)."""
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        shared = list(range(3, 15))  # 12-token shared system prompt
+        prompts = [shared + [100 + j, 200 + j] for j in range(5)]
+        ref = LlamaEngine(preset="tiny", max_seq=128, max_batch=4,
+                          prefix_cache_mb=0)
+        try:
+            want = [ref.generate(p, max_tokens=6)["token_ids"]
+                    for p in prompts]
+        finally:
+            ref.close()
+        eng = LlamaEngine(preset="tiny", max_seq=128, max_batch=4,
+                          prefix_cache_mb=8, prefix_min_len=8)
+        try:
+            got = [eng.generate(p, max_tokens=6) for p in prompts]
+            assert [r["token_ids"] for r in got] == want
+            st = eng.stats()["prefix_cache"]
+            assert st["hits"] >= 1 and st["tokens_saved"] > 0
+            assert st["pinned"] == 0  # every pin released at harvest
+            # later requests actually rode the graft
+            assert any(r["cached_prefix_len"] > 0 for r in got)
+        finally:
+            eng.close()
+
+    def test_tagged_request_caches_on_first_sight(self):
+        """`cache_prefix=True` (the HTTP body tag) inserts the prompt's
+        prefix without waiting for min_seen repeats."""
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        eng = LlamaEngine(preset="tiny", max_seq=128, max_batch=2,
+                          prefix_cache_mb=8, prefix_min_len=8)
+        try:
+            p = list(range(5, 20))
+            eng.generate(p, max_tokens=2, cache_prefix=True)
+            st = eng.stats()["prefix_cache"]
+            assert st["inserts"] == 1 and st["entries"] == 1
+            r = eng.generate(p + [42], max_tokens=2)
+            assert r["cached_prefix_len"] >= 8
+        finally:
+            eng.close()
+
+    def test_timeout_vacation_releases_pin(self):
+        """Regression (satellite): a request that times out while its
+        row is mid-prefill must release the prefix-cache pin its graft
+        took — a leaked refcount blocks eviction forever."""
+        import threading
+
+        import numpy as np
+
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        eng = LlamaEngine(preset="tiny", max_seq=64, max_batch=1,
+                          prefix_cache_mb=8, prefix_min_len=4)
+        try:
+            self._freeze(eng)  # test drives admission; prefill never runs
+            L, _, _, KV, hd = eng._cache["k"].shape
+            k = np.zeros((L, 16, KV, hd), np.float32)
+            prefix = [1, 2, 3, 4, 5, 6]
+            assert eng._pcache.insert(prefix, k, k.copy(), len(prefix))
+            entry = eng._pcache._entries[tuple(prefix)]
+            t = threading.Thread(
+                target=eng.generate,
+                args=(prefix + [7, 8],),
+                kwargs={"max_tokens": 4, "timeout_s": 0.3},
+            )
+            t.start()
+            # wait for the request to queue, then admit it: the match
+            # pins the entry and the graft lands in row 0
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                with eng._cv:
+                    eng._admit_locked()
+                    if eng._slots[0] is not None:
+                        break
+                time.sleep(0.01)
+            assert eng._slots[0] is not None
+            assert entry.refs == 1 and eng._slots[0].cached_len == len(prefix)
+            t.join(timeout=10)  # generate() times out and vacates
+            assert not t.is_alive()
+            assert entry.refs == 0, "vacated slot leaked its prefix pin"
+            assert eng._slots[0] is None and list(eng._waiting) == []
+        finally:
+            with eng._cv:
+                eng._stop = True
+                eng._cv.notify_all()
+
+    def test_graft_overflow_falls_back_to_full_prefill(self):
+        """A graft whose start + suffix bucket would spill past max_seq
+        must be dropped (dynamic_update_slice CLAMPS: the suffix would
+        land at the wrong positions) — the row full-prefills instead and
+        the output stays exact."""
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        # max_seq=32: a 20-token prefix + 16-token min bucket overflows
+        ref = LlamaEngine(preset="tiny", max_seq=32, max_batch=1,
+                          prefix_cache_mb=0)
+        eng = LlamaEngine(preset="tiny", max_seq=32, max_batch=1,
+                          prefix_cache_mb=8, prefix_min_len=4)
+        try:
+            shared = list(range(2, 22))  # 20 tokens
+            a = shared + [101]
+            b = shared + [102]
+            want = [ref.generate(p, max_tokens=4)["token_ids"]
+                    for p in (a, b)]
+            got = [eng.generate(p, max_tokens=4) for p in (a, b)]
+            assert [r["token_ids"] for r in got] == want
+            # the graft was dropped, not misplaced
+            assert all(r["cached_prefix_len"] == 0 for r in got)
+            assert eng._pcache.stats()["pinned"] == 0
+        finally:
+            ref.close()
+            eng.close()
