@@ -362,6 +362,114 @@ def bench_goodput_under_preemption() -> dict:
             }
 
 
+def bench_crash_recovery() -> dict:
+    """Crash-recovery costs (docs/robustness.md): per-write WAL overhead
+    for each fsync policy vs the pure-memory store, snapshot-bounded
+    rehydration latency, and end-to-end time-to-reconverge after a
+    simulated operator SIGKILL (restart on the same WAL dir, adopt every
+    running pod, launch nothing twice)."""
+    import tempfile
+    import time as _t
+
+    from kubedl_tpu.core.objects import Pod, PodPhase
+    from kubedl_tpu.core.store import ObjectStore
+
+    def pod(i):
+        p = Pod()
+        p.metadata.name = f"bench-{i}"
+        return p
+
+    def writes_per_sec(store, n=400):
+        t0 = _t.perf_counter()
+        pods = [store.create(pod(i)) for i in range(n)]
+        for p in pods:
+            p.status.phase = PodPhase.RUNNING
+            store.update(p)
+        return (2 * n) / (_t.perf_counter() - t0)
+
+    out: dict = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        out["no_wal_writes_per_s"] = round(writes_per_sec(ObjectStore()))
+        for policy in ("off", "batch", "always"):
+            s = ObjectStore(wal_dir=os.path.join(tmp, f"w-{policy}"),
+                            wal_fsync=policy)
+            out[f"wal_fsync_{policy}_writes_per_s"] = round(writes_per_sec(s))
+            s.close()
+        # slowdown of a WAL'd (no-fsync) write vs the pure-memory store
+        out["wal_overhead_pct_no_fsync"] = round(max(
+            0.0,
+            (out["no_wal_writes_per_s"]
+             / out["wal_fsync_batch_writes_per_s"] - 1.0) * 100.0,
+        ), 1)
+
+        # rehydration: snapshot + tail replay of 500 live objects
+        d = os.path.join(tmp, "rehydrate")
+        s = ObjectStore(wal_dir=d, wal_fsync="off")
+        for i in range(500):
+            s.create(pod(i))
+        s.compact()
+        s.close()
+        s2 = ObjectStore(wal_dir=d)
+        out["rehydrate_objects"] = len(s2.list("Pod"))
+        out["rehydrate_ms"] = round(s2.recovery_seconds * 1e3, 1)
+        s2.close()
+
+        # e2e: kill-recover-adopt with real subprocess pods
+        from kubedl_tpu.api.topology import get_slice
+        from kubedl_tpu.api.types import JobConditionType
+        from kubedl_tpu.gang.slice_scheduler import SliceInventory
+        from kubedl_tpu.operator import Operator, OperatorOptions
+        from kubedl_tpu.runtime.executor import SubprocessRuntime
+        from tests.helpers import make_tpujob
+
+        def inv():
+            v = SliceInventory()
+            v.add_slice("s1", "v5e-8")
+            v.add_slice("s2", "v5e-8")
+            return v
+
+        def running(store):
+            return [p for p in store.list("Pod")
+                    if p.status.phase == PodPhase.RUNNING]
+
+        opts = OperatorOptions(
+            local_addresses=True, wal_dir=os.path.join(tmp, "e2e-wal"),
+            artifact_registry_root=os.path.join(tmp, "reg"),
+        )
+        op1 = Operator(opts, runtime=SubprocessRuntime(), inventory=inv())
+        op1.start()
+        topo = get_slice("v5e-8")
+        for name in ("cr1", "cr2"):
+            op1.submit(make_tpujob(
+                name, workers=2, topology=topo,
+                command=[sys.executable, "-c", "import time; time.sleep(60)"],
+            ))
+            op1.wait_for_phase("TPUJob", name, JobConditionType.RUNNING,
+                               timeout=30)
+        op1.manager.wait(lambda: len(running(op1.store)) == 4, timeout=20)
+        # simulated SIGKILL: no teardown, pods stay alive, WAL detaches
+        op1.manager.stop()
+        op1.node_heartbeater.stop()
+        op1.kubelet._running.clear()
+        op1.kubelet._running_uid.clear()
+        op1.store.close()
+
+        t0 = _t.perf_counter()
+        op2 = Operator(opts, runtime=SubprocessRuntime(), inventory=inv())
+        op2.start()
+        op2.manager.wait(
+            lambda: op2.kubelet.adopted_count == 4
+            and len(running(op2.store)) == 4,
+            timeout=30,
+        )
+        out["reconverge_s"] = round(_t.perf_counter() - t0, 3)
+        out["adopted_pods"] = op2.kubelet.adopted_count
+        out["relaunched_pods"] = op2.kubelet.launch_count
+        out["replayed_records"] = op2.store.replayed_records
+        op2.stop()
+    return out
+
+
 def bench_serving_engine(on_tpu: bool, raw: dict) -> dict:
     """BASELINE.md target 5 through the PRODUCTION path (VERDICT r4
     missing #3): the raw-decode microbench never exercised the
@@ -1084,6 +1192,10 @@ def main() -> int:
         targets["goodput_under_preemption"] = bench_goodput_under_preemption()
     except Exception as e:
         targets["goodput_under_preemption"] = {"error": str(e)}
+    try:
+        targets["crash_recovery"] = bench_crash_recovery()
+    except Exception as e:
+        targets["crash_recovery"] = {"error": str(e)}
 
     tps_chip = summary["tokens_per_sec_per_chip"]
     mfu = summary["mfu"]

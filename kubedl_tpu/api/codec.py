@@ -169,8 +169,18 @@ def _ensure_builtin_kinds() -> None:
 
         from kubedl_tpu.core import objects as co
 
-        for cls in (co.Pod, co.Service, co.ConfigMap, co.Event):
+        for cls in (
+            co.Pod, co.Service, co.ConfigMap, co.Event,
+            co.PodGroup, co.Node, co.IngressRoute,
+        ):
             _KINDS.setdefault(cls.KIND, cls)
+
+        # Lease rides the store too (WAL replay must round-trip it for the
+        # leader-failover drill); leases imports store, store imports codec
+        # lazily, so this import is cycle-safe here
+        from kubedl_tpu.core.leases import Lease
+
+        _KINDS.setdefault(Lease.KIND, Lease)
 
         from kubedl_tpu.cron.types import Cron
         from kubedl_tpu.lineage.types import Model, ModelVersion

@@ -124,7 +124,13 @@ def workload_schemas() -> Dict[str, Dict[str, Any]]:
     cron kinds — the CRD-equivalent artifact set."""
     from kubedl_tpu.api.codec import known_kinds
 
-    skip = {"Pod", "Service", "ConfigMap", "Event", "TrafficPolicy"}
+    # substrate kinds (users never author these), not workload CRDs —
+    # the crash-recovery WAL registers them in the codec, but they don't
+    # belong in the rendered schema artifact set
+    skip = {
+        "Pod", "Service", "ConfigMap", "Event", "TrafficPolicy",
+        "PodGroup", "Node", "IngressRoute", "Lease",
+    }
     return {
         kind: json_schema(cls, kind=kind)
         for kind, cls in sorted(known_kinds().items())

@@ -20,6 +20,8 @@ Injection sites wired in this repo::
     remote.request                               blob-server transport
     serving.dispatch                             device segment dispatch
     checkpoint.torn                              die between shard + manifest
+    store.wal_append                             torn WAL record (half-write)
+    store.wal_fsync                              fail the WAL fsync syscall
 
 Schedules are per-site and deterministic: ``nth(n)`` fails exactly the
 n-th call (1-based), ``first(k)`` fails the first k calls, ``prob(p, k)``

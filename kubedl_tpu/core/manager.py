@@ -93,6 +93,10 @@ class _Registration:
     queue: WorkQueue
     workers: int = 1
     threads: List[threading.Thread] = field(default_factory=list)
+    #: list-then-watch: enqueue every current object's keys at start()
+    resync_on_start: bool = False
+    watch_kinds: Tuple[str, ...] = ()
+    mapper: Optional[EventMapper] = None
 
 
 class ControllerManager:
@@ -113,11 +117,23 @@ class ControllerManager:
         watch_kinds: List[str],
         mapper: EventMapper,
         workers: int = 1,
+        resync_on_start: bool = False,
     ) -> WorkQueue:
         """Wire a controller: watch ``watch_kinds``, map events to keys, feed
-        a dedicated workqueue drained by ``workers`` threads."""
+        a dedicated workqueue drained by ``workers`` threads.
+
+        ``resync_on_start=True`` gives the registration informer
+        list-then-watch semantics: every :meth:`start` synthesizes ADDED
+        events from current state through the mapper, so keys that existed
+        before the watch (a rehydrated store, a leader takeover) are
+        re-enqueued instead of waiting for their next mutation. A fresh
+        store makes it a no-op."""
         queue: WorkQueue = WorkQueue()
-        reg = _Registration(name=name, reconcile=reconcile, queue=queue, workers=workers)
+        reg = _Registration(
+            name=name, reconcile=reconcile, queue=queue, workers=workers,
+            resync_on_start=resync_on_start,
+            watch_kinds=tuple(watch_kinds), mapper=mapper,
+        )
         self._registrations.append(reg)
 
         def on_event(event: str, obj: BaseObject, old: Optional[BaseObject]) -> None:
@@ -163,6 +179,12 @@ class ControllerManager:
             return
         self._running = True
         self._stop.clear()
+        for reg in self._registrations:
+            if reg.resync_on_start and reg.mapper is not None:
+                for kind in reg.watch_kinds:
+                    for obj in self.store.list(kind, namespace=None):
+                        for key in reg.mapper("ADDED", obj, None):
+                            reg.queue.add(key)
         for reg in self._registrations:
             for i in range(reg.workers):
                 t = threading.Thread(

@@ -13,16 +13,33 @@ service DNS (service.go:260-307).
 from __future__ import annotations
 
 import enum
-import itertools
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import ClassVar, Dict, List, Optional, Tuple
 
-_uid_counter = itertools.count(1)
+_uid_lock = threading.Lock()
+_uid_next = 1
 
 
 def new_uid() -> str:
-    return f"uid-{next(_uid_counter):08d}"
+    global _uid_next
+    with _uid_lock:
+        n = _uid_next
+        _uid_next += 1
+    return f"uid-{n:08d}"
+
+
+def ensure_uid_floor(floor: int) -> None:
+    """Advance the uid counter past ``floor``. A restarted process starts
+    minting from 1 again; WAL rehydration calls this with the highest
+    replayed uid so fresh objects never collide with adopted ones —
+    adoption matches by (name, uid), and a collision would let a stale
+    process stamp a pod it no longer owns."""
+    global _uid_next
+    with _uid_lock:
+        if _uid_next <= floor:
+            _uid_next = floor + 1
 
 
 @dataclass

@@ -7,17 +7,28 @@ reason the reference reads via a cache and writes via the client), and fans
 out Added/Modified/Deleted events to registered watchers. Controllers never
 poll: watch events feed their workqueues
 (:mod:`kubedl_tpu.core.workqueue`), exactly like informer event handlers.
+
+Durability is opt-in: ``ObjectStore(wal_dir=...)`` puts a write-ahead log
+(:mod:`kubedl_tpu.core.wal`) in front of every mutation and rehydrates the
+pre-crash world from snapshot+log in the constructor — before any
+controller registers. The default in-memory path is untouched (WAL-off
+writes pay one ``None`` test).
 """
 
 from __future__ import annotations
 
 import copy
+import logging
+import re
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from kubedl_tpu import chaos
-from kubedl_tpu.core.objects import BaseObject, match_labels
+from kubedl_tpu.core.objects import BaseObject, ensure_uid_floor, match_labels
+
+log = logging.getLogger("kubedl_tpu.core.store")
 
 WatchCallback = Callable[[str, BaseObject, Optional[BaseObject]], None]
 # signature: (event_type, new_obj, old_obj) with event_type in
@@ -43,11 +54,142 @@ class _Watcher:
 
 
 class ObjectStore:
-    def __init__(self) -> None:
+    def __init__(
+        self,
+        wal_dir: Optional[str] = None,
+        wal_fsync: str = "always",
+        wal_snapshot_every: int = 1000,
+    ) -> None:
         self._lock = threading.RLock()
         self._objects: Dict[str, Dict[Tuple[str, str], BaseObject]] = {}
         self._rv = 0
         self._watchers: List[_Watcher] = []
+        #: revision of the most recent delete — a watcher replaying from an
+        #: older revision can never see that DELETED event (gap detection)
+        self._last_delete_rev = 0
+        #: watchers registered with a since_revision older than replayable
+        #: history (exported as a gauge by the operator)
+        self.watch_gaps = 0
+        self._wal = None
+        self.rehydrated = False
+        self.replayed_records = 0
+        self.recovery_seconds = 0.0
+        if wal_dir:
+            self._open_wal(wal_dir, wal_fsync, wal_snapshot_every)
+
+    # ---- durability (WAL) ------------------------------------------------
+
+    @property
+    def revision(self) -> int:
+        with self._lock:
+            return self._rv
+
+    def _open_wal(self, wal_dir: str, fsync: str, snapshot_every: int) -> None:
+        """Replay snapshot+log into memory, then arm the WAL on the write
+        path. Runs in the constructor so every object is back before any
+        watcher or controller exists."""
+        from kubedl_tpu.api.codec import decode_object
+        from kubedl_tpu.core.wal import WriteAheadLog
+
+        t0 = time.perf_counter()
+        wal = WriteAheadLog(wal_dir, fsync=fsync, snapshot_every=snapshot_every)
+        snap_rev, snap_objs, records = wal.recover()
+        max_uid = 0
+        with self._lock:
+            self._rv = snap_rev
+            for data in snap_objs:
+                obj = decode_object(data)
+                self._objects.setdefault(obj.kind, {})[obj.key] = obj
+            for rec in records:
+                rev = int(rec["rev"])
+                if rec["op"] == "PUT":
+                    obj = decode_object(rec["obj"])
+                    self._objects.setdefault(obj.kind, {})[obj.key] = obj
+                else:
+                    self._objects.get(rec["kind"], {}).pop(
+                        (rec["namespace"], rec["name"]), None
+                    )
+                    self._last_delete_rev = rev
+                self._rv = max(self._rv, rev)
+            self.replayed_records = len(records)
+            self.rehydrated = bool(snap_objs or records)
+            # a restarted process mints uids from 1 again — colliding with
+            # replayed objects would defeat adoption-by-(name, uid)
+            for bucket in self._objects.values():
+                for obj in bucket.values():
+                    m = re.match(r"uid-(\d+)$", obj.metadata.uid)
+                    if m:
+                        max_uid = max(max_uid, int(m.group(1)))
+            self._wal = wal
+        ensure_uid_floor(max_uid)
+        self.recovery_seconds = time.perf_counter() - t0
+        if self.rehydrated:
+            live = sum(len(b) for b in self._objects.values())
+            log.info(
+                "rehydrated %d objects (snapshot rv=%d + %d WAL records, "
+                "%d torn bytes dropped) in %.1fms",
+                live, snap_rev, len(records), wal.torn_tail_bytes,
+                self.recovery_seconds * 1e3,
+            )
+
+    def _wal_put(self, rev: int, obj: BaseObject) -> None:
+        """Append a PUT record; raises (nothing applied) on failure."""
+        if self._wal is None:
+            return
+        from kubedl_tpu.api.codec import encode
+
+        self._wal.append(
+            rev, "PUT", obj.kind, obj.metadata.namespace, obj.metadata.name,
+            encode(obj),
+        )
+
+    def _wal_delete(self, rev: int, kind: str, namespace: str, name: str) -> None:
+        if self._wal is None:
+            return
+        self._wal.append(rev, "DELETE", kind, namespace, name)
+
+    def _maybe_compact(self) -> None:
+        """Snapshot + truncate once enough records accumulated. Caller
+        holds the lock; the dump is O(live objects)."""
+        if self._wal is None or not self._wal.should_snapshot():
+            return
+        from kubedl_tpu.api.codec import encode
+
+        objs = [
+            encode(o) for bucket in self._objects.values() for o in bucket.values()
+        ]
+        self._wal.snapshot(self._rv, objs)
+
+    @property
+    def wal_appends(self) -> int:
+        return self._wal.appends if self._wal is not None else 0
+
+    @property
+    def wal_fsyncs(self) -> int:
+        return self._wal.fsyncs if self._wal is not None else 0
+
+    def compact(self) -> None:
+        """Force a snapshot+truncate now (test/ops hook)."""
+        with self._lock:
+            if self._wal is None:
+                return
+            from kubedl_tpu.api.codec import encode
+
+            objs = [
+                encode(o)
+                for bucket in self._objects.values()
+                for o in bucket.values()
+            ]
+            self._wal.snapshot(self._rv, objs)
+
+    def close(self) -> None:
+        """Detach the WAL (flush + stop accepting writes). In-memory
+        operation continues — late writers from a dying incarnation mutate
+        only their abandoned memory image, never the files the next
+        incarnation replays."""
+        with self._lock:
+            if self._wal is not None:
+                self._wal.close()
 
     # ---- CRUD ------------------------------------------------------------
 
@@ -57,10 +199,14 @@ class ObjectStore:
             bucket = self._objects.setdefault(obj.kind, {})
             if obj.key in bucket:
                 raise AlreadyExists(f"{obj.kind} {obj.key} already exists")
-            self._rv += 1
-            obj.metadata.resource_version = self._rv
+            rev = self._rv + 1
             stored = copy.deepcopy(obj)
+            stored.metadata.resource_version = rev
+            self._wal_put(rev, stored)  # durability first; raises unapplied
+            self._rv = rev
+            obj.metadata.resource_version = rev
             bucket[obj.key] = stored
+            self._maybe_compact()
             snapshot = copy.deepcopy(stored)
         self._notify("ADDED", snapshot, None)
         return snapshot
@@ -96,10 +242,14 @@ class ObjectStore:
                     f"{obj.metadata.resource_version} != {cur.metadata.resource_version}"
                 )
             old = copy.deepcopy(cur)
-            self._rv += 1
-            obj.metadata.resource_version = self._rv
+            rev = self._rv + 1
             stored = copy.deepcopy(obj)
+            stored.metadata.resource_version = rev
+            self._wal_put(rev, stored)  # durability first; raises unapplied
+            self._rv = rev
+            obj.metadata.resource_version = rev
             bucket[obj.key] = stored
+            self._maybe_compact()
             snapshot = copy.deepcopy(stored)
         self._notify("MODIFIED", snapshot, old)
         return snapshot
@@ -128,7 +278,14 @@ class ObjectStore:
         chaos.check("store.delete")
         with self._lock:
             bucket = self._objects.get(kind, {})
-            obj = bucket.pop((namespace, name), None)
+            obj = bucket.get((namespace, name))
+            if obj is not None:
+                rev = self._rv + 1
+                self._wal_delete(rev, kind, namespace, name)  # raises unapplied
+                self._rv = rev
+                self._last_delete_rev = rev
+                bucket.pop((namespace, name))
+                self._maybe_compact()
         if obj is None:
             raise NotFound(f"{kind} {namespace}/{name} not found")
         self._notify("DELETED", copy.deepcopy(obj), copy.deepcopy(obj))
@@ -165,14 +322,47 @@ class ObjectStore:
     # ---- watches ---------------------------------------------------------
 
     def watch(
-        self, callback: WatchCallback, kinds: Optional[Iterable[str]] = None
+        self,
+        callback: WatchCallback,
+        kinds: Optional[Iterable[str]] = None,
+        since_revision: Optional[int] = None,
     ) -> Callable[[], None]:
         """Register a watcher; returns an unsubscribe function. Watchers run
         inline on the mutating thread (informer-style handlers must be quick
-        — typically just a workqueue enqueue)."""
+        — typically just a workqueue enqueue).
+
+        ``since_revision`` replays history missed before registration:
+        synthesized ADDED events (revision rides each object's
+        ``metadata.resource_version``) are delivered for every live matching
+        object newer than that revision — ``since_revision=0`` is a full
+        relist. Deletions are not reconstructible from live state; if any
+        happened after ``since_revision`` the watcher has a real gap, which
+        is logged and counted (``watch_gaps``) instead of passing silently.
+        Replay runs inline before this call returns; a concurrent mutation
+        may deliver its live event before the replayed ADDED (same
+        relist-vs-watch race informers have — handlers must be level-driven).
+        """
         w = _Watcher(tuple(kinds) if kinds else None, callback)
+        replay: List[BaseObject] = []
         with self._lock:
             self._watchers.append(w)
+            if since_revision is not None and since_revision < self._rv:
+                if since_revision < self._last_delete_rev:
+                    self.watch_gaps += 1
+                    log.warning(
+                        "watcher registered at revision %d but deletes up to "
+                        "revision %d are gone — DELETED events in that gap "
+                        "cannot be replayed",
+                        since_revision, self._last_delete_rev,
+                    )
+                for kind, bucket in self._objects.items():
+                    if w.kinds is not None and kind not in w.kinds:
+                        continue
+                    for obj in bucket.values():
+                        if obj.metadata.resource_version > since_revision:
+                            replay.append(copy.deepcopy(obj))
+        for obj in sorted(replay, key=lambda o: o.metadata.resource_version):
+            callback("ADDED", obj, None)
 
         def cancel() -> None:
             with self._lock:

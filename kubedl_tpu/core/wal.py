@@ -1,0 +1,233 @@
+"""Write-ahead log + snapshot for the object store — the etcd-WAL analogue.
+
+The reference operator survives restarts because the apiserver is its
+durable store; our :class:`~kubedl_tpu.core.store.ObjectStore` is in-memory,
+so this module gives it a disk image: every mutation is appended here
+BEFORE it becomes visible, and a restarted process replays snapshot + log
+to rebuild the exact pre-crash world (docs/robustness.md "Crash recovery").
+
+Layout under ``wal_dir``::
+
+    snapshot.json   {"revision": N, "objects": [encoded...]} — full state
+                    at revision N, written atomically (tmp + rename)
+    wal.log         records with revision > N, appended in revision order
+
+Record framing (binary, little-endian)::
+
+    <u32 payload-length> <u32 crc32(payload)> <payload: UTF-8 JSON>
+
+The JSON payload is ``{"rev", "op": "PUT"|"DELETE", "kind", "namespace",
+"name", "obj"}`` where ``obj`` is the :func:`kubedl_tpu.api.codec.encode`
+form for PUT and absent for DELETE.
+
+Recovery semantics (the acceptance contract):
+
+- A *torn trailing* record (fewer bytes on disk than the header promises —
+  the process died mid-append) is tolerated: replay stops at the last good
+  record and the tail is truncated so new appends start clean.
+- A record whose bytes are all present but whose CRC mismatches is
+  *corruption*, not a torn write, and raises :class:`WalCorruption` —
+  silently dropping interior history would resurrect deleted objects.
+- Snapshot + compaction (every ``snapshot_every`` appends) bound replay to
+  O(live objects + log tail), not O(total writes ever).
+
+fsync policy knob: ``"always"`` fsyncs each append (durability to the
+record), ``"batch"`` fsyncs only at snapshot/close (a crash may lose the
+un-synced tail — torn-tail tolerance makes that a clean rollback), ``"off"``
+never fsyncs (tests/benchmarks).
+
+Chaos sites: ``store.wal_append`` tears an append in half (simulating
+death mid-write; the log is then dead, crash-only) and ``store.wal_fsync``
+fails the fsync call.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from kubedl_tpu import chaos
+
+_HEADER = struct.Struct("<II")
+
+WAL_FILE = "wal.log"
+SNAPSHOT_FILE = "snapshot.json"
+
+VALID_FSYNC = ("always", "batch", "off")
+
+
+class WalCorruption(Exception):
+    """A fully-present record failed its CRC (or carried unparseable JSON)."""
+
+
+class WriteAheadLog:
+    """Append/replay engine. Not thread-safe by itself — the owning
+    ObjectStore serializes calls under its own lock."""
+
+    def __init__(
+        self, wal_dir: str, fsync: str = "always", snapshot_every: int = 1000
+    ) -> None:
+        if fsync not in VALID_FSYNC:
+            raise ValueError(f"fsync policy {fsync!r} not in {VALID_FSYNC}")
+        self.dir = wal_dir
+        self.fsync_policy = fsync
+        self.snapshot_every = max(1, snapshot_every)
+        os.makedirs(wal_dir, exist_ok=True)
+        self.log_path = os.path.join(wal_dir, WAL_FILE)
+        self.snapshot_path = os.path.join(wal_dir, SNAPSHOT_FILE)
+        #: cumulative counters, exported as metrics by the operator
+        self.appends = 0
+        self.fsyncs = 0
+        self.torn_tail_bytes = 0  # bytes truncated by the last recover()
+        self._since_snapshot = 0
+        self._f: Optional[Any] = None
+        #: a torn append (chaos or IO error) poisons the handle: the bytes
+        #: on disk no longer end on a record boundary, so further appends
+        #: would corrupt interior history. Crash-only — reopen to recover.
+        self._dead = False
+        self._closed = False
+
+    # ---- recovery --------------------------------------------------------
+
+    def recover(self) -> Tuple[int, List[dict], List[dict]]:
+        """Load the snapshot and replay the log tail. Returns
+        ``(snapshot_revision, snapshot_objects, tail_records)``; truncates
+        a torn trailing record and opens the log for appending."""
+        snap_rev, snap_objs = 0, []
+        if os.path.exists(self.snapshot_path):
+            with open(self.snapshot_path, "r") as f:
+                snap = json.load(f)
+            snap_rev = int(snap.get("revision", 0))
+            snap_objs = list(snap.get("objects", []))
+
+        records: List[dict] = []
+        good_end = 0
+        if os.path.exists(self.log_path):
+            with open(self.log_path, "rb") as f:
+                buf = f.read()
+            offset = 0
+            while offset < len(buf):
+                if offset + _HEADER.size > len(buf):
+                    break  # torn header
+                length, crc = _HEADER.unpack_from(buf, offset)
+                start = offset + _HEADER.size
+                if start + length > len(buf):
+                    break  # torn payload
+                payload = buf[start : start + length]
+                if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                    raise WalCorruption(
+                        f"{self.log_path}: CRC mismatch at offset {offset}"
+                    )
+                try:
+                    records.append(json.loads(payload.decode("utf-8")))
+                except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                    raise WalCorruption(
+                        f"{self.log_path}: bad payload at offset {offset}: {e}"
+                    ) from e
+                offset = start + length
+                good_end = offset
+            self.torn_tail_bytes = len(buf) - good_end
+            if self.torn_tail_bytes:
+                with open(self.log_path, "r+b") as f:
+                    f.truncate(good_end)
+        self._f = open(self.log_path, "ab")  # noqa: SIM115 — held for appends
+        self._since_snapshot = len(records)
+        return snap_rev, snap_objs, records
+
+    # ---- append ----------------------------------------------------------
+
+    def append(
+        self,
+        rev: int,
+        op: str,
+        kind: str,
+        namespace: str,
+        name: str,
+        obj: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Durably record one mutation. Raises before the caller applies it
+        to memory; on success the record is on disk (fsync per policy)."""
+        if self._closed:
+            return  # detached (clean shutdown raced a late writer): drop
+        if self._dead or self._f is None:
+            raise WalCorruption(f"{self.log_path}: log is dead after torn append")
+        record: Dict[str, Any] = {
+            "rev": rev, "op": op, "kind": kind,
+            "namespace": namespace, "name": name,
+        }
+        if obj is not None:
+            record["obj"] = obj
+        payload = json.dumps(record, separators=(",", ":")).encode("utf-8")
+        data = _HEADER.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
+        if chaos.should_fail("store.wal_append"):
+            # simulate the process dying mid-write: half the record reaches
+            # disk, the rest never will — replay must roll the tail back
+            self._f.write(data[: max(1, len(data) // 2)])
+            self._f.flush()
+            self._dead = True
+            raise chaos.FaultInjected(
+                f"chaos: torn WAL append at store.wal_append (rev {rev})"
+            )
+        self._f.write(data)
+        self._f.flush()
+        self.appends += 1
+        self._since_snapshot += 1
+        if self.fsync_policy == "always":
+            self._fsync()
+
+    def _fsync(self) -> None:
+        chaos.check("store.wal_fsync")
+        os.fsync(self._f.fileno())
+        self.fsyncs += 1
+
+    # ---- snapshot + compaction ------------------------------------------
+
+    def should_snapshot(self) -> bool:
+        return (
+            not self._dead
+            and not self._closed
+            and self._since_snapshot >= self.snapshot_every
+        )
+
+    def snapshot(self, revision: int, objects: List[dict]) -> None:
+        """Write the full state at ``revision`` atomically, then truncate
+        the log — replay cost returns to O(live objects)."""
+        if self._closed or self._dead:
+            return
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"revision": revision, "objects": objects}, f)
+            f.flush()
+            if self.fsync_policy != "off":
+                os.fsync(f.fileno())
+        os.replace(tmp, self.snapshot_path)
+        # every logged record <= revision is now in the snapshot: truncate
+        if self._f is not None:
+            self._f.close()
+        open(self.log_path, "wb").close()
+        self._f = open(self.log_path, "ab")  # noqa: SIM115
+        self._since_snapshot = 0
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def close(self) -> None:
+        """Detach: flush what is already appended and stop accepting
+        writes. Late appends (e.g. a reap thread finishing after operator
+        shutdown) are dropped silently — the next incarnation owns the
+        files."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._f is not None:
+            try:
+                self._f.flush()
+                if self.fsync_policy != "off" and not self._dead:
+                    os.fsync(self._f.fileno())
+                    self.fsyncs += 1
+            except (OSError, ValueError):
+                pass
+            self._f.close()
+            self._f = None

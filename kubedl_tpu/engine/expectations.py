@@ -75,6 +75,30 @@ class ControllerExpectations:
         with self._lock:
             self._exps.pop(key, None)
 
+    def clear(self) -> None:
+        """Drop every expectation — crash recovery: expectations recorded
+        by a dead incarnation must never suppress the new incarnation's
+        reconciles (its creates/deletes were either durably observed via
+        the rehydrated store or never happened)."""
+        with self._lock:
+            self._exps.clear()
+
+    def collect_expired(self, job_key: str) -> list[str]:
+        """Pop and return the job's timed-out expectation keys. A reconcile
+        that proceeds past these lost watch events (or inherited them from
+        a dead incarnation) — callers log + count instead of letting the
+        expiry pass silently."""
+        prefix = job_key + "/"
+        with self._lock:
+            expired = [
+                k
+                for k, exp in self._exps.items()
+                if k.startswith(prefix) and exp.expired() and not exp.fulfilled()
+            ]
+            for k in expired:
+                del self._exps[k]
+        return expired
+
     def delete_job_expectations(self, job_key: str) -> None:
         """Drop every '<job_key>/<rtype>/<resource>' entry for a job."""
         prefix = job_key + "/"

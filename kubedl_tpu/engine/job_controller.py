@@ -142,6 +142,19 @@ class JobEngine:
             self.expectations.delete_job_expectations(f"{namespace}/{name}")
             return None
         assert isinstance(job, JobObject)
+        expired = self.expectations.collect_expired(job_key(job))
+        if expired:
+            # a watch event was lost (or the expectation came from a dead
+            # incarnation): proceeding is correct — the store is the source
+            # of truth — but it must be loud, not silent
+            log.warning(
+                "%s %s: proceeding past %d timed-out expectation(s): %s",
+                self.controller.KIND, job_key(job), len(expired),
+                ", ".join(expired),
+            )
+            self.metrics.expectations_expired.inc(
+                len(expired), kind=self.controller.KIND
+            )
         if not self.expectations.all_satisfied(job_key(job)):
             return None  # watch events will re-trigger once caches settle
         if job.status.phase == JobConditionType.QUARANTINED:

@@ -11,6 +11,7 @@ requirement for checkpoint-resume with sharded checkpoints.
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -21,6 +22,8 @@ from kubedl_tpu.api.topology import SliceTopology, get_slice
 from kubedl_tpu.core.objects import Pod, PodGroup
 from kubedl_tpu.core.store import AlreadyExists, NotFound, ObjectStore
 from kubedl_tpu.gang.interface import GangScheduler
+
+log = logging.getLogger("kubedl_tpu.gang")
 
 
 @dataclass
@@ -93,6 +96,24 @@ class SliceInventory:
             for s in taken:
                 s.allocated_to = owner
             return sorted(already + [s.name for s in taken])
+
+    def reserve_exact(self, names: List[str], owner: str) -> bool:
+        """Re-pin a specific assignment (crash recovery: the store's
+        PodGroup remembers WHICH slices a gang held; the in-memory
+        inventory does not survive a restart). All-or-nothing and
+        idempotent on an identical assignment: every named slice must be
+        free or already held by ``owner``, else nothing changes and the
+        caller treats the gang as needing fresh admission."""
+        with self._lock:
+            infos = []
+            for n in names:
+                s = self._slices.get(n)
+                if s is None or (s.allocated_to and s.allocated_to != owner):
+                    return False
+                infos.append(s)
+            for s in infos:
+                s.allocated_to = owner
+            return True
 
     def release(self, owner: str) -> None:
         with self._lock:
@@ -262,8 +283,43 @@ class SliceGangScheduler(GangScheduler):
             "PodGroup", _gang_name(job), job.metadata.namespace
         )
 
+    def adopt_reservations(self) -> int:
+        """Crash recovery: re-reserve every admitted gang's recorded slice
+        assignment from the rehydrated store into this (fresh) inventory so
+        running jobs keep their slices and nothing double-books them.
+        Returns the number of gangs re-pinned."""
+        adopted = 0
+        for gang in self.store.list("PodGroup", namespace=None):
+            if gang.phase != "Running" or not gang.assigned_slices:
+                continue
+            owner = f"{gang.metadata.namespace}/{gang.metadata.name}"
+            if self.inventory.reserve_exact(gang.assigned_slices, owner):
+                adopted += 1
+            else:
+                log.warning(
+                    "gang %s: recorded slices %s are not re-reservable "
+                    "(inventory changed across the restart)",
+                    owner, gang.assigned_slices,
+                )
+        return adopted
+
     def try_admit(self, gang: PodGroup) -> bool:
         if gang.phase == "Running" and (gang.assigned_slices or not gang.slice_type):
+            if gang.assigned_slices:
+                owner = f"{gang.metadata.namespace}/{gang.metadata.name}"
+                if not self.inventory.owned_slices(owner):
+                    # post-restart reconcile raced ahead of (or ran
+                    # without) adopt_reservations: the store says admitted
+                    # but the fresh inventory holds nothing — re-pin the
+                    # recorded assignment (idempotent)
+                    if not self.inventory.reserve_exact(
+                        gang.assigned_slices, owner
+                    ):
+                        log.warning(
+                            "gang %s: recorded slices %s held by another "
+                            "owner; keeping store assignment",
+                            owner, gang.assigned_slices,
+                        )
             return True
         if chaos.should_fail("gang.bind"):
             return False  # injected bind rejection → job waits, re-admits

@@ -261,6 +261,39 @@ class JobMetrics:
             "over the last measured window (1 - overhead of checkpoints, "
             "restarts and resizes)",
         )
+        # Crash recovery (core/wal.py + docs/robustness.md "Crash recovery"):
+        self.recovery_duration = r.gauge(
+            "kubedl_tpu_recovery_duration_seconds",
+            "Time the last cold start spent rehydrating the store "
+            "(snapshot+WAL replay) plus re-adopting gangs and pods",
+        )
+        self.replayed_records = r.counter(
+            "kubedl_tpu_wal_replayed_records",
+            "WAL records replayed into the store at the last cold start",
+        )
+        self.adopted_pods = r.counter(
+            "kubedl_tpu_pods_adopted",
+            "Running pods re-attached by the kubelet after an operator "
+            "restart instead of being re-created",
+        )
+        self.wal_appends = r.gauge(
+            "kubedl_tpu_wal_appends",
+            "Records appended to the write-ahead log by this incarnation",
+        )
+        self.wal_fsyncs = r.gauge(
+            "kubedl_tpu_wal_fsyncs",
+            "fsync calls issued by the write-ahead log",
+        )
+        self.watch_gaps = r.gauge(
+            "kubedl_tpu_store_watch_gaps",
+            "Watchers registered with a since_revision older than "
+            "replayable history (missed DELETED events)",
+        )
+        self.expectations_expired = r.counter(
+            "kubedl_tpu_expectations_expired",
+            "Reconciles that proceeded past timed-out controller "
+            "expectations (the dead-incarnation / lost-watch-event signal)",
+        )
 
 
 #: ms-scale buckets for the decode pipeline's per-tick timings (the

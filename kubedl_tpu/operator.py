@@ -93,6 +93,16 @@ class OperatorOptions:
     #: (shrinks away from draining slices bypass the cooldown). See
     #: kubedl_tpu/elastic/policy.py and docs/elasticity.md.
     elastic_cooldown_seconds: float = 30.0
+    #: crash recovery (docs/robustness.md "Crash recovery"): directory for
+    #: the store's write-ahead log + snapshot. "" keeps the store purely
+    #: in-memory; set it and a restarted operator rehydrates the whole
+    #: object world, re-reserves gang slices and adopts running pods.
+    #: Ignored when an explicit ``store`` is passed to the constructor.
+    wal_dir: str = ""
+    #: WAL fsync policy: "always" | "batch" | "off" (core/wal.py)
+    wal_fsync: str = "always"
+    #: WAL records between snapshot+compaction passes
+    wal_snapshot_every: int = 1000
 
 
 class ValidationError(ValueError):
@@ -114,7 +124,12 @@ class Operator:
         self.options = options or OperatorOptions()
         #: pass an existing store to run several operators against one
         #: object world (HA deployments — pair with leader_elect=True)
-        self.store = store or ObjectStore()
+        self.store = store or ObjectStore(
+            wal_dir=self.options.wal_dir or None,
+            wal_fsync=self.options.wal_fsync,
+            wal_snapshot_every=self.options.wal_snapshot_every,
+        )
+        self._owns_store = store is None
         self.manager = ControllerManager(self.store)
         self.metrics_registry = MetricsRegistry()
         self.metrics = JobMetrics(self.metrics_registry)
@@ -157,15 +172,30 @@ class Operator:
                 watch_kinds=[kind, "Pod", "Service", "PodGroup"],
                 mapper=self._engine_mapper(kind),
                 workers=self.options.max_concurrent_reconciles,
+                # list-then-watch: rehydrated jobs are re-enqueued at start
+                # instead of waiting for their next mutation
+                resync_on_start=True,
             )
             # live running/pending gauges (reference: status_counter.go:22-81)
             self._register_status_gauges(kind)
 
         # pod runtime
         self.kubelet = Kubelet(
-            self.store, runtime or SubprocessRuntime(self.options.pod_log_dir)
+            self.store, runtime or SubprocessRuntime(self.options.pod_log_dir),
+            metrics=self.metrics,
         )
         self.kubelet.setup(self.manager)
+
+        # crash-recovery observability (core/wal.py; gauges read live)
+        self.metrics.wal_appends.set_function(
+            lambda: float(self.store.wal_appends)
+        )
+        self.metrics.wal_fsyncs.set_function(
+            lambda: float(self.store.wal_fsyncs)
+        )
+        self.metrics.watch_gaps.set_function(
+            lambda: float(getattr(self.store, "watch_gaps", 0))
+        )
 
         # node lifecycle: heartbeat-driven failure detection (the k8s
         # node-controller analogue the reference delegates to the cluster)
@@ -308,12 +338,16 @@ class Operator:
     def start(self) -> None:
         self.node_heartbeater.start()
         if not self.options.leader_elect:
+            self._recover()
             self.manager.start()
             return
         # HA mode (reference: main.go:76-84): reconcile only while holding
         # the lease. The follower builds everything but starts nothing;
-        # on acquisition it resyncs (kick_all) and runs; on LOSS it stops
-        # for good (crash-only — the process restarts to re-campaign).
+        # on acquisition it runs the SAME rehydrate-then-adopt recovery a
+        # cold restart does (the previous leader's world — gangs, running
+        # pods — is in the shared/replayed store, not in this process),
+        # then resyncs (kick_all) and runs; on LOSS it stops for good
+        # (crash-only — the process restarts to re-campaign).
         from kubedl_tpu.core.leases import LeaderElector
 
         self.elector = LeaderElector(
@@ -323,10 +357,43 @@ class Operator:
         )
 
         def on_started() -> None:
+            self._recover(takeover=True)
             self.manager.start()
             self.manager.kick_all()
 
         self.elector.start(on_started=on_started, on_stopped=self._on_deposed)
+
+    def _recover(self, takeover: bool = False) -> None:
+        """Cold-start / takeover recovery (docs/robustness.md): drop the
+        dead incarnation's expectations, re-reserve recorded gang slice
+        assignments into this inventory, arm pod adoption, and re-enqueue
+        every key. Runs BEFORE controllers start; a fresh empty store makes
+        every step a no-op."""
+        rehydrated = getattr(self.store, "rehydrated", False)
+        if not (rehydrated or takeover):
+            return
+        import time as _time
+
+        t0 = _time.perf_counter()
+        for engine in self.engines.values():
+            engine.expectations.clear()
+        adopted_gangs = self.gang.adopt_reservations()
+        adoptable_pods = self.kubelet.begin_recovery()
+        if rehydrated:
+            self.metrics.replayed_records.inc(self.store.replayed_records)
+            # relist/resync: controllers registered without resync_on_start
+            # (serving, lineage, cron, ...) still see every existing key
+            self.manager.kick_all()
+        self.metrics.recovery_duration.set(
+            getattr(self.store, "recovery_seconds", 0.0)
+            + (_time.perf_counter() - t0)
+        )
+        log.info(
+            "recovery: %d WAL records replayed, %d gangs re-reserved, "
+            "%d pods adoptable (takeover=%s)",
+            getattr(self.store, "replayed_records", 0), adopted_gangs,
+            adoptable_pods, takeover,
+        )
 
     def _on_deposed(self) -> None:
         self.kubelet.shutdown()
@@ -339,6 +406,8 @@ class Operator:
         self.node_heartbeater.stop()
         self.kubelet.shutdown()
         self.manager.stop()
+        if self._owns_store:
+            self.store.close()  # flush + detach the WAL (no-op without one)
         for backend in (self.object_backend, self.event_backend):
             if backend is not None:
                 backend.close()

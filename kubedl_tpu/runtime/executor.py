@@ -5,6 +5,7 @@ from __future__ import annotations
 import importlib
 import logging
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -18,6 +19,11 @@ from kubedl_tpu.core.store import NotFound, ObjectStore
 
 log = logging.getLogger("kubedl_tpu.runtime")
 
+#: OS pid of the pod's main process, stamped at launch — the handle a
+#: RESTARTED kubelet needs to re-attach to (adopt) a still-running pod
+#: instead of orphaning or re-creating it (docs/robustness.md)
+PID_ANNOTATION = "kubedl-tpu.io/runtime-pid"
+
 
 class ProcHandle:
     """One running container; wait() returns the exit code."""
@@ -27,6 +33,11 @@ class ProcHandle:
 
     def kill(self) -> None:
         raise NotImplementedError
+
+    def pid(self) -> Optional[int]:
+        """OS pid when the container is a real process (adoptable across
+        operator restarts); None for thread/placeholder handles."""
+        return None
 
 
 class ContainerRuntime:
@@ -48,6 +59,67 @@ class _SubprocHandle(ProcHandle):
                 self.proc.wait(timeout=3)
             except subprocess.TimeoutExpired:
                 self.proc.kill()
+
+    def pid(self) -> Optional[int]:
+        return self.proc.pid
+
+
+class _AttachedHandle(ProcHandle):
+    """A process launched by a PREVIOUS operator incarnation, re-attached
+    by pid after a restart. When the pid is still this process's child
+    (in-process crash simulation) ``waitpid`` yields the real exit status;
+    an orphan reparented to init can only be liveness-polled, so its exit
+    reads as 0 — a non-child cannot be reaped, which is the documented
+    adoption limit (real kubelets read containerd state instead)."""
+
+    def __init__(self, pid: int) -> None:
+        self._pid = pid
+
+    def pid(self) -> Optional[int]:
+        return self._pid
+
+    def _poll(self) -> Optional[int]:
+        """None while alive; exit code once gone."""
+        try:
+            done, status = os.waitpid(self._pid, os.WNOHANG)
+            if done == self._pid:
+                if os.WIFEXITED(status):
+                    return os.WEXITSTATUS(status)
+                if os.WIFSIGNALED(status):
+                    return -os.WTERMSIG(status)
+                return 1
+            return None
+        except ChildProcessError:
+            pass  # not our child (true orphan) or already reaped elsewhere
+        try:
+            os.kill(self._pid, 0)
+            return None
+        except ProcessLookupError:
+            return 0  # gone; exit code unknowable for a non-child
+        except PermissionError:
+            return None  # alive, different user
+
+    def wait(self) -> int:
+        while True:
+            code = self._poll()
+            if code is not None:
+                return code
+            time.sleep(0.05)
+
+    def kill(self) -> None:
+        try:
+            os.kill(self._pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.time() + 3.0
+        while time.time() < deadline:
+            if self._poll() is not None:
+                return
+            time.sleep(0.05)
+        try:
+            os.kill(self._pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
 
 
 class SubprocessRuntime(ContainerRuntime):
@@ -174,11 +246,23 @@ class Kubelet:
         runtime: ContainerRuntime,
         nodes: Optional[set] = None,
         pod_ip: str = "127.0.0.1",
+        metrics=None,
     ) -> None:
         self.store = store
         self.runtime = runtime
         self.nodes = nodes
         self.pod_ip = pod_ip
+        self.metrics = metrics  # JobMetrics or None (adopted_pods counter)
+        #: processes started by THIS incarnation — the restart e2e asserts
+        #: zero duplicate creates via this count
+        self.launch_count = 0
+        self.adopted_count = 0
+        #: (ns/name -> uid) of RUNNING pods captured at begin_recovery():
+        #: exactly the pods whose processes may have outlived the previous
+        #: operator. Adoption applies ONLY to these — in steady state a
+        #: RUNNING pod missing from _running is a reap-in-progress race,
+        #: not an orphan, and must not be failed or re-attached.
+        self._recovery: Dict[str, str] = {}
         self._lock = threading.Lock()
         self._running: Dict[str, ProcHandle] = {}
         #: pod uid each running handle belongs to — a same-name replacement
@@ -210,6 +294,10 @@ class Kubelet:
             watch_kinds=["Pod", "ConfigMap"],
             mapper=mapper,
             workers=4,
+            # list-then-watch: pods that already exist when the manager
+            # starts (rehydrated store) get their launch/adoption pass
+            # without waiting for a mutation
+            resync_on_start=True,
         )
 
     # ------------------------------------------------------------------
@@ -253,9 +341,19 @@ class Kubelet:
             # live handle here always means external termination.
             with self._lock:
                 handle = self._running.get(key)
+                self._recovery.pop(key, None)
             if handle is not None and not isinstance(handle, _PlaceholderHandle):
                 handle.kill()
             return None
+        if self._recovery:
+            with self._lock:
+                rec_uid = self._recovery.pop(key, None)
+            if (
+                rec_uid is not None
+                and rec_uid == pod.metadata.uid
+                and pod.status.phase == PodPhase.RUNNING
+            ):
+                return self._adopt(pod, key)
         with self._lock:
             recorded_uid = self._running_uid.get(key)
             stale = (
@@ -308,8 +406,12 @@ class Kubelet:
                 if rc != 0:
                     raise RuntimeError(f"init container {init.name} exited {rc}")
         handle = self.runtime.start(pod, env)
+        self.launch_count += 1
         with self._lock:
             self._running[key] = handle
+        pid = handle.pid()
+        if pid is not None:
+            self._stamp_pid(pod, pid)
         self._set_phase(pod, PodPhase.RUNNING)
         # an eviction landing DURING launch (init containers etc.) found
         # only the placeholder handle and could kill nothing; now that the
@@ -322,6 +424,9 @@ class Kubelet:
         ):
             handle.kill()
 
+        self._start_reaper(pod, key, handle)
+
+    def _start_reaper(self, pod: Pod, key: str, handle: ProcHandle) -> None:
         def reap() -> None:
             code = handle.wait()
             with self._lock:
@@ -335,6 +440,86 @@ class Kubelet:
             self.reconcile(pod.metadata.namespace, pod.metadata.name)
 
         threading.Thread(target=reap, daemon=True, name=f"reap-{key}").start()
+
+    # ---- crash recovery: pod adoption --------------------------------
+
+    def begin_recovery(self) -> int:
+        """Arm the adoption pass. Called after store rehydration, BEFORE
+        controllers start: records every RUNNING pod of the dead
+        incarnation so the first reconcile of each re-attaches its live
+        process (by pid annotation) instead of ignoring it forever — or
+        fails it retryably when the process did not survive. Returns the
+        number of candidates."""
+        with self._lock:
+            for pod in self.store.list("Pod", namespace=None):
+                if not isinstance(pod, Pod) or not self._served(pod):
+                    continue
+                key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+                if (
+                    pod.status.phase == PodPhase.RUNNING
+                    and not pod.is_terminal()
+                    and key not in self._running
+                ):
+                    self._recovery[key] = pod.metadata.uid
+            return len(self._recovery)
+
+    def _adopt(self, pod: Pod, key: str) -> None:
+        """First post-restart reconcile of a RUNNING pod: re-attach by
+        (name, uid, pid) or fail it retryably (exit 137 -> gang restart)."""
+        handle = self._attach(pod)
+        if handle is None:
+            log.warning(
+                "pod %s (uid %s) was Running before the restart but its "
+                "process is gone — failing retryably",
+                key, pod.metadata.uid,
+            )
+            self._set_phase(
+                pod, PodPhase.FAILED, reason="LostOnRestart", exit_code=137
+            )
+            return None
+        with self._lock:
+            self._running[key] = handle
+            self._running_uid[key] = pod.metadata.uid
+        self.adopted_count += 1
+        if self.metrics is not None:
+            self.metrics.adopted_pods.inc()
+        log.info("adopted pod %s (uid %s, pid %s)", key, pod.metadata.uid,
+                 handle.pid())
+        self._start_reaper(pod, key, handle)
+        return None
+
+    def _attach(self, pod: Pod) -> Optional[ProcHandle]:
+        pid_s = pod.metadata.annotations.get(PID_ANNOTATION, "")
+        if not pid_s:
+            return None  # thread/fake runtime pods die with the process
+        try:
+            pid = int(pid_s)
+        except ValueError:
+            return None
+        try:
+            os.kill(pid, 0)  # liveness (zombie children still count:
+            # _AttachedHandle reaps them for the real exit code)
+        except ProcessLookupError:
+            return None
+        except PermissionError:
+            pass
+        return _AttachedHandle(pid)
+
+    def _stamp_pid(self, pod: Pod, pid: int) -> None:
+        """Durably record the pod's OS pid so a restarted kubelet can
+        adopt the live process (the containerd-state analogue)."""
+
+        def mutate(obj: Pod) -> None:  # type: ignore[type-arg]
+            if obj.metadata.uid != pod.metadata.uid or obj.is_terminal():
+                raise Kubelet._StalePod()
+            obj.metadata.annotations[PID_ANNOTATION] = str(pid)
+
+        try:
+            self.store.update_with_retry(
+                "Pod", pod.metadata.name, pod.metadata.namespace, mutate
+            )
+        except (NotFound, Kubelet._StalePod):
+            pass
 
     def _materialize_config_volumes(self, pod: Pod) -> None:
         """Write ConfigMap-backed volumes to their mount path (the kubelet

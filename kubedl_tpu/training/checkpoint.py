@@ -226,21 +226,43 @@ def _available_steps(ckpt_dir: str):
     return sorted(steps, reverse=True)
 
 
-def restore_checkpoint(ckpt_dir: str, like, step: Optional[int] = None):
+def restore_checkpoint(
+    ckpt_dir: str, like, step: Optional[int] = None, gc_torn: bool = False
+):
     """Load into the structure/shardings of `like` (an existing state).
     Returns None when the dir holds no complete checkpoint. With no
     explicit ``step``, tries the newest step dir first and falls back to
     older ones — a save torn by preemption (the exact crash this feature
-    recovers from) must not block resume from the previous good save."""
+    recovers from) must not block resume from the previous good save.
+    With ``gc_torn=True`` the torn newer step dirs skipped over by a
+    successful fallback are deleted, so they can't accumulate across
+    restarts or shadow the good step in ad-hoc tooling. GC only runs
+    after a SUCCESSFUL older restore — single-process only (a multi-host
+    peer may still be writing its shard of the "torn" step)."""
     candidates = [step] if step is not None else _available_steps(ckpt_dir)
     last_err: Optional[Exception] = None
+    torn: list = []
     for cand in candidates:
         try:
-            return _restore_step(ckpt_dir, like, cand)
+            state = _restore_step(ckpt_dir, like, cand)
         except (IncompleteCheckpoint, FileNotFoundError, KeyError) as e:
             if step is not None:
                 raise
             last_err = e
+            torn.append(cand)
+            continue
+        if gc_torn and torn:
+            import logging
+            import shutil
+
+            for t in torn:
+                d = Path(ckpt_dir) / f"step-{t:08d}"
+                shutil.rmtree(d, ignore_errors=True)
+            logging.getLogger(__name__).warning(
+                "restored step %d; garbage-collected %d torn newer step "
+                "dir(s): %s", cand, len(torn), torn,
+            )
+        return state
     if last_err is not None:
         import logging
 

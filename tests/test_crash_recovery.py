@@ -1,0 +1,525 @@
+"""Control-plane crash recovery (docs/robustness.md "Crash recovery"):
+WAL-backed ObjectStore, cold-start rehydration, pod adoption, gang
+re-reservation, and the observability that rides along.
+
+The acceptance spine is the kill-recover e2e: N jobs running under a
+WAL-backed operator, hard-kill mid-reconcile, restart on the same WAL dir,
+and the new incarnation adopts every running pod (zero duplicate launches),
+re-reserves the identical gang slice assignments, and finishes the job that
+was caught mid-gang-create."""
+
+import os
+import shutil
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from kubedl_tpu import chaos
+from kubedl_tpu.chaos import FaultInjected, FaultPlan, FaultSpec
+from kubedl_tpu.core.objects import Pod, PodGroup, PodPhase, new_uid
+from kubedl_tpu.core.store import Conflict, ObjectStore
+from kubedl_tpu.core.wal import WalCorruption, WriteAheadLog
+
+from tests.helpers import make_tpujob
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    chaos.disarm()
+    yield
+    chaos.disarm()
+
+
+def _pod(name: str, phase: PodPhase = PodPhase.PENDING) -> Pod:
+    p = Pod()
+    p.metadata.name = name
+    p.status.phase = phase
+    return p
+
+
+# ---------------------------------------------------------------------------
+# WAL unit behavior
+# ---------------------------------------------------------------------------
+
+
+class TestWalStore:
+    def test_round_trip_rehydration(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        s1 = ObjectStore(wal_dir=wal)
+        p1 = s1.create(_pod("p1"))
+        s1.create(_pod("p2"))
+        g = PodGroup(min_member=2, slice_type="v5e-8",
+                     assigned_slices=["s1"], phase="Running")
+        g.metadata.name = "gang1"
+        s1.create(g)
+        # mutate + delete must replay too
+        p1.status.phase = PodPhase.RUNNING
+        s1.update(p1)
+        s1.delete("Pod", "p2", "default")
+        rv = s1.revision
+        s1.close()
+
+        s2 = ObjectStore(wal_dir=wal)
+        assert s2.rehydrated and s2.replayed_records > 0
+        assert s2.revision == rv
+        got = s2.get("Pod", "p1")
+        assert got.status.phase == PodPhase.RUNNING
+        assert got.metadata.uid == p1.metadata.uid
+        assert s2.try_get("Pod", "p2") is None  # delete survived replay
+        gg = s2.get("PodGroup", "gang1")
+        assert gg.phase == "Running" and gg.assigned_slices == ["s1"]
+        # optimistic concurrency still works against replayed objects
+        got.status.phase = PodPhase.SUCCEEDED
+        s2.update(got)
+        stale = s1.get("Pod", "p1")  # from the dead incarnation's memory
+        stale.status.reason = "stale"
+        with pytest.raises(Conflict):
+            s2.update(stale)
+
+    def test_fresh_dir_is_not_rehydrated(self, tmp_path):
+        s = ObjectStore(wal_dir=str(tmp_path / "wal"))
+        assert not s.rehydrated and s.replayed_records == 0
+
+    def test_uid_floor_prevents_collisions(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        s1 = ObjectStore(wal_dir=wal)
+        p = s1.create(_pod("p1"))
+        s1.close()
+        s2 = ObjectStore(wal_dir=wal)
+        adopted_uid = s2.get("Pod", "p1").metadata.uid
+        assert adopted_uid == p.metadata.uid
+        # a fresh object minted AFTER rehydration must not reuse an
+        # adopted uid (adoption matches pods by (name, uid))
+        fresh = s2.create(_pod("p-new"))
+        assert fresh.metadata.uid != adopted_uid
+
+    def test_torn_append_applies_nothing(self, tmp_path):
+        """A crash mid-append (torn record) must leave memory and the
+        caller's object untouched, and replay must truncate the torn tail
+        instead of refusing to start."""
+        wal = str(tmp_path / "wal")
+        s1 = ObjectStore(wal_dir=wal)
+        s1.create(_pod("good"))
+        with FaultPlan(1, sites={"store.wal_append": [FaultSpec.nth(1)]}):
+            torn = _pod("torn")
+            with pytest.raises(FaultInjected):
+                s1.create(torn)
+        assert s1.try_get("Pod", "torn") is None  # not applied to memory
+        assert torn.metadata.resource_version == 0  # caller untouched
+        # the WAL is now crash-only: further writes refuse instead of
+        # appending after a known-torn tail
+        with pytest.raises(WalCorruption):
+            s1.create(_pod("after"))
+
+        s2 = ObjectStore(wal_dir=wal)
+        assert s2.try_get("Pod", "good") is not None
+        assert s2.try_get("Pod", "torn") is None
+        # the truncated log accepts appends again
+        s2.create(_pod("after"))
+        s2.close()
+        s3 = ObjectStore(wal_dir=wal)
+        assert {p.metadata.name for p in s3.list("Pod")} == {"good", "after"}
+
+    def test_corrupted_record_rejected(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        s1 = ObjectStore(wal_dir=wal)
+        s1.create(_pod("p1"))
+        s1.close()
+        log_file = tmp_path / "wal" / "wal.log"
+        raw = bytearray(log_file.read_bytes())
+        raw[12] ^= 0xFF  # flip a payload byte, lengths intact
+        log_file.write_bytes(bytes(raw))
+        with pytest.raises(WalCorruption):
+            ObjectStore(wal_dir=wal)
+
+    def test_snapshot_bounds_replay(self, tmp_path):
+        """Compaction: with snapshot_every=10, 100 writes must leave a
+        snapshot + a short tail, not a 100-record log — replay cost is
+        O(live objects + tail), not O(history)."""
+        wal = str(tmp_path / "wal")
+        s1 = ObjectStore(wal_dir=wal, wal_snapshot_every=10)
+        p = s1.create(_pod("hot"))
+        for i in range(100):
+            p.status.reason = f"tick-{i}"
+            s1.update(p)
+        s1.close()
+        snap = WriteAheadLog(wal)
+        snap_rev, snap_objs, records = snap.recover()
+        snap.close()
+        assert snap_rev > 0 and len(snap_objs) == 1  # one live object
+        assert len(records) <= 10  # tail only
+        s2 = ObjectStore(wal_dir=wal)
+        assert s2.get("Pod", "hot").status.reason == "tick-99"
+        assert s2.revision == 101
+
+    def test_explicit_compact(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        s1 = ObjectStore(wal_dir=wal)
+        for i in range(5):
+            s1.create(_pod(f"p{i}"))
+        s1.compact()
+        assert os.path.getsize(tmp_path / "wal" / "wal.log") == 0
+        s1.close()
+        s2 = ObjectStore(wal_dir=wal)
+        assert len(s2.list("Pod")) == 5
+
+    def test_fsync_policy_knob(self, tmp_path):
+        with pytest.raises(ValueError):
+            ObjectStore(wal_dir=str(tmp_path / "w1"), wal_fsync="sometimes")
+        for policy in ("always", "batch", "off"):
+            d = str(tmp_path / f"wal-{policy}")
+            s = ObjectStore(wal_dir=d, wal_fsync=policy)
+            s.create(_pod("p1"))
+            fsyncs = s.wal_fsyncs
+            s.close()
+            if policy == "always":
+                assert fsyncs >= 1
+            else:
+                assert fsyncs == 0
+            assert ObjectStore(wal_dir=d).try_get("Pod", "p1") is not None
+
+    def test_fsync_fault_injected(self, tmp_path):
+        s = ObjectStore(wal_dir=str(tmp_path / "wal"), wal_fsync="always")
+        with FaultPlan(1, sites={"store.wal_fsync": [FaultSpec.nth(1)]}):
+            with pytest.raises(FaultInjected):
+                s.create(_pod("p1"))
+
+    def test_wal_off_store_has_zero_overhead_path(self):
+        s = ObjectStore()
+        assert s._wal is None and s.wal_appends == 0
+        t0 = time.perf_counter()
+        pods = [s.create(_pod(f"p{i}")) for i in range(1500)]
+        for p in pods:
+            p.status.phase = PodPhase.RUNNING
+            s.update(p)
+        elapsed = time.perf_counter() - t0
+        # generous guard (scheduler_microbench owns the tight budget):
+        # 3000 ops of pure-memory store work must stay fast
+        assert elapsed < 5.0, f"WAL-off store slowed down: {elapsed:.2f}s"
+
+
+class TestWatchGapRobustness:
+    def test_since_revision_replays_missed_changes(self, tmp_path):
+        s = ObjectStore()
+        s.create(_pod("old"))
+        rev = s.revision
+        s.create(_pod("new1"))
+        p = s.create(_pod("new2"))
+        p.status.phase = PodPhase.RUNNING
+        s.update(p)
+        seen = []
+        s.watch(lambda e, obj, old: seen.append((e, obj.metadata.name)),
+                kinds=["Pod"], since_revision=rev)
+        # everything changed after `rev` is synthesized as ADDED, in
+        # revision order, exactly once per object
+        assert seen == [("ADDED", "new1"), ("ADDED", "new2")]
+        assert s.watch_gaps == 0
+
+    def test_deletion_gap_is_flagged(self):
+        s = ObjectStore()
+        s.create(_pod("p1"))
+        rev = s.revision
+        s.create(_pod("p2"))
+        s.delete("Pod", "p2", "default")
+        seen = []
+        s.watch(lambda e, obj, old: seen.append(e), kinds=["Pod"],
+                since_revision=rev)
+        # the DELETED event is unreplayable from live state: the gap is
+        # counted instead of silently dropped
+        assert s.watch_gaps == 1
+
+    def test_current_revision_replays_nothing(self):
+        s = ObjectStore()
+        s.create(_pod("p1"))
+        seen = []
+        s.watch(lambda e, obj, old: seen.append(e), kinds=["Pod"],
+                since_revision=s.revision)
+        assert seen == [] and s.watch_gaps == 0
+
+
+# ---------------------------------------------------------------------------
+# expectations observability (satellite c)
+# ---------------------------------------------------------------------------
+
+
+class TestExpectationsExpiry:
+    def test_collect_expired_pops_only_expired_unfulfilled(self, monkeypatch):
+        from kubedl_tpu.engine import expectations as exmod
+
+        exps = exmod.ControllerExpectations()
+        exps.expect_creations("default/a/worker/pods", 2)
+        exps.expect_creations("default/a2/worker/pods", 2)  # prefix-bounded
+        exps.expect_creations("default/b/worker/pods", 1)
+        exps.creation_observed("default/b/worker/pods")  # fulfilled
+        monkeypatch.setattr(exmod, "EXPECTATION_TIMEOUT", 0.0)
+        time.sleep(0.01)
+        assert exps.collect_expired("default/a") == ["default/a/worker/pods"]
+        assert exps.collect_expired("default/a") == []  # popped
+        assert exps.collect_expired("default/b") == []  # fulfilled != lost
+
+    def test_reconcile_past_expired_expectations_counts(self, tmp_path,
+                                                        monkeypatch):
+        from kubedl_tpu.engine import expectations as exmod
+        from kubedl_tpu.engine.expectations import expectation_key
+        from kubedl_tpu.operator import Operator, OperatorOptions
+        from kubedl_tpu.runtime.executor import ThreadRuntime
+
+        opts = OperatorOptions(
+            local_addresses=True,
+            artifact_registry_root=str(tmp_path / "reg"),
+        )
+        with Operator(opts, runtime=ThreadRuntime()) as op:
+            engine = op.engines["TPUJob"]
+            job = make_tpujob("expjob", workers=1,
+                              entrypoint="tests.test_crash_recovery:_noop")
+            op.submit(job)
+            from kubedl_tpu.api.types import JobConditionType
+
+            op.wait_for_phase(
+                "TPUJob", "expjob",
+                [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+                timeout=30,
+            )
+            # a dead incarnation's expectation that timed out: the next
+            # reconcile proceeds but says so
+            key = expectation_key("default/expjob", "worker", "pods")
+            engine.expectations.expect_creations(key, 3)
+            monkeypatch.setattr(exmod, "EXPECTATION_TIMEOUT", 0.0)
+            time.sleep(0.01)
+            engine.reconcile("default", "expjob")
+            assert op.metrics.expectations_expired.value(kind="TPUJob") == 1.0
+            assert "kubedl_tpu_expectations_expired" in op.render_metrics()
+
+
+def _noop(env):
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint fallback across an operator kill mid-save (satellite d)
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointCrashFallback:
+    def test_torn_save_falls_back_and_gc(self, tmp_path):
+        from kubedl_tpu.training.checkpoint import (
+            restore_checkpoint, save_checkpoint,
+        )
+
+        state = {"w": np.arange(16, dtype=np.float32),
+                 "step": np.asarray(0, dtype=np.int64)}
+        ckpt = str(tmp_path / "ckpt")
+        save_checkpoint(ckpt, state, step=1, process_index=0)
+        # simulated SIGKILL between shard write and manifest: step-2 dir
+        # holds shards but no meta.json
+        state2 = {"w": np.arange(16, dtype=np.float32) * 2,
+                  "step": np.asarray(2, dtype=np.int64)}
+        with FaultPlan(1, sites={"checkpoint.torn": [FaultSpec.nth(1)]}):
+            with pytest.raises(FaultInjected):
+                save_checkpoint(ckpt, state2, step=2, process_index=0)
+        assert (tmp_path / "ckpt" / "step-00000002").exists()
+
+        got = restore_checkpoint(ckpt, state2, gc_torn=True)
+        assert got is not None
+        assert int(got["step"]) == 0  # step-1 payload (saved step field)
+        np.testing.assert_array_equal(got["w"], state["w"])
+        # the torn newer dir was garbage-collected by the fallback
+        assert not (tmp_path / "ckpt" / "step-00000002").exists()
+        assert (tmp_path / "ckpt" / "step-00000001").exists()
+
+    def test_gc_off_keeps_torn_dir(self, tmp_path):
+        from kubedl_tpu.training.checkpoint import (
+            restore_checkpoint, save_checkpoint,
+        )
+
+        state = {"w": np.ones(4, dtype=np.float32)}
+        ckpt = str(tmp_path / "ckpt")
+        save_checkpoint(ckpt, state, step=1, process_index=0)
+        with FaultPlan(1, sites={"checkpoint.torn": [FaultSpec.nth(1)]}):
+            with pytest.raises(FaultInjected):
+                save_checkpoint(ckpt, state, step=2, process_index=0)
+        assert restore_checkpoint(ckpt, state) is not None
+        assert (tmp_path / "ckpt" / "step-00000002").exists()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance spine: kill-recover e2e
+# ---------------------------------------------------------------------------
+
+
+def _fresh_inventory():
+    from kubedl_tpu.gang.slice_scheduler import SliceInventory
+
+    inv = SliceInventory()
+    for s in ("s1", "s2", "s3"):
+        inv.add_slice(s, "v5e-8")
+    return inv
+
+
+def _hard_kill(op) -> None:
+    """Simulated SIGKILL inside one process: no graceful teardown, the
+    pods keep running, the kubelet forgets its handles, the WAL detaches.
+    (The cross-process variant with a REAL SIGKILL lives in
+    scripts/verify-drives/drive_crash_recovery.py.)"""
+    op.manager.stop()
+    op.node_heartbeater.stop()
+    op.kubelet._running.clear()
+    op.kubelet._running_uid.clear()
+    op.store.close()
+
+
+def _running_pods(store):
+    return {
+        f"{p.metadata.namespace}/{p.metadata.name}": p.metadata.uid
+        for p in store.list("Pod")
+        if p.status.phase == PodPhase.RUNNING
+    }
+
+
+class TestKillRecoverE2E:
+    def test_restart_adopts_everything(self, tmp_path):
+        from kubedl_tpu.api.topology import get_slice
+        from kubedl_tpu.api.types import JobConditionType
+        from kubedl_tpu.operator import Operator, OperatorOptions
+        from kubedl_tpu.runtime.executor import SubprocessRuntime
+
+        wal = str(tmp_path / "wal")
+        sleep_cmd = [sys.executable, "-c", "import time; time.sleep(60)"]
+        topo = get_slice("v5e-8")
+        opts = OperatorOptions(
+            local_addresses=True, wal_dir=wal,
+            pod_log_dir=str(tmp_path / "logs"),
+            artifact_registry_root=str(tmp_path / "reg"),
+        )
+        op1 = Operator(opts, runtime=SubprocessRuntime(str(tmp_path / "logs")),
+                       inventory=_fresh_inventory())
+        op2 = None
+        try:
+            op1.start()
+            for name in ("job1", "job2"):
+                op1.submit(make_tpujob(name, workers=2, command=sleep_cmd,
+                                       topology=topo))
+                op1.wait_for_phase("TPUJob", name, JobConditionType.RUNNING,
+                                   timeout=30)
+            assert op1.manager.wait(
+                lambda: len(_running_pods(op1.store)) == 4, timeout=20)
+            before = _running_pods(op1.store)
+            assert op1.kubelet.launch_count == 4
+            pre_gangs = {g.metadata.name: list(g.assigned_slices)
+                         for g in op1.store.list("PodGroup")}
+
+            # job3 dies mid-gang-create: PodGroup admitted (Running,
+            # slices assigned, durably in the WAL) but zero pods yet
+            op1.manager.stop()
+            job3 = make_tpujob("job3", workers=2, command=sleep_cmd,
+                               topology=topo)
+            op1.submit(job3)
+            gang3 = op1.gang.create_gang(job3)
+            assert op1.gang.try_admit(gang3)
+            pre_gangs["job3-gang"] = list(
+                op1.store.get("PodGroup", "job3-gang").assigned_slices)
+            _hard_kill(op1)
+
+            # restart on the same WAL dir: fresh store, fresh kubelet,
+            # fresh (empty) inventory — everything must come back
+            op2 = Operator(opts,
+                           runtime=SubprocessRuntime(str(tmp_path / "logs")),
+                           inventory=_fresh_inventory())
+            assert op2.store.rehydrated
+            op2.start()
+            op2.wait_for_phase("TPUJob", "job3", JobConditionType.RUNNING,
+                               timeout=30)
+            assert op2.manager.wait(
+                lambda: len(_running_pods(op2.store)) == 6, timeout=20)
+            after = _running_pods(op2.store)
+
+            # every pre-kill pod adopted in place: same name, SAME uid
+            for key, uid in before.items():
+                assert after[key] == uid, f"{key} was re-created, not adopted"
+            assert op2.kubelet.adopted_count == 4
+            # zero duplicate creates: only job3's two pods launched
+            assert op2.kubelet.launch_count == 2
+            # identical gang slice assignments, re-reserved in the fresh
+            # inventory under the same owners
+            post_gangs = {g.metadata.name: list(g.assigned_slices)
+                          for g in op2.store.list("PodGroup")}
+            assert post_gangs == pre_gangs
+            for g in op2.store.list("PodGroup"):
+                owner = f"{g.metadata.namespace}/{g.metadata.name}"
+                assert sorted(op2.inventory.owned_slices(owner)) == sorted(
+                    g.assigned_slices)
+            # same phases as before the kill
+            for name in ("job1", "job2", "job3"):
+                assert (op2.store.get("TPUJob", name).status.phase
+                        == JobConditionType.RUNNING)
+            # recovery observability
+            assert op2.store.replayed_records > 0
+            rendered = op2.render_metrics()
+            assert "kubedl_tpu_pods_adopted 4.0" in rendered
+            assert "kubedl_tpu_wal_replayed_records" in rendered
+            assert "kubedl_tpu_recovery_duration_seconds" in rendered
+        finally:
+            if op2 is not None:
+                op2.stop()
+            try:
+                op1.stop()
+            except Exception:
+                pass
+
+    def test_lost_pod_fails_retryably(self, tmp_path):
+        """A pod whose process died WITH the operator (or whose pid
+        annotation is gone) must fail with a retryable exit, not hang as
+        a RUNNING ghost."""
+        from kubedl_tpu.api.types import JobConditionType
+        from kubedl_tpu.operator import Operator, OperatorOptions
+        from kubedl_tpu.runtime.executor import (
+            PID_ANNOTATION, SubprocessRuntime,
+        )
+
+        wal = str(tmp_path / "wal")
+        opts = OperatorOptions(
+            local_addresses=True, wal_dir=wal,
+            artifact_registry_root=str(tmp_path / "reg"),
+        )
+        sleep_cmd = [sys.executable, "-c", "import time; time.sleep(60)"]
+        op1 = Operator(opts, runtime=SubprocessRuntime())
+        op2 = None
+        try:
+            op1.start()
+            op1.submit(make_tpujob("ghost", workers=1, command=sleep_cmd))
+            op1.wait_for_phase("TPUJob", "ghost", JobConditionType.RUNNING,
+                               timeout=30)
+            assert op1.manager.wait(
+                lambda: len(_running_pods(op1.store)) == 1, timeout=20)
+            # operator dies first, THEN the pod's process dies with the
+            # host — the restarted operator finds a stale pid annotation
+            # (the WAL still says RUNNING: the dead incarnation's reaper
+            # can no longer write through its detached WAL)
+            [(key, _)] = _running_pods(op1.store).items()
+            pod = op1.store.get("Pod", key.split("/", 1)[1])
+            pid = int(pod.metadata.annotations[PID_ANNOTATION])
+            _hard_kill(op1)
+            os.kill(pid, 9)
+            time.sleep(0.3)
+
+            op2 = Operator(opts, runtime=SubprocessRuntime())
+            op2.start()
+            # the ghost is detected, failed retryably (exit 137), and the
+            # job restarts it — back to RUNNING with a NEW pod
+            def recovered():
+                pods = _running_pods(op2.store)
+                return len(pods) == 1 and op2.kubelet.launch_count >= 1
+
+            assert op2.manager.wait(recovered, timeout=30)
+            # detected as lost (not adopted), failed retryably, relaunched
+            assert op2.kubelet.adopted_count == 0
+            assert op2.kubelet.launch_count >= 1
+        finally:
+            if op2 is not None:
+                op2.stop()
+            try:
+                op1.stop()
+            except Exception:
+                pass

@@ -143,3 +143,144 @@ class TestOperatorHA:
         finally:
             op1.stop()
             op2.stop()
+
+
+class TestFailoverTiming:
+    def test_clean_stop_hands_over_faster_than_crash(self):
+        """Satellite of the crash-recovery PR: a clean stop() releases the
+        lease, so the standby acquires within ~one renew interval; after a
+        crash (no release) it must wait out the remaining TTL. The two
+        delays are measured with real clocks and must be cleanly ordered."""
+        ttl = 1.5  # renew interval = ttl/3 = 0.5
+
+        def wait_leader(elector, timeout):
+            deadline = time.time() + timeout
+            while time.time() < deadline and not elector.is_leader:
+                time.sleep(0.02)
+            return elector.is_leader
+
+        # clean handoff
+        store = ObjectStore()
+        a = LeaderElector(store, identity="a", ttl=ttl)
+        b = LeaderElector(store, identity="b", ttl=ttl)
+        a.start()
+        assert wait_leader(a, 5)
+        b.start()
+        t0 = time.time()
+        a.stop()  # releases the lease
+        assert wait_leader(b, ttl * 4)
+        clean_delay = time.time() - t0
+        b.stop()
+
+        # crash (campaign thread dies, lease NOT released)
+        store2 = ObjectStore()
+        c = LeaderElector(store2, identity="c", ttl=ttl)
+        d = LeaderElector(store2, identity="d", ttl=ttl)
+        c.start()
+        assert wait_leader(c, 5)
+        d.start()
+        c._stop.set()
+        c._thread.join(timeout=2)
+        t0 = time.time()
+        assert wait_leader(d, ttl * 4)
+        crash_delay = time.time() - t0
+        d.stop()
+
+        # clean handoff beats TTL expiry: within ~one renew interval
+        # (generous CI slack) vs. most of the TTL
+        assert clean_delay < ttl * 0.6, clean_delay
+        assert crash_delay > ttl * 0.55, crash_delay
+        assert clean_delay < crash_delay
+
+
+class TestFailoverDrill:
+    def test_standby_takeover_adopts_pods_and_slices(self, tmp_path):
+        """The leader-failover drill (docs/robustness.md): kill the lease
+        holder WITHOUT touching its pods; the standby must take over and
+        run the same rehydrate-then-adopt path a cold restart does —
+        re-reserving gang slices into ITS inventory and adopting the
+        running processes instead of relaunching them."""
+        import sys
+
+        from tests.helpers import make_tpujob
+
+        from kubedl_tpu.api.topology import get_slice
+        from kubedl_tpu.api.types import JobConditionType
+        from kubedl_tpu.gang.slice_scheduler import SliceInventory
+        from kubedl_tpu.operator import Operator, OperatorOptions
+        from kubedl_tpu.runtime.executor import SubprocessRuntime
+
+        store = ObjectStore()
+        logs = str(tmp_path / "logs")
+
+        def inventory():
+            inv = SliceInventory()
+            inv.add_slice("s1", "v5e-8")
+            return inv
+
+        def opts(ident):
+            return OperatorOptions(
+                local_addresses=True, pod_log_dir=logs,
+                artifact_registry_root=str(tmp_path / f"reg-{ident}"),
+                leader_elect=True, leader_identity=ident,
+                leader_lease_ttl=0.6,
+            )
+
+        op1 = Operator(opts("op1"), runtime=SubprocessRuntime(logs),
+                       store=store, inventory=inventory())
+        op2 = Operator(opts("op2"), runtime=SubprocessRuntime(logs),
+                       store=store, inventory=inventory())
+        try:
+            op1.start()
+            deadline = time.time() + 5
+            while time.time() < deadline and not op1.elector.is_leader:
+                time.sleep(0.02)
+            assert op1.elector.is_leader
+            op2.start()  # standby
+
+            job = make_tpujob(
+                "drill", workers=2,
+                command=[sys.executable, "-c", "import time; time.sleep(60)"],
+                topology=get_slice("v5e-8"),
+            )
+            op1.submit(job)
+            op1.wait_for_phase("TPUJob", "drill", JobConditionType.RUNNING,
+                               timeout=30)
+
+            def running(s):
+                from kubedl_tpu.core.objects import PodPhase
+                return {p.metadata.name: p.metadata.uid
+                        for p in s.list("Pod")
+                        if p.status.phase == PodPhase.RUNNING}
+
+            assert op1.manager.wait(lambda: len(running(store)) == 2,
+                                    timeout=20)
+            before = running(store)
+
+            # crash the leader but leave its pods alive (stop the campaign
+            # thread so the lease is NOT released, drop kubelet handles)
+            op1.elector._stop.set()
+            op1.elector._thread.join(timeout=2)
+            op1.manager.stop()
+            op1.node_heartbeater.stop()
+            op1.kubelet._running.clear()
+            op1.kubelet._running_uid.clear()
+
+            deadline = time.time() + 10
+            while time.time() < deadline and not op2.elector.is_leader:
+                time.sleep(0.05)
+            assert op2.elector.is_leader
+
+            # same pods, same uids, adopted not relaunched
+            assert op2.manager.wait(
+                lambda: op2.kubelet.adopted_count == 2, timeout=10)
+            assert running(store) == before
+            assert op2.kubelet.launch_count == 0
+            # gang slices re-reserved into the NEW leader's inventory
+            gang = store.get("PodGroup", "drill-gang")
+            assert sorted(op2.inventory.owned_slices(
+                "default/drill-gang")) == sorted(gang.assigned_slices)
+            assert gang.assigned_slices == ["s1"]
+        finally:
+            op2.stop()
+            op1.stop()
