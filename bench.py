@@ -470,6 +470,55 @@ def bench_crash_recovery() -> dict:
     return out
 
 
+def bench_checkpoint_overhead() -> dict:
+    """Async-checkpoint stall budget (docs/robustness.md "Async
+    checkpointing"): the step loop's blocking cost per save must be <10%
+    of a synchronous save of the same state. A single ~64MB leaf makes
+    the npz/disk write the dominant sync cost (like a real shard), so
+    the ratio isolates what the async split actually buys — the loop
+    pays only the device->host snapshot while the writer thread eats
+    the IO."""
+    import statistics
+    import tempfile
+    import time as _t
+
+    import jax.numpy as jnp
+
+    from kubedl_tpu.training.checkpoint import (
+        AsyncCheckpointer, save_checkpoint,
+    )
+
+    state = {
+        "step": jnp.zeros((), jnp.int32),
+        "params": {"w": jnp.arange(16 << 20, dtype=jnp.float32)},  # 64 MB
+    }
+    trials = 5
+    sync_s, stall_s = [], []
+    with tempfile.TemporaryDirectory() as tmp:
+        for i in range(trials):
+            t0 = _t.perf_counter()
+            save_checkpoint(os.path.join(tmp, "sync"), state, i + 1)
+            sync_s.append(_t.perf_counter() - t0)
+        acp = AsyncCheckpointer(os.path.join(tmp, "async"))
+        for i in range(trials):
+            t0 = _t.perf_counter()
+            acp.save(state, i + 1)
+            stall_s.append(_t.perf_counter() - t0)
+            # drain OUTSIDE the timed window: each trial measures the
+            # steady-state stall, not a backpressure pile-up
+            acp.wait_for_pending()
+    sync_med = statistics.median(sync_s)
+    stall_med = statistics.median(stall_s)
+    return {
+        "payload_mb": 64,
+        "sync_save_median_s": round(sync_med, 4),
+        "async_stall_median_s": round(stall_med, 4),
+        "stall_pct_of_sync": round(stall_med / sync_med * 100.0, 1),
+        "async_total_stall_s": round(acp.stall_seconds, 4),
+        "pass": stall_med < 0.10 * sync_med,
+    }
+
+
 def bench_serving_engine(on_tpu: bool, raw: dict) -> dict:
     """BASELINE.md target 5 through the PRODUCTION path (VERDICT r4
     missing #3): the raw-decode microbench never exercised the
@@ -1196,6 +1245,10 @@ def main() -> int:
         targets["crash_recovery"] = bench_crash_recovery()
     except Exception as e:
         targets["crash_recovery"] = {"error": str(e)}
+    try:
+        targets["checkpoint_overhead"] = bench_checkpoint_overhead()
+    except Exception as e:
+        targets["checkpoint_overhead"] = {"error": str(e)}
 
     tps_chip = summary["tokens_per_sec_per_chip"]
     mfu = summary["mfu"]

@@ -72,6 +72,18 @@ ENV_CKPT_DIR = "KUBEDL_CKPT_DIR"
 #: checkpoint dir so gang restarts / resizes / resumes warm-hit instead of
 #: re-paying first-step compile (VERDICT.md round-2 weak #1).
 ENV_COMPILE_CACHE_DIR = "KUBEDL_COMPILE_CACHE_DIR"
+#: Progress-beacon file (kubedl_tpu/watchdog/): operator-injected per-pod
+#: path where the worker's beacon thread stamps {step, tokens, ts}; the
+#: kubelet heartbeat publishes it onto the pod's Node object and the
+#: watchdog classifies hangs/stragglers/silent deaths from it.
+ENV_BEACON_FILE = "KUBEDL_BEACON_FILE"
+#: seconds between beacon stamps (default 0.5)
+ENV_BEACON_INTERVAL = "KUBEDL_BEACON_INTERVAL"
+#: Peer replica root for async checkpointing (training/checkpoint.py):
+#: a remote blob URL (http://host:port/prefix) each process mirrors its
+#: shard files to, so restore-from-latest survives losing the owning
+#: host's local checkpoint dir (preference: local -> peer -> blob store).
+ENV_CKPT_PEER = "KUBEDL_CKPT_PEER"
 
 # Default port every replica's coordinator/service listens on.
 DEFAULT_PORT = 2222

@@ -110,6 +110,13 @@ class JobConditionType(str, enum.Enum):
     #: hot-looping the workqueue. NOT terminal: the job is neither
     #: succeeded nor failed, it is awaiting operator intervention.
     QUARANTINED = "Quarantined"
+    #: TPU addition (progress watchdog, kubedl_tpu/watchdog/): a replica
+    #: stopped making training progress WITHOUT exiting — a wedged step
+    #: loop (hang), a host whose beacons stopped while the pod stayed
+    #: RUNNING (silent death). The watchdog fails the replica retryably,
+    #: so the next reconcile takes the normal gang-restart path and
+    #: supersedes this condition with RESTARTING. NOT terminal.
+    HANG_DETECTED = "HangDetected"
 
 
 TERMINAL_CONDITIONS = (JobConditionType.SUCCEEDED, JobConditionType.FAILED)

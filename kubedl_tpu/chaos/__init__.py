@@ -15,6 +15,7 @@ from kubedl_tpu.chaos.plan import (
     check,
     disarm,
     should_fail,
+    sites,
 )
 from kubedl_tpu.chaos.retry import RetryBudgetExhausted, RetryPolicy
 
@@ -30,4 +31,5 @@ __all__ = [
     "check",
     "disarm",
     "should_fail",
+    "sites",
 ]

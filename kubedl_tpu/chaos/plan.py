@@ -22,6 +22,8 @@ Injection sites wired in this repo::
     checkpoint.torn                              die between shard + manifest
     store.wal_append                             torn WAL record (half-write)
     store.wal_fsync                              fail the WAL fsync syscall
+    watchdog.beacon                              freeze a node's beacon publish
+    trainer.step_stall                           wedge the training step loop
 
 Schedules are per-site and deterministic: ``nth(n)`` fails exactly the
 n-th call (1-based), ``first(k)`` fails the first k calls, ``prob(p, k)``
@@ -41,6 +43,35 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 class FaultInjected(Exception):
     """Raised by :func:`check` when the armed plan schedules a fault."""
+
+
+#: Canonical registry of every injection site wired into production code,
+#: name -> one-line description. The module docstring table above and the
+#: ``chaos.check``/``chaos.should_fail`` literals in the source are both
+#: asserted against this mapping by the doc-drift test
+#: (tests/test_chaos.py) — add new sites HERE first.
+SITES: Dict[str, str] = {
+    "store.create": "ObjectStore create write",
+    "store.update": "ObjectStore update write",
+    "store.delete": "ObjectStore delete write",
+    "node.heartbeat": "skip a kubelet beat",
+    "elastic.preempt": "preemption notice on a node",
+    "gang.bind": "reject a slice reservation",
+    "client.http": "console client transport",
+    "remote.request": "blob-server transport",
+    "serving.dispatch": "device segment dispatch",
+    "checkpoint.torn": "die between shard + manifest",
+    "store.wal_append": "torn WAL record (half-write)",
+    "store.wal_fsync": "fail the WAL fsync syscall",
+    "watchdog.beacon": "freeze a node's beacon publish",
+    "trainer.step_stall": "wedge the training step loop",
+}
+
+
+def sites() -> Dict[str, str]:
+    """Introspection: every wired injection site with its description
+    (a copy — mutating the result never corrupts the registry)."""
+    return dict(SITES)
 
 
 @dataclass(frozen=True)

@@ -64,6 +64,15 @@ class NodeHeartbeater:
         #: (a real preemption signal arrives on the HOST, so it is the
         #: kubelet's heartbeat that publishes it — elastic/preemption.py)
         self._notices: Dict[str, Optional[str]] = {}
+        #: training-progress beacons riding the same channel (progress
+        #: watchdog): node name -> {"ns/pod": beacon dict}. Fed either by
+        #: :meth:`announce_progress` (in-process workers / tests) or by a
+        #: pluggable ``beacon_source`` the operator wires to scan the
+        #: beacon files subprocess workers write (watchdog/beacon.py).
+        self._progress: Dict[str, Dict[str, Dict[str, float]]] = {}
+        #: callable(node_name) -> {"ns/pod": beacon dict} for pods hosted
+        #: on that node, or None when no file-based source is wired
+        self.beacon_source = None
 
     # -- preemption notices (elastic slice scaling) ---------------------
 
@@ -78,6 +87,43 @@ class NodeHeartbeater:
         """Queue withdrawal of the notice (capacity returns to service)."""
         self._notices[node_name] = None
 
+    # -- progress beacons (silent-hang watchdog) ------------------------
+
+    def announce_progress(
+        self, node_name: str, pod_key: str, step: int, tokens: float = 0.0,
+        ts: Optional[float] = None,
+    ) -> None:
+        """Publish a worker's per-step progress beacon through the next
+        beat — the same channel preemption notices ride. ``pod_key`` is
+        "namespace/pod". Sticky: re-stamped every beat until cleared, so
+        the watchdog judges freshness by observing VALUE changes."""
+        self._progress.setdefault(node_name, {})[pod_key] = {
+            "step": float(step), "tokens": float(tokens),
+            "ts": float(self.clock() if ts is None else ts),
+        }
+
+    def clear_progress(self, node_name: str, pod_key: Optional[str] = None) -> None:
+        if pod_key is None:
+            self._progress.pop(node_name, None)
+        else:
+            self._progress.get(node_name, {}).pop(pod_key, None)
+
+    def _beacons_for(self, name: str):
+        """Merge file-sourced beacons (subprocess workers) over announced
+        ones (in-process workers); None = leave the Node's map untouched
+        (the ``watchdog.beacon`` chaos site simulates a kubelet whose
+        beacon publication wedged while its heartbeat stayed healthy —
+        the silent-death signature)."""
+        if chaos.should_fail("watchdog.beacon"):
+            return None
+        merged = dict(self._progress.get(name, {}))
+        if self.beacon_source is not None:
+            try:
+                merged.update(self.beacon_source(name) or {})
+            except Exception:
+                log.exception("beacon source failed for node %s", name)
+        return merged
+
     def beat_once(self) -> None:
         now = self.clock()
         for name in self.node_names:
@@ -87,6 +133,7 @@ class NodeHeartbeater:
             if chaos.should_fail("node.heartbeat"):
                 continue  # injected missed beat → lifecycle eviction path
             notice = self._notices.pop(name, False)
+            beacons = self._beacons_for(name)
             try:
                 def mutate(obj: Node) -> None:
                     obj.last_heartbeat = now
@@ -96,6 +143,8 @@ class NodeHeartbeater:
                     if notice is not False:
                         obj.preempt_at = now if notice is not None else 0.0
                         obj.preempt_reason = notice or ""
+                    if beacons is not None:
+                        obj.beacons = beacons
 
                 self.store.update_with_retry("Node", name, NODE_NAMESPACE, mutate)
             except NotFound:
@@ -103,6 +152,8 @@ class NodeHeartbeater:
                 if notice not in (False, None):
                     node.preempt_at = now
                     node.preempt_reason = notice  # type: ignore[assignment]
+                if beacons:
+                    node.beacons = beacons
                 node.metadata.name = name
                 node.metadata.namespace = NODE_NAMESPACE
                 try:

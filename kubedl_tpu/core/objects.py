@@ -289,6 +289,13 @@ class Node(BaseObject):
     #: marks its slice draining so jobs vacate before the reclaim lands.
     preempt_at: float = 0.0
     preempt_reason: str = ""
+    #: per-pod training-progress beacons riding this node's heartbeat
+    #: (progress watchdog, kubedl_tpu/watchdog/): "ns/pod" -> {"step",
+    #: "tokens", "ts"} as stamped by the worker. The kubelet's beat
+    #: REPLACES the mapping each cycle, so pods that left the node drop
+    #: out; the watchdog judges staleness by when it OBSERVED values
+    #: change, never by comparing the worker's ``ts`` to its own clock.
+    beacons: Dict[str, Dict[str, float]] = field(default_factory=dict)
 
 
 @dataclass

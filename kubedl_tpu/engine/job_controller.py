@@ -93,6 +93,7 @@ class JobEngine:
         features: Optional[FeatureGates] = None,
         cluster_domain: str = "",
         compile_cache_dir: str = "",
+        beacon_dir: str = "",
     ) -> None:
         self.store = store
         self.controller = controller
@@ -102,6 +103,7 @@ class JobEngine:
         self.features = features or DEFAULT_GATES
         self.cluster_domain = cluster_domain
         self.compile_cache_dir = compile_cache_dir
+        self.beacon_dir = beacon_dir
         self.expectations = ControllerExpectations()
         #: poison-pill protection: consecutive reconcile exceptions per job
         #: before it is parked with a Quarantined condition instead of
@@ -794,6 +796,22 @@ class JobEngine:
             if main.get_env(constants.ENV_COMPILE_CACHE_DIR) is None:
                 main.set_env(
                     constants.ENV_COMPILE_CACHE_DIR, self.compile_cache_dir
+                )
+
+        # progress beacon (kubedl_tpu/watchdog/): per-pod file the worker's
+        # beacon thread stamps and the kubelet heartbeat publishes onto the
+        # Node object. User-set env wins (same contract as the cache dir).
+        if self.beacon_dir:
+            from kubedl_tpu.watchdog.beacon import beacon_path
+
+            main = pod.spec.main_container()
+            if main.get_env(constants.ENV_BEACON_FILE) is None:
+                main.set_env(
+                    constants.ENV_BEACON_FILE,
+                    beacon_path(
+                        self.beacon_dir, job.metadata.namespace,
+                        pod.metadata.name,
+                    ),
                 )
 
         # gang binding: placement computed at admission

@@ -294,6 +294,24 @@ class JobMetrics:
             "Reconciles that proceeded past timed-out controller "
             "expectations (the dead-incarnation / lost-watch-event signal)",
         )
+        # Progress watchdog (kubedl_tpu/watchdog/, docs/robustness.md
+        # "Hang detection"): restarts it triggered, labeled by the failure
+        # class it classified — reason="hang" (beacons fresh, step frozen)
+        # or reason="silent_death" (beacons stopped, pod still RUNNING)
+        self.watchdog_restarts = r.counter(
+            "kubedl_tpu_watchdog_restarts",
+            "Gang restarts triggered by the progress watchdog, by reason",
+        )
+        self.watchdog_stragglers = r.counter(
+            "kubedl_tpu_watchdog_stragglers",
+            "Replicas flagged as stragglers (step rate far below the "
+            "gang median); observational — no restart is triggered",
+        )
+        self.watchdog_tracked = r.gauge(
+            "kubedl_tpu_watchdog_tracked_replicas",
+            "Replicas currently tracked by the progress watchdog "
+            "(a replica opts in by emitting its first beacon)",
+        )
 
 
 #: ms-scale buckets for the decode pipeline's per-tick timings (the

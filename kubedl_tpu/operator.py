@@ -89,6 +89,25 @@ class OperatorOptions:
     #: node names THIS process's kubelet heartbeats (opt-in; defaults to
     #: [node_name] when node_name is set)
     heartbeat_nodes: List[str] = field(default_factory=list)
+    #: progress watchdog (kubedl_tpu/watchdog/, docs/robustness.md "Hang
+    #: detection"): classify hung / silently-dead / straggling replicas
+    #: from per-step beacons and drive the normal gang-restart path
+    watchdog_enabled: bool = True
+    #: hang budget multiplier over the observed step-time EWMA
+    watchdog_multiplier: float = 4.0
+    #: floor under every watchdog budget (seconds)
+    watchdog_min_budget_seconds: float = 30.0
+    #: budget before the first observed step advance (covers compilation)
+    watchdog_startup_grace_seconds: float = 300.0
+    #: straggler flag: step rate below this fraction of the gang median
+    watchdog_straggler_ratio: float = 0.25
+    #: directory for per-pod progress-beacon files (KUBEDL_BEACON_FILE).
+    #: Per-user default for the same poisoning reason as the compile
+    #: cache; "" disables beacon injection (watchdog then only sees
+    #: in-process announce_progress traffic).
+    beacon_dir: str = field(default_factory=lambda: os.path.join(
+        tempfile.gettempdir(), f"kubedl-tpu-beacons-{os.getuid()}"
+    ))
     #: elastic slice scaling: minimum seconds between GROW resizes per job
     #: (shrinks away from draining slices bypass the cooldown). See
     #: kubedl_tpu/elastic/policy.py and docs/elasticity.md.
@@ -163,6 +182,7 @@ class Operator:
                 features=self.features,
                 cluster_domain=self.options.cluster_domain,
                 compile_cache_dir=self.options.compile_cache_dir,
+                beacon_dir=self.options.beacon_dir,
             )
             self.engines[kind] = engine
             self.controllers[kind] = controller
@@ -213,6 +233,37 @@ class Operator:
             self.store, beat_names,
             interval=max(self.options.node_grace_seconds / 3.0, 0.5),
         )
+
+        # progress watchdog: beacons ride the heartbeat onto Node objects;
+        # the controller classifies hang / silent-death / straggler and
+        # fails wedged pods retryably (kubedl_tpu/watchdog/)
+        self.watchdog = None
+        if self.options.watchdog_enabled:
+            from kubedl_tpu.watchdog import (
+                FileBeaconSource,
+                WatchdogConfig,
+                WatchdogController,
+            )
+
+            if self.options.beacon_dir:
+                self.node_heartbeater.beacon_source = FileBeaconSource(
+                    self.options.beacon_dir, self.store
+                )
+            self.watchdog = WatchdogController(
+                self.store, self.manager.recorder, metrics=self.metrics,
+                config=WatchdogConfig(
+                    multiplier=self.options.watchdog_multiplier,
+                    min_budget_seconds=self.options.watchdog_min_budget_seconds,
+                    startup_grace_seconds=(
+                        self.options.watchdog_startup_grace_seconds
+                    ),
+                    straggler_ratio=self.options.watchdog_straggler_ratio,
+                ),
+            )
+            self.watchdog.setup(self.manager)
+            self.metrics.watchdog_tracked.set_function(
+                lambda: float(self.watchdog.tracked())
+            )
 
         # elastic slice scaling: preemption notices -> draining slices ->
         # policy-driven grow/shrink (kubedl_tpu/elastic/, docs/elasticity.md)

@@ -272,6 +272,10 @@ class Kubelet:
         #: (ns, pod, volume) -> (pod uid, ConfigMap resource version) last
         #: materialized; cleared when the pod is deleted
         self._materialized: Dict[tuple, tuple] = {}
+        #: ns/name -> progress-beacon file path (KUBEDL_BEACON_FILE env),
+        #: recorded at launch so pod deletion can remove the file — a
+        #: stale beacon from a dead pod must never be re-published
+        self._beacon_files: Dict[str, str] = {}
 
     def setup(self, manager: ControllerManager) -> None:
         def mapper(event: str, obj: BaseObject, old):
@@ -320,14 +324,27 @@ class Kubelet:
         key = f"{namespace}/{name}"
         pod = self.store.try_get("Pod", name, namespace)
         if pod is None:
+            # deleted: kill the container but KEEP the _running slot — the
+            # reap thread frees it (and relaunches any same-name
+            # replacement) only after handle.wait() returns, i.e. after
+            # the old container fully tore down. Freeing the slot here let
+            # a replacement launch while the cancelled entrypoint was
+            # still unwinding — two trainers sharing one device runtime,
+            # one of them mid-teardown (real kubelets likewise never start
+            # a same-name container before the old one is gone).
             with self._lock:
-                handle = self._running.pop(key, None)
-                self._running_uid.pop(key, None)
+                handle = self._running.get(key)
+                beacon = self._beacon_files.pop(key, None)
                 for sk in [k for k in self._materialized
                            if (k[0], k[1]) == (namespace, name)]:
                     del self._materialized[sk]
             if handle is not None:
                 handle.kill()
+            if beacon:
+                try:
+                    os.unlink(beacon)
+                except OSError:
+                    pass
             return None
         assert isinstance(pod, Pod)
         if not self._served(pod):
@@ -398,6 +415,10 @@ class Kubelet:
 
     def _launch(self, pod: Pod, key: str) -> None:
         env = self._pod_env(pod)
+        beacon = env.get("KUBEDL_BEACON_FILE")
+        if beacon:
+            with self._lock:
+                self._beacon_files[key] = beacon
         self._materialize_config_volumes(pod)
         # init containers run to completion first (code-sync etc.)
         for init in pod.spec.init_containers:

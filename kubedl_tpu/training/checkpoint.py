@@ -28,11 +28,14 @@ from __future__ import annotations
 
 import glob
 import json
+import logging
 import os
 import re
 import tempfile
+import threading
+import time
 from pathlib import Path
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Sequence
 
 import jax
 import numpy as np
@@ -55,14 +58,22 @@ def _shard_key(key: str, index, shape=None) -> str:
     return f"{key}@{offs}+" + "x".join(str(n) for n in shape)
 
 
-def save_checkpoint(
-    ckpt_dir: str, state, step: int, process_index: Optional[int] = None
-) -> str:
-    """Write this process's shards (+ manifest and marker on rank 0)."""
-    pid = jax.process_index() if process_index is None else process_index
-    d = Path(ckpt_dir) / f"step-{step:08d}"
-    d.mkdir(parents=True, exist_ok=True)
+def snapshot_state(state, process_index: Optional[int] = None):
+    """Device->host capture of this process's shards + the manifest.
 
+    This is the only part of a save that must happen at a step boundary
+    (it reads device buffers that the next step will overwrite); the
+    returned ``(shards, manifest)`` are plain host numpy arrays that
+    :func:`write_snapshot` can persist from any thread, any time later.
+
+    Every shard is an OWNED copy, never a view: the train step donates
+    the state buffers, so on backends where device_get is zero-copy
+    (CPU) a view would alias memory the next step overwrites — the
+    deferred write would then serialize the WRONG step's values (or read
+    freed memory). The memcpy here is the entire price the step loop
+    pays for an async save.
+    """
+    pid = jax.process_index() if process_index is None else process_index
     shards: Dict[str, np.ndarray] = {}
     manifest: Dict[str, Any] = {}
     for key, leaf in _leaf_items(state):
@@ -71,19 +82,33 @@ def save_checkpoint(
             manifest[key] = {"shape": list(arr.shape), "dtype": str(arr.dtype)}
             if arr.is_fully_replicated:
                 if pid == 0:
-                    shards[key] = np.asarray(jax.device_get(arr))
+                    shards[key] = np.array(jax.device_get(arr))
             else:
                 for s in arr.addressable_shards:
                     if s.replica_id == 0:
                         shards[_shard_key(key, s.index, s.data.shape)] = (
-                            np.asarray(s.data)
+                            np.array(s.data)
                         )
         else:
             a = np.asarray(arr)
             manifest[key] = {"shape": list(a.shape), "dtype": str(a.dtype)}
             if pid == 0:
-                shards[key] = a
+                shards[key] = np.array(a)
+    return shards, manifest
 
+
+def write_snapshot(
+    ckpt_dir: str,
+    shards: Dict[str, np.ndarray],
+    manifest: Dict[str, Any],
+    step: int,
+    pid: int,
+    nprocs: int,
+) -> str:
+    """Persist a captured snapshot: shard file, then (rank 0) manifest and
+    marker. Pure host-side IO — safe off the step loop."""
+    d = Path(ckpt_dir) / f"step-{step:08d}"
+    d.mkdir(parents=True, exist_ok=True)
     # atomic-ish: write to tmp then rename
     fd, tmp = tempfile.mkstemp(dir=str(d), suffix=".tmp.npz")
     os.close(fd)
@@ -94,12 +119,21 @@ def save_checkpoint(
     chaos.check("checkpoint.torn")
     if pid == 0:
         (d / "meta.json").write_text(
-            json.dumps(
-                {"step": step, "nprocs": jax.process_count(), "leaves": manifest}
-            )
+            json.dumps({"step": step, "nprocs": nprocs, "leaves": manifest})
         )
         (Path(ckpt_dir) / "latest").write_text(d.name)
     return str(d)
+
+
+def save_checkpoint(
+    ckpt_dir: str, state, step: int, process_index: Optional[int] = None
+) -> str:
+    """Write this process's shards (+ manifest and marker on rank 0)."""
+    pid = jax.process_index() if process_index is None else process_index
+    shards, manifest = snapshot_state(state, pid)
+    return write_snapshot(
+        ckpt_dir, shards, manifest, step, pid, jax.process_count()
+    )
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
@@ -303,8 +337,164 @@ def _restore_step(ckpt_dir: str, like, step: int):
                     store.region(k, sh, dt, idx)
                 ),
             )
+            # force an XLA-OWNED buffer: when the assembled host array
+            # happens to satisfy the runtime's alignment requirements,
+            # make_array_from_callback zero-copies on CPU and the jax
+            # Array ALIASES numpy-owned memory. The first train step
+            # then donates it, and XLA writes its output into / frees a
+            # buffer numpy also manages — heap corruption, or silently
+            # scrambled weights when the write lands before the free.
+            # Whether a given leaf aliases depends on allocator luck, so
+            # the bug is a coin flip per restart; the copy makes every
+            # restored leaf donation-safe. jnp.copy preserves sharding.
+            arr = jax.numpy.copy(arr)
         else:
             a = np.asarray(leaf)
             arr = store.full(key, a.shape, a.dtype)
         out.append(arr)
     return jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(like), out)
+
+
+# ---- asynchronous replicated saves (docs/robustness.md) -------------------
+
+
+class AsyncCheckpointer:
+    """Interval saves off the step loop, with optional peer replication.
+
+    ``save(state, step)`` blocks only for (a) the previous write to finish
+    (at-most-one-in-flight backpressure — snapshots are host RAM, an
+    unbounded queue would OOM long before disk caught up) and (b) the
+    device->host snapshot; the npz/manifest/marker IO and the peer push
+    run on a background writer thread. ``wait_for_pending()`` is the
+    barrier clean exits and resizes must take before trusting ``latest``.
+
+    ``peer_url`` (a remote blob root, ``http://host:port/blobs/...``)
+    mirrors each completed step dir to another host's blob store — the
+    replica :func:`restore_from_best` pulls from when the owning host's
+    local dir is gone. Replication is best-effort: a dead peer degrades
+    durability, never training.
+    """
+
+    def __init__(
+        self,
+        ckpt_dir: str,
+        peer_url: str = "",
+        process_index: Optional[int] = None,
+        nprocs: Optional[int] = None,
+    ) -> None:
+        self.ckpt_dir = ckpt_dir
+        self.peer_url = peer_url.rstrip("/") if peer_url else ""
+        self._pid = process_index
+        self._nprocs = nprocs
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        #: step of the most recently ENQUEUED save (callers use it to skip
+        #: a redundant final save; wait_for_pending makes it durable)
+        self.last_saved_step: Optional[int] = None
+        #: cumulative seconds save() blocked the caller — the number the
+        #: checkpoint_overhead bench compares against sync saves
+        self.stall_seconds = 0.0
+        self.saves = 0
+        self.peer_pushes = 0
+
+    def save(self, state, step: int) -> None:
+        t0 = time.perf_counter()
+        try:
+            self.wait_for_pending()  # backpressure + surface prior errors
+            pid = jax.process_index() if self._pid is None else self._pid
+            nprocs = (
+                jax.process_count() if self._nprocs is None else self._nprocs
+            )
+            shards, manifest = snapshot_state(state, pid)
+            self._thread = threading.Thread(
+                target=self._write,
+                args=(shards, manifest, step, pid, nprocs),
+                daemon=True,
+                name="kubedl-ckpt-writer",
+            )
+            self._thread.start()
+            self.last_saved_step = step
+            self.saves += 1
+        finally:
+            self.stall_seconds += time.perf_counter() - t0
+
+    def _write(self, shards, manifest, step, pid, nprocs) -> None:
+        try:
+            write_snapshot(self.ckpt_dir, shards, manifest, step, pid, nprocs)
+        except BaseException as e:  # noqa: BLE001 — re-raised at the barrier
+            self._error = e
+            return
+        if self.peer_url:
+            self._push_to_peer(step, pid)
+
+    def _push_to_peer(self, step: int, pid: int) -> None:
+        from kubedl_tpu.remote import client as remote
+
+        d = Path(self.ckpt_dir) / f"step-{step:08d}"
+        try:
+            remote.upload_tree(str(d), f"{self.peer_url}/{d.name}")
+            if pid == 0:
+                # marker last, mirroring the local write order: a reader
+                # following the replica's `latest` always finds a step dir
+                # whose files are fully uploaded
+                base, prefix = remote._split(self.peer_url)
+                key = f"{prefix}/latest" if prefix else "latest"
+                remote.put_blob(base, key, d.name.encode())
+            self.peer_pushes += 1
+        except Exception as e:  # best-effort: degraded durability only
+            logging.getLogger(__name__).warning(
+                "peer replication of step %d to %s failed: %s",
+                step, self.peer_url, e,
+            )
+
+    def wait_for_pending(self) -> None:
+        """Join the in-flight write; re-raise its failure (a save the
+        caller believes happened must not silently not-exist)."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.wait_for_pending()
+
+
+def restore_from_best(
+    ckpt_dir: str,
+    like,
+    sources: Sequence[str] = (),
+    step: Optional[int] = None,
+):
+    """Restore with the replica preference order: local dir first, then
+    each remote source (peer replica, blob store) mirrored INTO the local
+    dir and retried. Returns None only when every source is exhausted."""
+    state = restore_checkpoint(ckpt_dir, like, step=step)
+    if state is not None:
+        return state
+    log = logging.getLogger(__name__)
+    for src in sources:
+        if not src:
+            continue
+        from kubedl_tpu.remote.client import download_tree
+
+        try:
+            n = download_tree(src, ckpt_dir)
+        except Exception as e:
+            log.warning("checkpoint source %s unreachable: %s", src, e)
+            continue
+        if n <= 0:
+            continue
+        state = restore_checkpoint(ckpt_dir, like, step=step)
+        if state is not None:
+            log.warning(
+                "restored from replica %s (%d files) — local checkpoint "
+                "dir was missing or torn", src, n,
+            )
+            return state
+    return None

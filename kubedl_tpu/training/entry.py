@@ -72,7 +72,15 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
     if spawn_ts:
         phases["spawn_to_proc"] = max(t_start - spawn_ts, 0.0)
     if env:
-        os.environ.update({k: v for k, v in env.items() if isinstance(v, str)})
+        # set each var only when its value actually changes: glibc
+        # setenv/putenv may realloc the process environ block, racing
+        # native getenv from XLA's persistent worker threads (one
+        # process hosts every gang attempt).  A replacement pod
+        # re-enters with an identical env, so the steady-state restart
+        # path must not touch environ at all.
+        for k, v in env.items():
+            if isinstance(v, str) and os.environ.get(k) != v:
+                os.environ[k] = v
     # import jax only after env is set (JAX_PLATFORMS etc.)
     from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested
 
@@ -112,7 +120,7 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
                                       name="kubedl-devinit")
         dev_thread.start()
     t0 = time.time()
-    from kubedl_tpu.training.checkpoint import restore_checkpoint
+    from kubedl_tpu.training.checkpoint import restore_from_best
     from kubedl_tpu.training.data import SyntheticTokens
     from kubedl_tpu.training.trainer import TrainConfig, Trainer
 
@@ -142,6 +150,7 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
         context_parallel_impl=opts.get("context_parallel_impl", "ring"),
         microbatches=int(opts.get("microbatches", 0)),
         ckpt_every=int(opts.get("ckpt_every", 0)),
+        ckpt_async=bool(opts.get("ckpt_async", True)),
         opt_moment_dtype=opts.get("opt_moment_dtype", "float32"),
     )
     # elastic resize (docs/elasticity.md): when the gang restarted at a
@@ -195,8 +204,14 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
     # and is reused as-is on a cold start — init runs exactly once.
     t0 = time.time()
     state = trainer.init_state()
+    # peer-replicated restore (docs/robustness.md "Async checkpointing"):
+    # when the owning host's local shard dir is gone (node replacement),
+    # pull the mirrored shards from the peer blob root before giving up
+    ckpt_peer = os.environ.get(constants.ENV_CKPT_PEER, "")
     if ckpt_dir:
-        restored = restore_checkpoint(ckpt_dir, state)
+        restored = restore_from_best(
+            ckpt_dir, state, sources=[s for s in (ckpt_peer,) if s]
+        )
         if restored is not None:
             state = restored
             step = int(jax.device_get(state["step"]))
@@ -225,9 +240,28 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
     fault_step = int(os.environ.get("KUBEDL_FAULT_ONCE_AT_STEP", "-1"))
     fault_marker = os.environ.get("KUBEDL_FAULT_MARKER", "")
 
+    # progress beacon (kubedl_tpu/watchdog/): a side thread stamps
+    # {step, tokens, ts} to the operator-injected file so the watchdog can
+    # tell a wedged step loop (ts fresh, step frozen) from a dead process
+    # (everything frozen). Training never depends on the beacon.
+    beacon = None
+    beacon_file = os.environ.get(constants.ENV_BEACON_FILE, "")
+    if beacon_file:
+        from kubedl_tpu.watchdog.beacon import ProgressBeacon
+
+        try:
+            beat = float(os.environ.get(constants.ENV_BEACON_INTERVAL, "0.5"))
+        except ValueError:
+            beat = 0.5
+        beacon = ProgressBeacon(beacon_file, interval=beat).start()
+    tokens_per_step = float(cfg.global_batch * cfg.seq_len)
+    from kubedl_tpu import chaos
+
     def on_step(i, metrics):
         if "t" not in first_step_wall:
             first_step_wall["t"] = time.time()
+        if beacon is not None:
+            beacon.step(i + 1, tokens=(i + 1) * tokens_per_step)
         if cancel is not None and getattr(cancel, "is_set", lambda: False)():
             raise SystemExit(137)  # retryable: gang restart requested
         if (
@@ -239,6 +273,18 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
             with open(fault_marker, "w") as f:
                 f.write("fired")
             raise SystemExit(137)
+        if chaos.should_fail("trainer.step_stall"):
+            # injected hang: wedge the STEP LOOP without exiting — the
+            # beacon thread keeps stamping fresh ts, so the watchdog sees
+            # the hang signature (not silent death). Only the kubelet's
+            # cancel/kill gets us out. A latency-mode spec returns after
+            # should_fail's own bounded sleep instead of entering this.
+            while True:
+                if cancel is not None and getattr(
+                    cancel, "is_set", lambda: False
+                )():
+                    raise SystemExit(137)
+                time.sleep(0.02)
 
     # a warm restart never waits long for the background AOT compile: the
     # plain jit deserializes the on-disk entry in seconds, so a stalled
@@ -271,14 +317,19 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
             warm_join_timeout = 30.0  # never let a bad env kill the job
         if warm_join_timeout < 0:
             warm_join_timeout = None
-    state, summary = trainer.fit(
-        iter(data),
-        state=state,
-        on_step=on_step,
-        ckpt_dir=ckpt_dir or None,
-        ckpt_every=cfg.ckpt_every,
-        warm_join_timeout=warm_join_timeout,
-    )
+    try:
+        state, summary = trainer.fit(
+            iter(data),
+            state=state,
+            on_step=on_step,
+            ckpt_dir=ckpt_dir or None,
+            ckpt_every=cfg.ckpt_every,
+            ckpt_peer=ckpt_peer,
+            warm_join_timeout=warm_join_timeout,
+        )
+    finally:
+        if beacon is not None:
+            beacon.stop()  # flush the final step count
     summary["first_step_wall_time"] = first_step_wall.get("t", time.time())
     total = summary["first_step_wall_time"] - (spawn_ts or t_start)
     # phases must SUM to total_to_first_step (round-4 VERDICT: a 57s warm

@@ -133,6 +133,11 @@ class TrainConfig:
     microbatches: int = 0
     #: save a checkpoint every N steps (0 = only via explicit fit args)
     ckpt_every: int = 0
+    #: interval saves go through AsyncCheckpointer (device->host snapshot
+    #: at the step boundary, npz/manifest IO on a writer thread) — the
+    #: step loop pays only the snapshot, not the disk. False = legacy
+    #: synchronous save_checkpoint on the step loop.
+    ckpt_async: bool = True
     #: dtype of the adam FIRST moment (mu). "bfloat16" halves mu's HBM —
     #: mu is a running mean of grads and tolerates bf16; nu (the second
     #: moment) stays fp32 because rsqrt amplifies its quantization.
@@ -602,6 +607,7 @@ class Trainer:
         on_step: Optional[Callable[[int, Dict[str, Any]], None]] = None,
         ckpt_dir: Optional[str] = None,
         ckpt_every: Optional[int] = None,
+        ckpt_peer: str = "",
         warm_join_timeout: Optional[float] = None,
     ) -> Tuple[Dict[str, Any], Dict[str, float]]:
         """Run the loop; returns (state, summary) with the north-star
@@ -611,11 +617,22 @@ class Trainer:
         ``steps`` is the TOTAL step budget: a restored ``state`` whose step
         counter is already k trains only steps-k more (resume semantics).
         Passing ``ckpt_dir`` saves every ``ckpt_every`` steps (defaults to
-        cfg.ckpt_every) plus once at the end.
+        cfg.ckpt_every) plus once at the end — asynchronously when
+        ``cfg.ckpt_async`` (the loop pays only the device->host snapshot;
+        the final pending write is joined before fit returns). ``ckpt_peer``
+        optionally mirrors completed saves to a peer blob root.
         """
+        from kubedl_tpu.training.checkpoint import (
+            AsyncCheckpointer, save_checkpoint,
+        )
+
         steps = steps or self.cfg.steps
         state = state or self.init_state()
         ckpt_every = self.cfg.ckpt_every if ckpt_every is None else ckpt_every
+        checkpointer: Optional[AsyncCheckpointer] = None
+        if ckpt_dir and self.cfg.ckpt_async:
+            checkpointer = AsyncCheckpointer(ckpt_dir, peer_url=ckpt_peer)
+        last_saved_step: Optional[int] = None
         # join the warm AOT compile FIRST (timed separately, bounded by
         # warm_join_timeout): the compile wait overlaps init's async device
         # work, and a stalled compile thread attributes to its own phase
@@ -635,54 +652,74 @@ class Trainer:
         first_loss = None
         t_run = t0
         ckpt_overhead = 0.0
-        with self.mesh:
-            for i in range(start, steps):
-                batch = self.shard_batch(next(data))
-                if i == start and step_fn is not self.train_step:
-                    try:
+        try:
+            with self.mesh:
+                for i in range(start, steps):
+                    batch = self.shard_batch(next(data))
+                    if i == start and step_fn is not self.train_step:
+                        try:
+                            state, metrics = step_fn(state, batch)
+                        except (TypeError, ValueError):
+                            # AOT executable rejected the args (sharding/layout
+                            # drift — argument validation raises TypeError/
+                            # ValueError BEFORE any execution, so donation has
+                            # not consumed the buffers): fall back to the jit,
+                            # which recompiles or hits the persistent cache
+                            # entry the AOT compile wrote. Runtime failures
+                            # (XlaRuntimeError etc.) propagate — retrying them
+                            # with donated/deleted buffers would mask the
+                            # real error.
+                            step_fn = self.train_step
+                            self._warm_compiled = None  # don't re-pick it
+                            self._aot_used = False
+                            state, metrics = step_fn(state, batch)
+                    else:
                         state, metrics = step_fn(state, batch)
-                    except (TypeError, ValueError):
-                        # AOT executable rejected the args (sharding/layout
-                        # drift — argument validation raises TypeError/
-                        # ValueError BEFORE any execution, so donation has
-                        # not consumed the buffers): fall back to the jit,
-                        # which recompiles or hits the persistent cache
-                        # entry the AOT compile wrote. Runtime failures
-                        # (XlaRuntimeError etc.) propagate — retrying them
-                        # with donated/deleted buffers would mask the
-                        # real error.
-                        step_fn = self.train_step
-                        self._warm_compiled = None  # don't re-pick it
-                        self._aot_used = False
-                        state, metrics = step_fn(state, batch)
-                else:
-                    state, metrics = step_fn(state, batch)
-                losses.append(metrics["loss"])
-                if i == start:
-                    # true barrier: scalar fetch (block_until_ready lies on
-                    # the tunnel platform — see module docstring)
-                    first_loss = _fetch_scalar(metrics["loss"])
-                    first_step_s = time.perf_counter() - t0
-                    t_run = time.perf_counter()
-                if on_step is not None:
-                    on_step(i, metrics)
-                if (
-                    ckpt_dir
-                    and ckpt_every
-                    and (i + 1) % ckpt_every == 0
-                    and (i + 1) < steps
-                ):
-                    t_ck = time.perf_counter()
-                    from kubedl_tpu.training.checkpoint import save_checkpoint
-
-                    save_checkpoint(ckpt_dir, state, i + 1)
-                    ckpt_overhead += time.perf_counter() - t_ck
-            # stop the clock on a true barrier: the last loss transitively
-            # depends on every dispatched step via the donated state chain
-            if losses:
-                last_loss = _fetch_scalar(losses[-1])
-            else:  # resume found nothing left to do
-                last_loss = first_loss = float("nan")
+                    losses.append(metrics["loss"])
+                    if i == start:
+                        # true barrier: scalar fetch (block_until_ready lies on
+                        # the tunnel platform — see module docstring)
+                        first_loss = _fetch_scalar(metrics["loss"])
+                        first_step_s = time.perf_counter() - t0
+                        t_run = time.perf_counter()
+                    if on_step is not None:
+                        on_step(i, metrics)
+                    if (
+                        ckpt_dir
+                        and ckpt_every
+                        and (i + 1) % ckpt_every == 0
+                    ):
+                        t_ck = time.perf_counter()
+                        if checkpointer is not None:
+                            checkpointer.save(state, i + 1)
+                        else:
+                            save_checkpoint(ckpt_dir, state, i + 1)
+                        last_saved_step = i + 1
+                        ckpt_overhead += time.perf_counter() - t_ck
+                # stop the clock on a true barrier: the last loss transitively
+                # depends on every dispatched step via the donated state chain
+                if losses:
+                    last_loss = _fetch_scalar(losses[-1])
+                else:  # resume found nothing left to do
+                    last_loss = first_loss = float("nan")
+        except BaseException:
+            # killed mid-loop (SystemExit 137 from cancel/preemption/
+            # watchdog): quiesce BEFORE unwinding. Draining the
+            # dispatched-step chain means no donated-buffer execution
+            # is in flight while this frame's references die and a
+            # same-name replacement spins up; joining the writer makes
+            # the in-flight async save durable — the restart resumes
+            # from it. Secondary failures must not mask the kill.
+            try:
+                jax.block_until_ready(state)
+            except Exception:
+                pass
+            if checkpointer is not None:
+                try:
+                    checkpointer.wait_for_pending()
+                except Exception:
+                    pass
+            raise
         total = time.perf_counter() - t_run - ckpt_overhead
         n_chips = jax.device_count()
         steady_steps = len(losses) - 1
@@ -718,9 +755,22 @@ class Trainer:
             # restored state that had nothing left to train must not write a
             # mislabeled dir that misorders restore-from-newest (and when no
             # steps ran there is nothing new to save at all)
-            from kubedl_tpu.training.checkpoint import save_checkpoint
-
-            save_checkpoint(ckpt_dir, state, int(jax.device_get(state["step"])))
+            final_step = int(jax.device_get(state["step"]))
+            if last_saved_step != final_step:
+                # skipped when the last interval save already wrote this
+                # exact step — re-serializing an identical state bought
+                # nothing and doubled exit latency
+                if checkpointer is not None:
+                    checkpointer.save(state, final_step)
+                else:
+                    save_checkpoint(ckpt_dir, state, final_step)
+        if checkpointer is not None:
+            # the clean-exit barrier: fit's caller may publish/delete/exit
+            # the moment we return, so the in-flight write must be durable
+            checkpointer.wait_for_pending()
+            summary["ckpt_stall_s"] = checkpointer.stall_seconds
+            summary["ckpt_saves"] = checkpointer.saves
+        summary["ckpt_async"] = checkpointer is not None
         return state, summary
 
     def _mfu(self, tokens_per_sec: float, n_chips: int) -> float:

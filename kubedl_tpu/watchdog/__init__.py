@@ -1,0 +1,33 @@
+"""Progress watchdog: hang / straggler / silent-death detection.
+
+Restart policies only fire on pod EXIT; a wedged worker — an XLA
+deadlock, a stalled ICI collective, a hung host thread — keeps its
+RUNNING phase forever while the gang burns chips producing nothing.
+This package closes that hole:
+
+- workers stamp a per-step progress beacon (:class:`ProgressBeacon`)
+  that rides the kubelet heartbeat path (core/nodes.py — the same
+  channel preemption notices use);
+- :class:`WatchdogController` tracks per-replica progress and drives
+  the existing ``ON_FAILURE_SLICE`` gang-restart machinery with a
+  ``HangDetected`` condition when progress stops without an exit.
+
+``docs/robustness.md`` ("Hang detection") documents the contract.
+"""
+
+from kubedl_tpu.watchdog.beacon import (
+    FileBeaconSource,
+    ProgressBeacon,
+    beacon_path,
+    read_beacon,
+)
+from kubedl_tpu.watchdog.controller import WatchdogConfig, WatchdogController
+
+__all__ = [
+    "FileBeaconSource",
+    "ProgressBeacon",
+    "WatchdogConfig",
+    "WatchdogController",
+    "beacon_path",
+    "read_beacon",
+]

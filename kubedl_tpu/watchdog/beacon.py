@@ -1,0 +1,141 @@
+"""Worker-side progress beacons + the kubelet-side file source.
+
+The beacon is a tiny JSON file the worker rewrites atomically on a side
+thread: ``{"step": N, "tokens": T, "ts": wall_time}``. Running it on a
+dedicated thread is what makes the watchdog's three failure classes
+distinguishable — a wedged STEP LOOP (hang) keeps stamping fresh ``ts``
+with a frozen ``step``, while a dead host process stops stamping
+entirely (silent death, beacons stop but the pod object stays RUNNING).
+
+The kubelet's :class:`~kubedl_tpu.core.nodes.NodeHeartbeater` publishes
+beacons onto Node objects each beat via :class:`FileBeaconSource`
+(subprocess pods write files; in-process/test workers may instead call
+``NodeHeartbeater.announce_progress`` directly — same channel).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, Optional
+
+
+def beacon_path(root: str, namespace: str, pod_name: str) -> str:
+    """Deterministic per-pod beacon file path, computable at spec-build
+    time (engine injects it as env) and at beat time (source reads it)."""
+    return os.path.join(root, namespace, pod_name + ".json")
+
+
+def read_beacon(path: str) -> Optional[Dict[str, float]]:
+    try:
+        with open(path, "r") as f:
+            raw = json.load(f)
+    except (OSError, ValueError):
+        return None  # absent, mid-replace, or torn — next beat retries
+    if not isinstance(raw, dict) or "step" not in raw:
+        return None
+    return {
+        "step": float(raw.get("step", 0.0)),
+        "tokens": float(raw.get("tokens", 0.0)),
+        "ts": float(raw.get("ts", 0.0)),
+    }
+
+
+class ProgressBeacon:
+    """Stamps the worker's progress to ``path`` every ``interval``.
+
+    ``step(n, tokens)`` is called from the training loop's per-step hook;
+    the writer thread persists the latest values independently, so a
+    wedged step loop still produces fresh ``ts`` stamps (the hang
+    signature the watchdog keys on).
+    """
+
+    def __init__(self, path: str, interval: float = 0.5,
+                 clock=time.time) -> None:
+        self.path = path
+        self.interval = max(float(interval), 0.05)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._step = 0.0
+        self._tokens = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.writes = 0
+
+    def step(self, step: int, tokens: float = 0.0) -> None:
+        with self._lock:
+            self._step = float(step)
+            self._tokens = float(tokens)
+
+    def write_once(self) -> None:
+        with self._lock:
+            payload = {"step": self._step, "tokens": self._tokens,
+                       "ts": self.clock()}
+        d = os.path.dirname(self.path)
+        try:
+            if d:
+                os.makedirs(d, exist_ok=True)
+            # atomic replace: a reader never sees a torn beacon
+            fd, tmp = tempfile.mkstemp(dir=d or ".", suffix=".beacon.tmp")
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.path)
+            self.writes += 1
+        except OSError:
+            pass  # beacon loss degrades to silent-death detection, never crashes training
+
+    def start(self) -> "ProgressBeacon":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self.write_once()  # announce liveness before the first step
+
+        def loop() -> None:
+            while not self._stop.wait(self.interval):
+                self.write_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="kubedl-beacon")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        self.write_once()  # flush the final step count
+
+    def __enter__(self) -> "ProgressBeacon":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class FileBeaconSource:
+    """``callable(node_name) -> {"ns/pod": beacon}`` for the heartbeater:
+    reads the beacon file of every non-terminal pod bound to the node.
+    Returning a full mapping each beat means pods that left the node drop
+    off the Node object automatically."""
+
+    def __init__(self, root: str, store) -> None:
+        self.root = root
+        self.store = store
+
+    def __call__(self, node_name: str) -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {}
+        if not self.root:
+            return out
+        for pod in self.store.list("Pod", namespace=None):
+            if pod.spec.node_name != node_name or pod.is_terminal():
+                continue
+            b = read_beacon(beacon_path(
+                self.root, pod.metadata.namespace, pod.metadata.name
+            ))
+            if b is not None:
+                out[f"{pod.metadata.namespace}/{pod.metadata.name}"] = b
+        return out

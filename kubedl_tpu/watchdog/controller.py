@@ -1,0 +1,320 @@
+"""WatchdogController: classify stalled replicas and drive gang restarts.
+
+Beacons arrive on Node objects (stamped by the kubelet heartbeat,
+core/nodes.py); this controller watches Nodes, tracks per-replica
+progress, and classifies three failure modes:
+
+- **hang** — beacons stay fresh but the step counter stops advancing
+  past a model-aware budget: ``multiplier × EWMA(observed step time)``
+  (floored at ``min_budget_seconds``; before the first observed step
+  advance, ``startup_grace_seconds`` covers compilation).
+- **silent death** — beacons stop changing entirely while the pod object
+  stays RUNNING (host process died without the kubelet noticing, or the
+  whole beacon thread went with it).
+- **straggler** — the replica's step rate falls far below the gang
+  median. Observational only: a synchronous gang already runs at the
+  straggler's pace, so a restart would only lose progress; the event +
+  metric make the slow host visible to operators.
+
+Hang and silent death fail the pod RETRYABLY (exit 137, the same class
+node eviction uses) and stamp a ``HangDetected`` condition on the owning
+job, so the next engine reconcile takes the normal ``ON_FAILURE_SLICE``
+gang-restart path — watchdog restarts count against the same
+``backoff_limit`` budget as crash restarts.
+
+Staleness is judged by when THIS controller OBSERVED a value change
+(k8s lease-observation semantics, same as NodeLifecycleController) —
+never by comparing the worker's wall clock against ours.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from kubedl_tpu.api import constants
+from kubedl_tpu.core.manager import ControllerManager, EventRecorder
+from kubedl_tpu.core.objects import ContainerStatus, Node, Pod, PodPhase
+from kubedl_tpu.core.store import Conflict, NotFound, ObjectStore
+
+log = logging.getLogger("kubedl_tpu.watchdog")
+
+#: retryable (SIGKILL-class) exit stamped on wedged pods — the same code
+#: node eviction uses, so every restart policy treats a hang like
+#: preemption, not a code bug
+HANG_EXIT_CODE = 137
+
+
+@dataclass
+class WatchdogConfig:
+    #: hang budget = max(min_budget, multiplier × observed step-time EWMA)
+    multiplier: float = 4.0
+    #: floor under every budget; must exceed the beacon/heartbeat cadence
+    #: or healthy replicas flap
+    min_budget_seconds: float = 30.0
+    #: budget before the FIRST observed step advance (covers compilation
+    #: and restore — step time is unknowable until one step lands)
+    startup_grace_seconds: float = 300.0
+    #: straggler: step rate below this fraction of the gang median
+    #: (gangs of >= ``straggler_min_gang`` tracked replicas only)
+    straggler_ratio: float = 0.25
+    straggler_min_gang: int = 2
+    #: re-evaluation cadence while replicas are tracked (silent death
+    #: produces NO watch events — the timer is the only wake-up)
+    check_interval_seconds: float = 0.0  # 0 = max(min_budget/4, 0.25)
+
+    def interval(self) -> float:
+        if self.check_interval_seconds > 0:
+            return self.check_interval_seconds
+        return max(self.min_budget_seconds / 4.0, 0.25)
+
+
+@dataclass
+class _Track:
+    """Observation state for one beaconing replica."""
+
+    uid: str
+    node: str
+    step: float
+    ts: float
+    tokens: float = 0.0
+    #: OUR clock when the step / ts value last changed (first obs = now)
+    step_seen: float = 0.0
+    ts_seen: float = 0.0
+    #: EWMA of seconds between observed step advances; 0 = none seen yet
+    step_ewma: float = 0.0
+    beacon_ewma: float = 0.0
+    #: steps/sec over observed advances (straggler math)
+    rate: float = 0.0
+    step_changes: int = 0
+    straggler: bool = False
+
+
+def _blend(ewma: float, sample: float, alpha: float = 0.3) -> float:
+    return sample if ewma <= 0 else (1 - alpha) * ewma + alpha * sample
+
+
+class WatchdogController:
+    NAME = "progress-watchdog"
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        recorder: Optional[EventRecorder] = None,
+        metrics=None,
+        config: Optional[WatchdogConfig] = None,
+        clock=time.time,
+    ) -> None:
+        self.store = store
+        self.recorder = recorder or EventRecorder(store)
+        self.metrics = metrics  # JobMetrics or None
+        self.cfg = config or WatchdogConfig()
+        self.clock = clock
+        self._tracks: Dict[str, _Track] = {}  # "ns/pod" -> _Track
+        #: per-reason fire counts, for tests/drives without a registry
+        self.fired: Dict[str, int] = {"hang": 0, "silent_death": 0}
+
+    # ------------------------------------------------------------ wiring
+
+    def setup(self, manager: ControllerManager) -> None:
+        manager.register(
+            self.NAME,
+            self.reconcile,
+            watch_kinds=["Node"],
+            mapper=lambda e, obj, old: [
+                (obj.metadata.namespace, obj.metadata.name)
+            ],
+        )
+
+    def tracked(self) -> int:
+        return len(self._tracks)
+
+    # --------------------------------------------------------- reconcile
+
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        node = self.store.try_get("Node", name, namespace)
+        if isinstance(node, Node):
+            self._ingest(node)
+        self._evaluate()
+        return self.cfg.interval() if self._tracks else None
+
+    def _ingest(self, node: Node) -> None:
+        """Fold one Node's beacons into per-replica observation state."""
+        now = self.clock()
+        for pod_key, beacon in (node.beacons or {}).items():
+            ns, _, pname = pod_key.partition("/")
+            pod = self.store.try_get("Pod", pname, ns)
+            if not isinstance(pod, Pod) or pod.is_terminal():
+                self._drop(pod_key)
+                continue
+            tr = self._tracks.get(pod_key)
+            if tr is not None and tr.uid != pod.metadata.uid:
+                tr = None  # same-name replacement pod: fresh grace window
+            if tr is None:
+                # opt-in by construction: a replica is tracked only once
+                # it has beaconed; first observation starts every clock
+                self._tracks[pod_key] = _Track(
+                    uid=pod.metadata.uid, node=node.metadata.name,
+                    step=beacon.get("step", 0.0), ts=beacon.get("ts", 0.0),
+                    tokens=beacon.get("tokens", 0.0),
+                    step_seen=now, ts_seen=now,
+                )
+                continue
+            tr.node = node.metadata.name
+            ts = beacon.get("ts", 0.0)
+            if ts != tr.ts:
+                tr.beacon_ewma = _blend(tr.beacon_ewma, now - tr.ts_seen)
+                tr.ts, tr.ts_seen = ts, now
+            step = beacon.get("step", 0.0)
+            if step != tr.step:
+                dt = max(now - tr.step_seen, 1e-6)
+                tr.step_ewma = _blend(tr.step_ewma, dt)
+                # any VALUE change counts as progress — a restarted
+                # worker's counter legitimately jumps backward to its
+                # restored checkpoint step
+                advanced = max(step - tr.step, 1.0)
+                tr.rate = _blend(tr.rate, advanced / dt)
+                tr.step, tr.step_seen = step, now
+                tr.step_changes += 1
+            tr.tokens = beacon.get("tokens", tr.tokens)
+
+    def _drop(self, pod_key: str) -> None:
+        self._tracks.pop(pod_key, None)
+
+    # -------------------------------------------------------- evaluation
+
+    def _budgets(self, tr: _Track) -> Tuple[float, float]:
+        """(hang_budget, silent_budget) for one replica."""
+        cfg = self.cfg
+        if tr.step_changes == 0:
+            hang = max(cfg.startup_grace_seconds, cfg.min_budget_seconds)
+        else:
+            hang = max(cfg.min_budget_seconds, cfg.multiplier * tr.step_ewma)
+        silent = max(cfg.min_budget_seconds, cfg.multiplier * tr.beacon_ewma)
+        return hang, silent
+
+    def _evaluate(self) -> None:
+        now = self.clock()
+        for pod_key, tr in list(self._tracks.items()):
+            ns, _, pname = pod_key.partition("/")
+            pod = self.store.try_get("Pod", pname, ns)
+            if (
+                not isinstance(pod, Pod)
+                or pod.is_terminal()
+                or pod.metadata.uid != tr.uid
+            ):
+                self._drop(pod_key)
+                continue
+            if pod.status.phase != PodPhase.RUNNING:
+                continue  # Pending replicas haven't started their clock
+            hang_budget, silent_budget = self._budgets(tr)
+            silent_age = now - tr.ts_seen
+            step_age = now - tr.step_seen
+            if silent_age > silent_budget:
+                self._fire(pod, tr, "silent_death",
+                           f"beacons stopped {silent_age:.1f}s ago "
+                           f"(budget {silent_budget:.1f}s) while pod "
+                           "stayed Running")
+                self._drop(pod_key)
+            elif step_age > hang_budget:
+                self._fire(pod, tr, "hang",
+                           f"no step advance past step {tr.step:.0f} for "
+                           f"{step_age:.1f}s (budget {hang_budget:.1f}s = "
+                           f"{self.cfg.multiplier:g} x {tr.step_ewma:.2f}s "
+                           "EWMA step time; beacons still fresh)")
+                self._drop(pod_key)
+        self._flag_stragglers()
+
+    def _flag_stragglers(self) -> None:
+        by_job: Dict[Tuple[str, str], list] = {}
+        for pod_key, tr in self._tracks.items():
+            ns, _, pname = pod_key.partition("/")
+            pod = self.store.try_get("Pod", pname, ns)
+            if not isinstance(pod, Pod):
+                continue
+            jname = pod.metadata.labels.get(constants.LABEL_JOB_NAME, "")
+            if jname and tr.rate > 0:
+                by_job.setdefault((ns, jname), []).append((pod, tr))
+        for (ns, jname), members in by_job.items():
+            if len(members) < self.cfg.straggler_min_gang:
+                continue
+            rates = sorted(tr.rate for _, tr in members)
+            median = rates[len(rates) // 2]
+            if median <= 0:
+                continue
+            for pod, tr in members:
+                slow = tr.rate < self.cfg.straggler_ratio * median
+                if slow and not tr.straggler:
+                    tr.straggler = True
+                    if self.metrics is not None:
+                        self.metrics.watchdog_stragglers.inc()
+                    self.recorder.event(
+                        pod, "Warning", "Straggler",
+                        f"step rate {tr.rate:.2f}/s is below "
+                        f"{self.cfg.straggler_ratio:g}x the gang median "
+                        f"{median:.2f}/s — the whole gang runs at this "
+                        "pace (sync training)",
+                    )
+                elif not slow:
+                    tr.straggler = False
+
+    # ------------------------------------------------------------ firing
+
+    class _AlreadyTerminal(Exception):
+        pass
+
+    def _fire(self, pod: Pod, tr: _Track, reason: str, detail: str) -> None:
+        """Fail the wedged pod retryably and stamp HangDetected on its
+        job — from here the normal slice-granular restart machinery
+        (engine/job_controller.py ON_FAILURE_SLICE) takes over."""
+        cond_reason = "SilentDeath" if reason == "silent_death" else "HangWatchdogFired"
+
+        def mutate(obj: Pod) -> None:
+            if obj.is_terminal():
+                raise WatchdogController._AlreadyTerminal()
+            obj.status.phase = PodPhase.FAILED
+            obj.status.reason = "HangDetected"
+            obj.status.finish_time = self.clock()
+            obj.status.container_statuses = [
+                ContainerStatus(exit_code=HANG_EXIT_CODE)
+            ]
+
+        try:
+            self.store.update_with_retry(
+                "Pod", pod.metadata.name, pod.metadata.namespace, mutate
+            )
+        except (NotFound, Conflict, WatchdogController._AlreadyTerminal):
+            return
+        self.fired[reason] = self.fired.get(reason, 0) + 1
+        if self.metrics is not None:
+            self.metrics.watchdog_restarts.inc(reason=reason)
+        self.recorder.event(
+            pod, "Warning", "HangDetected",
+            f"{reason.replace('_', ' ')}: {detail}",
+        )
+        self._stamp_job(pod, cond_reason, detail)
+        log.warning("watchdog failed %s/%s (%s): %s",
+                    pod.metadata.namespace, pod.metadata.name, reason, detail)
+
+    def _stamp_job(self, pod: Pod, cond_reason: str, detail: str) -> None:
+        from kubedl_tpu.api.types import JobConditionType
+
+        kind = pod.metadata.labels.get(constants.LABEL_JOB_KIND, "")
+        jname = pod.metadata.labels.get(constants.LABEL_JOB_NAME, "")
+        if not kind or not jname:
+            return
+
+        def mutate(job) -> None:
+            job.status.set_condition(
+                JobConditionType.HANG_DETECTED, cond_reason,
+                f"{pod.metadata.name}: {detail}",
+            )
+
+        try:
+            self.store.update_with_retry(
+                kind, jname, pod.metadata.namespace, mutate
+            )
+        except (NotFound, Conflict):
+            pass

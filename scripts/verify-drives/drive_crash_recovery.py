@@ -156,7 +156,17 @@ def parent_main():
               str(op.kubelet.adopted_count))
         check("new incarnation launched ONLY job3's pods",
               op.kubelet.launch_count == 2, str(op.kubelet.launch_count))
-        lines = open(launch_log).read().split()
+        # a pod is RUNNING the moment its process spawns, but the
+        # fingerprint line lands only once the subprocess executes its
+        # first statement — poll for all 6 before judging uniqueness
+        # (the invariant under test is ZERO DUPLICATES, not exec speed)
+        deadline = time.perf_counter() + 15.0
+        lines = []
+        while time.perf_counter() < deadline:
+            lines = open(launch_log).read().split()
+            if len(lines) >= 6:
+                break
+            time.sleep(0.1)
         check("zero duplicate launches across both incarnations",
               len(lines) == 6 and len(set(lines)) == 6, str(sorted(lines)))
         gangs = {g.metadata.name: sorted(g.assigned_slices)

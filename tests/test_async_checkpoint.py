@@ -1,0 +1,286 @@
+"""Asynchronous replicated checkpointing (training/checkpoint.py).
+
+Invariants asserted here:
+- the snapshot/write split produces byte-identical checkpoints to the
+  legacy synchronous save (same format, same restore);
+- AsyncCheckpointer holds at most ONE write in flight (backpressure) and
+  wait_for_pending() is a real durability barrier that also re-raises
+  background-write failures — a save the caller believes happened must
+  not silently not-exist;
+- completed saves mirror to a peer blob root with the `latest` marker
+  uploaded LAST, and restore_from_best pulls from the peer when the
+  local shard dir is gone (ISSUE 6 acceptance) — preferring local when
+  it exists;
+- trainer.fit wires it all up: one save per interval boundary, the
+  redundant final save skipped when the last interval already wrote that
+  exact step, the pending write joined before fit returns.
+"""
+
+import shutil
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from kubedl_tpu import chaos
+from kubedl_tpu.api.topology import MeshSpec
+from kubedl_tpu.chaos import FaultInjected, FaultPlan, FaultSpec
+from kubedl_tpu.models import llama
+from kubedl_tpu.parallel.mesh import build_mesh
+from kubedl_tpu.training import checkpoint as ck
+from kubedl_tpu.training.checkpoint import (
+    AsyncCheckpointer,
+    latest_step,
+    restore_checkpoint,
+    restore_from_best,
+    save_checkpoint,
+    snapshot_state,
+    write_snapshot,
+)
+from kubedl_tpu.training.data import SyntheticTokens
+from kubedl_tpu.training.trainer import TrainConfig, Trainer
+
+CFG = llama.TINY
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    chaos.disarm()
+    yield
+    chaos.disarm()
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """(trainer, state) after a short fit — module-scoped: the fit is the
+    expensive part and every test here only reads the state."""
+    mesh = build_mesh(MeshSpec({"data": 2}), jax.devices()[:2])
+    cfg = TrainConfig(model=CFG, global_batch=4, seq_len=16, steps=2)
+    trainer = Trainer(cfg, mesh)
+    data = SyntheticTokens(4, 16, CFG.vocab_size)
+    state, _ = trainer.fit(iter(data))
+    return trainer, state
+
+
+def _assert_same_params(restored, state):
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(restored["params"]["embed"])),
+        np.asarray(jax.device_get(state["params"]["embed"])),
+    )
+
+
+class TestSnapshotWriteSplit:
+    def test_split_save_restores_identically_to_sync(self, trained, tmp_path):
+        trainer, state = trained
+        sync_dir, split_dir = tmp_path / "sync", tmp_path / "split"
+        save_checkpoint(str(sync_dir), state, 2)
+        shards, manifest = snapshot_state(state)
+        write_snapshot(str(split_dir), shards, manifest, 2, 0, 1)
+        for d in (sync_dir, split_dir):
+            restored = restore_checkpoint(str(d), trainer.init_state())
+            assert int(jax.device_get(restored["step"])) == 2
+            _assert_same_params(restored, state)
+        # same files, same names — one on-disk format, not two
+        assert sorted(p.name for p in (sync_dir / "step-00000002").iterdir()) \
+            == sorted(p.name for p in (split_dir / "step-00000002").iterdir())
+
+    def test_restored_leaves_never_alias_host_buffers(
+        self, trained, tmp_path, monkeypatch
+    ):
+        """Restore must hand back XLA-OWNED buffers: when an assembled
+        host array happens to be sufficiently aligned,
+        make_array_from_callback zero-copies on CPU and the restored
+        jax.Array aliases numpy-owned memory. The first train step then
+        DONATES that leaf, and XLA recycles a buffer numpy also manages
+        — heap corruption, or silently scrambled weights, on a per-leaf
+        coin flip. Record every host pointer the shard store hands out
+        and assert no device shard ended up on one of them."""
+        trainer, state = trained
+        save_checkpoint(str(tmp_path), state, 2)
+        host_ptrs = set()
+        real_region = ck._ShardStore.region
+
+        def spy_region(self, key, shape, dtype, index):
+            out = real_region(self, key, shape, dtype, index)
+            base = out
+            while base.base is not None:
+                base = base.base
+            host_ptrs.add(base.__array_interface__["data"][0])
+            host_ptrs.add(out.__array_interface__["data"][0])
+            return out
+
+        monkeypatch.setattr(ck._ShardStore, "region", spy_region)
+        restored = restore_checkpoint(str(tmp_path), trainer.init_state())
+        assert host_ptrs  # the spy actually saw the reads
+        for leaf in jax.tree_util.tree_leaves(restored):
+            if not isinstance(leaf, jax.Array):
+                continue
+            for s in leaf.addressable_shards:
+                assert s.data.unsafe_buffer_pointer() not in host_ptrs
+
+    def test_snapshot_is_immutable_host_copy(self, trained, tmp_path):
+        """The snapshot must be detached from devices: the train step
+        DONATES the state, so on CPU (where device_get is zero-copy) a
+        view-based snapshot would alias buffers the NEXT step overwrites
+        — the deferred write would persist the wrong step's values. Run
+        a real donating step between snapshot and write to prove the
+        captured values survive buffer recycling."""
+        trainer, _ = trained
+        state = trainer.init_state()  # private state: donation-safe here
+        shards, manifest = snapshot_state(state)
+        before = {k: v.copy() for k, v in shards.items()}
+        batch = trainer.shard_batch(
+            next(iter(SyntheticTokens(4, 16, CFG.vocab_size))))
+        state, _ = trainer.train_step(state, batch)  # recycles old buffers
+        jax.block_until_ready(state["step"])
+        write_snapshot(str(tmp_path), shards, manifest, 2, 0, 1)
+        import numpy as _np
+
+        with _np.load(str(tmp_path / "step-00000002" / "shards-p0.npz")) as z:
+            for k, v in before.items():
+                _np.testing.assert_array_equal(z[k], v)
+
+
+class TestAsyncCheckpointer:
+    def test_save_then_barrier_is_restorable(self, trained, tmp_path):
+        trainer, state = trained
+        with AsyncCheckpointer(str(tmp_path)) as acp:
+            acp.save(state, 2)
+            assert acp.last_saved_step == 2
+        # __exit__ == wait_for_pending: latest marker durable now
+        assert latest_step(str(tmp_path)) == 2
+        restored = restore_checkpoint(str(tmp_path), trainer.init_state())
+        _assert_same_params(restored, state)
+        assert acp.saves == 1
+
+    def test_at_most_one_write_in_flight(self, trained, tmp_path, monkeypatch):
+        """Backpressure: save() must JOIN the previous write before
+        enqueueing — snapshots are host RAM; a queue would OOM."""
+        _, state = trained
+        gauge = {"cur": 0, "max": 0}
+        lock = threading.Lock()
+        real = ck.write_snapshot
+
+        def slow_write(*a, **kw):
+            with lock:
+                gauge["cur"] += 1
+                gauge["max"] = max(gauge["max"], gauge["cur"])
+            time.sleep(0.05)
+            try:
+                return real(*a, **kw)
+            finally:
+                with lock:
+                    gauge["cur"] -= 1
+
+        monkeypatch.setattr(ck, "write_snapshot", slow_write)
+        acp = AsyncCheckpointer(str(tmp_path))
+        for step in (1, 2, 3):
+            acp.save(state, step)
+        acp.wait_for_pending()
+        assert gauge["max"] == 1
+        assert acp.saves == 3
+        # the blocking shows up as caller stall — the bench's metric
+        assert acp.stall_seconds >= 0.05
+
+    def test_background_failure_reraises_at_barrier(self, trained, tmp_path):
+        """A torn write on the writer thread (checkpoint.torn chaos site)
+        must surface at the next barrier, not vanish."""
+        _, state = trained
+        acp = AsyncCheckpointer(str(tmp_path))
+        with FaultPlan(3, sites={"checkpoint.torn": [FaultSpec.nth(1)]}):
+            acp.save(state, 2)
+            with pytest.raises(FaultInjected):
+                acp.wait_for_pending()
+        # the error is consumed: the checkpointer stays usable and the
+        # NEXT save lands durably (retry semantics, not poisoned-forever)
+        acp.save(state, 4)
+        acp.wait_for_pending()
+        assert latest_step(str(tmp_path)) == 4
+
+
+class TestPeerReplication:
+    def test_push_and_restore_from_peer_after_local_loss(self, trained, tmp_path):
+        """ISSUE 6 acceptance: delete the local shard dir, restore
+        succeeds from the peer replica."""
+        from kubedl_tpu.remote import RemoteStoreServer, list_blobs
+
+        trainer, state = trained
+        local = tmp_path / "ck"
+        with RemoteStoreServer(str(tmp_path / "peer-root")) as srv:
+            peer = f"{srv.base_url}/blobs/replicas/w0"
+            with AsyncCheckpointer(str(local), peer_url=peer) as acp:
+                acp.save(state, 2)
+            assert acp.peer_pushes == 1
+            blobs = list_blobs(srv.base_url, "replicas/w0")
+            assert any(b.endswith("latest") for b in blobs), blobs
+            assert any("step-00000002/shards-p0" in b for b in blobs), blobs
+            assert any("step-00000002/meta.json" in b for b in blobs), blobs
+            # local disk lost wholesale (node replacement)
+            shutil.rmtree(local)
+            restored = restore_from_best(
+                str(local), trainer.init_state(), sources=[peer]
+            )
+            assert restored is not None
+            assert int(jax.device_get(restored["step"])) == 2
+            _assert_same_params(restored, state)
+
+    def test_restore_prefers_local_when_present(self, trained, tmp_path):
+        """Preference order local -> peer: an intact local dir restores
+        without touching the (unreachable) peer at all."""
+        trainer, state = trained
+        local = tmp_path / "ck"
+        save_checkpoint(str(local), state, 2)
+        restored = restore_from_best(
+            str(local), trainer.init_state(),
+            sources=["http://127.0.0.1:1/blobs/nope"],  # would error if hit
+        )
+        assert restored is not None
+        assert int(jax.device_get(restored["step"])) == 2
+
+    def test_dead_peer_degrades_not_fails(self, trained, tmp_path):
+        """Replication is best-effort: an unreachable peer must not fail
+        the save (durability degrades; training never does)."""
+        _, state = trained
+        acp = AsyncCheckpointer(
+            str(tmp_path / "ck"), peer_url="http://127.0.0.1:1/blobs/nope"
+        )
+        acp.save(state, 2)
+        acp.wait_for_pending()  # must NOT raise
+        assert acp.peer_pushes == 0
+        assert latest_step(str(tmp_path / "ck")) == 2  # local landed
+
+
+class TestTrainerIntegration:
+    def test_fit_async_saves_each_interval_and_skips_final_dup(self, tmp_path):
+        """ckpt_every=2, steps=4: interval saves at 2 and 4; the final
+        save is SKIPPED because step 4 is already on disk (the duplicate
+        double-save the sync path used to pay)."""
+        mesh = build_mesh(MeshSpec({"data": 2}), jax.devices()[:2])
+        cfg = TrainConfig(model=CFG, global_batch=4, seq_len=16, steps=4,
+                          ckpt_every=2)
+        trainer = Trainer(cfg, mesh)
+        data = SyntheticTokens(4, 16, CFG.vocab_size)
+        state, summary = trainer.fit(iter(data), ckpt_dir=str(tmp_path))
+        assert summary["ckpt_async"] is True
+        assert summary["ckpt_saves"] == 2  # steps 2 and 4 — NOT 3
+        assert summary["ckpt_stall_s"] >= 0.0
+        steps_on_disk = sorted(
+            p.name for p in tmp_path.iterdir() if p.name.startswith("step-")
+        )
+        assert steps_on_disk == ["step-00000002", "step-00000004"]
+        # durable by the time fit returned (the wait_for_pending barrier)
+        restored = restore_checkpoint(str(tmp_path), trainer.init_state())
+        assert int(jax.device_get(restored["step"])) == 4
+
+    def test_fit_sync_fallback_still_writes(self, tmp_path):
+        mesh = build_mesh(MeshSpec({"data": 2}), jax.devices()[:2])
+        cfg = TrainConfig(model=CFG, global_batch=4, seq_len=16, steps=3,
+                          ckpt_every=2, ckpt_async=False)
+        trainer = Trainer(cfg, mesh)
+        data = SyntheticTokens(4, 16, CFG.vocab_size)
+        _, summary = trainer.fit(iter(data), ckpt_dir=str(tmp_path))
+        assert summary["ckpt_async"] is False
+        assert "ckpt_saves" not in summary
+        assert latest_step(str(tmp_path)) == 3  # final save still lands

@@ -754,3 +754,63 @@ class TestRepoHygiene:
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         assert mod.check() == []
+
+
+# --------------------------------------------------------------------------
+# Injection-site registry (doc-drift guard)
+# --------------------------------------------------------------------------
+
+
+class TestSiteRegistry:
+    """chaos.sites() is the canonical registry; the module docstring table
+    and the check()/should_fail() literals in production code must both
+    agree with it — a new site wired without a registry entry (or a stale
+    doc row) fails here, not in a postmortem."""
+
+    def test_docstring_table_matches_registry(self):
+        import re
+
+        import kubedl_tpu.chaos.plan as plan_mod
+
+        block = plan_mod.__doc__.split(
+            "Injection sites wired in this repo::", 1
+        )[1]
+        documented = set()
+        for line in block.splitlines():
+            s = line.strip()
+            if not s:
+                if documented:
+                    break  # blank line after the rows ends the table
+                continue
+            first_col = re.split(r"\s{2,}", s)[0]
+            for name in first_col.split(" / "):
+                documented.add(name.strip())
+        assert documented == set(chaos.sites()), (
+            f"docstring table drifted from chaos.sites(): "
+            f"missing={sorted(set(chaos.sites()) - documented)} "
+            f"stale={sorted(documented - set(chaos.sites()))}"
+        )
+
+    def test_source_literals_match_registry(self):
+        import re
+
+        pat = re.compile(
+            r"""chaos\.(?:check|should_fail)\(\s*["']([^"']+)["']"""
+        )
+        consulted = set()
+        for p in (REPO / "kubedl_tpu").rglob("*.py"):
+            consulted |= set(pat.findall(p.read_text()))
+        registered = set(chaos.sites())
+        assert consulted <= registered, (
+            f"sites consulted in code but missing from chaos.sites(): "
+            f"{sorted(consulted - registered)}"
+        )
+        assert registered <= consulted, (
+            f"sites registered but consulted nowhere (dead registry rows): "
+            f"{sorted(registered - consulted)}"
+        )
+
+    def test_sites_returns_a_copy(self):
+        s = chaos.sites()
+        s["bogus.site"] = "mutation"
+        assert "bogus.site" not in chaos.sites()

@@ -1,0 +1,454 @@
+"""Progress watchdog (kubedl_tpu/watchdog/): hang / straggler /
+silent-death classification from per-step beacons, and the restart path
+it drives.
+
+Invariants asserted here:
+- beacons ride the heartbeat channel onto Node objects and survive the
+  codec (announce_progress AND the file source);
+- classification is observation-based (clock-skew safe), startup grace
+  covers compilation, and a replaced pod (new uid) gets a fresh window;
+- hang and silent death fail the pod RETRYABLY (exit 137) and stamp a
+  HangDetected condition; stragglers get an event + metric, no restart;
+- watchdog restarts consume the SAME backoff_limit budget crash restarts
+  do, and the boundary is exact (== limit continues, limit+1 fails);
+- e2e: a chaos-injected hang (no pod exit) triggers HangDetected + a
+  gang restart that resumes from the latest checkpoint (ISSUE 6
+  acceptance).
+"""
+
+import time
+
+import pytest
+
+from kubedl_tpu import chaos
+from kubedl_tpu.api import constants
+from kubedl_tpu.api.types import JobConditionType, ReplicaType, RestartPolicy
+from kubedl_tpu.chaos import FaultPlan, FaultSpec
+from kubedl_tpu.core.nodes import NODE_NAMESPACE, NodeHeartbeater
+from kubedl_tpu.core.objects import Container, Pod, PodPhase
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.observability.metrics import JobMetrics, MetricsRegistry
+from kubedl_tpu.watchdog import (
+    ProgressBeacon,
+    WatchdogConfig,
+    WatchdogController,
+    beacon_path,
+    read_beacon,
+)
+
+from tests.helpers import make_tpujob
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    chaos.disarm()
+    yield
+    chaos.disarm()
+
+
+def make_pod(store, name, node="hostX", job="job1", phase=PodPhase.RUNNING,
+             namespace="default"):
+    p = Pod()
+    p.metadata.name = name
+    p.metadata.namespace = namespace
+    p.metadata.labels = {
+        constants.LABEL_JOB_NAME: job,
+        constants.LABEL_JOB_KIND: "TPUJob",
+    }
+    p.spec.containers.append(Container())
+    p.spec.node_name = node
+    p.status.phase = phase
+    store.create(p)
+    return store.get("Pod", name, namespace)
+
+
+# --------------------------------------------------------------------------
+# Beacon primitives
+# --------------------------------------------------------------------------
+
+
+class TestBeacon:
+    def test_write_read_roundtrip(self, tmp_path):
+        path = beacon_path(str(tmp_path), "default", "p0")
+        b = ProgressBeacon(path, clock=lambda: 42.0)
+        b.step(7, tokens=1024.0)
+        b.write_once()
+        got = read_beacon(path)
+        assert got == {"step": 7.0, "tokens": 1024.0, "ts": 42.0}
+
+    def test_read_missing_or_malformed_is_none(self, tmp_path):
+        assert read_beacon(str(tmp_path / "nope.json")) is None
+        bad = tmp_path / "bad.json"
+        bad.write_text("{half a json")
+        assert read_beacon(str(bad)) is None
+        bad.write_text('{"no_step": 1}')
+        assert read_beacon(str(bad)) is None
+
+    def test_writer_thread_stamps_fresh_ts_while_step_frozen(self, tmp_path):
+        """The hang signature: a wedged step loop never calls .step() again
+        but the side thread keeps refreshing ts."""
+        path = str(tmp_path / "b.json")
+        with ProgressBeacon(path, interval=0.05) as b:
+            b.step(3)
+            time.sleep(0.2)
+            first = read_beacon(path)
+            time.sleep(0.2)
+            second = read_beacon(path)
+        assert first["step"] == second["step"] == 3.0
+        assert second["ts"] > first["ts"]
+        assert b.writes >= 3
+
+    def test_file_source_scans_only_this_nodes_live_pods(self, tmp_path):
+        from kubedl_tpu.watchdog import FileBeaconSource
+
+        store = ObjectStore()
+        make_pod(store, "p0", node="hostX")
+        make_pod(store, "p1", node="hostY")
+        make_pod(store, "p2", node="hostX", phase=PodPhase.SUCCEEDED)
+        for name in ("p0", "p1", "p2"):
+            b = ProgressBeacon(beacon_path(str(tmp_path), "default", name))
+            b.step(5)
+            b.write_once()
+        src = FileBeaconSource(str(tmp_path), store)
+        got = src("hostX")
+        assert set(got) == {"default/p0"}  # not hostY's, not the terminal
+        assert got["default/p0"]["step"] == 5.0
+
+
+class TestHeartbeatChannel:
+    def test_announce_progress_rides_beat_onto_node(self):
+        store = ObjectStore()
+        hb = NodeHeartbeater(store, ["hostX"], clock=lambda: 100.0)
+        hb.announce_progress("hostX", "default/p0", step=4, tokens=64.0)
+        hb.beat_once()
+        node = store.get("Node", "hostX", NODE_NAMESPACE)
+        assert node.beacons["default/p0"]["step"] == 4.0
+        assert node.beacons["default/p0"]["ts"] == 100.0
+
+    def test_beat_replaces_the_mapping(self):
+        """A pod that left the node drops off the Node object on the next
+        beat — no stale beacon lingers to confuse the watchdog."""
+        store = ObjectStore()
+        hb = NodeHeartbeater(store, ["hostX"])
+        hb.announce_progress("hostX", "default/p0", step=1)
+        hb.beat_once()
+        hb.clear_progress("hostX", "default/p0")
+        hb.beat_once()
+        assert store.get("Node", "hostX", NODE_NAMESPACE).beacons == {}
+
+    def test_beacons_survive_the_codec(self):
+        from kubedl_tpu.api.codec import decode_object, encode
+        from kubedl_tpu.core.objects import Node
+
+        n = Node(beacons={"ns/p": {"step": 2.0, "tokens": 3.0, "ts": 9.0}})
+        n.metadata.name = "hostX"
+        assert decode_object(encode(n)).beacons == n.beacons
+
+    def test_chaos_freeze_leaves_node_map_untouched(self):
+        """watchdog.beacon: the kubelet's beacon publish wedges while its
+        heartbeat stays healthy — the Node keeps the OLD beacons (frozen),
+        which is exactly the silent-death signature downstream."""
+        store = ObjectStore()
+        t = {"now": 100.0}
+        hb = NodeHeartbeater(store, ["hostX"], clock=lambda: t["now"])
+        hb.announce_progress("hostX", "default/p0", step=1)
+        hb.beat_once()
+        with FaultPlan(1, sites={"watchdog.beacon": [FaultSpec.always()]}):
+            t["now"] = 105.0
+            hb.announce_progress("hostX", "default/p0", step=9)
+            hb.beat_once()
+        node = store.get("Node", "hostX", NODE_NAMESPACE)
+        assert node.last_heartbeat == 105.0  # heartbeat itself healthy
+        assert node.beacons["default/p0"]["step"] == 1.0  # frozen
+
+
+# --------------------------------------------------------------------------
+# Classification (fake clock, manual store)
+# --------------------------------------------------------------------------
+
+
+def _rig(grace=50.0, min_budget=5.0, mult=3.0, ratio=0.25):
+    store = ObjectStore()
+    t = {"now": 1000.0}
+    clock = lambda: t["now"]
+    hb = NodeHeartbeater(store, ["hostX"], clock=clock)
+    metrics = JobMetrics(MetricsRegistry())
+    wd = WatchdogController(
+        store, metrics=metrics, clock=clock,
+        config=WatchdogConfig(
+            multiplier=mult, min_budget_seconds=min_budget,
+            startup_grace_seconds=grace, straggler_ratio=ratio,
+        ),
+    )
+    return store, t, hb, wd, metrics
+
+
+def _tick(t, hb, wd, pod_key="default/p0", step=None, dt=1.0):
+    """Advance the fake clock, beat a fresh beacon, reconcile."""
+    t["now"] += dt
+    if step is not None:
+        hb.announce_progress("hostX", pod_key, step=step, ts=t["now"])
+    hb.beat_once()
+    wd.reconcile(NODE_NAMESPACE, "hostX")
+
+
+class TestClassification:
+    def test_hang_fires_after_ewma_budget(self):
+        store, t, hb, wd, metrics = _rig()
+        store.create(make_tpujob("job1", workers=1))
+        make_pod(store, "p0")
+        _tick(t, hb, wd, step=1)
+        for s in range(2, 7):  # steady 1s steps: ewma ~= 1
+            _tick(t, hb, wd, step=s)
+        # freeze the step, keep ts fresh (the step loop wedged, the beacon
+        # thread did not): budget = max(5, 3*~1) = 5s
+        for _ in range(4):
+            _tick(t, hb, wd, step=6)  # 4s frozen: under budget
+        assert store.get("Pod", "p0").status.phase == PodPhase.RUNNING
+        for _ in range(3):
+            _tick(t, hb, wd, step=6)  # 7s frozen: past budget
+        pod = store.get("Pod", "p0")
+        assert pod.status.phase == PodPhase.FAILED
+        assert pod.status.reason == "HangDetected"
+        assert pod.status.container_statuses[0].exit_code == 137
+        assert wd.fired["hang"] == 1
+        assert metrics.watchdog_restarts.value(reason="hang") == 1
+        job = store.get("TPUJob", "job1")
+        assert job.status.phase == JobConditionType.HANG_DETECTED
+        assert any(e.reason == "HangDetected"
+                   for e in store.list("Event", None))
+        assert wd.tracked() == 0  # track dropped with the pod
+
+    def test_silent_death_fires_when_beacons_stop(self):
+        store, t, hb, wd, _ = _rig()
+        store.create(make_tpujob("job1", workers=1))
+        make_pod(store, "p0")
+        _tick(t, hb, wd, step=1)
+        for s in range(2, 6):  # beacon ewma ~= 1 -> silent budget = 5
+            _tick(t, hb, wd, step=s)
+        # beacons stop ENTIRELY (ts frozen too): the Node map keeps the
+        # last value; only the requeue timer re-evaluates
+        for _ in range(7):
+            t["now"] += 1.0
+            wd.reconcile(NODE_NAMESPACE, "hostX")
+        pod = store.get("Pod", "p0")
+        assert pod.status.phase == PodPhase.FAILED
+        assert wd.fired["silent_death"] == 1
+        assert store.get("TPUJob", "job1").status.phase == (
+            JobConditionType.HANG_DETECTED
+        )
+
+    def test_startup_grace_covers_compilation(self):
+        """No step has EVER advanced: the budget is startup_grace (compile/
+        restore time is unknowable), not min_budget."""
+        store, t, hb, wd, _ = _rig(grace=50.0, min_budget=5.0)
+        store.create(make_tpujob("job1", workers=1))
+        make_pod(store, "p0")
+        _tick(t, hb, wd, step=0)
+        for _ in range(40):  # 40s of fresh beacons, step pinned at 0
+            _tick(t, hb, wd, step=0)
+        assert store.get("Pod", "p0").status.phase == PodPhase.RUNNING
+        for _ in range(12):  # past the 50s grace
+            _tick(t, hb, wd, step=0)
+        assert store.get("Pod", "p0").status.phase == PodPhase.FAILED
+        assert wd.fired["hang"] == 1
+
+    def test_replacement_pod_gets_fresh_window(self):
+        """Same name, new uid (gang restart): the track resets — the new
+        incarnation must not inherit the dead one's stale clocks."""
+        store, t, hb, wd, _ = _rig(grace=50.0)
+        store.create(make_tpujob("job1", workers=1))
+        make_pod(store, "p0")
+        _tick(t, hb, wd, step=1)
+        for s in range(2, 7):
+            _tick(t, hb, wd, step=s)
+        store.delete("Pod", "p0")
+        make_pod(store, "p0")  # fresh uid, restarting from scratch
+        for _ in range(20):  # 20s at step 0: under the fresh 50s grace
+            _tick(t, hb, wd, step=0)
+        assert store.get("Pod", "p0").status.phase == PodPhase.RUNNING
+        assert wd.fired == {"hang": 0, "silent_death": 0}
+
+    def test_pending_pod_never_fires(self):
+        store, t, hb, wd, _ = _rig(grace=5.0, min_budget=2.0)
+        store.create(make_tpujob("job1", workers=1))
+        make_pod(store, "p0", phase=PodPhase.PENDING)
+        _tick(t, hb, wd, step=0)
+        for _ in range(20):
+            _tick(t, hb, wd, step=0)
+        assert store.get("Pod", "p0").status.phase == PodPhase.PENDING
+
+    def test_straggler_flagged_not_restarted_and_recovers(self):
+        store, t, hb, wd, metrics = _rig()
+        store.create(make_tpujob("job1", workers=2))
+        make_pod(store, "p0")
+        make_pod(store, "p1")
+        sa, sb = 0, 0
+        for _ in range(12):  # A: 10 steps/s, B: 1 step/s -> B < 0.25*median
+            sa += 10
+            sb += 1
+            t["now"] += 1.0
+            hb.announce_progress("hostX", "default/p0", step=sa, ts=t["now"])
+            hb.announce_progress("hostX", "default/p1", step=sb, ts=t["now"])
+            hb.beat_once()
+            wd.reconcile(NODE_NAMESPACE, "hostX")
+        assert store.get("Pod", "p1").status.phase == PodPhase.RUNNING
+        assert wd.fired == {"hang": 0, "silent_death": 0}
+        assert metrics.watchdog_stragglers.value() == 1  # flagged ONCE
+        assert any(e.reason == "Straggler" for e in store.list("Event", None))
+        # B recovers: the flag clears (so a later relapse re-counts)
+        for _ in range(25):
+            sa += 10
+            sb += 10
+            t["now"] += 1.0
+            hb.announce_progress("hostX", "default/p0", step=sa, ts=t["now"])
+            hb.announce_progress("hostX", "default/p1", step=sb, ts=t["now"])
+            hb.beat_once()
+            wd.reconcile(NODE_NAMESPACE, "hostX")
+        assert all(not tr.straggler for tr in wd._tracks.values())
+
+
+# --------------------------------------------------------------------------
+# Restart budget integration (satellite: backoff boundary)
+# --------------------------------------------------------------------------
+
+
+from tests.test_engine import make_engine, submit_and_reconcile  # noqa: E402
+from tests.helpers import PodDriver, pod_names  # noqa: E402
+
+
+class TestBackoffBudget:
+    def test_restart_count_at_limit_continues(self):
+        """Boundary: _check_limits uses `>` — restart_count == backoff_limit
+        must still rebuild the gang."""
+        engine, store, _ = make_engine()
+        driver = PodDriver(store)
+        job = make_tpujob(workers=1)
+        job.spec.run_policy.backoff_limit = 1
+        submit_and_reconcile(engine, store, job)
+        driver.fail("job1-worker-0", exit_code=137)
+        engine.reconcile("default", "job1")  # slice restart
+        engine.reconcile("default", "job1")  # recreate
+        got = store.get("TPUJob", "job1")
+        assert got.status.restart_count == 1  # == limit
+        assert got.status.phase != JobConditionType.FAILED
+        assert pod_names(store) == ["job1-worker-0"]
+
+    def test_restart_count_past_limit_fails(self):
+        engine, store, _ = make_engine()
+        driver = PodDriver(store)
+        job = make_tpujob(workers=1)
+        job.spec.run_policy.backoff_limit = 1
+        submit_and_reconcile(engine, store, job)
+        for _ in range(2):
+            driver.fail("job1-worker-0", exit_code=137)
+            engine.reconcile("default", "job1")
+            engine.reconcile("default", "job1")
+        got = store.get("TPUJob", "job1")
+        assert got.status.restart_count == 2  # == limit + 1
+        assert got.status.phase == JobConditionType.FAILED
+        assert got.status.conditions[-1].reason == "BackoffLimitExceeded"
+
+    def test_watchdog_restart_counts_against_backoff_budget(self):
+        """A watchdog-failed pod takes the SAME gang-restart path a crash
+        does: restart_count increments, and with backoff_limit=0 the very
+        first watchdog fire exhausts the budget."""
+        engine, store, _ = make_engine()
+        driver = PodDriver(store)
+        t = {"now": 1000.0}
+        hb = NodeHeartbeater(store, ["hostX"], clock=lambda: t["now"])
+        wd = WatchdogController(
+            store, clock=lambda: t["now"],
+            config=WatchdogConfig(multiplier=3.0, min_budget_seconds=5.0,
+                                  startup_grace_seconds=50.0),
+        )
+        job = make_tpujob(workers=1)
+        job.spec.run_policy.backoff_limit = 0
+        submit_and_reconcile(engine, store, job)
+        driver.run("job1-worker-0")
+        store.update_with_retry(  # pin to the beaconing host
+            "Pod", "job1-worker-0", "default",
+            lambda p: setattr(p.spec, "node_name", "hostX"),
+        )
+        s = 0
+        for _ in range(6):
+            s += 1
+            _tick(t, hb, wd, pod_key="default/job1-worker-0", step=s)
+        for _ in range(7):  # wedge past budget -> watchdog fails the pod
+            _tick(t, hb, wd, pod_key="default/job1-worker-0", step=s)
+        assert store.get("Pod", "job1-worker-0").status.phase == PodPhase.FAILED
+        engine.reconcile("default", "job1")  # gang restart: count += 1
+        got = store.get("TPUJob", "job1")
+        assert got.status.restart_count == 1
+        engine.reconcile("default", "job1")
+        # 1 > backoff_limit 0: the watchdog restart consumed the budget
+        assert store.get("TPUJob", "job1").status.phase == (
+            JobConditionType.FAILED
+        )
+
+
+# --------------------------------------------------------------------------
+# E2e: injected hang -> HangDetected -> gang restart resumes from checkpoint
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_injected_hang_gang_restarts_and_resumes(tmp_path):
+    """ISSUE 6 acceptance: a deterministic chaos-injected hang (the pod
+    never exits) is classified by the watchdog, the job gains a
+    HangDetected condition, and the gang restart resumes from the latest
+    checkpoint instead of step 0."""
+    import json
+
+    from kubedl_tpu.core.objects import EnvVar
+    from kubedl_tpu.operator import Operator, OperatorOptions
+    from kubedl_tpu.runtime.executor import ThreadRuntime
+    from kubedl_tpu.training import entry as entry_mod
+
+    opts = OperatorOptions(
+        local_addresses=True,
+        artifact_registry_root=str(tmp_path / "reg"),
+        node_grace_seconds=3.0,          # heartbeat (and beacon publish) every 1s
+        heartbeat_nodes=["hostX"],
+        beacon_dir=str(tmp_path / "beacons"),
+        watchdog_multiplier=3.0,
+        watchdog_min_budget_seconds=1.0,
+        # generous: compile time must never fire the watchdog; the hang
+        # budget comes from the observed step EWMA (~0.7s latency spec)
+        watchdog_startup_grace_seconds=300.0,
+    )
+    cfg = {"model": "tiny", "steps": 6, "global_batch": 8, "seq_len": 32,
+           "ckpt_every": 2}
+    # call 3 (= step 3 of attempt 1) wedges the step loop WITHOUT exiting;
+    # every other call pays a 700ms latency so beacons observe real step
+    # spacing before the wedge (the EWMA the hang budget derives from)
+    plan = FaultPlan(7, sites={"trainer.step_stall": [
+        FaultSpec.nth(3), FaultSpec.latency(700.0, every=1),
+    ]})
+    with plan, Operator(opts, runtime=ThreadRuntime()) as op:
+        job = make_tpujob(
+            "hangjob", workers=1,
+            entrypoint="kubedl_tpu.training.entry:train_main",
+        )
+        spec = job.spec.replica_specs[ReplicaType.WORKER]
+        spec.template.spec.node_name = "hostX"
+        main = spec.template.spec.containers[0]
+        main.env.append(EnvVar("KUBEDL_TRAIN_CONFIG", json.dumps(cfg)))
+        main.env.append(EnvVar(constants.ENV_CKPT_DIR, str(tmp_path / "ck")))
+        op.submit(job)
+        got = op.wait_for_phase(
+            "TPUJob", "hangjob",
+            [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
+            timeout=180,
+        )
+        assert got.status.phase == JobConditionType.SUCCEEDED
+        assert got.status.restart_count >= 1
+        assert any(c.type == JobConditionType.HANG_DETECTED
+                   for c in got.status.conditions), got.status.conditions
+        assert any(e.reason == "HangDetected"
+                   for e in op.store.list("Event", None))
+        assert op.metrics.watchdog_restarts.value(reason="hang") >= 1
+    # the retried attempt resumed from the step-2 checkpoint, not step 0
+    assert entry_mod.LAST_SUMMARY is not None
+    assert entry_mod.LAST_SUMMARY["start_step"] >= 2
+    assert plan.faults("trainer.step_stall") == 1
