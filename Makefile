@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test test-fast bench-smoke bench dryrun install lint all render-deploy \
-	validate-deploy docker-build kind-e2e
+	validate-deploy docker-build kind-e2e drive-router
 
 all: test
 
@@ -50,6 +50,11 @@ docker-build:
 # means "toolchain absent" and keeps the lane green
 kind-e2e:
 	bash scripts/kind-e2e.sh || { rc=$$?; [ $$rc -eq 2 ] && echo "kind-e2e skipped (no cluster toolchain)" || exit $$rc; }
+
+# serving-router fault drill: 3 real engine subprocesses, seeded SIGKILL
+# under load, eject -> readmit, drain semantics (scripts/verify-drives/)
+drive-router:
+	JAX_PLATFORMS=cpu $(PY) scripts/verify-drives/drive_router.py
 
 install:
 	$(PY) -m pip install -e .

@@ -757,6 +757,140 @@ def bench_prefix_reuse(on_tpu: bool) -> dict:
     return out
 
 
+def bench_router_availability(on_tpu: bool) -> dict:
+    """Serving-router availability through a replica kill (docs/serving.md
+    "Router"): three engine replicas behind the router under steady client
+    load; one replica is hard-stopped mid-run (sockets severed — the
+    router sees exactly what a SIGKILL looks like) and restarted later.
+    Acceptance: zero lost requests (every one completes via failover, at
+    most one retry each), the breaker ejects then readmits the restarted
+    replica, and greedy outputs stay bit-identical to a direct engine
+    call through the whole drill."""
+    import statistics
+    import threading as _threading
+    import time as _time
+    from http.server import ThreadingHTTPServer
+
+    from kubedl_tpu.serving import router_policy as _policy
+    from kubedl_tpu.serving.router import ServingRouter
+    from kubedl_tpu.serving.server import LlamaEngine, make_handler
+
+    preset = "gemma-2b" if on_tpu else "tiny"
+
+    def spawn(port=0):
+        eng = LlamaEngine(preset=preset, max_batch=2, max_seq=64)
+        srv = ThreadingHTTPServer(("127.0.0.1", port),
+                                  make_handler(eng, preset))
+        _threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return eng, srv
+
+    fleet = {f"r{i}": spawn() for i in range(3)}
+    victim = "r1"
+    router = ServingRouter(
+        [(n, "127.0.0.1", s.server_port) for n, (e, s) in
+         sorted(fleet.items())],
+        probe_interval_s=0.1, probe_timeout_s=1.0,
+        eject_threshold=3, readmit_cooldown_s=0.5,
+        hedge_enabled=True, hedge_default_ms=3000.0, max_retries=1,
+    )
+    router.start()
+    router.probe_once()
+    try:
+        # bit-identity reference, measured direct on one engine
+        ref_prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        direct = fleet["r0"][0].generate(list(ref_prompt), max_tokens=8)
+        code, via, _ = router.handle_generate(
+            {"prompt_ids": list(ref_prompt), "max_tokens": 8}, 30_000)
+        identical = (code == 200
+                     and via["token_ids"] == direct["token_ids"])
+
+        n_req, kill_at, restart_at = 60, 20, 40
+        lat_ms = [None] * n_req
+        codes = [None] * n_req
+        marks = {}
+
+        def client(i):
+            t0 = _time.perf_counter()
+            body = {"prompt_ids": [(i % 7) + 2] * 8 + [100 + i],
+                    "max_tokens": 4, "temperature": 0.0}
+            c, p, _h = router.handle_generate(body, deadline_ms=20_000)
+            # a 200 whose payload lacks tokens (engine torn down mid-
+            # request) is NOT a success — availability counts answers
+            codes[i] = c if (c != 200 or "token_ids" in p) else 599
+            lat_ms[i] = (_time.perf_counter() - t0) * 1e3
+
+        threads = []
+        for i in range(n_req):
+            if i == kill_at:
+                eng, srv = fleet[victim]
+                port = srv.server_port
+                srv.shutdown()
+                srv.server_close()
+                eng.close()
+                marks["killed"] = _time.perf_counter()
+            if i == restart_at:
+                fleet[victim] = spawn(port)
+                marks["restarted"] = _time.perf_counter()
+            t = _threading.Thread(target=client, args=(i,), daemon=True)
+            t.start()
+            threads.append(t)
+            _time.sleep(0.05)  # ~20 rps offered over 3 replicas
+        for t in threads:
+            t.join(timeout=30)
+        # wait out the eject -> readmit arc for the recovery timings
+        deadline = _time.perf_counter() + 15
+        eject_ms = readmit_ms = None
+        while _time.perf_counter() < deadline:
+            st = router.stats()["replicas"][victim]
+            if eject_ms is None and st["ejections"] >= 1:
+                eject_ms = True
+            if st["state"] == _policy.CLOSED and st["ejections"] >= 1:
+                readmit_ms = round(
+                    (_time.perf_counter() - marks["restarted"]) * 1e3, 1)
+                break
+            _time.sleep(0.05)
+        done = [c for c in codes if c is not None]
+        okc = sum(1 for c in done if c == 200)
+        lats = sorted(v for v in lat_ms if v is not None)
+        st = router.stats()["replicas"][victim]
+        out = {
+            "model": preset,
+            "replicas": 3,
+            "requests": n_req,
+            "completed": len(done),
+            "ok": okc,
+            "availability_pct": round(100.0 * okc / n_req, 2),
+            "lost": n_req - len(done),
+            "error_burst": len(done) - okc,
+            "retries": router.metrics.retries.value(),
+            "hedges": router.metrics.hedges.value(),
+            "latency_ms_p50": round(statistics.median(lats), 2),
+            "latency_ms_p99": round(
+                lats[min(len(lats) - 1, int(len(lats) * 0.99))], 2),
+            "victim_ejections": st["ejections"],
+            "victim_readmissions": st["readmissions"],
+            "readmit_after_restart_ms": readmit_ms,
+            "greedy_outputs_identical": identical,
+        }
+        # sanity gates, same spirit as the training bench: an availability
+        # number with lost requests or divergent outputs is not a result
+        if n_req - len(done) > 0 or not identical:
+            out["gate_failed"] = True
+        return out
+    finally:
+        router.stop()
+        for eng, srv in fleet.values():
+            try:
+                srv.shutdown()
+                srv.server_close()
+            except Exception:
+                pass
+            try:
+                eng.close()
+            except Exception:
+                pass
+
+
 def bench_flash_numerics(on_tpu: bool) -> dict:
     """Numerics gate (ADVICE r4): the fused single-pass flash backward and
     the classic split two-kernel backward must agree ON CHIP. The fused
@@ -1233,6 +1367,10 @@ def main() -> int:
         targets["prefix_reuse"] = bench_prefix_reuse(on_tpu)
     except Exception as e:
         targets["prefix_reuse"] = {"error": str(e)}
+    try:
+        targets["router_availability"] = bench_router_availability(on_tpu)
+    except Exception as e:
+        targets["router_availability"] = {"error": str(e)}
     try:
         targets["long_context"] = bench_long_context(on_tpu)
     except Exception as e:

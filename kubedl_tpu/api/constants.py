@@ -31,6 +31,9 @@ ANNOTATION_NETWORK_MODE = API_GROUP + "/network-mode"
 ANNOTATION_TENANCY = API_GROUP + "/tenancy"
 ANNOTATION_OWNER = API_GROUP + "/owner"  # reference: tenancy.go:25-43 user field
 ANNOTATION_PROFILER_CONFIG = API_GROUP + "/profiler-config"  # TPU addition
+#: monotonic timestamp stamped when the controller began draining a
+#: predictor pod ahead of scale-down/GC (docs/serving.md "Router")
+ANNOTATION_DRAIN_STARTED = API_GROUP + "/drain-started"
 #: world size (total processes) the job was SUBMITTED with — stamped once
 #: at first defaulting and stable across elastic resizes, so workers can
 #: rescale gradient accumulation to preserve the effective global batch

@@ -24,6 +24,9 @@ Injection sites wired in this repo::
     store.wal_fsync                              fail the WAL fsync syscall
     watchdog.beacon                              freeze a node's beacon publish
     trainer.step_stall                           wedge the training step loop
+    router.forward                               replica forward transport failure
+    router.probe                                 router health-probe failure
+    router.hedge                                 suppress a hedge dispatch
 
 Schedules are per-site and deterministic: ``nth(n)`` fails exactly the
 n-th call (1-based), ``first(k)`` fails the first k calls, ``prob(p, k)``
@@ -65,6 +68,9 @@ SITES: Dict[str, str] = {
     "store.wal_fsync": "fail the WAL fsync syscall",
     "watchdog.beacon": "freeze a node's beacon publish",
     "trainer.step_stall": "wedge the training step loop",
+    "router.forward": "replica forward transport failure",
+    "router.probe": "router health-probe failure",
+    "router.hedge": "suppress a hedge dispatch",
 }
 
 

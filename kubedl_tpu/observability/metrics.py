@@ -417,6 +417,95 @@ class ServingMetrics:
             "+ first sampled id harvested), ms",
             buckets=_TTFT_MS_BUCKETS,
         )
+        # controller-side replica health (the probe-failure satellite:
+        # a replica that stops answering its stats probe must SURFACE,
+        # not silently drop out of the QPS math)
+        self.probe_failures = r.counter(
+            "kubedl_tpu_serving_probe_failures",
+            "Autoscaler stats-probe failures, by predictor pod",
+        )
+        self.replicas_not_ready = r.gauge(
+            "kubedl_tpu_serving_replicas_not_ready",
+            "RUNNING predictor pods whose stats probe has failed "
+            "consecutively past the NotReady threshold",
+        )
+
+
+class RouterMetrics:
+    """The routing-tier metric family (kubedl_tpu/serving/router.py):
+    per-replica health (ejections/readmissions/probe failures, labeled by
+    replica), the tail-tolerance mechanisms (retries, hedges + wins,
+    cancellations, deadline misses), and fleet availability gauges —
+    what `/metrics` on the router exports."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry or MetricsRegistry()
+        r = self.registry
+        self.requests = r.counter(
+            "kubedl_tpu_router_requests", "Requests accepted by the router"
+        )
+        self.retries = r.counter(
+            "kubedl_tpu_router_retries",
+            "Failover re-dispatches after a replica error/shed "
+            "(budget-gated: never more than ~ratio of offered load)",
+        )
+        self.hedges = r.counter(
+            "kubedl_tpu_router_hedges",
+            "Duplicate dispatches fired after the p95-based hedge delay",
+        )
+        self.hedge_wins = r.counter(
+            "kubedl_tpu_router_hedge_wins",
+            "Requests whose hedge answered before the primary",
+        )
+        self.cancellations = r.counter(
+            "kubedl_tpu_router_cancellations",
+            "Loser attempts cancelled after another attempt won",
+        )
+        self.ejections = r.counter(
+            "kubedl_tpu_router_ejections",
+            "Circuit-breaker ejections (K consecutive failures), by replica",
+        )
+        self.readmissions = r.counter(
+            "kubedl_tpu_router_readmissions",
+            "Half-open probes that readmitted an ejected replica, by replica",
+        )
+        self.probe_failures = r.counter(
+            "kubedl_tpu_router_probe_failures",
+            "Active health-probe failures, by replica",
+        )
+        self.transport_errors = r.counter(
+            "kubedl_tpu_router_transport_errors",
+            "Request forwards that failed at the transport, by replica",
+        )
+        self.upstream_sheds = r.counter(
+            "kubedl_tpu_router_upstream_sheds",
+            "503 + Retry-After shed responses received from replicas",
+        )
+        self.deadline_exceeded = r.counter(
+            "kubedl_tpu_router_deadline_exceeded",
+            "Requests that ran out of deadline budget (504 to the client)",
+        )
+        self.no_replica = r.counter(
+            "kubedl_tpu_router_no_replica",
+            "Requests rejected because no replica was routable",
+        )
+        self.drain_rejects = r.counter(
+            "kubedl_tpu_router_drain_rejects",
+            "Requests rejected 503 while the router itself drains",
+        )
+        self.replicas_available = r.gauge(
+            "kubedl_tpu_router_replicas_available",
+            "Replicas currently routable (breaker closed, not draining)",
+        )
+        self.replicas_draining = r.gauge(
+            "kubedl_tpu_router_replicas_draining",
+            "Replicas currently refusing admission to drain",
+        )
+        self.request_ms = r.histogram(
+            "kubedl_tpu_router_request_ms",
+            "End-to-end router latency per request (all attempts), ms",
+            buckets=_TTFT_MS_BUCKETS,
+        )
 
 
 #: Process-wide default, mirroring the reference's promauto default registry.

@@ -62,6 +62,15 @@ class OperatorOptions:
     #: (e.g. kubedl_tpu.serving.controller.http_qps_probe). None disables
     #: load-driven scaling (autoscale min/max clamping still applies).
     serving_qps_probe: Optional[object] = None
+    #: graceful-drain window (s) for retiring predictor pods: scale-down
+    #: and predictor GC first tell the engine to drain (503 reason:
+    #: draining, in-flight decodes finish) and delete only once idle or
+    #: past the grace. 0 preserves delete-on-sight.
+    serving_drain_grace_s: float = 0.0
+    #: drain trigger: callable(pod) -> None (e.g.
+    #: kubedl_tpu.serving.controller.http_drain_hook). None still delays
+    #: deletion by the idle-probe/grace when serving_drain_grace_s > 0.
+    serving_drain_hook: Optional[object] = None
     #: persistent XLA compilation-cache dir injected into every training/
     #: serving pod (KUBEDL_COMPILE_CACHE_DIR) so gang restarts, resizes,
     #: and resumes deserialize compiled programs instead of re-lowering
@@ -340,6 +349,8 @@ class Operator:
             cluster_domain=self.options.cluster_domain,
             qps_probe=self.options.serving_qps_probe,
             compile_cache_dir=self.options.compile_cache_dir,
+            drain_grace_s=self.options.serving_drain_grace_s,
+            drain_hook=self.options.serving_drain_hook,
         )
         self.serving.setup(self.manager)
 

@@ -10,4 +10,5 @@ router/console).
 
 from kubedl_tpu.serving.controller import InferenceController  # noqa: F401
 from kubedl_tpu.serving.prefix_cache import PrefixCache, PrefixEntry  # noqa: F401
+from kubedl_tpu.serving.router import ServingRouter  # noqa: F401
 from kubedl_tpu.serving.types import Inference, Predictor, TrafficPolicy  # noqa: F401

@@ -63,11 +63,33 @@ def http_qps_probe(port: int = 8080, timeout: float = 2.0):
     return probe
 
 
+def http_drain_hook(port: int = 8080, timeout: float = 2.0):
+    """Default drain trigger for real deployments: POST the engine's
+    /admin/drain on the pod's IP. The engine stops admission (503 with
+    ``reason: draining``) but finishes in-flight decodes — the controller
+    deletes the pod only once it reports idle (or the grace expires)."""
+    import urllib.request
+
+    def drain(pod) -> None:
+        host = getattr(pod.status, "pod_ip", "") or "127.0.0.1"
+        req = urllib.request.Request(
+            f"http://{host}:{port}/admin/drain", data=b"{}",
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=timeout).read()
+
+    return drain
+
+
 class InferenceController:
     NAME = "inference-controller"
 
     #: seconds between autoscale changes for one predictor (flap damping)
     AUTOSCALE_COOLDOWN = 30.0
+
+    #: consecutive stats-probe failures before a RUNNING pod surfaces as
+    #: NotReady in the predictor status (+ event + metric)
+    PROBE_NOTREADY_THRESHOLD = 3
 
     def __init__(
         self,
@@ -78,6 +100,9 @@ class InferenceController:
         qps_probe=None,
         clock=None,
         compile_cache_dir: str = "",
+        metrics=None,
+        drain_grace_s: float = 0.0,
+        drain_hook=None,
     ) -> None:
         self.store = store
         self.recorder = recorder or EventRecorder(store)
@@ -91,10 +116,30 @@ class InferenceController:
         #: deployment-specific, so it's injected; None disables
         #: target_qps-driven scaling (min/max clamping still applies).
         self.qps_probe = qps_probe
+        #: ServingMetrics-compatible sink for probe_failures /
+        #: replicas_not_ready; None disables the metric side
+        self.metrics = metrics
+        #: graceful drain window for scale-down/GC: > 0 means a retiring
+        #: RUNNING pod is first told to drain (drain_hook + annotation)
+        #: and deleted only once idle or past the grace — a canary shift
+        #: never severs an in-flight stream. 0 preserves delete-on-sight.
+        self.drain_grace_s = float(drain_grace_s)
+        #: drain_hook(pod): tell one replica to stop admission (e.g.
+        #: http_drain_hook). None with drain_grace_s > 0 still delays
+        #: deletion by the grace/idle check — the router's probe sees
+        #: the pod disappear only after its streams finish.
+        self.drain_hook = drain_hook
         import time as _time
 
         self.clock = clock or _time.time
         self._last_scale: Dict[tuple, float] = {}
+        #: pod name -> consecutive stats-probe failures (the silent
+        #: swallowing fix: failures surface instead of dropping replicas
+        #: out of the QPS math unnoticed)
+        self._probe_failures: Dict[str, int] = {}
+        #: set by _retire_pod during a reconcile when a pod is mid-drain
+        #: (the reconcile returns a short requeue to finish the job)
+        self._drain_wait = False
 
     def setup(self, manager: ControllerManager) -> None:
         manager.register(
@@ -131,6 +176,14 @@ class InferenceController:
 
         self._sync_entry_service(inf)
         pods = self._pods_of(inf)
+        # probe-failure bookkeeping follows the pod set: a deleted pod
+        # must not leave a stale NotReady count behind
+        live = {p.metadata.name for p in pods}
+        prefix = f"{inf.metadata.name}-"
+        for k in [k for k in self._probe_failures
+                  if k.startswith(prefix) and k not in live]:
+            self._probe_failures.pop(k, None)
+        self._drain_wait = False
         statuses: Dict[str, PredictorStatus] = {}
         ready_weights: Dict[str, int] = {}
         for pred in inf.predictors:
@@ -141,6 +194,13 @@ class InferenceController:
         self._gc_removed_predictors(inf, pods)
         self._sync_traffic(inf, ready_weights)
         self._update_status(inf, statuses)
+        if self.metrics is not None:
+            self.metrics.replicas_not_ready.set(
+                float(sum(len(s.not_ready) for s in statuses.values())),
+                inference=inf.metadata.name,
+            )
+        if self._drain_wait:
+            return 1.0  # a retiring pod is mid-drain: come back soon
         if self.qps_probe is not None and any(
             p.autoscale is not None and p.autoscale.target_qps
             for p in inf.predictors
@@ -225,11 +285,82 @@ class InferenceController:
                 pass
         for i, p in have.items():
             if i >= replicas:
-                self.store.try_delete("Pod", p.metadata.name, p.metadata.namespace)
+                self._retire_pod(inf, p)
         ready = sum(1 for p in mine if p.status.phase == PodPhase.RUNNING)
-        return PredictorStatus(
-            replicas=replicas, ready_replicas=ready, image=mv.image
+        not_ready = sorted(
+            p.metadata.name for p in mine
+            if p.status.phase == PodPhase.RUNNING
+            and self._probe_failures.get(p.metadata.name, 0)
+            >= self.PROBE_NOTREADY_THRESHOLD
         )
+        return PredictorStatus(
+            replicas=replicas, ready_replicas=ready, image=mv.image,
+            not_ready=not_ready,
+            message=(
+                f"{len(not_ready)} replica(s) NotReady (stats probe "
+                f"failing)" if not_ready else ""
+            ),
+        )
+
+    def _retire_pod(self, inf: Inference, pod: Pod) -> bool:
+        """Remove a pod that scale-down/GC no longer wants — gracefully
+        when a drain window is configured: first sight stamps a drain
+        annotation and triggers ``drain_hook`` (the engine stops admission
+        but finishes in-flight decodes); the pod is deleted only once its
+        stats report idle, or the grace expires. Returns True once the
+        pod is actually deleted."""
+        if (self.drain_grace_s <= 0
+                or pod.status.phase != PodPhase.RUNNING):
+            self.store.try_delete(
+                "Pod", pod.metadata.name, pod.metadata.namespace
+            )
+            return True
+        started = pod.metadata.annotations.get(
+            constants.ANNOTATION_DRAIN_STARTED
+        )
+        now = self.clock()
+        if started is None:
+            if self.drain_hook is not None:
+                try:
+                    self.drain_hook(pod)
+                except Exception:
+                    log.warning("drain hook failed for %s",
+                                pod.metadata.name, exc_info=True)
+
+            def mutate(p: Pod) -> None:
+                p.metadata.annotations[
+                    constants.ANNOTATION_DRAIN_STARTED
+                ] = repr(now)
+
+            try:
+                self.store.update_with_retry(
+                    "Pod", pod.metadata.name, pod.metadata.namespace, mutate
+                )
+            except NotFound:
+                return True
+            self.recorder.event(
+                inf, "Normal", "Draining",
+                f"pod {pod.metadata.name} draining before removal "
+                f"(grace {self.drain_grace_s:.0f}s)",
+            )
+            self._drain_wait = True
+            return False
+        drained = False
+        if self.qps_probe is not None:
+            try:
+                st = self.qps_probe(pod)
+                if isinstance(st, dict):
+                    drained = (int(st.get("active_slots", 0)) == 0
+                               and int(st.get("queued", 0)) == 0)
+            except Exception:
+                drained = True  # unreachable: nothing left to sever
+        if drained or now - float(started) >= self.drain_grace_s:
+            self.store.try_delete(
+                "Pod", pod.metadata.name, pod.metadata.namespace
+            )
+            return True
+        self._drain_wait = True
+        return False
 
     def _desired_replicas(self, inf: Inference, pred: Predictor,
                           pods: List[Pod]) -> int:
@@ -279,6 +410,24 @@ class InferenceController:
 
         with ThreadPoolExecutor(max_workers=min(8, len(mine_running))) as ex:
             readings = list(ex.map(safe_probe, mine_running))
+        # failures SURFACE instead of silently dropping out of the QPS
+        # math: consecutive failures per pod feed a NotReady predictor
+        # condition (threshold crossing fires one event) + metric
+        for p, v in zip(mine_running, readings):
+            pname = p.metadata.name
+            if v is None:
+                n = self._probe_failures.get(pname, 0) + 1
+                self._probe_failures[pname] = n
+                if self.metrics is not None:
+                    self.metrics.probe_failures.inc(pod=pname)
+                if n == self.PROBE_NOTREADY_THRESHOLD:
+                    self.recorder.event(
+                        inf, "Warning", "ReplicaNotReady",
+                        f"predictor {pred.name} pod {pname}: {n} "
+                        f"consecutive stats-probe failures",
+                    )
+            else:
+                self._probe_failures.pop(pname, None)
         healthy = [v for v in readings if v is not None]
         if not healthy:
             return current  # no signal: never act blind
@@ -369,7 +518,9 @@ class InferenceController:
         for pod in pods:
             pname = pod.metadata.labels.get(LABEL_PREDICTOR, "")
             if pname and pname not in names:
-                self.store.try_delete("Pod", pod.metadata.name, pod.metadata.namespace)
+                # GC takes the same graceful path as scale-down: a canary
+                # being withdrawn still finishes its in-flight streams
+                self._retire_pod(inf, pod)
         for svc in self.store.list(
             "Service", inf.metadata.namespace, {LABEL_INFERENCE: inf.metadata.name}
         ):

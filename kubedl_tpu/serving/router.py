@@ -1,0 +1,785 @@
+"""Fault-tolerant serving router: N replicas behind one endpoint.
+
+The routing tier ROADMAP open item 1 calls for: an HTTP front that makes
+single-replica death a non-event. One `LlamaEngine` server is a single
+point of failure — a crash loses every queued request and a canary shift
+severs in-flight streams. The router owns the tail-at-scale mechanics
+(PAPERS.md; docs/serving.md "Router"):
+
+- **Health + circuit breakers** — an active prober GETs each replica's
+  `/v1/stats` (the same signal `http_qps_probe` reads); K consecutive
+  failures eject the replica (breaker OPEN), a half-open probe readmits
+  it when it answers again. Request-path transport errors feed the same
+  breaker, so detection is bounded by the probe interval, not by it.
+- **Deadline propagation** — the client budget rides `X-Deadline-Ms` to
+  the engine (mapped onto `generate(timeout_s=...)`); every retry/hedge
+  re-computes the REMAINING budget and an expired budget is a 504
+  without ever dispatching.
+- **Retry budgets** — failovers honor the engine's 503 + `Retry-After`
+  shed contract (no dispatch to a shedding replica before its window)
+  and spend a token-bucket budget (`router_policy.RetryBudget`), so
+  retries cannot amplify a fleet-wide overload.
+- **Hedging** — after a p95-based delay the request is duplicated to a
+  second replica; first answer wins, the loser is cancelled via the
+  engine's `/v1/cancel` so it releases its queue slot.
+- **Graceful drain** — SIGTERM (or `drain()`) stops admission with a
+  distinguishable 503 (`reason: draining`), finishes in-flight requests,
+  then the server exits; replicas that report `"draining"` stop
+  receiving new work but keep their in-flight streams.
+- **Prefix affinity** — consistent hashing on the observed prompt prefix
+  (falling back to least-loaded) keeps PR 4's per-engine prefix KV cache
+  hot across the fleet.
+
+Routing and hedging never change RESULTS: greedy outputs through the
+router are bit-identical to direct engine calls (tier-1 enforced).
+
+Chaos sites (kubedl_tpu/chaos/plan.py): ``router.forward`` fails a
+request forward at the transport, ``router.probe`` fails a health probe,
+``router.hedge`` suppresses a hedge dispatch (degradation: the primary
+still owns the request).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kubedl_tpu import chaos
+from kubedl_tpu.observability.metrics import RouterMetrics
+from kubedl_tpu.serving import router_policy as policy
+
+log = logging.getLogger("kubedl_tpu.serving.router")
+
+
+class ReplicaDown(Exception):
+    """Transport-level failure talking to a replica (crash/partition)."""
+
+
+class DeadlineExceeded(Exception):
+    """The request's end-to-end budget expired."""
+
+
+class ReplicaShedding(Exception):
+    """The replica answered 503: alive but refusing admission."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0,
+                 reason: str = "overloaded") -> None:
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+        self.reason = reason
+
+
+class UpstreamError(Exception):
+    """Non-retryable upstream HTTP error — passed through verbatim."""
+
+    def __init__(self, code: int, payload: dict) -> None:
+        super().__init__(f"upstream {code}")
+        self.code = code
+        self.payload = payload
+
+
+class Replica:
+    """Router-side view of one engine replica: address, breaker, and the
+    load/health signals the selection policy reads."""
+
+    def __init__(self, name: str, host: str, port: int, weight: int = 100,
+                 fail_threshold: int = 3, cooldown_s: float = 2.0,
+                 clock=time.monotonic) -> None:
+        self.name = name
+        self.host = host
+        self.port = int(port)
+        self.weight = int(weight)
+        self.breaker = policy.CircuitBreaker(
+            fail_threshold=fail_threshold, cooldown_s=cooldown_s, clock=clock
+        )
+        self._lock = threading.Lock()
+        self.inflight = 0           # router-side dispatched, unanswered
+        self.draining = False       # replica reported/returned draining
+        self.shed_until = 0.0       # honor Retry-After: no dispatch before
+        self.probe_failures = 0     # consecutive
+        self.stats: Dict = {}       # last /v1/stats snapshot
+
+    def base_url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def begin(self) -> None:
+        with self._lock:
+            self.inflight += 1
+
+    def end(self) -> None:
+        with self._lock:
+            self.inflight = max(0, self.inflight - 1)
+
+    def load(self) -> int:
+        """Least-loaded signal: router-side in-flight plus the engine's
+        own queue depth and recent sheds (a replica rejecting 503s is
+        saturated even when its queue reads shallow)."""
+        with self._lock:
+            inflight = self.inflight
+        st = self.stats
+        return inflight + int(st.get("queued", 0)) + int(st.get("shed_recent", 0))
+
+
+class ServingRouter:
+    """The routing tier. Construct with replica specs (``(name, host,
+    port)`` or ``(name, host, port, weight)`` tuples), `start()` the
+    prober, and serve `handle_generate` — directly (tests) or through
+    :func:`make_router_handler` (HTTP)."""
+
+    def __init__(
+        self,
+        replicas: Sequence = (),
+        probe_interval_s: float = 0.5,
+        probe_timeout_s: float = 1.0,
+        eject_threshold: int = 3,
+        readmit_cooldown_s: float = 2.0,
+        hedge_enabled: bool = True,
+        hedge_floor_ms: float = 50.0,
+        hedge_default_ms: float = 1000.0,
+        retry_budget_ratio: float = 0.2,
+        max_retries: int = 1,
+        default_deadline_ms: float = 30_000.0,
+        affinity_prefix_len: int = 8,
+        metrics: Optional[RouterMetrics] = None,
+        clock=time.monotonic,
+    ) -> None:
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.eject_threshold = int(eject_threshold)
+        self.readmit_cooldown_s = float(readmit_cooldown_s)
+        self.hedge_enabled = bool(hedge_enabled)
+        self.hedge_floor_ms = float(hedge_floor_ms)
+        #: at most ONE failover retry per request by default — the
+        #: acceptance contract: only in-flight-on-a-dead-replica work is
+        #: re-dispatched, at most once, inside its deadline
+        self.max_retries = int(max_retries)
+        self.default_deadline_ms = float(default_deadline_ms)
+        self.affinity_prefix_len = int(affinity_prefix_len)
+        self.metrics = metrics or RouterMetrics()
+        self.clock = clock
+        self.retry_budget = policy.RetryBudget(ratio=retry_budget_ratio)
+        self.latency = policy.LatencyTracker(default_ms=hedge_default_ms)
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, Replica] = {}
+        self._ring = policy.ConsistentHashRing()
+        self._inflight = 0
+        self._idle = threading.Condition(self._lock)
+        self._draining = False
+        self._stop = threading.Event()
+        self._prober: Optional[threading.Thread] = None
+        self.set_replicas(replicas)
+
+    # -- fleet membership --------------------------------------------------
+
+    def set_replicas(self, specs: Sequence) -> None:
+        """Declare the replica set. Existing replicas keep their breaker/
+        health state (a resync must not mass-readmit ejected replicas);
+        removed names are deregistered, new ones start CLOSED."""
+        parsed: List[Tuple[str, str, int, int]] = []
+        for s in specs:
+            if isinstance(s, dict):
+                parsed.append((s["name"], s.get("host", "127.0.0.1"),
+                               int(s["port"]), int(s.get("weight", 100))))
+            else:
+                name, host, port = s[0], s[1], int(s[2])
+                weight = int(s[3]) if len(s) > 3 else 100
+                parsed.append((name, host, port, weight))
+        with self._lock:
+            keep = {p[0] for p in parsed}
+            for name in [n for n in self._replicas if n not in keep]:
+                del self._replicas[name]
+            for name, host, port, weight in parsed:
+                rep = self._replicas.get(name)
+                if rep is None:
+                    self._replicas[name] = Replica(
+                        name, host, port, weight,
+                        fail_threshold=self.eject_threshold,
+                        cooldown_s=self.readmit_cooldown_s,
+                        clock=self.clock,
+                    )
+                else:
+                    rep.host, rep.port, rep.weight = host, port, weight
+            self._ring.rebuild(sorted(self._replicas))
+
+    def sync_from_store(self, store, inference_name: str,
+                        namespace: str = "default") -> int:
+        """Build the replica set from the control plane: RUNNING predictor
+        pods of an Inference, weighted by its TrafficPolicy canary routes
+        (a predictor at weight 0 stays registered but unroutable). Returns
+        the number of replicas registered."""
+        from kubedl_tpu.core.objects import PodPhase
+        from kubedl_tpu.serving.controller import LABEL_INFERENCE, LABEL_PREDICTOR
+
+        weights: Dict[str, int] = {}
+        tp = store.try_get("TrafficPolicy", inference_name, namespace)
+        if tp is not None:
+            weights = {r.predictor: r.weight for r in tp.routes}
+        specs = []
+        for pod in store.list("Pod", namespace,
+                              {LABEL_INFERENCE: inference_name}):
+            if pod.status.phase != PodPhase.RUNNING:
+                continue
+            pred = pod.metadata.labels.get(LABEL_PREDICTOR, "")
+            port = 8080
+            main = pod.spec.main_container()
+            cfg = main.get_env("KUBEDL_SERVE_CONFIG")
+            if cfg:
+                port = int(json.loads(cfg).get("port", port))
+            host = getattr(pod.status, "pod_ip", "") or "127.0.0.1"
+            specs.append((pod.metadata.name, host, port,
+                          weights.get(pred, 100) if weights else 100))
+        self.set_replicas(specs)
+        return len(specs)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._prober is not None:
+            return
+        self._stop.clear()
+        self._prober = threading.Thread(
+            target=self._probe_loop, daemon=True, name="router-prober"
+        )
+        self._prober.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout=5)
+            self._prober = None
+
+    def drain(self, wait: bool = False, timeout_s: float = 30.0) -> bool:
+        """Stop admitting (503 ``reason: draining``); with ``wait``,
+        block until in-flight requests finish — then shutdown severs
+        nothing."""
+        with self._lock:
+            self._draining = True
+        if not wait:
+            return True
+        deadline = time.monotonic() + timeout_s
+        with self._idle:
+            while self._inflight > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._idle.wait(timeout=min(left, 0.1))
+        return True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # -- health probing ----------------------------------------------------
+
+    def _probe_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.probe_once()
+            except Exception:
+                log.exception("router probe sweep failed")
+            self._stop.wait(self.probe_interval_s)
+
+    def _fetch_stats(self, rep: Replica) -> Dict:
+        with urllib.request.urlopen(
+            f"{rep.base_url()}/v1/stats", timeout=self.probe_timeout_s
+        ) as r:
+            return json.loads(r.read())
+
+    def probe_once(self) -> None:
+        """One active health sweep: every replica whose breaker admits a
+        call gets a `/v1/stats` GET. Success closes the breaker (readmits
+        an ejected replica via its half-open trial) and refreshes the
+        load/draining view; failure counts toward ejection."""
+        m = self.metrics
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            br = rep.breaker
+            if br.state != policy.CLOSED and not br.allow():
+                continue  # OPEN and still cooling down
+            try:
+                chaos.check("router.probe")
+                st = self._fetch_stats(rep)
+            except Exception:
+                rep.probe_failures += 1
+                m.probe_failures.inc(replica=rep.name)
+                self._record_failure(rep)
+                continue
+            rep.probe_failures = 0
+            rep.stats = st
+            rep.draining = bool(st.get("draining", False))
+            readmitted = br.readmissions
+            br.record_success()
+            if br.readmissions > readmitted:
+                m.readmissions.inc(replica=rep.name)
+                log.info("router: readmitted replica %s", rep.name)
+        avail = sum(
+            1 for r in reps
+            if r.breaker.state == policy.CLOSED and not r.draining
+        )
+        m.replicas_available.set(float(avail))
+        m.replicas_draining.set(float(sum(1 for r in reps if r.draining)))
+
+    def _record_failure(self, rep: Replica) -> None:
+        ejected = rep.breaker.ejections
+        rep.breaker.record_failure()
+        if rep.breaker.ejections > ejected:
+            self.metrics.ejections.inc(replica=rep.name)
+            log.warning("router: ejected replica %s (%d consecutive failures)",
+                        rep.name, rep.breaker.consecutive_failures)
+
+    # -- request path ------------------------------------------------------
+
+    def _select(self, body: Dict, tried: set) -> Optional[Replica]:
+        """Next replica for this request: routable (breaker CLOSED, not
+        draining, not inside a Retry-After window, weight > 0, not
+        already tried), ordered prefix-affinity-first then least-loaded
+        (router_policy.pick_replicas)."""
+        now = self.clock()
+        with self._lock:
+            reps = list(self._replicas.values())
+        candidates = {
+            r.name: r.load() for r in reps
+            if r.name not in tried
+            and r.weight > 0
+            and not r.draining
+            and r.shed_until <= now
+            and r.breaker.state == policy.CLOSED
+        }
+        order = policy.pick_replicas(
+            candidates, body.get("prompt_ids", []), self._ring,
+            self.affinity_prefix_len,
+        )
+        with self._lock:
+            return self._replicas.get(order[0]) if order else None
+
+    def _forward(self, rep: Replica, rid: str, body: Dict,
+                 deadline: float) -> Dict:
+        rem = policy.remaining_ms(deadline, self.clock)
+        if rem <= 0:
+            raise DeadlineExceeded("budget expired before dispatch")
+        try:
+            chaos.check("router.forward")
+        except chaos.FaultInjected as e:
+            raise ReplicaDown(str(e))
+        data = json.dumps({**body, "request_id": rid}).encode()
+        req = urllib.request.Request(
+            f"{rep.base_url()}/v1/generate", data=data,
+            headers={
+                "Content-Type": "application/json",
+                # the engine maps this onto generate(timeout_s=...) — the
+                # whole deadline story end to end
+                "X-Deadline-Ms": str(int(rem)),
+            },
+        )
+        try:
+            # transport timeout slightly past the deadline: the ENGINE
+            # owns deadline enforcement (504); the transport cap only
+            # bounds a dead-but-connected socket
+            with urllib.request.urlopen(
+                req, timeout=rem / 1000.0 + 2.0
+            ) as r:
+                payload = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read() or b"{}")
+            except Exception:
+                detail = {}
+            if e.code == 503:
+                rep.breaker.record_success()  # alive — just refusing
+                raise ReplicaShedding(
+                    detail.get("error", "shed"),
+                    retry_after_s=float(e.headers.get("Retry-After", "1")),
+                    reason=detail.get("reason", "overloaded"),
+                )
+            if e.code == 504:
+                rep.breaker.record_success()
+                raise DeadlineExceeded(detail.get("error", "deadline"))
+            raise UpstreamError(e.code, detail)
+        except (OSError, urllib.error.URLError) as e:
+            raise ReplicaDown(str(e))
+        rep.breaker.record_success()
+        return payload
+
+    def _attempt(self, rep: Replica, rid: str, body: Dict, deadline: float,
+                 out: "queue.Queue") -> None:
+        try:
+            out.put((rid, rep, self._forward(rep, rid, body, deadline)))
+        except Exception as e:
+            out.put((rid, rep, e))
+        finally:
+            rep.end()
+
+    def _cancel_attempt(self, rep: Replica, rid: str) -> None:
+        """Best-effort loser cancellation: frees the loser's engine queue
+        slot/row so a hedge never doubles steady-state load."""
+        self.metrics.cancellations.inc()
+
+        def go():
+            try:
+                data = json.dumps({"request_id": rid}).encode()
+                req = urllib.request.Request(
+                    f"{rep.base_url()}/v1/cancel", data=data,
+                    headers={"Content-Type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=2.0).read()
+            except Exception:
+                pass
+
+        threading.Thread(target=go, daemon=True).start()
+
+    def handle_generate(self, body: Dict,
+                        deadline_ms: Optional[float] = None
+                        ) -> Tuple[int, Dict, Dict]:
+        """Route one generate request. Returns ``(status, payload,
+        extra_headers)`` so it serves both the HTTP handler and direct
+        in-process callers (tests/bench)."""
+        m = self.metrics
+        if self._draining:
+            m.drain_rejects.inc()
+            return (503, {"error": "router draining", "shed": True,
+                          "reason": "draining"}, {"Retry-After": "1"})
+        m.requests.inc()
+        self.retry_budget.on_request()
+        with self._lock:
+            self._inflight += 1
+        t0 = self.clock()
+        try:
+            return self._run(body, deadline_ms, t0)
+        finally:
+            with self._idle:
+                self._inflight -= 1
+                self._idle.notify_all()
+            m.request_ms.observe((self.clock() - t0) * 1e3)
+
+    def _run(self, body: Dict, deadline_ms: Optional[float],
+             t0: float) -> Tuple[int, Dict, Dict]:
+        m = self.metrics
+        budget = float(deadline_ms if deadline_ms is not None
+                       else self.default_deadline_ms)
+        deadline = policy.deadline_at(budget, self.clock)
+        results: "queue.Queue" = queue.Queue()
+        outstanding: Dict[str, Tuple[Replica, bool]] = {}
+        tried: set = set()
+        retries = 0
+        hedged = False
+        last_shed: Optional[ReplicaShedding] = None
+
+        def launch(rep: Replica, hedge: bool = False) -> None:
+            rid = uuid.uuid4().hex
+            outstanding[rid] = (rep, hedge)
+            tried.add(rep.name)
+            rep.begin()
+            threading.Thread(
+                target=self._attempt,
+                args=(rep, rid, body, deadline, results),
+                daemon=True,
+            ).start()
+
+        first = self._select(body, tried)
+        if first is None:
+            m.no_replica.inc()
+            return (503, {"error": "no replica available", "shed": True,
+                          "reason": "no_replica"}, {"Retry-After": "1"})
+        if policy.remaining_ms(deadline, self.clock) <= 0:
+            # expired budget: NEVER dispatched, not even once
+            m.deadline_exceeded.inc()
+            return 504, {"error": "deadline exceeded"}, {}
+        launch(first)
+        hedge_delay_s = (
+            self.latency.hedge_delay_ms(self.hedge_floor_ms) / 1000.0
+            if self.hedge_enabled else None
+        )
+
+        while True:
+            rem_s = policy.remaining_ms(deadline, self.clock) / 1000.0
+            if rem_s <= 0:
+                # out of budget with attempts still in flight: the
+                # client's answer is 504 NOW; cancel what remains
+                for rid, (rep, _) in outstanding.items():
+                    self._cancel_attempt(rep, rid)
+                m.deadline_exceeded.inc()
+                return 504, {"error": "deadline exceeded"}, {}
+            timeout = rem_s
+            if hedge_delay_s is not None and not hedged:
+                timeout = min(
+                    timeout, max(0.0, (t0 + hedge_delay_s) - self.clock())
+                )
+            try:
+                rid, rep, outcome = results.get(timeout=timeout + 0.002)
+            except queue.Empty:
+                if hedge_delay_s is not None and not hedged:
+                    hedged = True
+                    self._maybe_hedge(body, tried, deadline, launch)
+                continue
+            was_hedge = outstanding.pop(rid, (rep, False))[1]
+
+            if isinstance(outcome, dict):
+                self.latency.record((self.clock() - t0) * 1e3)
+                if was_hedge:
+                    m.hedge_wins.inc()
+                for orid, (orep, _) in outstanding.items():
+                    self._cancel_attempt(orep, orid)
+                return 200, outcome, {}
+
+            if isinstance(outcome, ReplicaShedding):
+                if outcome.reason == "draining":
+                    # deterministic signal, request never admitted: fail
+                    # over for free (no budget spend, no breaker penalty)
+                    rep.draining = True
+                    nxt = self._select(body, tried)
+                    if (nxt is not None
+                            and policy.remaining_ms(deadline, self.clock) > 0):
+                        launch(nxt)
+                        continue
+                else:
+                    m.upstream_sheds.inc()
+                    rep.shed_until = self.clock() + outcome.retry_after_s
+                    last_shed = outcome
+                    nxt = self._select(body, tried)
+                    if (nxt is not None
+                            and policy.remaining_ms(deadline, self.clock) > 0
+                            and retries < self.max_retries
+                            and self.retry_budget.try_spend()):
+                        retries += 1
+                        m.retries.inc()
+                        launch(nxt)
+                        continue
+                if outstanding:
+                    continue  # a hedge may still answer
+                ra = last_shed.retry_after_s if last_shed else 1.0
+                reason = outcome.reason
+                return (503, {"error": str(outcome), "shed": True,
+                              "reason": reason},
+                        {"Retry-After": str(int(math.ceil(ra)))})
+
+            if isinstance(outcome, DeadlineExceeded):
+                if outstanding:
+                    continue
+                m.deadline_exceeded.inc()
+                return 504, {"error": "deadline exceeded"}, {}
+
+            if isinstance(outcome, UpstreamError):
+                # non-retryable (bad request): the replica is fine, the
+                # request is not — pass the upstream verdict through
+                for orid, (orep, _) in outstanding.items():
+                    self._cancel_attempt(orep, orid)
+                return outcome.code, outcome.payload, {}
+
+            # transport failure (ReplicaDown / unexpected): the replica
+            # may be gone — feed the breaker, fail over within budget
+            m.transport_errors.inc(replica=rep.name)
+            self._record_failure(rep)
+            nxt = self._select(body, tried)
+            if (nxt is not None
+                    and policy.remaining_ms(deadline, self.clock) > 0
+                    and retries < self.max_retries
+                    and self.retry_budget.try_spend()):
+                retries += 1
+                m.retries.inc()
+                launch(nxt)
+                continue
+            if outstanding:
+                continue
+            return (502, {"error": f"replica {rep.name} unavailable: "
+                                   f"{outcome}"}, {})
+
+    def _maybe_hedge(self, body: Dict, tried: set, deadline: float,
+                     launch) -> None:
+        """Fire the tail-latency hedge: a second replica gets a duplicate
+        once the primary is slower than p95. Budget-gated (hedges share
+        the retry budget) and chaos-testable: an injected ``router.hedge``
+        fault suppresses the hedge, never the request."""
+        rep = self._select(body, tried)
+        if rep is None:
+            return
+        if policy.remaining_ms(deadline, self.clock) <= 0:
+            return
+        if not self.retry_budget.try_spend():
+            return
+        try:
+            chaos.check("router.hedge")
+        except chaos.FaultInjected:
+            return  # degradation: no hedge this request, primary runs on
+        self.metrics.hedges.inc()
+        launch(rep, hedge=True)
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> Dict:
+        with self._lock:
+            reps = list(self._replicas.values())
+            inflight = self._inflight
+            draining = self._draining
+        out: Dict = {
+            "draining": draining,
+            "inflight": inflight,
+            "retry_budget_tokens": round(self.retry_budget.tokens, 2),
+            "retries_spent": self.retry_budget.spent,
+            "retries_denied": self.retry_budget.denied,
+            "hedge_delay_ms": round(
+                self.latency.hedge_delay_ms(self.hedge_floor_ms), 2
+            ),
+            "replicas": {},
+        }
+        for r in reps:
+            out["replicas"][r.name] = {
+                "url": r.base_url(),
+                "state": r.breaker.state,
+                "draining": r.draining,
+                "weight": r.weight,
+                "inflight": r.inflight,
+                "load": r.load(),
+                "probe_failures": r.probe_failures,
+                "ejections": r.breaker.ejections,
+                "readmissions": r.breaker.readmissions,
+            }
+        return out
+
+
+def make_router_handler(router: ServingRouter):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet
+            log.debug(fmt, *args)
+
+        def _json(self, code: int, payload: dict,
+                  headers: Optional[Dict[str, str]] = None) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                if router.draining:
+                    self._json(503, {"status": "draining"})
+                else:
+                    self._json(200, {"status": "ok"})
+            elif self.path == "/v1/stats":
+                self._json(200, router.stats())
+            elif self.path == "/metrics":
+                body = router.metrics.registry.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path == "/admin/drain":
+                router.drain()
+                self._json(200, {"draining": True})
+                return
+            if self.path != "/v1/generate":
+                self._json(404, {"error": "not found"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length) or b"{}")
+            except Exception as e:
+                self._json(400, {"error": str(e)})
+                return
+            deadline_ms: Optional[float] = None
+            hdr = self.headers.get("X-Deadline-Ms")
+            if hdr is not None:
+                deadline_ms = float(hdr)
+            elif "deadline_ms" in req:
+                deadline_ms = float(req.pop("deadline_ms"))
+            code, payload, extra = router.handle_generate(req, deadline_ms)
+            self._json(code, payload, headers=extra)
+
+    return Handler
+
+
+def router_kwargs(cfg: Dict) -> Dict:
+    """KUBEDL_ROUTER_CONFIG -> ServingRouter kwargs (separate so the
+    config plumbing is testable without binding a server)."""
+    out: Dict = {}
+    for key, cast in (
+        ("probe_interval_s", float), ("probe_timeout_s", float),
+        ("eject_threshold", int), ("readmit_cooldown_s", float),
+        ("hedge_enabled", bool), ("hedge_floor_ms", float),
+        ("hedge_default_ms", float), ("retry_budget_ratio", float),
+        ("max_retries", int), ("default_deadline_ms", float),
+        ("affinity_prefix_len", int),
+    ):
+        if key in cfg:
+            out[key] = cast(cfg[key])
+    out["replicas"] = [
+        (r["name"], r.get("host", "127.0.0.1"), int(r["port"]),
+         int(r.get("weight", 100)))
+        for r in cfg.get("replicas", [])
+    ]
+    return out
+
+
+def serve_router_main(env: Optional[Dict[str, str]] = None) -> int:
+    """Router container entrypoint (ThreadRuntime-compatible). Reads
+    KUBEDL_ROUTER_CONFIG: ``{"port": ..., "replicas": [{"name": ...,
+    "host": ..., "port": ...}, ...], <router knobs>}``. SIGTERM drains
+    gracefully (distinguishable 503, finish in-flight, then exit)."""
+    if env:
+        os.environ.update({k: v for k, v in env.items() if isinstance(v, str)})
+    cfg = json.loads(os.environ.get("KUBEDL_ROUTER_CONFIG", "{}"))
+    router = ServingRouter(**router_kwargs(cfg))
+    router.start()
+    port = int(cfg.get("port", 8081))
+    host = cfg.get("host") or os.environ.get("KUBEDL_SERVE_HOST", "127.0.0.1")
+    server = ThreadingHTTPServer((host, port), make_router_handler(router))
+    log.info("routing %d replicas on :%d", len(cfg.get("replicas", [])), port)
+
+    drain_grace = float(cfg.get("drain_grace_s", 10.0))
+
+    def graceful_stop() -> None:
+        router.drain(wait=True, timeout_s=drain_grace)
+        server.shutdown()
+
+    try:
+        import signal
+
+        signal.signal(
+            signal.SIGTERM,
+            lambda *_: threading.Thread(
+                target=graceful_stop, daemon=True
+            ).start(),
+        )
+    except (ValueError, OSError):
+        pass  # not the main thread: the cancel event below drains
+
+    cancel = (env or {}).get("_KUBEDL_CANCEL")
+    if cancel is not None:
+        def watch():
+            cancel.wait()
+            graceful_stop()
+
+        threading.Thread(target=watch, daemon=True).start()
+    try:
+        server.serve_forever(poll_interval=0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        router.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(serve_router_main())

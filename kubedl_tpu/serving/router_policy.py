@@ -1,0 +1,282 @@
+"""Host-side routing policy: the decisions, without the HTTP.
+
+Everything the router (kubedl_tpu/serving/router.py) decides — eject or
+trust a replica, retry or surface an error, hedge or wait, which replica
+owns a prompt prefix — lives here as small deterministic state machines
+so the policy layer is unit-testable with fake clocks and no sockets.
+The mechanisms are the tail-at-scale toolkit (PAPERS.md): circuit
+breakers for fast failure detection, retry *budgets* (not counts) so
+retries cannot amplify an overload, p95-based hedging for tail latency,
+and consistent hashing so the fleet keeps PR 4's prefix-cache hit rate.
+
+docs/serving.md "Router" documents the knobs; docs/robustness.md has the
+failure-modes table these policies implement.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# -- circuit breaker --------------------------------------------------------
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Per-replica breaker: ``fail_threshold`` CONSECUTIVE failures open
+    it (replica ejected from routing), after ``cooldown_s`` it half-opens
+    and admits exactly one trial (the health probe); a success closes it,
+    a failure re-opens with a fresh cooldown. Consecutive — not windowed —
+    because a replica that answers at all is better kept in rotation and
+    judged by the retry layer."""
+
+    def __init__(self, fail_threshold: int = 3, cooldown_s: float = 2.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.fail_threshold = max(1, int(fail_threshold))
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.ejections = 0          # CLOSED/HALF_OPEN -> OPEN transitions
+        self.readmissions = 0       # HALF_OPEN -> CLOSED transitions
+        self._opened_at = 0.0
+        self._trial_out = False     # half-open: one probe in flight
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self.state != CLOSED:
+                self.readmissions += 1
+            self.state = CLOSED
+            self.consecutive_failures = 0
+            self._trial_out = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.consecutive_failures += 1
+            self._trial_out = False
+            if self.state == HALF_OPEN:
+                # the trial failed: back to OPEN, restart the cooldown
+                self.state = OPEN
+                self._opened_at = self.clock()
+            elif (self.state == CLOSED
+                    and self.consecutive_failures >= self.fail_threshold):
+                self.state = OPEN
+                self.ejections += 1
+                self._opened_at = self.clock()
+
+    def allow(self) -> bool:
+        """May a request (or probe) be sent to this replica right now?
+        OPEN converts to HALF_OPEN once the cooldown elapses, and
+        HALF_OPEN admits exactly ONE in-flight trial at a time."""
+        with self._lock:
+            if self.state == CLOSED:
+                return True
+            if self.state == OPEN:
+                if self.clock() - self._opened_at < self.cooldown_s:
+                    return False
+                self.state = HALF_OPEN
+                self._trial_out = True
+                return True
+            # HALF_OPEN: only the single outstanding trial
+            if self._trial_out:
+                return False
+            self._trial_out = True
+            return True
+
+    @property
+    def available(self) -> bool:
+        """Cheap availability view for replica *selection* (no state
+        transition): CLOSED, or OPEN past its cooldown."""
+        with self._lock:
+            if self.state == CLOSED:
+                return True
+            if self.state == OPEN:
+                return self.clock() - self._opened_at >= self.cooldown_s
+            return not self._trial_out
+
+
+# -- retry budget -----------------------------------------------------------
+
+class RetryBudget:
+    """Retries as a FRACTION of traffic, not a per-request count: each
+    accepted request deposits ``ratio`` tokens, each retry (or hedge)
+    withdraws one. Under a fleet-wide overload the budget drains and
+    retries stop — the classic retry-storm amplifier (N clients x M
+    attempts) is capped at ``1 + ratio`` of offered load.
+    ``min_tokens`` keeps a trickle so a cold router can still fail over."""
+
+    def __init__(self, ratio: float = 0.2, min_tokens: float = 2.0,
+                 max_tokens: float = 100.0) -> None:
+        self.ratio = float(ratio)
+        self.min_tokens = float(min_tokens)
+        self.max_tokens = float(max_tokens)
+        self._lock = threading.Lock()
+        self._tokens = self.min_tokens
+        self.spent = 0      # granted retries/hedges
+        self.denied = 0     # withdrawals refused (budget exhausted)
+
+    def on_request(self) -> None:
+        with self._lock:
+            self._tokens = min(self.max_tokens, self._tokens + self.ratio)
+
+    def try_spend(self) -> bool:
+        with self._lock:
+            # epsilon: N deposits of ratio=1/N must sum to a whole token
+            if self._tokens >= 1.0 - 1e-9:
+                self._tokens -= 1.0
+                self.spent += 1
+                return True
+            self.denied += 1
+            return False
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+
+# -- latency tracking (hedge delay) -----------------------------------------
+
+class LatencyTracker:
+    """Sliding window of request latencies; the hedge fires when the
+    primary has been out longer than p95 — by definition ~5% of requests
+    hedge, the tail-at-scale sweet spot. Until ``min_samples`` real
+    latencies exist, hedging uses ``default_ms`` (conservatively high so
+    a cold router does not double its own traffic)."""
+
+    def __init__(self, window: int = 512, min_samples: int = 20,
+                 default_ms: float = 1000.0) -> None:
+        self._lock = threading.Lock()
+        self._samples: "deque[float]" = deque(maxlen=window)
+        self.min_samples = int(min_samples)
+        self.default_ms = float(default_ms)
+
+    def record(self, ms: float) -> None:
+        with self._lock:
+            self._samples.append(float(ms))
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            n = len(self._samples)
+            if n < self.min_samples:
+                return None
+            srt = sorted(self._samples)
+        return srt[min(n - 1, int(n * q))]
+
+    def hedge_delay_ms(self, floor_ms: float = 0.0) -> float:
+        p95 = self.quantile(0.95)
+        if p95 is None:
+            return max(self.default_ms, floor_ms)
+        return max(p95, floor_ms)
+
+
+# -- deadlines --------------------------------------------------------------
+
+def deadline_at(budget_ms: float,
+                clock: Callable[[], float] = time.monotonic) -> float:
+    """Absolute (monotonic-clock) deadline for a client budget."""
+    return clock() + max(0.0, float(budget_ms)) / 1000.0
+
+
+def remaining_ms(deadline: float,
+                 clock: Callable[[], float] = time.monotonic) -> float:
+    """Remaining budget in ms; <= 0 means expired (never dispatch)."""
+    return (deadline - clock()) * 1000.0
+
+
+# -- prefix affinity (consistent hashing) -----------------------------------
+
+def _stable_hash(data: bytes) -> int:
+    # NOT the builtin hash(): PYTHONHASHSEED would shuffle the ring every
+    # process restart and the affinity (and its cache hit rate) with it
+    return int.from_bytes(hashlib.sha1(data).digest()[:8], "big")
+
+
+class ConsistentHashRing:
+    """Replica ring with virtual nodes: a prompt-prefix key maps to a
+    deterministic PREFERENCE ORDER of replicas (walk clockwise), so when
+    the owner is ejected/draining the key falls to the same second owner
+    every time — its prefix KV warms exactly one fallback, not a random
+    one. Adding/removing one replica remaps only ~1/N of key space, which
+    is the whole point: a canary shift must not flush every engine's
+    prefix cache (PR 4) fleet-wide."""
+
+    def __init__(self, vnodes: int = 64) -> None:
+        self.vnodes = max(1, int(vnodes))
+        self._ring: List[Tuple[int, str]] = []  # (point, replica name)
+        self._names: List[str] = []
+
+    def rebuild(self, names: Sequence[str]) -> None:
+        ring: List[Tuple[int, str]] = []
+        for name in names:
+            for v in range(self.vnodes):
+                ring.append(
+                    (_stable_hash(f"{name}#{v}".encode()), name)
+                )
+        ring.sort()
+        self._ring = ring
+        self._names = list(names)
+
+    def key_for_prefix(self, prompt_ids: Sequence[int],
+                       prefix_len: int) -> Optional[int]:
+        """Hash point for a prompt's affinity prefix; None when the
+        prompt is shorter than the affinity length (no shared prefix
+        worth pinning — let least-loaded decide) or affinity is disabled
+        (``prefix_len <= 0``)."""
+        if prefix_len <= 0:
+            return None
+        ids = list(prompt_ids)[:prefix_len]
+        if len(ids) < prefix_len:
+            return None
+        return _stable_hash(
+            b",".join(str(int(t)).encode() for t in ids)
+        )
+
+    def preference(self, point: int) -> List[str]:
+        """Distinct replica names in ring order starting at ``point``."""
+        if not self._ring:
+            return []
+        seen: List[str] = []
+        start = bisect.bisect_left(self._ring, (point, ""))
+        n = len(self._ring)
+        for i in range(n):
+            name = self._ring[(start + i) % n][1]
+            if name not in seen:
+                seen.append(name)
+                if len(seen) == len(self._names):
+                    break
+        return seen
+
+
+def pick_replicas(
+    candidates: Dict[str, int],
+    prompt_ids: Sequence[int],
+    ring: ConsistentHashRing,
+    prefix_len: int,
+) -> List[str]:
+    """Routing order for one request: prefix-affinity first (consistent
+    hash on the first ``prefix_len`` prompt tokens, filtered to available
+    replicas), least-loaded (by in-flight count, then name for
+    determinism) as tie-break and fallback. ``candidates`` maps available
+    replica name -> current in-flight count; returns every candidate,
+    best first — the caller takes [0] as primary, [1] as hedge/failover."""
+    if not candidates:
+        return []
+    by_load = sorted(candidates, key=lambda n: (candidates[n], n))
+    point = ring.key_for_prefix(prompt_ids, prefix_len)
+    if point is None:
+        return by_load
+    pref = [n for n in ring.preference(point) if n in candidates]
+    # affinity owner first, then the rest by load: the hedge/failover
+    # target is the least-loaded NON-owner, not the ring's second owner,
+    # so a hot prefix cannot overload two replicas in lockstep
+    rest = [n for n in by_load if not pref or n != pref[0]]
+    return ([pref[0]] if pref else []) + rest

@@ -33,22 +33,31 @@ log = logging.getLogger("kubedl_tpu.serving.server")
 class EngineOverloaded(Exception):
     """Queue-depth/age budget exceeded — callers get 503 + Retry-After
     instead of joining a queue that can no longer meet its latency budget
-    (docs/robustness.md: shedding early keeps the served fraction fast)."""
+    (docs/robustness.md: shedding early keeps the served fraction fast).
 
-    def __init__(self, msg: str, retry_after_s: float = 1.0) -> None:
+    ``reason`` distinguishes the two admission-stop causes the router
+    must treat differently: "overloaded" (come back after Retry-After)
+    vs "draining" (this replica is going away — fail over NOW, and the
+    rejection never counts against the retry budget because the request
+    was never admitted)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0,
+                 reason: str = "overloaded") -> None:
         super().__init__(msg)
         self.retry_after_s = retry_after_s
+        self.reason = reason
 
 
 class _Slot:
     """One in-flight sequence occupying a batch row."""
 
     def __init__(self, prompt, max_tokens: int, temperature: float,
-                 cache_prefix: bool = False) -> None:
+                 cache_prefix: bool = False, request_id: str = "") -> None:
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.temperature = temperature
         self.cache_prefix = cache_prefix  # request opted into insertion
+        self.request_id = request_id  # non-empty: cancellable via cancel()
         self.fed = 0  # inputs consumed (prompt + generated)
         self.pending = 0  # tokens dispatched on device, not yet harvested
         self.cached_len = 0  # prompt tokens grafted from the prefix cache
@@ -195,6 +204,13 @@ class LlamaEngine:
         )
         self._prefix_evictions_seen = 0  # metric delta vs pcache stats
         self._stop = False
+        #: graceful drain (docs/serving.md "Router"): once set, NEW
+        #: requests are rejected with a distinguishable 503 while every
+        #: already-admitted/queued request still runs to completion
+        self._draining = False
+        #: request_id -> slot for requests that opted into cancellation
+        #: (the router's hedge-loser path)
+        self._requests: Dict[str, _Slot] = {}
         #: jitted multi-step decode segments keyed by (n_steps, greedy)
         #: + the PRNG chain for on-device sampling — llama.decode_segment
         self._segments: Dict[tuple, object] = {}
@@ -218,7 +234,8 @@ class LlamaEngine:
         #: device compute instead of idling the chip between segments.
         self._pending: Optional[Dict] = None
         self._stats = {"requests": 0, "tokens_out": 0, "tokens_in": 0,
-                       "shed": 0, "started_at": time.time()}
+                       "shed": 0, "drain_rejects": 0,
+                       "started_at": time.time()}
         #: load-shedding budget: reject (503) instead of queueing once the
         #: queue is deeper than max_queue_depth or its head has waited
         #: longer than max_queue_age_s (the queue is not draining)
@@ -269,18 +286,86 @@ class LlamaEngine:
             self._cv.notify_all()
         self._thread.join(timeout=5)
 
+    # -- graceful drain ----------------------------------------------------
+
+    def drain(self, wait: bool = False, timeout_s: float = 30.0) -> bool:
+        """Stop ADMISSION, not work: new requests get a 503 whose reason
+        is "draining" (vs the shed path's "overloaded" — the router fails
+        those over immediately instead of backing off), while every
+        queued/in-flight request still runs to completion. The graceful
+        half of shutdown that `close()` alone never had — `close()`
+        hard-joins with a 5 s timeout and strands in-flight rows."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        if wait:
+            return self.wait_drained(timeout_s)
+        return True
+
+    def wait_drained(self, timeout_s: float = 30.0) -> bool:
+        """Block until no request is queued, resident in a row, or in
+        flight on device (then `close()` severs nothing). True on idle."""
+        deadline = time.perf_counter() + timeout_s
+        while True:
+            with self._cv:
+                idle = (
+                    not self._waiting
+                    and self._pending is None
+                    and all(s is None for s in self._slots)
+                )
+            if idle:
+                return True
+            if time.perf_counter() >= deadline:
+                return False
+            time.sleep(0.01)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
     # -- request path ------------------------------------------------------
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel a request by id (the router's hedge-loser path): a
+        queued request leaves the admission queue, an in-flight one has
+        its row vacated (same mechanics as the generate() timeout path —
+        prefix pin released, stale device work masked by the harvest's
+        identity check). The waiter wakes with a ``cancelled`` result.
+        Returns False for unknown/already-finished ids."""
+        with self._cv:
+            slot = self._requests.pop(request_id, None)
+            if slot is None or slot.done.is_set():
+                return False
+            try:
+                self._waiting.remove(slot)
+            except ValueError:
+                pass
+            for i, s in enumerate(self._slots):
+                if s is slot:
+                    self._slots[i] = None
+            self._release_prefix_locked(slot)
+            slot.result = {"error": "cancelled", "cancelled": True}
+            slot.done.set()
+            self._cv.notify_all()
+        return True
 
     def generate(self, prompt_ids, max_tokens: int = 16,
                  temperature: float = 0.0, timeout_s: float = 600.0,
-                 cache_prefix: bool = False) -> Dict:
+                 cache_prefix: bool = False, request_id: str = "") -> Dict:
         budget = self.max_seq - 1
         prompt = [int(t) for t in list(prompt_ids)[:budget]]
         if not prompt:
             prompt = [0]
         max_tokens = max(0, min(int(max_tokens), budget - len(prompt)))
-        slot = _Slot(prompt, max_tokens, float(temperature), cache_prefix)
+        slot = _Slot(prompt, max_tokens, float(temperature), cache_prefix,
+                     request_id=request_id)
         with self._cv:
+            if self._draining:
+                self._stats["drain_rejects"] += 1
+                raise EngineOverloaded(
+                    "engine is draining", retry_after_s=1.0,
+                    reason="draining",
+                )
             depth = len(self._waiting)
             head_age = (
                 time.perf_counter() - self._waiting[0].t0 if self._waiting else 0.0
@@ -299,6 +384,8 @@ class LlamaEngine:
                     retry_after_s=retry,
                 )
             self._waiting.append(slot)
+            if request_id:
+                self._requests[request_id] = slot
             self._cv.notify_all()
         if not slot.done.wait(timeout=timeout_s):
             # free the row/queue entry: an abandoned request must not keep
@@ -312,8 +399,10 @@ class LlamaEngine:
                 # a vacated row must not keep its prefix-cache entry
                 # pinned forever — the pin would block eviction for good
                 self._release_prefix_locked(slot)
-        result = slot.result or {"error": "timed out"}
+        result = slot.result or {"error": "timed out", "timed_out": True}
         with self._cv:
+            if request_id:
+                self._requests.pop(request_id, None)
             self._stats["requests"] += 1
             self._stats["tokens_in"] += len(prompt)
             self._stats["tokens_out"] += len(result.get("token_ids", []))
@@ -337,7 +426,11 @@ class LlamaEngine:
             queued = len(self._waiting)
             active = sum(1 for s in self._slots if s is not None)
             ttft = list(self._ttft_recent)
+            draining = self._draining
         up = max(now - out["started_at"], 1e-9)
+        # surfaced so both the router (stop picking this replica, don't
+        # count its rejections as overload) and the autoscaler see drain
+        out["draining"] = draining
         out["uptime_s"] = round(up, 1)
         # windowed rate over min(window, uptime): a fresh engine under a
         # burst reports the burst, a long-idle engine reports ~0
@@ -982,23 +1075,58 @@ def make_handler(engine: LlamaEngine, model_name: str):
             else:
                 self._json(404, {"error": "not found"})
 
+        def _read_json(self) -> dict:
+            length = int(self.headers.get("Content-Length", "0"))
+            return json.loads(self.rfile.read(length) or b"{}")
+
         def do_POST(self):
+            if self.path == "/v1/cancel":
+                # hedge-loser cancellation (router): vacate the request's
+                # queue entry / batch row so the loser never holds a slot
+                try:
+                    req = self._read_json()
+                    ok = engine.cancel(str(req.get("request_id", "")))
+                    self._json(200, {"cancelled": ok})
+                except Exception as e:
+                    self._json(400, {"error": str(e)})
+                return
+            if self.path == "/admin/drain":
+                # stop admission, finish in-flight; the router/controller
+                # polls /v1/stats "draining" + active_slots to know when
+                # deleting the pod severs nothing
+                engine.drain()
+                self._json(200, {"draining": True})
+                return
             if self.path != "/v1/generate":
                 self._json(404, {"error": "not found"})
                 return
             try:
-                length = int(self.headers.get("Content-Length", "0"))
-                req = json.loads(self.rfile.read(length) or b"{}")
+                req = self._read_json()
+                # end-to-end deadline propagation: the router forwards the
+                # client's REMAINING budget in X-Deadline-Ms; an already-
+                # expired budget is a 504 without touching the engine
+                timeout_s = 600.0
+                deadline_hdr = self.headers.get("X-Deadline-Ms")
+                if deadline_hdr is not None:
+                    timeout_s = float(deadline_hdr) / 1000.0
+                    if timeout_s <= 0:
+                        self._json(504, {"error": "deadline exceeded"})
+                        return
                 result = engine.generate(
                     req.get("prompt_ids", []),
                     int(req.get("max_tokens", 16)),
                     float(req.get("temperature", 0.0)),
+                    timeout_s=timeout_s,
                     cache_prefix=bool(req.get("cache_prefix", False)),
+                    request_id=str(req.get("request_id", "")),
                 )
+                if result.get("timed_out") and deadline_hdr is not None:
+                    self._json(504, {"error": "deadline exceeded"})
+                    return
                 self._json(200, result)
             except EngineOverloaded as e:
                 self._json(
-                    503, {"error": str(e), "shed": True},
+                    503, {"error": str(e), "shed": True, "reason": e.reason},
                     headers={"Retry-After": str(int(e.retry_after_s + 0.999))},
                 )
             except Exception as e:  # serving must not die on a bad request
@@ -1072,11 +1200,34 @@ def serve_main(env: Optional[Dict[str, str]] = None) -> int:
     )
     log.info("serving %s on :%d", model_name, port)
 
+    drain_grace = float(cfg.get("drain_grace_s", 10.0))
+
+    def graceful_stop() -> None:
+        # graceful drain: stop admission (distinguishable 503), let every
+        # queued/in-flight decode finish (bounded by drain_grace_s), THEN
+        # stop serving — a SIGTERM from a canary shift or scale-down never
+        # severs an in-flight stream
+        engine.drain()
+        engine.wait_drained(drain_grace)
+        server.shutdown()
+
+    try:
+        import signal
+
+        signal.signal(
+            signal.SIGTERM,
+            lambda *_: threading.Thread(
+                target=graceful_stop, daemon=True
+            ).start(),
+        )
+    except (ValueError, OSError):
+        pass  # not the main thread (ThreadRuntime): cancel event below
+
     cancel = (env or {}).get("_KUBEDL_CANCEL")
     if cancel is not None:
         def watch():
             cancel.wait()
-            server.shutdown()
+            graceful_stop()
 
         threading.Thread(target=watch, daemon=True).start()
     try:

@@ -73,6 +73,10 @@ class PredictorStatus:
     ready_replicas: int = 0
     image: str = ""  # model artifact ref being served
     message: str = ""
+    #: RUNNING pods whose stats probe has failed consecutively past the
+    #: controller's NotReady threshold — the replica is up but unreachable
+    #: (previously these silently dropped out of the QPS math)
+    not_ready: List[str] = field(default_factory=list)
 
 
 @dataclass

@@ -22,6 +22,8 @@ REPO = Path(__file__).resolve().parent.parent
 ARTIFACT = "BENCH_r05_builder.json"
 #: prefix-cache serving row (r6): separate artifact, same runs[] shape
 PREFIX_ARTIFACT = "BENCH_r06_prefix.json"
+#: router availability row (r7): separate artifact, same runs[] shape
+ROUTER_ARTIFACT = "BENCH_r07_router.json"
 
 
 def _runs_median(runs, *path) -> float:
@@ -92,6 +94,25 @@ def expected_prefix_strings(artifact: dict) -> dict:
     }
 
 
+def expected_router_strings(artifact: dict) -> dict:
+    """README router row strings derived from BENCH_r07_router.json."""
+    runs = artifact["runs"]
+    tgt = ("targets", "router_availability")
+    avail = _runs_median(runs, *tgt, "availability_pct")
+    lost = _runs_median(runs, *tgt, "lost")
+    burst = _runs_median(runs, *tgt, "error_burst")
+    reqs = _runs_median(runs, *tgt, "requests")
+    readmit = _runs_median(runs, *tgt, "readmit_after_restart_ms")
+    return {
+        f"**{avail:.0f}%** availability":
+            "median of runs[].targets.router_availability.availability_pct",
+        f"{lost:.0f} lost / {burst:.0f} errored of {reqs:.0f} requests":
+            "medians of runs[].targets.router_availability.lost/error_burst/requests",
+        f"breaker readmit **{readmit / 1000:.1f} s** after restart":
+            "median of runs[].targets.router_availability.readmit_after_restart_ms",
+    }
+
+
 def check(repo: Path = REPO) -> list:
     """Returns a list of mismatch descriptions (empty = README is clean)."""
     artifact = json.loads((repo / ARTIFACT).read_text())
@@ -100,6 +121,11 @@ def check(repo: Path = REPO) -> list:
     expected.update(
         expected_prefix_strings(
             json.loads((repo / PREFIX_ARTIFACT).read_text())
+        )
+    )
+    expected.update(
+        expected_router_strings(
+            json.loads((repo / ROUTER_ARTIFACT).read_text())
         )
     )
     problems = []

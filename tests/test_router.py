@@ -1,0 +1,626 @@
+"""Serving-router tests (docs/serving.md "Router"): policy state machines
+with fake clocks, the routed request path against stub HTTP replicas, and
+the tier-1 bit-identity contract — greedy outputs through the router match
+direct engine calls exactly (routing/hedging must not change results)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubedl_tpu.serving import router_policy as policy
+from kubedl_tpu.serving.router import ServingRouter, router_kwargs
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# policy layer (no sockets)
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_ejects_after_consecutive_failures_only(self):
+        clk = FakeClock()
+        br = policy.CircuitBreaker(fail_threshold=3, cooldown_s=2.0, clock=clk)
+        br.record_failure()
+        br.record_failure()
+        br.record_success()  # streak broken: consecutive, not windowed
+        br.record_failure()
+        br.record_failure()
+        assert br.state == policy.CLOSED
+        br.record_failure()
+        assert br.state == policy.OPEN
+        assert br.ejections == 1
+        assert not br.allow()  # cooling down: no traffic
+
+    def test_half_open_admits_one_trial_then_readmits(self):
+        clk = FakeClock()
+        br = policy.CircuitBreaker(fail_threshold=1, cooldown_s=2.0, clock=clk)
+        br.record_failure()
+        assert br.state == policy.OPEN
+        clk.t += 2.0
+        assert br.allow()       # the single half-open trial
+        assert not br.allow()   # second caller must wait for the verdict
+        br.record_success()
+        assert br.state == policy.CLOSED
+        assert br.readmissions == 1
+        assert br.allow()
+
+    def test_failed_trial_reopens_with_fresh_cooldown(self):
+        clk = FakeClock()
+        br = policy.CircuitBreaker(fail_threshold=1, cooldown_s=2.0, clock=clk)
+        br.record_failure()
+        clk.t += 2.0
+        assert br.allow()
+        br.record_failure()  # trial failed
+        assert br.state == policy.OPEN
+        assert not br.allow()  # cooldown restarted, not inherited
+        clk.t += 2.0
+        assert br.allow()
+
+
+class TestRetryBudget:
+    def test_retries_are_a_fraction_of_traffic(self):
+        b = policy.RetryBudget(ratio=0.1, min_tokens=0.0)
+        assert not b.try_spend()  # empty bucket: no retry
+        for _ in range(10):
+            b.on_request()
+        assert b.try_spend()      # 10 requests x 0.1 = 1 retry earned
+        assert not b.try_spend()
+        assert b.spent == 1 and b.denied == 2
+
+    def test_min_tokens_lets_a_cold_router_fail_over(self):
+        b = policy.RetryBudget(ratio=0.2, min_tokens=2.0)
+        assert b.try_spend() and b.try_spend()
+        assert not b.try_spend()
+
+
+class TestLatencyTracker:
+    def test_conservative_default_until_samples(self):
+        lt = policy.LatencyTracker(min_samples=5, default_ms=1000.0)
+        lt.record(10.0)
+        assert lt.quantile(0.95) is None
+        assert lt.hedge_delay_ms(floor_ms=50.0) == 1000.0
+
+    def test_p95_with_floor(self):
+        lt = policy.LatencyTracker(min_samples=5, default_ms=1000.0)
+        for ms in range(1, 101):
+            lt.record(float(ms))
+        assert lt.quantile(0.95) >= 95.0
+        assert lt.hedge_delay_ms(floor_ms=200.0) == 200.0
+
+
+class TestConsistentHashRing:
+    def test_deterministic_across_processes_and_rebuilds(self):
+        # sha1-based, NOT hash(): PYTHONHASHSEED must not move the ring
+        r1, r2 = policy.ConsistentHashRing(), policy.ConsistentHashRing()
+        r1.rebuild(["a", "b", "c"])
+        r2.rebuild(["a", "b", "c"])
+        for seed in range(20):
+            p = r1.key_for_prefix([seed] * 8, 8)
+            assert r1.preference(p) == r2.preference(p)
+
+    def test_removing_one_replica_remaps_minority(self):
+        big, small = policy.ConsistentHashRing(), policy.ConsistentHashRing()
+        big.rebuild(["a", "b", "c", "d"])
+        small.rebuild(["a", "b", "c"])
+        moved = 0
+        for seed in range(200):
+            p = big.key_for_prefix([seed] * 8, 8)
+            was = big.preference(p)[0]
+            if was != "d" and small.preference(p)[0] != was:
+                moved += 1
+        assert moved == 0  # keys not owned by the removed replica stay put
+
+    def test_short_prompt_has_no_affinity(self):
+        ring = policy.ConsistentHashRing()
+        ring.rebuild(["a", "b"])
+        assert ring.key_for_prefix([1, 2, 3], 8) is None
+
+    def test_pick_replicas_owner_first_then_least_loaded(self):
+        ring = policy.ConsistentHashRing()
+        ring.rebuild(["a", "b", "c"])
+        prompt = [7] * 8
+        owner = ring.preference(ring.key_for_prefix(prompt, 8))[0]
+        cands = {"a": 5, "b": 5, "c": 5}
+        order = policy.pick_replicas(cands, prompt, ring, 8)
+        assert order[0] == owner
+        # hedge/failover target is the least-loaded NON-owner
+        others = [n for n in cands if n != owner]
+        cands2 = dict(cands)
+        cands2[others[0]] = 0
+        assert policy.pick_replicas(cands2, prompt, ring, 8)[1] == others[0]
+        # no affinity (short prompt): pure least-loaded, name tie-break
+        assert policy.pick_replicas({"a": 2, "b": 1}, [1], ring, 8) == ["b", "a"]
+
+    def test_ejected_owner_falls_to_remaining(self):
+        ring = policy.ConsistentHashRing()
+        ring.rebuild(["a", "b"])
+        prompt = [3] * 8
+        owner = ring.preference(ring.key_for_prefix(prompt, 8))[0]
+        other = "b" if owner == "a" else "a"
+        assert policy.pick_replicas({other: 0}, prompt, ring, 8) == [other]
+
+
+# ---------------------------------------------------------------------------
+# routed request path against stub replicas
+# ---------------------------------------------------------------------------
+
+class _StubHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _json(self, code, payload, headers=None):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        st = {"queued": 0, "shed_recent": 0,
+              "draining": self.server.behavior.get("stats_draining", False)}
+        self._json(200, st)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", "0"))
+        req = json.loads(self.rfile.read(n) or b"{}")
+        beh = self.server.behavior
+        if self.path == "/v1/cancel":
+            self.server.cancels.append(req.get("request_id"))
+            self._json(200, {"cancelled": True})
+            return
+        self.server.calls.append(
+            {"req": req, "deadline_ms": self.headers.get("X-Deadline-Ms")}
+        )
+        if beh.get("delay"):
+            time.sleep(beh["delay"])
+        if beh.get("shed"):
+            self._json(503, {"error": "busy", "shed": True,
+                             "reason": beh.get("reason", "overloaded")},
+                       {"Retry-After": str(beh.get("retry_after", 1))})
+            return
+        if beh.get("deadline_504"):
+            self._json(504, {"error": "timed out", "timed_out": True})
+            return
+        self._json(200, {"token_ids": [1, 2, 3], "served_by": self.server.name})
+
+
+def _stub(name, **behavior):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _StubHandler)
+    srv.name = name
+    srv.behavior = behavior
+    srv.calls = []
+    srv.cancels = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    srv._thread = t
+    return srv
+
+
+def _owner_of(names, prefix_len=8):
+    """Which replica the affinity ring makes primary for [7]*prefix_len."""
+    ring = policy.ConsistentHashRing()
+    ring.rebuild(sorted(names))
+    return ring.preference(ring.key_for_prefix([7] * prefix_len, prefix_len))[0]
+
+
+@pytest.fixture
+def fleet():
+    servers = {}
+
+    def make(name, **behavior):
+        servers[name] = _stub(name, **behavior)
+        return servers[name]
+
+    yield make, servers
+    for s in servers.values():
+        s.shutdown()
+        s.server_close()
+
+
+class TestRouterPath:
+    def test_routes_and_propagates_deadline(self, fleet):
+        make, servers = fleet
+        a = make("a")
+        r = ServingRouter([("a", "127.0.0.1", a.server_port)],
+                          hedge_enabled=False)
+        code, payload, _ = r.handle_generate(
+            {"prompt_ids": [1, 2], "max_tokens": 4}, deadline_ms=5000)
+        assert code == 200 and payload["served_by"] == "a"
+        # the REMAINING budget rode X-Deadline-Ms to the engine
+        sent = float(a.calls[0]["deadline_ms"])
+        assert 0 < sent <= 5000
+
+    def test_transport_failure_fails_over_once(self, fleet):
+        make, servers = fleet
+        b = make("b")
+        dead = _stub("a")
+        port = dead.server_port
+        dead.shutdown()
+        dead.server_close()  # connection refused
+        r = ServingRouter([("a", "127.0.0.1", port),
+                           ("b", "127.0.0.1", b.server_port)],
+                          hedge_enabled=False, affinity_prefix_len=0)
+        # force the dead replica primary: give b artificial load via stats
+        with r._lock:
+            r._replicas["b"].stats = {"queued": 50}
+        code, payload, _ = r.handle_generate({"prompt_ids": [1]}, 5000)
+        assert code == 200 and payload["served_by"] == "b"
+        assert r.metrics.retries.value() == 1.0
+        assert r.retry_budget.spent == 1
+
+    def test_eject_then_readmit_via_half_open_probe(self, fleet):
+        make, servers = fleet
+        a = make("a")
+        port = a.server_port
+        r = ServingRouter([("a", "127.0.0.1", port)],
+                          eject_threshold=3, readmit_cooldown_s=0.05,
+                          probe_timeout_s=0.3, hedge_enabled=False)
+        a.shutdown()
+        a.server_close()
+        for _ in range(3):
+            r.probe_once()
+        rep = r._replicas["a"]
+        assert rep.breaker.state == policy.OPEN
+        assert r.metrics.ejections.value(replica="a") == 1.0
+        assert r.handle_generate({"prompt_ids": [1]}, 1000)[0] == 503
+        # replica restarts on the same port; past the cooldown the probe's
+        # half-open trial readmits it — requests never do
+        servers["a"] = _stub("a")
+        servers["a"].server_port_override = None
+        restarted = ThreadingHTTPServer(("127.0.0.1", port), _StubHandler)
+        restarted.name, restarted.behavior = "a", {}
+        restarted.calls, restarted.cancels = [], []
+        threading.Thread(target=restarted.serve_forever, daemon=True).start()
+        try:
+            time.sleep(0.06)
+            r.probe_once()
+            assert rep.breaker.state == policy.CLOSED
+            assert r.metrics.readmissions.value(replica="a") == 1.0
+            assert r.handle_generate({"prompt_ids": [1]}, 1000)[0] == 200
+        finally:
+            restarted.shutdown()
+            restarted.server_close()
+
+    def test_retry_after_honored_no_retry_storm(self, fleet):
+        make, servers = fleet
+        a = make("a", shed=True, retry_after=5)
+        b = make("b", shed=True, retry_after=5)
+        r = ServingRouter([("a", "127.0.0.1", a.server_port),
+                           ("b", "127.0.0.1", b.server_port)],
+                          hedge_enabled=False)
+        code, payload, headers = r.handle_generate({"prompt_ids": [1]}, 5000)
+        assert code == 503 and payload["reason"] == "overloaded"
+        assert headers["Retry-After"] == "5"
+        # one primary + at most max_retries dispatches, never a storm
+        assert len(a.calls) + len(b.calls) == 2
+        # both replicas are inside their Retry-After window now: further
+        # requests are refused at the router without touching the engines
+        code, payload, _ = r.handle_generate({"prompt_ids": [1]}, 5000)
+        assert code == 503 and payload["reason"] == "no_replica"
+        assert len(a.calls) + len(b.calls) == 2
+
+    def test_exhausted_budget_stops_retries(self, fleet):
+        make, servers = fleet
+        a = make("a", shed=True)
+        b = make("b")
+        r = ServingRouter([("a", "127.0.0.1", a.server_port),
+                           ("b", "127.0.0.1", b.server_port)],
+                          hedge_enabled=False, retry_budget_ratio=0.0,
+                          affinity_prefix_len=0)
+        while r.retry_budget.try_spend():
+            pass  # drain the min-token trickle
+        with r._lock:
+            r._replicas["b"].stats = {"queued": 50}  # a goes primary
+        code, payload, _ = r.handle_generate({"prompt_ids": [1]}, 5000)
+        assert code == 503
+        assert len(b.calls) == 0  # no budget -> no failover dispatch
+        assert r.retry_budget.denied > 0
+
+    def test_expired_deadline_never_dispatches(self, fleet):
+        make, servers = fleet
+        a = make("a")
+        r = ServingRouter([("a", "127.0.0.1", a.server_port)],
+                          hedge_enabled=False)
+        code, payload, _ = r.handle_generate({"prompt_ids": [1]}, 0)
+        assert code == 504
+        assert a.calls == []
+        assert r.metrics.deadline_exceeded.value() == 1.0
+
+    def test_engine_deadline_504_is_never_retried_elsewhere(self, fleet):
+        # a request that ran out of budget ON a replica must not be handed
+        # to a second replica — its deadline is just as expired there
+        make, servers = fleet
+        a = make("a", deadline_504=True)
+        b = make("b")
+        r = ServingRouter([("a", "127.0.0.1", a.server_port),
+                           ("b", "127.0.0.1", b.server_port)],
+                          hedge_enabled=False, affinity_prefix_len=0)
+        with r._lock:
+            r._replicas["b"].stats = {"queued": 50}  # a goes primary
+        code, payload, _ = r.handle_generate({"prompt_ids": [1]}, 5000)
+        assert code == 504
+        assert len(a.calls) == 1 and len(b.calls) == 0
+
+    def test_hedge_first_answer_wins_loser_cancelled(self, fleet):
+        make, servers = fleet
+        slow = _owner_of(["a", "b"])
+        fast = "b" if slow == "a" else "a"
+        s = make(slow, delay=0.8)
+        f = make(fast)
+        r = ServingRouter([(slow, "127.0.0.1", s.server_port),
+                           (fast, "127.0.0.1", f.server_port)],
+                          hedge_enabled=True, hedge_floor_ms=50.0,
+                          hedge_default_ms=80.0)
+        t0 = time.monotonic()
+        code, payload, _ = r.handle_generate(
+            {"prompt_ids": [7] * 8, "max_tokens": 4}, 8000)
+        elapsed = time.monotonic() - t0
+        assert code == 200 and payload["served_by"] == fast
+        assert elapsed < 0.7  # won by the hedge, not the slow primary
+        assert r.metrics.hedges.value() == 1.0
+        assert r.metrics.hedge_wins.value() == 1.0
+        # loser cancellation is async best-effort: give it a beat
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and not s.cancels:
+            time.sleep(0.02)
+        assert len(s.cancels) == 1  # the primary's request_id was cancelled
+        assert r.metrics.cancellations.value() == 1.0
+
+    def test_draining_replica_fails_over_free(self, fleet):
+        # drain 503s are deterministic "go elsewhere" signals, not failures:
+        # no retry-budget spend, no breaker penalty, replica marked draining
+        make, servers = fleet
+        draining = _owner_of(["a", "b"])
+        other = "b" if draining == "a" else "a"
+        d = make(draining, shed=True, reason="draining")
+        o = make(other)
+        r = ServingRouter([(draining, "127.0.0.1", d.server_port),
+                           (other, "127.0.0.1", o.server_port)],
+                          hedge_enabled=False)
+        code, payload, _ = r.handle_generate({"prompt_ids": [7] * 8}, 5000)
+        assert code == 200 and payload["served_by"] == other
+        assert r.retry_budget.spent == 0
+        rep = r._replicas[draining]
+        assert rep.draining and rep.breaker.state == policy.CLOSED
+        # next request skips the draining replica outright
+        r.handle_generate({"prompt_ids": [7] * 8}, 5000)
+        assert len(d.calls) == 1
+
+    def test_router_drain_rejects_with_reason(self, fleet):
+        make, servers = fleet
+        a = make("a")
+        r = ServingRouter([("a", "127.0.0.1", a.server_port)])
+        assert r.drain(wait=True, timeout_s=1.0)
+        code, payload, headers = r.handle_generate({"prompt_ids": [1]}, 1000)
+        assert code == 503 and payload["reason"] == "draining"
+        assert "Retry-After" in headers
+        assert a.calls == []
+
+    def test_set_replicas_preserves_breaker_state(self, fleet):
+        make, servers = fleet
+        a = make("a")
+        r = ServingRouter([("a", "127.0.0.1", a.server_port)],
+                          eject_threshold=1, readmit_cooldown_s=60.0)
+        r._record_failure(r._replicas["a"])
+        assert r._replicas["a"].breaker.state == policy.OPEN
+        # a fleet resync must not mass-readmit ejected replicas
+        r.set_replicas([("a", "127.0.0.1", a.server_port),
+                        ("b", "127.0.0.1", a.server_port)])
+        assert r._replicas["a"].breaker.state == policy.OPEN
+        assert r._replicas["b"].breaker.state == policy.CLOSED
+
+    def test_router_kwargs_parses_config(self):
+        kw = router_kwargs({
+            "eject_threshold": "4", "hedge_floor_ms": "25",
+            "replicas": [{"name": "r0", "port": 9000, "weight": 50}],
+        })
+        assert kw["eject_threshold"] == 4
+        assert kw["hedge_floor_ms"] == 25.0
+        assert kw["replicas"] == [("r0", "127.0.0.1", 9000, 50)]
+
+
+def test_sync_from_store_builds_fleet_from_control_plane():
+    """The router's replica set comes from the same store the controller
+    programs: RUNNING predictor pods, engine port from the pod's serve
+    config, canary weight from the TrafficPolicy."""
+    from kubedl_tpu.core.objects import PodPhase
+    from kubedl_tpu.core.store import ObjectStore
+    from kubedl_tpu.lineage.types import ModelVersion, ModelVersionPhase
+    from kubedl_tpu.serving.controller import HTTP_PORT, InferenceController
+    from kubedl_tpu.serving.types import Inference, Predictor
+
+    store = ObjectStore()
+    mv = ModelVersion(model_name="m", phase=ModelVersionPhase.SUCCEEDED,
+                      image="m:v1", storage_root="/tmp/x")
+    mv.metadata.name = "m-v1"
+    store.create(mv)
+    inf = Inference(predictors=[
+        Predictor(name="main", model_version="m-v1", replicas=2),
+    ])
+    inf.metadata.name = "svc"
+    store.create(inf)
+    ctrl = InferenceController(store, local_addresses=True)
+    ctrl.reconcile("default", "svc")
+    for p in store.list("Pod"):
+        def mut(o):
+            o.status.phase = PodPhase.RUNNING
+        store.update_with_retry("Pod", p.metadata.name, "default", mut)
+    ctrl.reconcile("default", "svc")  # TrafficPolicy over ready predictors
+
+    r = ServingRouter(hedge_enabled=False)
+    n = r.sync_from_store(store, "svc")
+    assert n == 2
+    st = r.stats()["replicas"]
+    assert sorted(st) == ["svc-main-0", "svc-main-1"]
+    for rep in st.values():
+        assert rep["url"].endswith(f":{HTTP_PORT}")
+        assert rep["weight"] == 100
+
+
+# ---------------------------------------------------------------------------
+# real engines behind the router
+# ---------------------------------------------------------------------------
+
+class TestRouterEngineIntegration:
+    def _serve(self, engine, name="tiny"):
+        from kubedl_tpu.serving.server import make_handler
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(engine, name))
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+
+    def test_greedy_bit_identical_through_router(self):
+        """Tier-1 acceptance: routing/hedging must not change RESULTS —
+        greedy outputs through the router are bit-identical to a direct
+        engine call, whichever replica serves them."""
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        e1 = LlamaEngine(preset="tiny", max_batch=2, max_seq=64)
+        e2 = LlamaEngine(preset="tiny", max_batch=2, max_seq=64)
+        s1 = s2 = None
+        try:
+            s1, s2 = self._serve(e1), self._serve(e2)
+            r = ServingRouter([("r0", "127.0.0.1", s1.server_port),
+                               ("r1", "127.0.0.1", s2.server_port)],
+                              hedge_enabled=True, hedge_default_ms=5000.0)
+            prompts = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8], [11] * 12]
+            for prompt in prompts:
+                direct = e1.generate(list(prompt), max_tokens=8,
+                                     temperature=0.0)
+                code, payload, _ = r.handle_generate(
+                    {"prompt_ids": list(prompt), "max_tokens": 8,
+                     "temperature": 0.0}, 30_000)
+                assert code == 200
+                assert payload["token_ids"] == direct["token_ids"]
+        finally:
+            for s in (s1, s2):
+                if s is not None:
+                    s.shutdown()
+                    s.server_close()
+            e1.close()
+            e2.close()
+
+    def test_cancel_releases_queue_slot(self):
+        """Hedge-loser cancellation frees the loser's engine queue slot:
+        a cancelled queued request leaves _waiting immediately instead of
+        occupying a batch slot when one frees up."""
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        eng = LlamaEngine(preset="tiny", max_batch=1, max_seq=128)
+        try:
+            done_a, res_b = threading.Event(), {}
+
+            def run_a():
+                eng.generate([1, 2, 3], max_tokens=100)
+                done_a.set()
+
+            ta = threading.Thread(target=run_a, daemon=True)
+            ta.start()
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if eng.stats()["active_slots"] == 1:
+                    break
+                time.sleep(0.005)
+            assert eng.stats()["active_slots"] == 1
+
+            def run_b():
+                res_b["r"] = eng.generate([5, 6], max_tokens=100,
+                                          request_id="loser")
+
+            tb = threading.Thread(target=run_b, daemon=True)
+            tb.start()
+            while time.monotonic() < deadline:
+                if eng.stats()["queued"] == 1:
+                    break
+                time.sleep(0.005)
+            assert eng.stats()["queued"] == 1
+            assert eng.cancel("loser") is True
+            tb.join(timeout=5)
+            assert res_b["r"].get("cancelled") is True
+            assert eng.stats()["queued"] == 0  # slot released NOW
+            assert eng.cancel("loser") is False  # idempotent
+            ta.join(timeout=30)
+            assert done_a.is_set()  # the running request was untouched
+        finally:
+            eng.close()
+
+    def test_engine_drain_rejects_new_finishes_inflight(self):
+        from kubedl_tpu.serving.server import EngineOverloaded, LlamaEngine
+
+        eng = LlamaEngine(preset="tiny", max_batch=1, max_seq=128)
+        try:
+            res = {}
+
+            def run():
+                res["r"] = eng.generate([1, 2], max_tokens=60)
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if eng.stats()["active_slots"] == 1:
+                    break
+                time.sleep(0.005)
+            eng.drain()
+            with pytest.raises(EngineOverloaded) as ei:
+                eng.generate([9], max_tokens=4)
+            assert ei.value.reason == "draining"
+            st = eng.stats()
+            assert st["draining"] is True and st["drain_rejects"] == 1
+            # in-flight work runs to completion despite the drain
+            assert eng.wait_drained(timeout_s=30.0)
+            t.join(timeout=5)
+            assert len(res["r"]["token_ids"]) == 60
+        finally:
+            eng.close()
+
+    def test_http_drain_and_deadline_endpoints(self):
+        import urllib.error
+        import urllib.request
+
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        eng = LlamaEngine(preset="tiny", max_batch=1, max_seq=64)
+        srv = None
+        try:
+            srv = self._serve(eng)
+            base = f"http://127.0.0.1:{srv.server_port}"
+            # an already-expired X-Deadline-Ms is a 504 before any decode
+            req = urllib.request.Request(
+                f"{base}/v1/generate",
+                data=json.dumps({"prompt_ids": [1]}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Deadline-Ms": "0"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 504
+            # POST /admin/drain flips admission off with the drain reason
+            req = urllib.request.Request(f"{base}/admin/drain", data=b"{}")
+            assert json.loads(
+                urllib.request.urlopen(req, timeout=5).read()
+            )["draining"] is True
+            req = urllib.request.Request(
+                f"{base}/v1/generate",
+                data=json.dumps({"prompt_ids": [1]}).encode())
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 503
+            assert json.loads(ei.value.read())["reason"] == "draining"
+        finally:
+            if srv is not None:
+                srv.shutdown()
+                srv.server_close()
+            eng.close()

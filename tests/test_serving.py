@@ -1205,3 +1205,188 @@ class TestPrefixReuse:
         finally:
             ref.close()
             eng.close()
+
+
+class TestProbeFailureSurfacing:
+    """Consecutive stats-probe failures must SURFACE (NotReady condition +
+    event + metric), not silently drop the pod out of the QPS math."""
+
+    def _setup(self, probe):
+        from kubedl_tpu.core.objects import PodPhase
+        from kubedl_tpu.core.store import ObjectStore
+        from kubedl_tpu.lineage.types import ModelVersion, ModelVersionPhase
+        from kubedl_tpu.observability.metrics import ServingMetrics
+        from kubedl_tpu.serving.controller import InferenceController
+        from kubedl_tpu.serving.types import AutoScaleSpec, Inference, Predictor
+
+        store = ObjectStore()
+        mv = ModelVersion(model_name="m", phase=ModelVersionPhase.SUCCEEDED,
+                          image="m:v1")
+        mv.metadata.name = "m-v1"
+        store.create(mv)
+        metrics = ServingMetrics()
+        ctrl = InferenceController(store, local_addresses=True,
+                                   qps_probe=probe, metrics=metrics)
+        inf = Inference()
+        inf.metadata.name = "svc"
+        inf.predictors.append(Predictor(
+            name="main", model_version="m-v1", replicas=1,
+            autoscale=AutoScaleSpec(min_replicas=1, max_replicas=2,
+                                    target_qps=10.0)))
+        store.create(inf)
+        ctrl.reconcile("default", "svc")
+        for p in store.list("Pod"):
+            def mut(o):
+                o.status.phase = PodPhase.RUNNING
+            store.update_with_retry("Pod", p.metadata.name, "default", mut)
+        return store, ctrl, metrics
+
+    def test_consecutive_failures_flip_not_ready_and_back(self):
+        state = {"fail": True}
+
+        def probe(pod):
+            if state["fail"]:
+                raise TimeoutError("stats probe timeout")
+            return {"qps": 1.0, "queued": 0}
+
+        store, ctrl, metrics = self._setup(probe)
+        thresh = ctrl.PROBE_NOTREADY_THRESHOLD
+        for i in range(thresh - 1):
+            ctrl.reconcile("default", "svc")
+            inf = store.get("Inference", "svc")
+            assert inf.predictor_statuses["main"].not_ready == []
+        ctrl.reconcile("default", "svc")  # threshold crossing
+        inf = store.get("Inference", "svc")
+        st = inf.predictor_statuses["main"]
+        assert st.not_ready == ["svc-main-0"]
+        assert "NotReady" in st.message
+        events = [e for e in store.list("Event")
+                  if e.reason == "ReplicaNotReady"]
+        assert len(events) == 1  # fires once at the crossing, no spam
+        assert metrics.probe_failures.value(pod="svc-main-0") == float(thresh)
+        assert metrics.replicas_not_ready.value(inference="svc") == 1.0
+        # a later reconcile past the threshold does NOT re-fire the event
+        ctrl.reconcile("default", "svc")
+        events = [e for e in store.list("Event")
+                  if e.reason == "ReplicaNotReady"]
+        assert len(events) == 1
+        # probe recovers: condition clears
+        state["fail"] = False
+        ctrl.reconcile("default", "svc")
+        inf = store.get("Inference", "svc")
+        assert inf.predictor_statuses["main"].not_ready == []
+        assert metrics.replicas_not_ready.value(inference="svc") == 0.0
+
+    def test_deleted_pod_counter_pruned(self):
+        def probe(pod):
+            raise TimeoutError("down")
+
+        store, ctrl, _ = self._setup(probe)
+        for _ in range(3):
+            ctrl.reconcile("default", "svc")
+        assert ctrl._probe_failures.get("svc-main-0", 0) >= 3
+        store.try_delete("Pod", "svc-main-0", "default")
+        ctrl.reconcile("default", "svc")
+        assert "svc-main-0" not in ctrl._probe_failures
+
+
+class TestDrainBeforeDelete:
+    """Scale-down/GC with a drain window: the controller tells the replica
+    to drain (hook + annotation), waits for idle stats or the grace, and
+    only then deletes — in-flight decodes are never severed."""
+
+    def _setup(self, clock, stats, drained_pods, grace=30.0):
+        from kubedl_tpu.api import constants
+        from kubedl_tpu.core.objects import PodPhase
+        from kubedl_tpu.core.store import ObjectStore
+        from kubedl_tpu.lineage.types import ModelVersion, ModelVersionPhase
+        from kubedl_tpu.serving.controller import InferenceController
+        from kubedl_tpu.serving.types import Inference, Predictor
+
+        store = ObjectStore()
+        mv = ModelVersion(model_name="m", phase=ModelVersionPhase.SUCCEEDED,
+                          image="m:v1")
+        mv.metadata.name = "m-v1"
+        store.create(mv)
+
+        def probe(pod):
+            return stats[pod.metadata.name]
+
+        def hook(pod):
+            drained_pods.append(pod.metadata.name)
+
+        ctrl = InferenceController(store, local_addresses=True,
+                                   qps_probe=probe, clock=clock,
+                                   drain_grace_s=grace, drain_hook=hook)
+        inf = Inference()
+        inf.metadata.name = "svc"
+        inf.predictors.append(Predictor(name="main", model_version="m-v1",
+                                        replicas=2))
+        store.create(inf)
+        ctrl.reconcile("default", "svc")
+        for p in store.list("Pod"):
+            def mut(o):
+                o.status.phase = PodPhase.RUNNING
+            store.update_with_retry("Pod", p.metadata.name, "default", mut)
+        return store, ctrl
+
+    def test_waits_for_idle_then_deletes(self):
+        from kubedl_tpu.api import constants
+
+        t = {"now": 100.0}
+        stats = {"svc-main-0": {"active_slots": 0, "queued": 0},
+                 "svc-main-1": {"active_slots": 2, "queued": 1}}
+        drained = []
+        store, ctrl = self._setup(lambda: t["now"], stats, drained)
+
+        def shrink(o):
+            o.predictors[0].replicas = 1
+        store.update_with_retry("Inference", "svc", "default", shrink)
+        # first sight: drain signal + annotation, pod NOT deleted
+        requeue = ctrl.reconcile("default", "svc")
+        pods = {p.metadata.name for p in store.list("Pod")}
+        assert pods == {"svc-main-0", "svc-main-1"}
+        assert drained == ["svc-main-1"]
+        pod = store.get("Pod", "svc-main-1")
+        assert constants.ANNOTATION_DRAIN_STARTED in pod.metadata.annotations
+        assert any(e.reason == "Draining" for e in store.list("Event"))
+        assert requeue == 1.0  # fast requeue while a drain is pending
+        # still busy inside the grace: the pod survives another pass
+        t["now"] += 1.0
+        ctrl.reconcile("default", "svc")
+        assert len(store.list("Pod")) == 2
+        assert drained == ["svc-main-1"]  # hook fires once, not per pass
+        # replica reports idle -> deleted before the grace expires
+        stats["svc-main-1"] = {"active_slots": 0, "queued": 0}
+        ctrl.reconcile("default", "svc")
+        pods = {p.metadata.name for p in store.list("Pod")}
+        assert pods == {"svc-main-0"}
+
+    def test_grace_expiry_deletes_busy_pod(self):
+        t = {"now": 100.0}
+        stats = {"svc-main-0": {"active_slots": 0, "queued": 0},
+                 "svc-main-1": {"active_slots": 2, "queued": 5}}
+        store, ctrl = self._setup(lambda: t["now"], stats, [], grace=30.0)
+
+        def shrink(o):
+            o.predictors[0].replicas = 1
+        store.update_with_retry("Inference", "svc", "default", shrink)
+        ctrl.reconcile("default", "svc")
+        assert len(store.list("Pod")) == 2
+        t["now"] += 31.0  # grace expired: availability wins, delete anyway
+        ctrl.reconcile("default", "svc")
+        assert {p.metadata.name for p in store.list("Pod")} == {"svc-main-0"}
+
+    def test_zero_grace_preserves_delete_on_sight(self):
+        t = {"now": 0.0}
+        stats = {"svc-main-0": {"active_slots": 0, "queued": 0},
+                 "svc-main-1": {"active_slots": 9, "queued": 9}}
+        drained = []
+        store, ctrl = self._setup(lambda: t["now"], stats, drained, grace=0.0)
+
+        def shrink(o):
+            o.predictors[0].replicas = 1
+        store.update_with_retry("Inference", "svc", "default", shrink)
+        ctrl.reconcile("default", "svc")
+        assert {p.metadata.name for p in store.list("Pod")} == {"svc-main-0"}
+        assert drained == []  # no drain dance when the window is off
