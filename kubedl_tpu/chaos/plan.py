@@ -19,6 +19,7 @@ Injection sites wired in this repo::
     client.http                                  console client transport
     remote.request                               blob-server transport
     serving.dispatch                             device segment dispatch
+    serving.kv_alloc                             KV block allocation failure
     checkpoint.torn                              die between shard + manifest
     store.wal_append                             torn WAL record (half-write)
     store.wal_fsync                              fail the WAL fsync syscall
@@ -63,6 +64,7 @@ SITES: Dict[str, str] = {
     "client.http": "console client transport",
     "remote.request": "blob-server transport",
     "serving.dispatch": "device segment dispatch",
+    "serving.kv_alloc": "KV block allocation failure",
     "checkpoint.torn": "die between shard + manifest",
     "store.wal_append": "torn WAL record (half-write)",
     "store.wal_fsync": "fail the WAL fsync syscall",

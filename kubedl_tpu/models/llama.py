@@ -1036,6 +1036,388 @@ def prefill_batched_from(
     return logits, {"k": new_k, "v": new_v, "pos": pos.astype(jnp.int32)}
 
 
+# ---- paged KV (block-table serving path) -----------------------------------
+#
+# The contiguous batched cache stores row b's position t at cache[l, b, t].
+# The PAGED cache stores it at pool[l, bt[b, t // BS], t % BS]: the cache is
+# a pool of NB fixed-size blocks of BS tokens and each row owns an ordered
+# block list (the [B, MB] block table, MB = max_seq // BS). Rows grow block
+# by block, so resident HBM tracks tokens actually cached instead of
+# max_seq * batch; blocks are refcounted host-side
+# (kubedl_tpu.serving.kv_blocks) so prefix-cache entries share blocks by
+# reference instead of copying whole prefixes into rows.
+#
+# Exactness contract (the tier-1 gate): every paged function below computes
+# the SAME attention math as its contiguous twin over a gathered
+# [B, T, KV, hd] view of the pool, where view position t is logical
+# position t. Valid positions (t < pos) hold bit-identical K/V by
+# induction; masked positions hold garbage that contributes an exact 0.0
+# through the -1e30 mask — the same garbage-beyond-pos contract the
+# contiguous path already relies on. Block-table entries a row does not own
+# point at block 0 (the trash block): writes from vacant rows, padded
+# prefill positions, and budget overshoot land there and are never read.
+
+
+def init_paged_cache(
+    cfg: LlamaConfig, batch: int, max_seq: int, num_blocks: int,
+    block_size: int,
+) -> Params:
+    """Paged serving cache: K/V pools ``[L, NB, BS, KV, hd]`` + per-row
+    positions + the ``[B, MB]`` block table (all entries start at the
+    trash block 0). ``max_seq`` must be a multiple of ``block_size`` so
+    the gathered view is exactly [B, max_seq, KV, hd]."""
+    if max_seq % block_size != 0:
+        raise ValueError(
+            f"max_seq {max_seq} not a multiple of block_size {block_size}"
+        )
+    shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads,
+             cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((batch,), jnp.int32),
+        "bt": jnp.zeros((batch, max_seq // block_size), jnp.int32),
+    }
+
+
+def _paged_view(pool: jax.Array, bt: jax.Array) -> jax.Array:
+    """Gather one layer's pool [NB, BS, KV, hd] through the block table
+    [B, MB] into the logical [B, MB*BS, KV, hd] view the contiguous
+    attention math runs over unchanged."""
+    B, MB = bt.shape
+    BS = pool.shape[1]
+    return pool[bt].reshape(B, MB * BS, pool.shape[2], pool.shape[3])
+
+
+def paged_decode_step_batched(
+    params: Params, cache: Params, tokens: jax.Array, cfg: LlamaConfig
+) -> Tuple[jax.Array, Params]:
+    """Block-table twin of :func:`decode_step_batched`: scatter the new
+    K/V into each row's current block at ``(bt[b, pos//BS], pos%BS)``,
+    then attend over the gathered view with the identical per-row
+    validity mask. Rows whose table entry is unmapped write to the trash
+    block (vacant rows keep advancing pos exactly like the contiguous
+    path — their writes just land in garbage)."""
+    B = tokens.shape[0]
+    hd = cfg.head_dim
+    pos = cache["pos"]  # [B]
+    bt = cache["bt"]  # [B, MB]
+    BS = cache["k"].shape[2]
+    max_s = bt.shape[1] * BS
+    x = gather_embed(params["embed"], tokens).astype(cfg.dtype)  # [B, 1, D]
+    if cfg.embed_scale:
+        x = x * math.sqrt(cfg.dim)
+    cos, sin = rope_freqs(cfg, max_s)
+    cos_t = cos[pos][:, None, None, :]
+    sin_t = sin[pos][:, None, None, :]
+    valid = (jnp.arange(max_s)[None, :] <= pos[:, None])  # [B, T]
+    mask = valid[:, None, None, None, :]
+    blk = bt[jnp.arange(B), pos // BS]  # [B] current block per row
+    off = pos % BS
+
+    def rot(t):
+        t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate(
+            [t1 * cos_t - t2 * sin_t, t1 * sin_t + t2 * cos_t], axis=-1
+        ).astype(t.dtype)
+
+    def body(x, inp):
+        lp, ckp, cvp = inp  # ckp/cvp: [NB, BS, KV, hd] this layer's pool
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps, cfg.norm_plus_one)
+        q = rot((h @ deq(lp["wq"])).reshape(B, 1, cfg.n_heads, hd))
+        k = rot((h @ deq(lp["wk"])).reshape(B, 1, cfg.n_kv_heads, hd))
+        v = (h @ deq(lp["wv"])).reshape(B, 1, cfg.n_kv_heads, hd)
+        ckp = ckp.at[blk, off].set(k[:, 0])
+        cvp = cvp.at[blk, off].set(v[:, 0])
+        attn = attention(
+            q, _paged_view(ckp, bt), _paged_view(cvp, bt),
+            causal=False, mask=mask,
+        )
+        x = x + attn.reshape(B, 1, cfg.n_heads * hd) @ deq(lp["wo"])
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps, cfg.norm_plus_one)
+        gate = _act(cfg)((h @ deq(lp["w_gate"])).astype(jnp.float32)).astype(h.dtype)
+        x = x + (gate * (h @ deq(lp["w_up"]))) @ deq(lp["w_down"])
+        return x, (ckp, cvp)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.norm_plus_one)
+    logits = (x[:, 0] @ lm_head_of(params, cfg)).astype(jnp.float32)
+    return logits, {
+        "k": new_k,
+        "v": new_v,
+        "pos": jnp.minimum(pos + 1, max_s - 1),
+        "bt": bt,
+    }
+
+
+def paged_decode_segment(
+    params: Params,
+    cache: Params,
+    tokens: jax.Array,  # [B, 1] first input token per row
+    temps: jax.Array,  # [B] sampling temperature; <= 0 = greedy
+    key: jax.Array,
+    cfg: LlamaConfig,
+    n_steps: int,
+    greedy: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array, Params]:
+    """Block-table twin of :func:`decode_segment` — same on-device
+    sample->feed chain and return contract, over the paged step. The
+    engine reserves blocks covering ``pos + n_steps`` for every decoding
+    row BEFORE dispatch, so in-segment writes never need a host trip."""
+    keys = jax.random.split(key, n_steps + 1)
+    next_key, gumbel_keys = keys[0], keys[1:]
+
+    def body(carry, step_key):
+        cache, toks = carry
+        logits, cache = paged_decode_step_batched(params, cache, toks, cfg)
+        if greedy:
+            z = logits
+        else:
+            g = jax.random.gumbel(step_key, logits.shape, dtype=logits.dtype)
+            z = jnp.where(
+                temps[:, None] > 0.0,
+                logits / jnp.maximum(temps[:, None], 1e-4) + g,
+                logits,
+            )
+        nxt = jnp.argmax(z, axis=-1).astype(jnp.int32)[:, None]
+        return (cache, nxt), nxt[:, 0]
+
+    (cache, last), toks = lax.scan(body, (cache, tokens), gumbel_keys)
+    return toks.T, last, next_key, cache
+
+
+def _paged_suffix_forward(
+    params: Params,
+    cache: Params,
+    tokens: jax.Array,  # [B, S] right-padded suffix tokens
+    lengths: jax.Array,  # [B] suffix lengths; 0 = row untouched
+    starts: jax.Array,  # [B] per-row global start offset
+    cfg: LlamaConfig,
+) -> Tuple[jax.Array, Params]:
+    """Shared body of paged prefill and speculative verify: run suffix
+    tokens at global positions ``starts[b] + s`` against the gathered
+    cache view (offset causal mask, same math as
+    :func:`prefill_batched_from`), scattering their K/V into each row's
+    blocks. Pad positions (``s >= lengths[b]``) and inactive rows route
+    their writes to the trash block — which retires the contiguous
+    path's dispatch-time graft-overflow fixup for paged engines: a
+    clamped write can only ever land in garbage, never inside a row.
+    Returns (final-norm hidden states [B, S, D], updated cache)."""
+    B, S = tokens.shape
+    hd = cfg.head_dim
+    bt = cache["bt"]
+    BS = cache["k"].shape[2]
+    max_s = bt.shape[1] * BS
+    active = lengths > 0
+    x = gather_embed(params["embed"], tokens).astype(cfg.dtype)
+    if cfg.embed_scale:
+        x = x * math.sqrt(cfg.dim)
+    cos_full, sin_full = rope_freqs(cfg, max_s)
+    posq = jnp.minimum(
+        starts[:, None] + jnp.arange(S)[None, :], max_s - 1
+    )  # [B, S]
+    cos_t = cos_full[posq][:, :, None, :]
+    sin_t = sin_full[posq][:, :, None, :]
+    mask = (
+        jnp.arange(max_s)[None, None, :] <= posq[:, :, None]
+    )[:, None, None]  # [B, 1, 1, S, T]
+    # scatter targets: pad/inactive positions write to the trash block
+    writable = active[:, None] & (jnp.arange(S)[None, :] < lengths[:, None])
+    blk = jnp.where(writable, bt[jnp.arange(B)[:, None], posq // BS], 0)
+    off = posq % BS
+
+    def rot(t):
+        t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate(
+            [t1 * cos_t - t2 * sin_t, t1 * sin_t + t2 * cos_t], axis=-1
+        ).astype(t.dtype)
+
+    def body(x, inp):
+        lp, ckp, cvp = inp
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps, cfg.norm_plus_one)
+        q = rot((h @ deq(lp["wq"])).reshape(B, S, cfg.n_heads, hd))
+        k = rot((h @ deq(lp["wk"])).reshape(B, S, cfg.n_kv_heads, hd))
+        v = (h @ deq(lp["wv"])).reshape(B, S, cfg.n_kv_heads, hd)
+        ckp = ckp.at[blk, off].set(k)
+        cvp = cvp.at[blk, off].set(v)
+        attn = attention(
+            q, _paged_view(ckp, bt), _paged_view(cvp, bt),
+            causal=False, mask=mask,
+        )
+        x = x + attn.reshape(B, S, cfg.n_heads * hd) @ deq(lp["wo"])
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps, cfg.norm_plus_one)
+        gate = _act(cfg)((h @ deq(lp["w_gate"])).astype(jnp.float32)).astype(h.dtype)
+        x = x + (gate * (h @ deq(lp["w_up"]))) @ deq(lp["w_down"])
+        return x, (ckp, cvp)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.norm_plus_one)
+    pos = jnp.where(
+        active, jnp.minimum(starts + lengths, max_s - 1), cache["pos"]
+    )
+    return x, {
+        "k": new_k, "v": new_v, "pos": pos.astype(jnp.int32), "bt": bt,
+    }
+
+
+def paged_prefill_batched(
+    params: Params,
+    cache: Params,
+    tokens: jax.Array,
+    lengths: jax.Array,
+    cfg: LlamaConfig,
+) -> Tuple[jax.Array, Params]:
+    """Block-table twin of :func:`prefill_batched` (whole prompts from
+    position 0): last-token logits + updated cache.
+
+    NOT routed through the suffix forward: prompts starting at 0 attend
+    only to their own fresh K/V, so this mirrors `prefill_batched`'s
+    LOCAL causal attention — identical ops on identical inputs, which is
+    what makes the tier-1 bit-identity gate hold for the prefill leg —
+    and only the cache WRITE differs (scatter into blocks instead of a
+    contiguous row update)."""
+    B, S = tokens.shape
+    hd = cfg.head_dim
+    bt = cache["bt"]
+    BS = cache["k"].shape[2]
+    max_s = bt.shape[1] * BS
+    active = lengths > 0
+    x = gather_embed(params["embed"], tokens).astype(cfg.dtype)
+    if cfg.embed_scale:
+        x = x * math.sqrt(cfg.dim)
+    cos, sin = rope_freqs(cfg, S)
+    posw = jnp.minimum(jnp.arange(S), max_s - 1)
+    writable = active[:, None] & (jnp.arange(S)[None, :] < lengths[:, None])
+    blk = jnp.where(writable, bt[:, posw // BS], 0)  # [B, S]
+    off = jnp.broadcast_to((posw % BS)[None, :], (B, S))
+
+    def body(x, inp):
+        lp, ckp, cvp = inp
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps, cfg.norm_plus_one)
+        q = apply_rope((h @ deq(lp["wq"])).reshape(B, S, cfg.n_heads, hd), cos, sin)
+        k = apply_rope((h @ deq(lp["wk"])).reshape(B, S, cfg.n_kv_heads, hd), cos, sin)
+        v = (h @ deq(lp["wv"])).reshape(B, S, cfg.n_kv_heads, hd)
+        attn = attention(q, k, v, causal=True)
+        x = x + attn.reshape(B, S, cfg.n_heads * hd) @ deq(lp["wo"])
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps, cfg.norm_plus_one)
+        gate = _act(cfg)((h @ deq(lp["w_gate"])).astype(jnp.float32)).astype(h.dtype)
+        x = x + (gate * (h @ deq(lp["w_up"]))) @ deq(lp["w_down"])
+        ckp = ckp.at[blk, off].set(k)
+        cvp = cvp.at[blk, off].set(v)
+        return x, (ckp, cvp)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.norm_plus_one)
+    idx = jnp.maximum(lengths - 1, 0)
+    x_last = jnp.take_along_axis(
+        x, idx[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    logits = (x_last @ lm_head_of(params, cfg)).astype(jnp.float32)
+    pos = jnp.where(active, jnp.minimum(lengths, max_s - 1), cache["pos"])
+    return logits, {
+        "k": new_k, "v": new_v, "pos": pos.astype(jnp.int32), "bt": bt,
+    }
+
+
+def paged_prefill_from(
+    params: Params,
+    cache: Params,
+    tokens: jax.Array,
+    lengths: jax.Array,
+    starts: jax.Array,
+    cfg: LlamaConfig,
+) -> Tuple[jax.Array, Params]:
+    """Block-table twin of :func:`prefill_batched_from` (suffix-only
+    prefill over a grafted prefix): last-token logits + updated cache."""
+    x, cache = _paged_suffix_forward(
+        params, cache, tokens, lengths, starts, cfg
+    )
+    idx = jnp.maximum(lengths - 1, 0)
+    x_last = jnp.take_along_axis(
+        x, idx[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    logits = (x_last @ lm_head_of(params, cfg)).astype(jnp.float32)
+    return logits, cache
+
+
+def paged_verify(
+    params: Params,
+    cache: Params,
+    tokens: jax.Array,  # [B, S]: [last accepted token, draft_1..draft_k]
+    lengths: jax.Array,  # [B] k+1 for verifying rows, 0 = untouched
+    starts: jax.Array,  # [B] row position before the verify
+    cfg: LlamaConfig,
+) -> Tuple[jax.Array, Params]:
+    """Speculative verify: score a draft-extended suffix in ONE forward
+    and return the target model's GREEDY token after every position —
+    ``ids[b, j]`` is the argmax continuation after consuming
+    ``tokens[b, j]``. The host accepts the longest prefix where
+    ``draft_j == ids[:, j-1]`` plus the bonus token ``ids[:, a]``; greedy
+    acceptance is exact by construction because every emitted token is
+    the target's own argmax given only accepted history. Rejected-suffix
+    KV stays in the row's blocks as garbage beyond the rolled-back pos
+    (the engine rewinds its host pos mirror and frees now-unneeded
+    blocks)."""
+    x, cache = _paged_suffix_forward(
+        params, cache, tokens, lengths, starts, cfg
+    )
+    logits = (x @ lm_head_of(params, cfg)).astype(jnp.float32)  # [B, S, V]
+    ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return ids, cache
+
+
+def copy_kv_block(cache: Params, src, dst) -> Params:
+    """Copy one block's K/V across all layers (``src`` -> ``dst``, traced
+    scalars: one compile total). The copy-on-write primitive: the engine
+    calls it when a row must append inside a SHARED block — the partial
+    tail of a grafted prefix — so the write lands in a private copy and
+    the prefix entry's block stays immutable for its other readers."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    return {
+        "k": cache["k"].at[:, dst].set(cache["k"][:, src]),
+        "v": cache["v"].at[:, dst].set(cache["v"][:, src]),
+        "pos": cache["pos"],
+        "bt": cache["bt"],
+    }
+
+
+def paged_graft_prefix(
+    cache: Params,
+    k: jax.Array,  # [L, P, KV, hd] array-payload prefix entry (padded)
+    v: jax.Array,
+    row,  # scalar int: batch row to graft into
+    length,  # scalar int: true prefix length (<= P)
+) -> Params:
+    """Array-payload twin of :func:`copy_prefix_into_row` for paged rows:
+    scatter a prefix entry's K/V into ``row``'s blocks at positions
+    [0, P) and set pos to ``length``. Block-ref entries never need this
+    (the engine splices the block table host-side at zero device cost);
+    it exists for entries holding materialized arrays — e.g. inserted by
+    tests or migrated from a contiguous engine. Pad positions beyond the
+    row's allocated blocks hit trash-block table entries and vanish."""
+    L, P, KV, hd = k.shape
+    bt = cache["bt"]
+    BS = cache["k"].shape[2]
+    posw = jnp.minimum(jnp.arange(P), bt.shape[1] * BS - 1)
+    blk = bt[row][posw // BS]  # [P]
+    off = posw % BS
+    length = jnp.asarray(length, jnp.int32)
+    pos = lax.dynamic_update_slice(cache["pos"], length[None], (row,))
+    return {
+        "k": cache["k"].at[:, blk, off].set(k),
+        "v": cache["v"].at[:, blk, off].set(v),
+        "pos": pos,
+        "bt": bt,
+    }
+
+
 def decode_step(
     params: Params, cache: Params, tokens: jax.Array, cfg: LlamaConfig
 ) -> Tuple[jax.Array, Params]:

@@ -411,6 +411,43 @@ class ServingMetrics:
             "kubedl_tpu_serving_prefix_cache_entries",
             "Prefix entries currently resident",
         )
+        # paged KV family (kubedl_tpu/serving/kv_blocks.py): block-pool
+        # occupancy — the autoscaler/router see MEMORY pressure, not
+        # just queue depth
+        self.kv_blocks_total = r.gauge(
+            "kubedl_tpu_serving_kv_blocks_total",
+            "Usable KV blocks in the paged pool (excludes the trash block)",
+        )
+        self.kv_blocks_free = r.gauge(
+            "kubedl_tpu_serving_kv_blocks_free",
+            "KV blocks on the free list",
+        )
+        self.kv_blocks_shared = r.gauge(
+            "kubedl_tpu_serving_kv_blocks_shared",
+            "KV blocks referenced by >= 2 owners (prefix sharing)",
+        )
+        self.kv_preemptions = r.counter(
+            "kubedl_tpu_serving_kv_preemptions",
+            "Decoding rows preempted-and-requeued under block exhaustion",
+        )
+        self.kv_block_sheds = r.counter(
+            "kubedl_tpu_serving_kv_block_sheds",
+            "Requests rejected 503 because free blocks fell below the "
+            "low watermark (hysteresis reopens at the high watermark)",
+        )
+        # speculative decoding family (kubedl_tpu/serving/speculative.py)
+        self.spec_proposed = r.counter(
+            "kubedl_tpu_serving_spec_tokens_proposed",
+            "Draft tokens proposed to verify forwards",
+        )
+        self.spec_accepted = r.counter(
+            "kubedl_tpu_serving_spec_tokens_accepted",
+            "Draft tokens accepted (agreed with the target's greedy argmax)",
+        )
+        self.spec_acceptance_rate = r.gauge(
+            "kubedl_tpu_serving_spec_acceptance_rate",
+            "Lifetime accepted/proposed draft-token ratio",
+        )
         self.ttft_ms = r.histogram(
             "kubedl_tpu_serving_ttft_ms",
             "Per-request time to first token (admission queue + prefill "

@@ -9,6 +9,11 @@ router/console).
 """
 
 from kubedl_tpu.serving.controller import InferenceController  # noqa: F401
+from kubedl_tpu.serving.kv_blocks import BlockAllocator, TRASH_BLOCK  # noqa: F401
 from kubedl_tpu.serving.prefix_cache import PrefixCache, PrefixEntry  # noqa: F401
 from kubedl_tpu.serving.router import ServingRouter  # noqa: F401
+from kubedl_tpu.serving.speculative import (  # noqa: F401
+    NgramDraft, RepeatDraft, ScriptedDraft, SpecStats, accept_length,
+    make_draft,
+)
 from kubedl_tpu.serving.types import Inference, Predictor, TrafficPolicy  # noqa: F401

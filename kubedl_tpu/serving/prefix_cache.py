@@ -42,19 +42,33 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 
 class PrefixEntry:
-    """One cached prefix: token key + device-resident per-layer K/V."""
+    """One cached prefix. Two payload shapes:
 
-    __slots__ = ("tokens", "length", "k", "v", "bytes", "refs", "last_use",
-                 "hits")
+    - **array payload** (contiguous engine): device-resident per-layer
+      K/V copies, ``bytes`` = their nbytes;
+    - **block payload** (paged engine): ``blocks`` holds pool block ids
+      the entry REFERENCES (refcounted by the engine's BlockAllocator —
+      no copy exists), and the caller passes the bytes those references
+      pin via ``nbytes``.
+    """
 
-    def __init__(self, tokens: Tuple[int, ...], k, v, length: int) -> None:
+    __slots__ = ("tokens", "length", "k", "v", "blocks", "bytes", "refs",
+                 "last_use", "hits")
+
+    def __init__(self, tokens: Tuple[int, ...], k, v, length: int,
+                 blocks: Optional[Tuple[int, ...]] = None,
+                 nbytes: Optional[int] = None) -> None:
         self.tokens = tokens
         self.length = length  # true prefix length (k/v are bucket-padded)
-        self.k = k  # [L, P, KV, hd]
+        self.k = k  # [L, P, KV, hd] (None for block-payload entries)
         self.v = v
-        self.bytes = int(getattr(k, "nbytes", 0)) + int(
-            getattr(v, "nbytes", 0)
-        )
+        self.blocks = tuple(blocks) if blocks else None
+        if nbytes is not None:
+            self.bytes = int(nbytes)
+        else:
+            self.bytes = int(getattr(k, "nbytes", 0)) + int(
+                getattr(v, "nbytes", 0)
+            )
         self.refs = 0  # in-flight rows using this entry (pin count)
         self.last_use = 0  # LRU clock value at last match/insert
         self.hits = 0
@@ -80,7 +94,13 @@ class PrefixCache:
         min_seen: int = 2,
         max_obs_nodes: int = 100_000,
         max_obs_depth: int = 4096,
+        on_evict=None,
     ) -> None:
+        #: callback(entry) fired whenever an entry leaves the cache via
+        #: eviction/reclaim — the paged engine returns the entry's block
+        #: references to its allocator here. Called under the cache lock;
+        #: the callback must not call back into this cache.
+        self.on_evict = on_evict
         #: HBM byte budget for entry payloads (k+v nbytes)
         self.budget_bytes = int(budget_bytes)
         #: prefixes shorter than this are not worth a graft dispatch
@@ -191,14 +211,20 @@ class PrefixCache:
 
     # -- insertion / eviction ----------------------------------------------
 
-    def insert(self, tokens: Sequence[int], k, v, length: int) -> bool:
-        """Store a prefix entry (payload bucket-padded by the caller).
+    def insert(self, tokens: Sequence[int], k, v, length: int,
+               blocks: Optional[Sequence[int]] = None,
+               nbytes: Optional[int] = None) -> bool:
+        """Store a prefix entry (payload bucket-padded by the caller;
+        or, paged, ``blocks`` references with explicit ``nbytes``).
         Duplicate keys just refresh the existing entry's LRU clock.
         Evicts LRU unpinned entries until the new entry fits; rejects it
         (False) if it cannot fit — pinned bytes never get evicted and a
         single entry larger than the budget never enters."""
         key = tuple(int(t) for t in tokens)
-        entry = PrefixEntry(key, k, v, int(length))
+        entry = PrefixEntry(
+            key, k, v, int(length),
+            blocks=tuple(blocks) if blocks else None, nbytes=nbytes,
+        )
         with self._lock:
             self._clock += 1
             existing = self._entries.get(key)
@@ -235,6 +261,34 @@ class PrefixCache:
         self._stats["evictions"] += 1
         return True
 
+    def reclaim(self, nbytes: int) -> int:
+        """Evict LRU UNPINNED entries until at least ``nbytes`` of budget
+        came back (or nothing evictable remains); returns bytes freed.
+        The paged engine's pressure valve: under block exhaustion, cached
+        prefixes are the first thing to go — they are an optimization,
+        resident rows are work. `on_evict` fires per entry, handing block
+        references back to the allocator."""
+        freed = 0
+        with self._lock:
+            while freed < int(nbytes):
+                before = self._bytes
+                if not self._evict_lru_locked():
+                    break
+                freed += before - self._bytes
+        return freed
+
+    def clear(self) -> None:
+        """Drop EVERY entry — pinned or not — without firing `on_evict`.
+        Error-recovery only: the engine rebuilt its device pool and
+        allocator, so the block references entries hold are already
+        dead and must not be double-freed into the new allocator."""
+        with self._lock:
+            for e in self._entries.values():
+                e.k = e.v = None
+            self._entries.clear()
+            self._root = _Node()
+            self._bytes = 0
+
     def _remove_locked(self, entry: PrefixEntry) -> None:
         del self._entries[entry.tokens]
         self._bytes -= entry.bytes
@@ -251,6 +305,8 @@ class PrefixCache:
                 del parent.children[tok]
             else:
                 break
+        if self.on_evict is not None:
+            self.on_evict(entry)
         entry.k = entry.v = None  # drop device buffer refs eagerly
 
     # -- introspection -----------------------------------------------------

@@ -96,15 +96,43 @@ class LlamaEngine:
                  metrics=None, max_queue_depth: int = 64,
                  max_queue_age_s: float = 30.0,
                  prefix_cache_mb: float = 64.0,
-                 prefix_min_len: int = 8) -> None:
+                 prefix_min_len: int = 8,
+                 kv_layout: str = "paged", kv_block_size: int = 16,
+                 kv_blocks: int = 0, kv_low_watermark: float = 0.05,
+                 kv_high_watermark: float = 0.15,
+                 spec_k: int = 0, spec_draft: str = "ngram") -> None:
         import jax
 
         from kubedl_tpu.models import llama
         from kubedl_tpu.training import checkpoint
 
+        if kv_layout not in ("paged", "contiguous"):
+            raise ValueError(f"unknown kv_layout {kv_layout!r}")
+        if mesh_axes and kv_layout == "paged":
+            # megatron-sharded serving keeps the CONTIGUOUS layout: the
+            # paged pool gather reorders attention reductions enough to
+            # flip near-tie argmaxes under row-parallel psum, which would
+            # break the sharded==unsharded exactness contract. Paged KV
+            # is a single-host batch-density lever.
+            kv_layout = "contiguous"
+            spec_k = 0
+        self.kv_layout = kv_layout
+        self._paged = kv_layout == "paged"
+        self.spec_k = int(spec_k)
+        if self.spec_k and not self._paged:
+            raise ValueError(
+                "speculative decoding requires kv_layout='paged' (the "
+                "verify rollback frees rejected-suffix blocks in place)"
+            )
         self.cfg = llama.preset(preset)
         self.max_seq = max_seq or min(self.cfg.max_seq, 512)
         self.max_batch = batch or max_batch
+        if self._paged:
+            # the gathered view is [B, MB * BS]: max_seq rounds UP to a
+            # whole number of blocks so view position t == logical t
+            bs = max(1, int(kv_block_size))
+            self.kv_block_size = bs
+            self.max_seq = ((self.max_seq + bs - 1) // bs) * bs
         params = llama.llama_init(jax.random.PRNGKey(0), self.cfg)
         if ckpt_dir and checkpoint.latest_step(ckpt_dir) is not None:
             state = checkpoint.restore_checkpoint(ckpt_dir, {"params": params})
@@ -136,32 +164,68 @@ class LlamaEngine:
         self._jax = jax
         # the cache is DONATED: decode/prefill update it in place in HBM
         # instead of allocating a fresh copy every step
-        self._decode = jax.jit(
-            lambda p, c, t: llama.decode_step_batched(p, c, t, self.cfg),
-            donate_argnums=(1,),
-        )
-        self._prefill = jax.jit(
-            lambda p, c, t, l: llama.prefill_batched(p, c, t, l, self.cfg),
-            donate_argnums=(1,),
-        )
-        #: suffix-only prefill (per-row start offsets): newly admitted
-        #: rows with a grafted prefix consume only their uncached tail.
-        #: Same power-of-2 bucketing as _prefill, so compile count stays
-        #: bounded (<= one per bucket per path).
-        self._prefill_from = jax.jit(
-            lambda p, c, t, l, st: llama.prefill_batched_from(
-                p, c, t, l, st, self.cfg
-            ),
-            donate_argnums=(1,),
-        )
-        #: prefix-cache device ops: graft writes a cached entry's K/V
-        #: into a row (donated: in-place in HBM), extract copies a row's
-        #: prefix span out as a new entry (NOT donated — the live cache
-        #: survives). One compile per entry bucket length.
-        self._graft = jax.jit(llama.copy_prefix_into_row, donate_argnums=(0,))
-        self._extract = jax.jit(
-            llama.extract_prefix_from_row, static_argnums=(2,)
-        )
+        if self._paged:
+            self._decode = jax.jit(
+                lambda p, c, t: llama.paged_decode_step_batched(
+                    p, c, t, self.cfg
+                ),
+                donate_argnums=(1,),
+            )
+            self._prefill = jax.jit(
+                lambda p, c, t, l: llama.paged_prefill_batched(
+                    p, c, t, l, self.cfg
+                ),
+                donate_argnums=(1,),
+            )
+            self._prefill_from = jax.jit(
+                lambda p, c, t, l, st: llama.paged_prefill_from(
+                    p, c, t, l, st, self.cfg
+                ),
+                donate_argnums=(1,),
+            )
+            #: paged prefix-cache ops: entries normally share the row's
+            #: blocks by reference (no device copy at all); _graft only
+            #: fires for array-payload entries (direct inserts in tests),
+            #: and _copy_block is the copy-on-write primitive for the
+            #: partial tail block of a graft. One compile each.
+            self._graft = jax.jit(
+                llama.paged_graft_prefix, donate_argnums=(0,)
+            )
+            self._copy_block = jax.jit(
+                llama.copy_kv_block, donate_argnums=(0,)
+            )
+            self._extract = None  # paged inserts never materialize arrays
+        else:
+            self._decode = jax.jit(
+                lambda p, c, t: llama.decode_step_batched(p, c, t, self.cfg),
+                donate_argnums=(1,),
+            )
+            self._prefill = jax.jit(
+                lambda p, c, t, l: llama.prefill_batched(
+                    p, c, t, l, self.cfg
+                ),
+                donate_argnums=(1,),
+            )
+            #: suffix-only prefill (per-row start offsets): newly admitted
+            #: rows with a grafted prefix consume only their uncached tail.
+            #: Same power-of-2 bucketing as _prefill, so compile count
+            #: stays bounded (<= one per bucket per path).
+            self._prefill_from = jax.jit(
+                lambda p, c, t, l, st: llama.prefill_batched_from(
+                    p, c, t, l, st, self.cfg
+                ),
+                donate_argnums=(1,),
+            )
+            #: prefix-cache device ops: graft writes a cached entry's K/V
+            #: into a row (donated: in-place in HBM), extract copies a
+            #: row's prefix span out as a new entry (NOT donated — the
+            #: live cache survives). One compile per entry bucket length.
+            self._graft = jax.jit(
+                llama.copy_prefix_into_row, donate_argnums=(0,)
+            )
+            self._extract = jax.jit(
+                llama.extract_prefix_from_row, static_argnums=(2,)
+            )
         # first-token sampler, ON DEVICE: fetching the prefill logits to
         # sample on the host moved the full [B, V] array over the wire —
         # 8MB for Gemma-2B at B=8, measured ~0.8s of the engine's TTFT on
@@ -182,9 +246,73 @@ class LlamaEngine:
         #: (llama.merge_chain_tokens) so interleaved admissions never force
         #: the chain back through the host
         self._merge_chain = jax.jit(llama.merge_chain_tokens)
-        self._cache = llama.init_batched_cache(
-            self.cfg, self.max_batch, self.max_seq
-        )
+        if self._paged:
+            import math
+
+            import numpy as np
+
+            from kubedl_tpu.serving.kv_blocks import BlockAllocator
+            from kubedl_tpu.serving.speculative import SpecStats, make_draft
+
+            bs = self.kv_block_size
+            mb = self.max_seq // bs
+            #: bytes one block holds across both pools and all layers —
+            #: the unit prefix-cache budget accounting is charged in
+            self._block_bytes = int(
+                2 * self.cfg.n_layers * bs * self.cfg.n_kv_heads
+                * self.cfg.head_dim * np.dtype(self.cfg.dtype).itemsize
+            )
+            if kv_blocks:
+                nb = int(kv_blocks)
+                if nb < mb + 1:
+                    raise ValueError(
+                        f"kv_blocks={nb} cannot hold one max_seq row "
+                        f"({mb} blocks + trash)"
+                    )
+            else:
+                # parity sizing: every batch row can still reach max_seq
+                # (the contiguous footprint), plus headroom for prefix-
+                # cache entries capped at one batch's worth of blocks
+                prefix_blocks = 0
+                if prefix_cache_mb > 0:
+                    prefix_blocks = min(
+                        math.ceil(prefix_cache_mb * 1e6 / self._block_bytes),
+                        self.max_batch * mb,
+                    )
+                nb = 1 + self.max_batch * mb + prefix_blocks
+            self.kv_blocks = nb
+            self._alloc = BlockAllocator(
+                nb, bs, low_watermark=kv_low_watermark,
+                high_watermark=kv_high_watermark,
+            )
+            #: host-authoritative mirrors of the device cache's pos/bt —
+            #: uploaded before EVERY dispatch so rollbacks (speculative
+            #: rejection, preemption, vacation) are just mirror edits
+            self._pos_host = np.zeros((self.max_batch,), np.int32)
+            self._bt_host = np.zeros((self.max_batch, mb), np.int32)
+            self._row_blocks: list = [[] for _ in range(self.max_batch)]
+            self._cache = llama.init_paged_cache(
+                self.cfg, self.max_batch, self.max_seq, nb, bs
+            )
+            self.spec_draft = spec_draft
+            if self.spec_k:
+                self._draft = make_draft(spec_draft)
+                self._spec_stats = SpecStats()
+                self._verify = jax.jit(
+                    lambda p, c, t, l, st: llama.paged_verify(
+                        p, c, t, l, st, self.cfg
+                    ),
+                    donate_argnums=(1,),
+                )
+            else:
+                self._draft = None
+                self._spec_stats = None
+        else:
+            self._cache = llama.init_batched_cache(
+                self.cfg, self.max_batch, self.max_seq
+            )
+            self._draft = None
+            self._spec_stats = None
         from collections import deque as _deque
 
         self._slots: list = [None] * self.max_batch
@@ -198,8 +326,12 @@ class LlamaEngine:
         #: row and prefills only the suffix. 0 MB disables it.
         from kubedl_tpu.serving.prefix_cache import PrefixCache
 
+        #: paged entries hold block REFERENCES, so eviction must give the
+        #: refs back to the allocator (the engine callback frees them)
+        _on_evict = self._paged_entry_evicted if self._paged else None
         self._pcache: Optional[PrefixCache] = (
-            PrefixCache(int(prefix_cache_mb * 1e6), min_len=prefix_min_len)
+            PrefixCache(int(prefix_cache_mb * 1e6), min_len=prefix_min_len,
+                        on_evict=_on_evict)
             if prefix_cache_mb > 0 else None
         )
         self._prefix_evictions_seen = 0  # metric delta vs pcache stats
@@ -235,6 +367,7 @@ class LlamaEngine:
         self._pending: Optional[Dict] = None
         self._stats = {"requests": 0, "tokens_out": 0, "tokens_in": 0,
                        "shed": 0, "drain_rejects": 0,
+                       "kv_preemptions": 0, "kv_sheds": 0,
                        "started_at": time.time()}
         #: load-shedding budget: reject (503) instead of queueing once the
         #: queue is deeper than max_queue_depth or its head has waited
@@ -343,6 +476,7 @@ class LlamaEngine:
             for i, s in enumerate(self._slots):
                 if s is slot:
                     self._slots[i] = None
+                    self._free_row_locked(i)
             self._release_prefix_locked(slot)
             slot.result = {"error": "cancelled", "cancelled": True}
             slot.done.set()
@@ -383,6 +517,20 @@ class LlamaEngine:
                     f"head age {head_age:.1f}s (budget {self.max_queue_age_s}s)",
                     retry_after_s=retry,
                 )
+            if self._paged and not self._alloc.admission_open():
+                # KV-pool pressure sheds too: below the low watermark a
+                # queued request cannot be admitted anyway, so reject at
+                # the door (hysteresis reopens at the high watermark)
+                self._stats["shed"] += 1
+                self._stats["kv_sheds"] += 1
+                self._shed_recent.append(time.time())
+                self.metrics.shed_requests.inc()
+                self.metrics.kv_block_sheds.inc()
+                raise EngineOverloaded(
+                    f"free KV blocks {self._alloc.free_count}/"
+                    f"{self._alloc.total} below low watermark",
+                    retry_after_s=1.0,
+                )
             self._waiting.append(slot)
             if request_id:
                 self._requests[request_id] = slot
@@ -396,6 +544,7 @@ class LlamaEngine:
                 for i, s in enumerate(self._slots):
                     if s is slot:
                         self._slots[i] = None
+                        self._free_row_locked(i)
                 # a vacated row must not keep its prefix-cache entry
                 # pinned forever — the pin would block eviction for good
                 self._release_prefix_locked(slot)
@@ -449,6 +598,10 @@ class LlamaEngine:
             )
         if self._pcache is not None:
             out["prefix_cache"] = self._pcache.stats()
+        if self._paged:
+            out["kv_blocks"] = self._alloc.stats()
+        if self._spec_stats is not None:
+            out["speculative"] = self._spec_stats.snapshot()
         out["pipeline"] = self.pipeline_stats()
         return out
 
@@ -516,21 +669,250 @@ class LlamaEngine:
         cand = min(cand, len(s.prompt) - 1)
         if cand <= s.cached_len or cand < self._pcache.min_len:
             return  # nothing new beyond what the matched entry covers
-        k, v = self._extract(self._cache, i, self._prefill_bucket(cand))
-        if self._pcache.insert(s.prompt[:cand], k, v, cand):
-            st = self._pcache.stats()
-            m = self.metrics
-            m.prefix_inserts.inc()
-            m.prefix_bytes.set(float(st["bytes"]))
-            m.prefix_entries.set(float(st["entries"]))
-            ev = st["evictions"] - self._prefix_evictions_seen
-            if ev > 0:
-                m.prefix_evictions.inc(ev)
-            self._prefix_evictions_seen = st["evictions"]
+        if self._paged:
+            # paged insert is (almost) free: the entry SHARES the row's
+            # full prefix blocks by reference (incref), and only the
+            # partial tail block is device-copied — the row keeps
+            # appending inside its own tail, so the entry needs a
+            # frozen copy (the insert-side half of copy-on-write)
+            bs = self.kv_block_size
+            full = cand // bs
+            row_blocks = self._row_blocks[i]
+            blocks = list(row_blocks[:full])
+            if cand % bs:
+                got = self._alloc.alloc(1)
+                if got is None:
+                    return  # pool pressure: skip the insert
+                self._cache = self._copy_block(
+                    self._cache, row_blocks[full], got[0]
+                )
+                blocks.append(got[0])
+            self._alloc.incref(blocks[:full])
+            ok = self._pcache.insert(
+                s.prompt[:cand], None, None, cand,
+                blocks=tuple(blocks),
+                nbytes=len(blocks) * self._block_bytes,
+            )
+            if not ok:
+                self._alloc.free(blocks)  # duplicate/over-budget: undo
+                return
+        else:
+            k, v = self._extract(self._cache, i, self._prefill_bucket(cand))
+            if not self._pcache.insert(s.prompt[:cand], k, v, cand):
+                return
+        st = self._pcache.stats()
+        m = self.metrics
+        m.prefix_inserts.inc()
+        m.prefix_bytes.set(float(st["bytes"]))
+        m.prefix_entries.set(float(st["entries"]))
+        ev = st["evictions"] - self._prefix_evictions_seen
+        if ev > 0:
+            m.prefix_evictions.inc(ev)
+        self._prefix_evictions_seen = st["evictions"]
+
+    # -- paged KV bookkeeping (host mirrors + block lifecycle) -------------
+
+    def _upload_mirror(self, arr):
+        """Upload a host mirror as an XLA-OWNED device buffer.
+
+        ``jnp.asarray`` zero-copy BORROWS an aligned numpy buffer, and the
+        engine donates the cache into every jitted dispatch — donating a
+        borrowed buffer lets XLA alias segment outputs onto it, which
+        either scribbles sampled tokens into the live mirror or hands the
+        harvest a stale view of the block table (both observed on the CPU
+        backend; whether a given numpy allocation is 64-byte aligned is
+        luck, hence flaky). The no-op add forces materialization into a
+        fresh buffer XLA owns outright."""
+        return self._jax.numpy.asarray(arr) + 0
+
+    def _free_row_locked(self, i: int) -> None:
+        """Return row ``i``'s blocks to the pool and point its table rows
+        at the trash block. Any still-in-flight dispatch keeps writing
+        through its own bt SNAPSHOT, but the device executes enqueued
+        calls in order, so a later owner's writes always land last.
+        Caller holds cv; no-op in contiguous mode."""
+        if not self._paged:
+            return
+        blocks = self._row_blocks[i]
+        if blocks:
+            self._alloc.free(blocks)
+        self._row_blocks[i] = []
+        self._bt_host[i, :] = 0
+        self._pos_host[i] = 0
+
+    def _reserve_locked(self, i: int, n_tokens: int) -> bool:
+        """Grow row ``i``'s block list to cover ``n_tokens`` cached
+        positions (all-or-nothing). Caller holds cv."""
+        need = self._alloc.blocks_for(min(int(n_tokens), self.max_seq))
+        blocks = self._row_blocks[i]
+        if need <= len(blocks):
+            return True
+        got = self._alloc.alloc(need - len(blocks))
+        if got is None:
+            return False
+        self._bt_host[i, len(blocks):need] = got
+        blocks.extend(got)
+        return True
+
+    def _trim_row_locked(self, i: int, n_tokens: int) -> None:
+        """Free row blocks beyond what ``n_tokens`` cached positions need
+        — how a rejected speculative suffix's KV is freed IN PLACE (its
+        positions are beyond the rolled-back pos mirror)."""
+        keep = self._alloc.blocks_for(min(int(n_tokens), self.max_seq))
+        blocks = self._row_blocks[i]
+        if len(blocks) <= keep:
+            return
+        drop = blocks[keep:]
+        del blocks[keep:]
+        self._bt_host[i, keep:keep + len(drop)] = 0
+        self._alloc.free(drop)
+
+    def _paged_entry_evicted(self, entry) -> None:
+        """PrefixCache eviction callback: hand the entry's block
+        references back to the allocator. Runs under the pcache lock and
+        touches only the allocator (its own lock) — never cv."""
+        blocks = getattr(entry, "blocks", None)
+        if blocks:
+            self._alloc.free(blocks)
+
+    def _reclaim_prefix_locked(self) -> bool:
+        """Evict unpinned prefix-cache entries to recover at least one
+        block; True when anything came back. The cheapest relief valve —
+        cache entries are an optimization, resident rows are work."""
+        if self._pcache is None or not self._paged:
+            return False
+        return self._pcache.reclaim(self._block_bytes) > 0
+
+    def _pick_victim_locked(self, held) -> Optional[int]:
+        """Pick the preemption victim: the YOUNGEST resident row (latest
+        arrival — least sunk decode work) that is not in ``held`` and has
+        nothing in flight (``pending`` rows owe tokens to the deferred
+        harvest's count-based accounting)."""
+        best = None
+        for j, s in enumerate(self._slots):
+            if s is None or j in held or s.pending or not self._row_blocks[j]:
+                continue
+            if best is None or s.t0 > self._slots[best].t0:
+                best = j
+        return best
+
+    def _preempt_locked(self, j: int) -> None:
+        """Preempt-and-requeue row ``j`` under block exhaustion: free its
+        blocks, reset the slot to its pre-admission state, and put it at
+        the FRONT of the queue (it was admitted first — it re-admits
+        first once blocks free up). Greedy requests regenerate the exact
+        same tokens from prefill, so preemption never changes output."""
+        s = self._slots[j]
+        self._slots[j] = None
+        self._free_row_locked(j)
+        self._release_prefix_locked(s)
+        s.fed = 0
+        s.cached_len = 0
+        s.out_ids = []
+        s.pending = 0
+        self._waiting.appendleft(s)
+        self._stats["kv_preemptions"] += 1
+        self.metrics.kv_preemptions.inc()
+        log.warning("KV blocks exhausted: preempted row %d (requeued)", j)
+
+    def _reserve_decode_locked(self, decoding, steps: int):
+        """Ensure every decoding row can cache ``steps`` more positions,
+        preempting victims when the pool runs dry (chaos site
+        ``serving.kv_alloc`` injects the failure). Rows that still cannot
+        grow sit this dispatch out and retry next tick. Caller holds cv;
+        returns the surviving rows."""
+        out = []
+        inject = chaos.should_fail("serving.kv_alloc")
+        for i, s in decoding:
+            if self._slots[i] is not s:
+                continue  # preempted earlier in this very loop
+            need = min(int(self._pos_host[i]) + steps, self.max_seq)
+            while True:
+                if not inject and self._reserve_locked(i, need):
+                    out.append((i, s))
+                    break
+                inject = False  # one injected failure exercises the path
+                if self._reclaim_prefix_locked():
+                    continue
+                victim = self._pick_victim_locked({i} | {j for j, _ in out})
+                if victim is None:
+                    break
+                self._preempt_locked(victim)
+        return out
+
+    def _admit_row_paged_locked(self, i: int, slot: _Slot) -> bool:
+        """Admit ``slot`` into row ``i`` under the block allocator: match
+        the prefix cache, SHARE the entry's full blocks by reference
+        (incref — no device copy at all), copy-on-write its partial tail
+        block, and allocate fresh blocks for the suffix. All-or-nothing:
+        on pool exhaustion every side effect is rolled back and the slot
+        stays queued. Caller holds cv."""
+        import jax.numpy as jnp
+
+        a = self._alloc
+        bs = self.kv_block_size
+        need_total = a.blocks_for(min(len(slot.prompt) + 1, self.max_seq))
+        entry, mlen = None, 0
+        if self._pcache is not None:
+            self._pcache.observe(slot.prompt)
+            entry, mlen = self._pcache.match(slot.prompt)
+        entry_blocks = (
+            getattr(entry, "blocks", None) if entry is not None else None
+        )
+        shared: list = []
+        tail_src = None
+        if entry_blocks:
+            full = mlen // bs
+            shared = list(entry_blocks[:full])
+            if mlen % bs:
+                tail_src = entry_blocks[full]
+        n_alloc = need_total - len(shared)
+        got = a.alloc(n_alloc)
+        if got is None and self._reclaim_prefix_locked():
+            got = a.alloc(n_alloc)
+        if got is None:
+            if entry is not None:
+                self._pcache.unpin(entry)
+            return False
+        a.incref(shared)
+        blocks = list(shared)
+        if tail_src is not None:
+            # copy-on-write: the entry's partial tail block is SHARED and
+            # this row's suffix prefill appends inside it — copy before
+            # any divergent write can land
+            tail_copy = got.pop(0)
+            self._cache = self._copy_block(self._cache, tail_src, tail_copy)
+            blocks.append(tail_copy)
+        blocks.extend(got)
+        self._row_blocks[i] = blocks
+        self._bt_host[i, :] = 0
+        self._bt_host[i, :len(blocks)] = blocks
+        self._pos_host[i] = mlen
+        self._slots[i] = slot
+        if entry is None:
+            if self._pcache is not None:
+                self.metrics.prefix_misses.inc()
+            return True
+        self.metrics.prefix_hits.inc()
+        slot.cached_len = mlen
+        slot.pinned = entry
+        if not entry_blocks:
+            # array-payload entry (direct insert): scatter its K/V into
+            # the row's fresh blocks through the just-updated table
+            self._cache["bt"] = self._upload_mirror(self._bt_host)
+            self._cache = self._graft(self._cache, entry.k, entry.v, i, mlen)
+        return True
 
     def _admit_locked(self) -> None:
         for i in range(self.max_batch):
             if self._slots[i] is None and self._waiting:
+                if self._paged:
+                    if not self._alloc.admission_open():
+                        break  # below low watermark: hysteresis holds
+                    if not self._admit_row_paged_locked(i, self._waiting[0]):
+                        break  # pool dry: wait for frees / preemption
+                    self._waiting.popleft()
+                    continue
                 slot = self._waiting.popleft()
                 self._slots[i] = slot
                 # reset this row's position; stale KV is masked by pos
@@ -577,9 +959,34 @@ class LlamaEngine:
                     # too: a segment that failed after the assignment
                     # leaves them referencing poisoned buffers, which
                     # would wedge every later request — re-seed/clear.
-                    self._cache = self._llama.init_batched_cache(
-                        self.cfg, self.max_batch, self.max_seq
-                    )
+                    if self._paged:
+                        from kubedl_tpu.serving.kv_blocks import (
+                            BlockAllocator,
+                        )
+
+                        self._cache = self._llama.init_paged_cache(
+                            self.cfg, self.max_batch, self.max_seq,
+                            self.kv_blocks, self.kv_block_size,
+                        )
+                        self._alloc = BlockAllocator(
+                            self.kv_blocks, self.kv_block_size,
+                            low_watermark=self._alloc.low_watermark,
+                            high_watermark=self._alloc.high_watermark,
+                        )
+                        self._pos_host[:] = 0
+                        self._bt_host[:] = 0
+                        self._row_blocks = [
+                            [] for _ in range(self.max_batch)
+                        ]
+                        if self._pcache is not None:
+                            # every entry references the dead pool's
+                            # blocks — drop them all (no evict callbacks:
+                            # the allocator was just rebuilt)
+                            self._pcache.clear()
+                    else:
+                        self._cache = self._llama.init_batched_cache(
+                            self.cfg, self.max_batch, self.max_seq
+                        )
                     self._key = self._jax.random.PRNGKey(
                         int(time.time()) & 0x7FFFFFFF
                     )
@@ -641,6 +1048,7 @@ class LlamaEngine:
             if s.ttft_ms is not None:
                 s.result["ttft_ms"] = round(s.ttft_ms, 3)
             self._slots[i] = None
+            self._free_row_locked(i)
             self._release_prefix_locked(s)
             s.done.set()
 
@@ -651,10 +1059,13 @@ class LlamaEngine:
         if fn is None:
             import functools
 
+            seg = (
+                self._llama.paged_decode_segment if self._paged
+                else self._llama.decode_segment
+            )
             fn = self._jax.jit(
                 functools.partial(
-                    self._llama.decode_segment,
-                    cfg=self.cfg, n_steps=n_steps, greedy=greedy,
+                    seg, cfg=self.cfg, n_steps=n_steps, greedy=greedy,
                 ),
                 donate_argnums=(1,),
             )
@@ -699,7 +1110,9 @@ class LlamaEngine:
         if pend is None:
             return 0.0, 0.0
         t0 = time.perf_counter()
-        rows = np.asarray(self._jax.device_get(pend["toks"]))  # [B, k]
+        # np.array (copy): device_get may return a zero-copy VIEW of the
+        # device buffer, which a later donated dispatch can reuse
+        rows = np.array(self._jax.device_get(pend["toks"]))  # [B, k]
         t1 = time.perf_counter()
         with self._cv:
             self._pipe["inflight"] = 0
@@ -721,7 +1134,7 @@ class LlamaEngine:
         import numpy as np
 
         t0 = time.perf_counter()
-        ids = np.asarray(self._jax.device_get(ids_dev))
+        ids = np.array(self._jax.device_get(ids_dev))  # copy: see harvest
         t1 = time.perf_counter()
         now = time.perf_counter()
         with self._cv:
@@ -746,6 +1159,94 @@ class LlamaEngine:
             self._admit_locked()
             self._cv.notify_all()
         return (t1 - t0) * 1e3, (time.perf_counter() - t1) * 1e3
+
+    def _spec_tick(self, decoding, acct: Dict) -> None:
+        """One draft-k/verify-1 round over every greedy decoding row.
+
+        Per row: the pluggable draft proposes k tokens from the full host
+        context; the verify forward consumes ``[next_input, d1..dk]`` in
+        ONE batched call (`llama.paged_verify`) and returns the target's
+        greedy argmax after each input. The longest prefix where drafts
+        agree with those argmaxes is accepted, plus one bonus token —
+        every emitted token is the target's own greedy choice given only
+        accepted history, so output is bit-identical to plain decode (the
+        tier-1 gate); speculation only changes how many sequential
+        forwards it takes. The pos mirror then rewinds past the rejected
+        suffix and `_trim_row_locked` frees its KV blocks in place."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        from kubedl_tpu.serving.speculative import accept_length
+
+        k = self.spec_k
+        S = k + 1
+        toks = np.zeros((self.max_batch, S), np.int32)
+        lens = np.zeros((self.max_batch,), np.int32)
+        starts = np.zeros((self.max_batch,), np.int32)
+        with self._cv:
+            rows = []
+            for i, s in decoding:
+                if self._slots[i] is not s:
+                    continue
+                ctx = s.prompt + s.out_ids
+                drafts = [int(t) for t in self._draft.propose(ctx, k)][:k]
+                if len(drafts) < k:
+                    pad = drafts[-1] if drafts else int(ctx[-1])
+                    drafts = drafts + [pad] * (k - len(drafts))
+                toks[i, 0] = s.next_input()
+                toks[i, 1:] = drafts
+                lens[i] = S
+                starts[i] = self._pos_host[i]
+                rows.append((i, s, drafts))
+            # coverage for S appends per row, preempting on exhaustion;
+            # rows the reserve drops sit this verify out entirely
+            surviving = self._reserve_decode_locked(
+                [(i, s) for i, s, _ in rows], S
+            )
+            dmap = {i: d for i, _, d in rows}
+            rows = [(i, s, dmap[i]) for i, s in surviving]
+            for i in set(dmap) - {i for i, _, _ in rows}:
+                lens[i] = 0  # dropped/preempted: inactive in the verify
+        if not rows:
+            return
+        chaos.check("serving.dispatch")
+        self._cache["pos"] = self._upload_mirror(self._pos_host)
+        self._cache["bt"] = self._upload_mirror(self._bt_host)
+        t0 = time.perf_counter()
+        ids_dev, self._cache = self._verify(
+            self.params, self._cache, jnp.asarray(toks),
+            jnp.asarray(lens), jnp.asarray(starts),
+        )
+        acct["dispatch_ms"] += (time.perf_counter() - t0) * 1e3
+        t1 = time.perf_counter()
+        ids = np.array(self._jax.device_get(ids_dev))  # [B, S] (copy)
+        acct["harvest_ms"] += (time.perf_counter() - t1) * 1e3
+        t2 = time.perf_counter()
+        with self._cv:
+            for i, s, drafts in rows:
+                a = accept_length(drafts, ids[i][:k])
+                if self._slots[i] is not s:
+                    continue  # vacated mid-verify; writes land in trash
+                take = min(a + 1, self._rem(s))
+                s.out_ids.extend(int(t) for t in ids[i][:take])
+                s.fed += take
+                # rewind past the rejected suffix: the device advanced
+                # pos by S, the mirror keeps only accepted history and
+                # the next upload makes it so
+                self._pos_host[i] = min(
+                    int(starts[i]) + take, self.max_seq - 1
+                )
+                self._trim_row_locked(i, int(self._pos_host[i]))
+                self._spec_stats.record(k, a, take)
+                self.metrics.spec_proposed.inc(k)
+                self.metrics.spec_accepted.inc(a)
+                self._maybe_finalize_locked(i, s)
+            self._admit_locked()
+            self._cv.notify_all()
+        # the verify consumed host-fed tokens: any device chain is stale
+        self._chain = None
+        acct["segments"] += 1
+        acct["host_ms"] += (time.perf_counter() - t2) * 1e3
 
     def _commit_tick(self, acct: Dict, tick_ms: float) -> None:
         """Fold one tick's accounting into the pipeline stats + metrics."""
@@ -785,6 +1286,13 @@ class LlamaEngine:
         m.host_ms.observe(acct["host_ms"])
         m.overlap_ratio.set(ratio)
         m.queue_depth.set(float(queued))
+        if self._paged:
+            st = self._alloc.stats()
+            m.kv_blocks_total.set(float(st["total"]))
+            m.kv_blocks_free.set(float(st["free"]))
+            m.kv_blocks_shared.set(float(st["shared"]))
+        if self._spec_stats is not None:
+            m.spec_acceptance_rate.set(self._spec_stats.acceptance_rate())
 
     def _loop_once(self) -> bool:
         """One tick of the DOUBLE-BUFFERED decode pipeline; returns True
@@ -863,6 +1371,12 @@ class LlamaEngine:
                 bucket = self._prefill_bucket(
                     max(len(s.prompt) - s.cached_len for _, s in todo)
                 )
+                if self._paged:
+                    # no overflow fixup needed: the paged suffix prefill
+                    # routes pad/clamped writes to the trash block, so a
+                    # graft whose start + bucket spills past max_seq is
+                    # harmless by construction (proven in test_kv_blocks)
+                    break
                 bad = [(i, s) for i, s in todo
                        if s.cached_len and s.cached_len + bucket > self.max_seq]
                 if not bad:
@@ -882,6 +1396,12 @@ class LlamaEngine:
                 starts[i] = s.cached_len
                 temps0[i] = max(float(s.temperature), 0.0)
             self._key, pick_key = self._jax.random.split(self._key)
+            if self._paged:
+                # the HOST mirrors are authoritative: upload pos + block
+                # table before every dispatch so rollbacks (speculative
+                # rejection, preemption, vacation) are plain mirror edits
+                self._cache["pos"] = self._upload_mirror(self._pos_host)
+                self._cache["bt"] = self._upload_mirror(self._bt_host)
             t0 = time.perf_counter()
             if np.any(starts > 0):
                 logits, self._cache = self._prefill_from(
@@ -922,6 +1442,12 @@ class LlamaEngine:
             acct["dispatch_ms"] += (time.perf_counter() - t0) * 1e3
             with self._cv:
                 for i, s in todo:
+                    if self._paged:
+                        # mirror the device's pos update for dispatched
+                        # rows (vacated rows get reset at readmission)
+                        self._pos_host[i] = min(
+                            int(starts[i]) + int(lens[i]), self.max_seq - 1
+                        )
                     if self._slots[i] is not s:
                         continue  # vacated (request timeout) mid-prefill
                     s.fed = len(s.prompt)
@@ -935,6 +1461,19 @@ class LlamaEngine:
                     pre.append((i, s, budgeted))
                 active = list(self._slots)
 
+        if self.spec_k and pre:
+            # speculative ticks feed the verify window from HOST context
+            # (prompt + harvested tokens), so the deferred prefill
+            # harvest has nothing to overlap — collect first tokens now
+            # and let fresh rows join this tick's verify
+            h, b = self._harvest_prefill(pre, prefill_ids)
+            acct["harvest_ms"] += h
+            acct["host_ms"] += b
+            pre = []
+            prefill_ids = None
+            with self._cv:
+                active = list(self._slots)
+
         # ---- decode segment DISPATCH: K steps in one jitted call with
         # on-device sampling (llama.decode_segment); rows whose budget
         # ends mid-segment discard the overshoot — they are finished and
@@ -944,6 +1483,32 @@ class LlamaEngine:
             (i, s) for i, s in enumerate(active)
             if s is not None and s.fed >= len(s.prompt) and self._rem(s) > 0
         ]
+
+        # ---- speculative verify (draft-k/verify-1): when every decoding
+        # row is greedy, one batched forward scores k drafted tokens +
+        # the next input per row; the longest draft/argmax agreement is
+        # accepted and the pos mirror simply rewinds past any rejected
+        # suffix (its blocks are freed in place). Mixed-temperature
+        # traffic falls through to the segment path unchanged.
+        if decoding and self.spec_k and all(
+            float(s.temperature) <= 0.0 for _, s in decoding
+        ):
+            if self._pending is not None:
+                # a deferred segment still owes tokens the verify's host-
+                # side draft context needs — flush it first
+                h, b = self._harvest_segment()
+                acct["harvest_ms"] += h
+                acct["host_ms"] += b
+                acct["flushes"] += 1
+                with self._cv:
+                    decoding = [
+                        (i, s) for i, s in decoding
+                        if self._slots[i] is s and self._rem(s) > 0
+                    ]
+            if decoding:
+                self._spec_tick(decoding, acct)
+            decoding = []
+
         new_pending = None
         if decoding:
             need = max(self._rem(s) for _, s in decoding)
@@ -986,6 +1551,13 @@ class LlamaEngine:
                 for i, s in decoding:
                     tokens[i, 0] = s.next_input()
                 tokens_dev = jnp.asarray(tokens)
+        if decoding and self._paged:
+            # block growth for the segment's k appends; on exhaustion the
+            # reserve preempts-and-requeues victims, and rows that still
+            # cannot grow sit this dispatch out (their device pos mirror
+            # stays put, so the skipped steps never happened for them)
+            with self._cv:
+                decoding = self._reserve_decode_locked(decoding, k)
         if decoding:
             # injected device fault mid-flight: raising here exercises the
             # _loop recovery contract (fail in-flight slots, rebuild the
@@ -994,6 +1566,9 @@ class LlamaEngine:
             fp = temps.tobytes()
             if self._temps_cache is None or self._temps_cache[0] != fp:
                 self._temps_cache = (fp, jnp.asarray(temps))
+            if self._paged:
+                self._cache["pos"] = self._upload_mirror(self._pos_host)
+                self._cache["bt"] = self._upload_mirror(self._bt_host)
             t0 = time.perf_counter()
             toks, last, self._key, self._cache = self._segment_fn(k, greedy)(
                 self.params, self._cache, tokens_dev,
@@ -1010,6 +1585,13 @@ class LlamaEngine:
                     s.pending += take
                     s.fed += take
                     sched.append((i, s, take))
+                    if self._paged:
+                        # scheduled rows advance k steps on device; rows
+                        # NOT scheduled keep their mirror (the upload
+                        # before the next dispatch rewinds device pos)
+                        self._pos_host[i] = min(
+                            int(self._pos_host[i]) + k, self.max_seq - 1
+                        )
                 self._pipe["inflight"] = 1
             new_pending = {"toks": toks, "sched": sched, "k": k}
             acct["segments"] += 1
@@ -1151,6 +1733,15 @@ def engine_kwargs(cfg: Dict, ckpt_dir: str) -> Dict:
         "max_queue_depth": int(cfg.get("max_queue_depth", 64)),
         "max_queue_age_s": float(cfg.get("max_queue_age_s", 30.0)),
         "prefix_cache_mb": float(cfg.get("prefix_cache_mb", 64.0)),
+        "kv_layout": cfg.get(
+            "kv_layout", os.environ.get("KUBEDL_SERVE_KV_LAYOUT", "paged")
+        ),
+        "kv_block_size": int(cfg.get("kv_block_size", 16)),
+        "kv_blocks": int(cfg.get("kv_blocks", 0)),
+        "spec_k": int(
+            cfg.get("spec_k", os.environ.get("KUBEDL_SERVE_SPEC_K", "0"))
+        ),
+        "spec_draft": cfg.get("spec_draft", "ngram"),
     }
 
 

@@ -1,0 +1,196 @@
+"""Draft-k / verify-1 speculative decoding for the paged serving engine.
+
+Decode is memory-bandwidth bound: every generated token re-reads the
+whole weight set for ONE row of matmul work. Speculative decoding buys
+back that bandwidth by making each target-model forward score ``k+1``
+positions at once: a cheap *draft* proposes k tokens, the target scores
+the whole proposal in one batched forward over the paged cache
+(`llama.paged_verify`), the longest prefix where the draft agrees with
+the target's own greedy choice is accepted, and one "bonus" token — the
+target's argmax after the last accepted position — is emitted for free.
+Every emitted token is the target's argmax given only accepted history,
+so GREEDY outputs are bit-identical to plain decode by construction (the
+tier-1 gate); the only thing speculation changes is how many sequential
+forwards it takes to produce them. Rejected-suffix KV lands beyond the
+rolled-back position and its blocks are freed in place by the engine
+(docs/serving.md "Speculative decoding").
+
+Drafts are PLUGGABLE: anything with ``propose(context, k) -> list[int]``
+works. Shipped drafts:
+
+- :class:`NgramDraft` ("ngram", the default): self-speculative prompt-
+  lookup — match the tail n-gram of the context against its own earlier
+  tokens and propose whatever followed the most recent match. Zero
+  model cost; strong on the repetitive traffic (templated output,
+  retried generations, code) where speculation pays most.
+- :class:`RepeatDraft` ("repeat"): propose the last token k times — the
+  degenerate baseline that still wins on run-length-heavy output.
+- :class:`ScriptedDraft`: tests force exact proposal streams to pin the
+  acceptance-length distribution.
+
+A wrong draft can never corrupt output — it only wastes the verify
+forward — so draft quality is purely a throughput knob, measured by the
+acceptance rate the engine exports (`stats()["speculative"]` and the
+``kubedl_tpu_serving_spec_*`` metrics).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+
+class DraftModel:
+    """Protocol for draft proposers (duck-typed; subclassing optional)."""
+
+    name = "draft"
+
+    def propose(self, context: Sequence[int], k: int) -> List[int]:
+        """Return up to ``k`` proposed continuation tokens for
+        ``context`` (prompt + generated so far). Shorter lists are
+        allowed — the engine pads the verify window with repeats of the
+        last proposal and simply accepts less."""
+        raise NotImplementedError
+
+
+class RepeatDraft(DraftModel):
+    """Propose the last context token k times: the zero-knowledge
+    baseline. Wins exactly on run-length repetition."""
+
+    name = "repeat"
+
+    def propose(self, context: Sequence[int], k: int) -> List[int]:
+        if not context:
+            return []
+        return [int(context[-1])] * k
+
+
+class NgramDraft(DraftModel):
+    """Self-speculative prompt-lookup decoding: find the most recent
+    earlier occurrence of the context's tail ``n``-gram (longest match
+    first, down to 1) and propose the tokens that followed it. The
+    context IS the draft model — no weights, no device time."""
+
+    name = "ngram"
+
+    def __init__(self, max_ngram: int = 3, window: int = 1024) -> None:
+        self.max_ngram = max(1, int(max_ngram))
+        self.window = max(8, int(window))
+
+    def propose(self, context: Sequence[int], k: int) -> List[int]:
+        ctx = [int(t) for t in context[-self.window:]]
+        n_ctx = len(ctx)
+        if n_ctx < 2:
+            return []
+        for n in range(min(self.max_ngram, n_ctx - 1), 0, -1):
+            tail = ctx[n_ctx - n:]
+            # scan for the most recent PRIOR occurrence of the tail
+            for i in range(n_ctx - n - 1, -1, -1):
+                if ctx[i:i + n] == tail:
+                    out = ctx[i + n:i + n + k]
+                    if out:
+                        return out
+                    break
+        # no lookup hit: fall back to run-length repetition
+        return [ctx[-1]] * k
+
+
+class ScriptedDraft(DraftModel):
+    """Deterministic proposal stream for tests: pops pre-seeded
+    proposals in order, then falls back to repeats."""
+
+    name = "scripted"
+
+    def __init__(self, proposals: Sequence[Sequence[int]]) -> None:
+        self._q = deque([list(p) for p in proposals])
+
+    def propose(self, context: Sequence[int], k: int) -> List[int]:
+        if self._q:
+            return [int(t) for t in self._q.popleft()][:k]
+        return RepeatDraft().propose(context, k)
+
+
+_DRAFTS = {
+    "ngram": NgramDraft,
+    "repeat": RepeatDraft,
+}
+
+
+def make_draft(name: str, **kwargs) -> DraftModel:
+    """Draft factory for the engine's ``spec_draft`` knob."""
+    try:
+        return _DRAFTS[name](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"unknown draft {name!r} (have: {sorted(_DRAFTS)})"
+        ) from None
+
+
+def accept_length(drafts: Sequence[int], greedy_ids: Sequence[int]) -> int:
+    """Longest agreeing prefix: number of draft tokens ``a`` such that
+    ``drafts[j] == greedy_ids[j]`` for all ``j < a`` (greedy_ids[j] is
+    the target's argmax after consuming the j-th verify input). The
+    engine emits ``greedy_ids[:a+1]`` — a accepted drafts plus the bonus
+    token, every one of them the target's own greedy choice."""
+    a = 0
+    for d, g in zip(drafts, greedy_ids):
+        if int(d) != int(g):
+            break
+        a += 1
+    return a
+
+
+class SpecStats:
+    """Acceptance accounting shared by the engine, stats(), and
+    /metrics. ``accepted``/``proposed`` count DRAFT tokens (the bonus
+    token is not a draft — a 0-acceptance verify still emits one token);
+    ``window`` keeps recent per-verify acceptance lengths for the
+    distribution tests and the p50 the autoscaler reads."""
+
+    def __init__(self, maxlen: int = 4096) -> None:
+        self._lock = threading.Lock()
+        self.proposed = 0
+        self.accepted = 0
+        self.verifies = 0
+        self.emitted = 0
+        self.window: "deque[int]" = deque(maxlen=maxlen)
+
+    def record(self, proposed: int, accepted: int, emitted: int) -> None:
+        with self._lock:
+            self.proposed += int(proposed)
+            self.accepted += int(accepted)
+            self.verifies += 1
+            self.emitted += int(emitted)
+            self.window.append(int(accepted))
+
+    def acceptance_rate(self) -> float:
+        with self._lock:
+            return self.accepted / self.proposed if self.proposed else 0.0
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            win = list(self.window)
+            out = {
+                "proposed": self.proposed,
+                "accepted": self.accepted,
+                "verifies": self.verifies,
+                "emitted": self.emitted,
+            }
+        out["acceptance_rate"] = round(
+            out["accepted"] / out["proposed"], 4
+        ) if out["proposed"] else 0.0
+        out["tokens_per_verify"] = round(
+            out["emitted"] / out["verifies"], 4
+        ) if out["verifies"] else 0.0
+        if win:
+            srt = sorted(win)
+            out["accept_len_p50"] = srt[len(srt) // 2]
+            out["accept_len_mean"] = round(sum(win) / len(win), 4)
+        return out
+
+
+__all__ = [
+    "DraftModel", "NgramDraft", "RepeatDraft", "ScriptedDraft",
+    "make_draft", "accept_length", "SpecStats",
+]

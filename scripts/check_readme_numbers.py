@@ -24,6 +24,8 @@ ARTIFACT = "BENCH_r05_builder.json"
 PREFIX_ARTIFACT = "BENCH_r06_prefix.json"
 #: router availability row (r7): separate artifact, same runs[] shape
 ROUTER_ARTIFACT = "BENCH_r07_router.json"
+#: paged-KV + speculative rows (r8): separate artifact, same runs[] shape
+PAGED_ARTIFACT = "BENCH_r08.json"
 
 
 def _runs_median(runs, *path) -> float:
@@ -113,6 +115,33 @@ def expected_router_strings(artifact: dict) -> dict:
     }
 
 
+def expected_paged_strings(artifact: dict) -> dict:
+    """README paged-KV + speculative row strings from BENCH_r08.json."""
+    runs = artifact["runs"]
+    pk = ("targets", "paged_kv")
+    sp = ("targets", "speculative")
+    gain = _runs_median(runs, *pk, "occupancy_gain")
+    paged = _runs_median(runs, *pk, "peak_concurrent_paged")
+    contig = _runs_median(runs, *pk, "peak_concurrent_contiguous")
+    budget = _runs_median(runs, *pk, "kv_slot_budget")
+    speedup = _runs_median(runs, *sp, "latency_speedup")
+    acc = _runs_median(runs, *sp, "acceptance_rate")
+    tpv = _runs_median(runs, *sp, "tokens_per_verify")
+    return {
+        f"**{gain:.1f}x** concurrent occupancy":
+            "median of runs[].targets.paged_kv.occupancy_gain",
+        f"{paged:.0f} vs {contig:.0f} in-flight at equal KV HBM "
+        f"(same {budget:.0f} token-slot budget)":
+            "medians of runs[].targets.paged_kv.peak_concurrent_*/"
+            "kv_slot_budget",
+        f"**{speedup:.2f}x** single-stream speedup":
+            "median of runs[].targets.speculative.latency_speedup",
+        f"acceptance {acc * 100:.0f}%, {tpv:.2f} tokens/verify":
+            "medians of runs[].targets.speculative.acceptance_rate/"
+            "tokens_per_verify",
+    }
+
+
 def check(repo: Path = REPO) -> list:
     """Returns a list of mismatch descriptions (empty = README is clean)."""
     artifact = json.loads((repo / ARTIFACT).read_text())
@@ -126,6 +155,11 @@ def check(repo: Path = REPO) -> list:
     expected.update(
         expected_router_strings(
             json.loads((repo / ROUTER_ARTIFACT).read_text())
+        )
+    )
+    expected.update(
+        expected_paged_strings(
+            json.loads((repo / PAGED_ARTIFACT).read_text())
         )
     )
     problems = []

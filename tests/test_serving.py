@@ -734,7 +734,9 @@ class TestShardedServing:
                       "max_batch": 3, "quantize": "int8",
                       "mesh_axes": {"tensor": 2},
                       "max_queue_depth": 8, "max_queue_age_s": 5.0,
-                      "prefix_cache_mb": 64.0}
+                      "prefix_cache_mb": 64.0,
+                      "kv_layout": "paged", "kv_block_size": 16,
+                      "kv_blocks": 0, "spec_k": 0, "spec_draft": "ngram"}
         defaults = engine_kwargs({}, "")
         assert defaults["mesh_axes"] is None
         # load-shedding budget defaults ride the config too
@@ -1029,6 +1031,23 @@ class TestSchedulerMicrobench:
         assert out["tokens_saved"] >= 8 * out["prefix_len"]
         assert out["tick_ms_p50"] <= PREFIX_BUDGET_MS, out
         assert out["match_graft_ms"] <= PREFIX_BUDGET_MS, out
+        assert out["within_budget"], out
+
+    def test_paged_block_table_within_budget(self):
+        """The paged layout's extra host work — mirror re-upload per
+        dispatch plus allocator alloc/free on admission/finalize — must
+        fit the same per-tick envelope, and the pool must drain back to
+        empty (no block leaks) once every request completes."""
+        from scripts.scheduler_microbench import (
+            PAGED_BUDGET_MS,
+            run_paged_microbench,
+        )
+
+        out = run_paged_microbench(requests=8, max_tokens=16, max_batch=4)
+        assert out["tokens"] == 8 * 16
+        assert out["blocks_leaked"] == 0, out
+        assert out["tick_ms_p50"] <= PAGED_BUDGET_MS, out
+        assert out["mirror_upload_ms"] <= PAGED_BUDGET_MS, out
         assert out["within_budget"], out
 
 
