@@ -1072,6 +1072,78 @@ def bench_router_availability(on_tpu: bool) -> dict:
                 pass
 
 
+def bench_planner(on_tpu: bool) -> dict:
+    """Auto-parallelism planner (kubedl_tpu/planner/, docs/planning.md):
+
+    (1) plan() host overhead over the full catalog x model-zoo admission
+    matrix — the same sweep the tier-1 microbench budgets, recorded here
+    so the artifact carries the headline numbers; (2) predicted-vs-
+    measured step time: the cost model prices the exact (model, mesh,
+    batch) the Trainer then runs on this host, and both numbers land in
+    the artifact so cost-model drift is visible across rounds. On CPU the
+    measured side uses the cpu-1 catalog stand-in (the ratio calibrates
+    the stand-in, not real ICI); on TPU the same recipe prices the tiny
+    driver shape against the detected chip."""
+    import jax
+
+    from kubedl_tpu.api.topology import MeshSpec, SliceTopology
+    from kubedl_tpu.planner import ModelDesc, estimate
+    from kubedl_tpu.training.data import SyntheticTokens
+    from kubedl_tpu.training.trainer import TrainConfig, Trainer
+    from kubedl_tpu.models import llama
+    from scripts.scheduler_microbench import run_planner_microbench
+
+    out = run_planner_microbench()
+
+    # --- predicted vs measured on the shape this host can actually run ---
+    ndev = jax.device_count()
+    model = llama.TINY
+    batch, seq, steps = max(2, ndev), 128, 5
+    desc = ModelDesc(
+        layers=model.n_layers, hidden=model.dim, ffn=model.ffn_dim,
+        vocab=model.vocab_size, seq_len=seq, global_batch=batch,
+        dtype="float32",
+    )
+    if on_tpu:
+        from kubedl_tpu.api.topology import SLICE_CATALOG
+
+        kind = jax.devices()[0].device_kind.lower()
+        gen = next((t.name.split("-")[0] for t in SLICE_CATALOG.values()
+                    if t.name.split("-")[0] in kind), "v5e")
+        base = next(t for t in SLICE_CATALOG.values()
+                    if t.name.startswith(gen + "-"))
+        topo = SliceTopology(f"{gen}-bench", ndev, 1, ndev, (ndev,),
+                             base.peak_bf16_tflops, base.hbm_gib_per_chip,
+                             base.hbm_gbps, base.ici_gbps, base.dcn_gbps)
+    else:
+        from kubedl_tpu.api.topology import get_slice
+
+        cpu1 = get_slice("cpu-1")
+        topo = SliceTopology("cpu-bench", ndev, 1, ndev, (ndev,),
+                             cpu1.peak_bf16_tflops, cpu1.hbm_gib_per_chip,
+                             cpu1.hbm_gbps, cpu1.ici_gbps, cpu1.dcn_gbps)
+    mesh = MeshSpec({"data": ndev})
+    predicted = estimate(desc, topo, mesh)
+    cfg = TrainConfig(model=model, global_batch=batch, seq_len=seq,
+                      steps=steps)
+    trainer = Trainer(cfg)
+    _, s = trainer.fit(iter(SyntheticTokens(batch, seq, model.vocab_size)))
+    measured_ms = float(s["step_time_ms"])
+    out.update({
+        "predicted_step_ms": round(predicted.step_ms, 2),
+        "predicted_compute_ms": round(predicted.compute_ms, 2),
+        "predicted_hbm_gib": round(predicted.hbm_gib, 4),
+        "measured_step_ms": round(measured_ms, 2),
+        "predicted_over_measured": round(
+            predicted.step_ms / measured_ms, 4
+        ) if measured_ms > 0 else None,
+        "pv_mesh": mesh.to_env(),
+        "pv_devices": ndev,
+        "pv_platform": "tpu" if on_tpu else "cpu",
+    })
+    return out
+
+
 def bench_flash_numerics(on_tpu: bool) -> dict:
     """Numerics gate (ADVICE r4): the fused single-pass flash backward and
     the classic split two-kernel backward must agree ON CHIP. The fused
@@ -1331,6 +1403,19 @@ def _run_headline_inprocess(op, train_cfg: dict) -> dict:
 
 
 def main() -> int:
+    if "--planner" in sys.argv[1:]:
+        # standalone planner round (BENCH_r09_planner.json): no training
+        # driver, no warm/cold gates — just the planner targets in the
+        # same runs[] shape check_readme_numbers reads
+        import jax as _jax
+
+        _on_tpu = _jax.default_backend() == "tpu"
+        print(json.dumps({
+            "runs": [{"detail": {"targets": {
+                "planner": bench_planner(_on_tpu)
+            }}}],
+        }, indent=2))
+        return 0
     from kubedl_tpu.operator import Operator, OperatorOptions
     from kubedl_tpu.runtime.executor import SubprocessRuntime, ThreadRuntime
     from tempfile import TemporaryDirectory
@@ -1576,6 +1661,10 @@ def main() -> int:
         targets["checkpoint_overhead"] = bench_checkpoint_overhead()
     except Exception as e:
         targets["checkpoint_overhead"] = {"error": str(e)}
+    try:
+        targets["planner"] = bench_planner(on_tpu)
+    except Exception as e:
+        targets["planner"] = {"error": str(e)}
 
     tps_chip = summary["tokens_per_sec_per_chip"]
     mfu = summary["mfu"]

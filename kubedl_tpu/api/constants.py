@@ -38,6 +38,16 @@ ANNOTATION_DRAIN_STARTED = API_GROUP + "/drain-started"
 #: at first defaulting and stable across elastic resizes, so workers can
 #: rescale gradient accumulation to preserve the effective global batch
 ANNOTATION_ELASTIC_BASE_WORLD = API_GROUP + "/elastic-base-world"
+#: the auto-parallelism planner's cached verdict (kubedl_tpu/planner/):
+#: compact JSON {axes, topology, slices, step_ms, hbm_gib}. The cache key
+#: is (topology, slices) — an elastic resize changes slices, so the next
+#: reconcile re-plans for the new world size (docs/planning.md).
+ANNOTATION_PLANNED_MESH = API_GROUP + "/planned-mesh"
+#: data-parallel world (replica*data*fsdp of the FIRST plan) — the planner
+#: analogue of elastic-base-world: workers rescale grad accumulation
+#: against the planned DP degree, not the raw process count, because a
+#: re-plan may move chips between data and model axes on resize
+ANNOTATION_ELASTIC_BASE_DP = API_GROUP + "/elastic-base-dp"
 
 NETWORK_MODE_HOST = "host"
 
@@ -60,6 +70,10 @@ ENV_MESH_AXES = "KUBEDL_MESH_AXES"  # logical mesh hint, e.g. "data=4,model=8"
 # (effective global batch is preserved across resizes); min/max ride the
 # ElasticDLJob master's env (the reference's master scales its own workers).
 ENV_ELASTIC_BASE_WORLD = "KUBEDL_ELASTIC_BASE_WORLD"
+#: base data-parallel degree from the planner's first plan; when present,
+#: entry.py rescales grad accumulation from base-dp -> current-dp (read
+#: off KUBEDL_MESH_AXES) instead of base-world -> world
+ENV_ELASTIC_BASE_DP = "KUBEDL_ELASTIC_BASE_DP"
 ENV_ELASTIC_MIN_SLICES = "KUBEDL_ELASTIC_MIN_SLICES"
 ENV_ELASTIC_MAX_SLICES = "KUBEDL_ELASTIC_MAX_SLICES"
 ENV_ELASTIC_NUM_SLICES = "KUBEDL_ELASTIC_NUM_SLICES"

@@ -142,6 +142,22 @@ class WorkloadController:
             f"{self.KIND} does not support elastic resize"
         )
 
+    # ---- auto-parallelism planning (kubedl_tpu/planner/) -----------------
+
+    def plan_mesh(self, job: JobObject):
+        """Compute (or refresh) the job's auto-parallelism plan.
+
+        Called by the engine early in every reconcile, before pods are
+        built. Return a ``kubedl_tpu.planner.Plan`` when a NEW plan was
+        computed this pass — the engine stamps the planned-mesh annotation,
+        ``status.plan``, a ``Planned`` condition/event and planner metrics.
+        Return None when the kind does not plan (the default) or the cached
+        plan is still valid for the current (topology, num_slices). May
+        raise ``kubedl_tpu.planner.PlanError`` when no feasible layout
+        exists — the engine fails the job with reason PlanInfeasible.
+        """
+        return None
+
     # ---- topology / ordering --------------------------------------------
 
     def reconcile_orders(self) -> List[ReplicaType]:

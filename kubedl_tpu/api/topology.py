@@ -39,6 +39,14 @@ class SliceTopology:
     #: Per-chip HBM bandwidth GB/s (spec sheet) — used for bench sanity
     #: floors (a training step cannot beat one full param read from HBM).
     hbm_gbps: float = 819.0
+    #: Per-chip aggregate ICI bandwidth GB/s (sum over links, one
+    #: direction) — prices intra-slice collectives in the auto-parallelism
+    #: planner's cost model (kubedl_tpu/planner/costmodel.py).
+    ici_gbps: float = 180.0
+    #: Per-chip DCN bandwidth GB/s — prices cross-slice (multislice)
+    #: collectives; one to two orders of magnitude below ICI, which is why
+    #: only the outermost (replica) mesh axis may cross slices.
+    dcn_gbps: float = 6.25
 
     @property
     def total_devices(self) -> int:
@@ -94,30 +102,30 @@ def _register(*topos: SliceTopology) -> None:
 
 _register(
     # v5e: 1 host = 4 chips (2x2), 197 bf16 TFLOP/s, 16 GiB HBM
-    SliceTopology("v5e-4", 4, 1, 4, (2, 2), 197.0, 16.0, 819.0),
-    SliceTopology("v5e-8", 8, 2, 4, (2, 4), 197.0, 16.0, 819.0),
-    SliceTopology("v5e-16", 16, 4, 4, (4, 4), 197.0, 16.0, 819.0),
-    SliceTopology("v5e-32", 32, 8, 4, (4, 8), 197.0, 16.0, 819.0),
-    SliceTopology("v5e-64", 64, 16, 4, (8, 8), 197.0, 16.0, 819.0),
-    SliceTopology("v5e-128", 128, 32, 4, (8, 16), 197.0, 16.0, 819.0),
-    SliceTopology("v5e-256", 256, 64, 4, (16, 16), 197.0, 16.0, 819.0),
+    SliceTopology("v5e-4", 4, 1, 4, (2, 2), 197.0, 16.0, 819.0, 180.0, 6.25),
+    SliceTopology("v5e-8", 8, 2, 4, (2, 4), 197.0, 16.0, 819.0, 180.0, 6.25),
+    SliceTopology("v5e-16", 16, 4, 4, (4, 4), 197.0, 16.0, 819.0, 180.0, 6.25),
+    SliceTopology("v5e-32", 32, 8, 4, (4, 8), 197.0, 16.0, 819.0, 180.0, 6.25),
+    SliceTopology("v5e-64", 64, 16, 4, (8, 8), 197.0, 16.0, 819.0, 180.0, 6.25),
+    SliceTopology("v5e-128", 128, 32, 4, (8, 16), 197.0, 16.0, 819.0, 180.0, 6.25),
+    SliceTopology("v5e-256", 256, 64, 4, (16, 16), 197.0, 16.0, 819.0, 180.0, 6.25),
     # v4: 1 host = 4 chips, 3D torus, 275 bf16 TFLOP/s, 32 GiB
-    SliceTopology("v4-8", 8, 1, 4, (2, 2, 1), 275.0, 32.0, 1228.0),
-    SliceTopology("v4-16", 16, 2, 4, (2, 2, 2), 275.0, 32.0, 1228.0),
-    SliceTopology("v4-32", 32, 4, 4, (2, 2, 4), 275.0, 32.0, 1228.0),
-    SliceTopology("v4-64", 64, 8, 4, (2, 4, 4), 275.0, 32.0, 1228.0),
+    SliceTopology("v4-8", 8, 1, 4, (2, 2, 1), 275.0, 32.0, 1228.0, 270.0, 6.25),
+    SliceTopology("v4-16", 16, 2, 4, (2, 2, 2), 275.0, 32.0, 1228.0, 270.0, 6.25),
+    SliceTopology("v4-32", 32, 4, 4, (2, 2, 4), 275.0, 32.0, 1228.0, 270.0, 6.25),
+    SliceTopology("v4-64", 64, 8, 4, (2, 4, 4), 275.0, 32.0, 1228.0, 270.0, 6.25),
     # v5p: 1 host = 4 chips, 459 bf16 TFLOP/s, 95 GiB
-    SliceTopology("v5p-8", 8, 2, 4, (2, 2, 1), 459.0, 95.0, 2765.0),
-    SliceTopology("v5p-16", 16, 4, 4, (2, 2, 2), 459.0, 95.0, 2765.0),
-    SliceTopology("v5p-32", 32, 8, 4, (2, 2, 4), 459.0, 95.0, 2765.0),
+    SliceTopology("v5p-8", 8, 2, 4, (2, 2, 1), 459.0, 95.0, 2765.0, 540.0, 6.25),
+    SliceTopology("v5p-16", 16, 4, 4, (2, 2, 2), 459.0, 95.0, 2765.0, 540.0, 6.25),
+    SliceTopology("v5p-32", 32, 8, 4, (2, 2, 4), 459.0, 95.0, 2765.0, 540.0, 6.25),
     # v6e (Trillium): 1 host = 4 chips, ~918 bf16 TFLOP/s, 32 GiB
-    SliceTopology("v6e-4", 4, 1, 4, (2, 2), 918.0, 32.0, 1640.0),
-    SliceTopology("v6e-8", 8, 2, 4, (2, 4), 918.0, 32.0, 1640.0),
-    SliceTopology("v6e-16", 16, 4, 4, (4, 4), 918.0, 32.0, 1640.0),
-    SliceTopology("v6e-32", 32, 8, 4, (4, 8), 918.0, 32.0, 1640.0),
+    SliceTopology("v6e-4", 4, 1, 4, (2, 2), 918.0, 32.0, 1640.0, 360.0, 12.5),
+    SliceTopology("v6e-8", 8, 2, 4, (2, 4), 918.0, 32.0, 1640.0, 360.0, 12.5),
+    SliceTopology("v6e-16", 16, 4, 4, (4, 4), 918.0, 32.0, 1640.0, 360.0, 12.5),
+    SliceTopology("v6e-32", 32, 8, 4, (4, 8), 918.0, 32.0, 1640.0, 360.0, 12.5),
     # CPU stand-in used by tests / kind-style local clusters
-    SliceTopology("cpu-1", 1, 1, 1, (1,), 0.5, 8.0, 50.0),
-    SliceTopology("cpu-8", 8, 8, 1, (8,), 0.5, 8.0, 50.0),
+    SliceTopology("cpu-1", 1, 1, 1, (1,), 0.5, 8.0, 50.0, 1.0, 0.5),
+    SliceTopology("cpu-8", 8, 8, 1, (8,), 0.5, 8.0, 50.0, 1.0, 0.5),
 )
 
 
@@ -224,7 +232,19 @@ class MeshSpec:
 def validate_mesh_for_slice(
     mesh: MeshSpec, topo: SliceTopology, num_slices: int = 1
 ) -> Optional[str]:
-    """Return an error message if the logical mesh cannot tile the slice."""
+    """Return an error message if the logical mesh cannot tile the slice.
+
+    Checked at job admission (workloads validate) so a bad mesh is rejected
+    on submit instead of failing inside the worker at ``build_mesh`` time.
+    """
+    for axis, size in mesh.axes.items():
+        if axis not in MeshSpec.AXIS_ORDER:
+            return (
+                f"unknown mesh axis {axis!r}; known axes: "
+                + ", ".join(MeshSpec.AXIS_ORDER)
+            )
+        if size < 1:
+            return f"mesh axis {axis}={size} must be >= 1"
     want = topo.chips * num_slices
     if mesh.size() != want:
         return f"mesh covers {mesh.size()} devices but topology has {want} chips"

@@ -110,6 +110,12 @@ class JobConditionType(str, enum.Enum):
     #: hot-looping the workqueue. NOT terminal: the job is neither
     #: succeeded nor failed, it is awaiting operator intervention.
     QUARANTINED = "Quarantined"
+    #: TPU addition (auto-parallelism planner, kubedl_tpu/planner/): the
+    #: cost model chose a mesh layout for this (topology, world size) and
+    #: the engine injected it via KUBEDL_MESH_AXES. Informational — it does
+    #: not gate the phase machine; message carries the chosen layout plus
+    #: predicted step time / HBM. Re-stamped after every elastic resize.
+    PLANNED = "Planned"
     #: TPU addition (progress watchdog, kubedl_tpu/watchdog/): a replica
     #: stopped making training progress WITHOUT exiting — a wedged step
     #: loop (hang), a host whose beacons stopped while the pod stayed
@@ -239,6 +245,25 @@ class JobCondition:
 
 
 @dataclass
+class PlanStatus:
+    """The auto-parallelism planner's published verdict (kubedl_tpu/planner/).
+
+    Surfaced on JobStatus so ``kubectl get -o yaml`` shows the chosen
+    layout and predictions without digging through events; refreshed after
+    every elastic resize (the plan is keyed on (topology, num_slices))."""
+
+    #: chosen layout in KUBEDL_MESH_AXES form, e.g. "data=4,fsdp=2"
+    mesh: str = ""
+    topology: str = ""
+    num_slices: int = 1
+    predicted_step_ms: float = 0.0
+    predicted_hbm_gib: float = 0.0
+    candidates_evaluated: int = 0
+    #: host wall time plan() spent (budgeted in scheduler_microbench.py)
+    plan_ms: float = 0.0
+
+
+@dataclass
 class JobStatus:
     """Observed job state (reference: types.go:26-51)."""
 
@@ -252,6 +277,9 @@ class JobStatus:
     restart_count: int = 0
     #: Name of the ModelVersion created on success, if any.
     model_version: str = ""
+    #: Auto-parallelism planner verdict; None until a plan is computed
+    #: (only jobs with a modelDesc / mesh:auto get one).
+    plan: Optional[PlanStatus] = None
 
     # ---- condition helpers (reference: pkg/util/status.go) ----------------
 

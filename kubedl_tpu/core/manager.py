@@ -29,7 +29,10 @@ EventMapper = Callable[[str, BaseObject, Optional[BaseObject]], List[Key]]
 
 class EventRecorder:
     """Writes Event objects into the store, deduplicating by
-    (involved, reason, message) the way client-go's recorder aggregates."""
+    (involved, reason) the way client-go's recorder aggregates: a repeat
+    with the same message bumps the count; a repeat with a NEW message
+    (e.g. a second Planned verdict after an elastic resize) bumps the
+    count and carries the latest message."""
 
     def __init__(self, store: ObjectStore) -> None:
         self._store = store
@@ -45,8 +48,9 @@ class EventRecorder:
         name = f"{obj.metadata.name}.{reason}".lower()[:253]
         with self._lock:
             existing = self._store.try_get("Event", name, obj.metadata.namespace)
-            if existing is not None and existing.message == message:  # type: ignore[attr-defined]
+            if existing is not None:
                 existing.count += 1  # type: ignore[attr-defined]
+                existing.message = message  # type: ignore[attr-defined]
                 existing.timestamp = time.time()  # type: ignore[attr-defined]
                 try:
                     self._store.update(existing)

@@ -52,6 +52,24 @@ def grad_accum_for_world(
     return target
 
 
+def data_parallel_world(mesh) -> int:
+    """Number of gradient replicas a mesh implies: the product of the
+    batch-sharding axes (replica x data x fsdp — fsdp shards parameters but
+    each rank still consumes its own batch shard).
+
+    This is the world size that batch semantics actually care about. When
+    the auto-parallelism planner owns the mesh (docs/planning.md), a resize
+    can move chips between data and model axes — e.g. 8 chips data=8 ->
+    16 chips data=8,tensor=2 — so rescaling grad accumulation by raw
+    process count would be wrong; entry.py uses this instead whenever the
+    operator stamped a base DP degree.
+    """
+    n = 1
+    for axis in ("replica", "data", "fsdp"):
+        n *= max(int(mesh.axes.get(axis, 1)), 1)
+    return n
+
+
 def goodput(step_seconds: float, wall_seconds: float) -> float:
     """Fraction of ``wall_seconds`` spent in training steps, in [0, 1]."""
     if wall_seconds <= 0:

@@ -39,6 +39,7 @@ from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadContro
 from kubedl_tpu.api.types import (
     CleanPodPolicy,
     JobConditionType,
+    PlanStatus,
     ReplicaSpec,
     ReplicaType,
     RestartPolicy,
@@ -280,6 +281,53 @@ class JobEngine:
                 "unsuspended; re-admitting",
             )
             self.recorder.event(job, "Normal", "Resumed", "re-admitting gang")
+
+        # --- auto-parallelism planning (kubedl_tpu/planner/) --------------
+        # BEFORE gang admission so the pods built this pass — including the
+        # ones rebuilt right after an elastic resize — carry a mesh planned
+        # for the CURRENT (topology, num_slices). The kind hook returns a
+        # Plan only when it computed a fresh one (cache key on the
+        # planned-mesh annotation); None means nothing to do this pass.
+        try:
+            new_plan = self.controller.plan_mesh(job)
+        except Exception as exc:
+            from kubedl_tpu.planner import PlanError
+
+            if not isinstance(exc, PlanError):
+                raise
+            # No feasible layout can train this model on this slice shape:
+            # fail fast at admission instead of letting workers OOM-loop.
+            status.set_condition(
+                JobConditionType.FAILED, "PlanInfeasible", str(exc)
+            )
+            status.completion_time = now
+            self.metrics.failed.inc(kind=self.controller.KIND)
+            self.recorder.event(job, "Warning", "PlanInfeasible", str(exc))
+            self._delete_pods(job, ctx.pods, CleanPodPolicy.RUNNING)
+            self._update_status(job)
+            return None
+        if new_plan is not None:
+            job.metadata.annotations[constants.ANNOTATION_PLANNED_MESH] = (
+                new_plan.to_annotation()
+            )
+            status.plan = PlanStatus(
+                mesh=new_plan.mesh.to_env(),
+                topology=new_plan.topology,
+                num_slices=new_plan.num_slices,
+                predicted_step_ms=round(new_plan.step_time_ms, 3),
+                predicted_hbm_gib=round(new_plan.hbm_gib, 3),
+                candidates_evaluated=new_plan.candidates_evaluated,
+                plan_ms=round(new_plan.plan_ms, 3),
+            )
+            status.set_condition(
+                JobConditionType.PLANNED, "MeshPlanned", new_plan.summary()
+            )
+            self.metrics.plans.inc(kind=self.controller.KIND)
+            self.metrics.planner_candidates.inc(
+                new_plan.candidates_evaluated, kind=self.controller.KIND
+            )
+            self.metrics.planner_plan_ms.observe(new_plan.plan_ms)
+            self.recorder.event(job, "Normal", "Planned", new_plan.summary())
 
         # --- gang admission (atomic slice acquisition) --------------------
         if self.gang is not None and self.features.enabled(GANG_SCHEDULING):

@@ -246,6 +246,20 @@ class JobMetrics:
             "In-place elastic gang resizes (grow or shrink) executed by "
             "the engine; coarse tear-down resizes count as restarts",
         )
+        # Auto-parallelism planner (kubedl_tpu/planner/, docs/planning.md):
+        self.plans = r.counter(
+            "kubedl_tpu_planner_plans_total",
+            "Mesh plans computed (first admission + every elastic re-plan)",
+        )
+        self.planner_candidates = r.counter(
+            "kubedl_tpu_planner_candidates_evaluated",
+            "Candidate layouts priced by the planner's cost model",
+        )
+        self.planner_plan_ms = r.histogram(
+            "kubedl_tpu_planner_plan_ms",
+            "Host wall time per plan() call, milliseconds",
+            buckets=(1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, float("inf")),
+        )
         self.preemption_notices = r.counter(
             "kubedl_tpu_preemption_notices",
             "Node preemption/maintenance notices that marked a slice "

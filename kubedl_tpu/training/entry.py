@@ -159,6 +159,17 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
     # size — global_batch (and the loss trajectory) is unchanged
     base_world = int(os.environ.get(constants.ENV_ELASTIC_BASE_WORLD, "0") or 0)
     world = int(os.environ.get(constants.ENV_NUM_PROCESSES, "1") or 1)
+    # planner-owned meshes (docs/planning.md): rescale in data-parallel
+    # units instead — a re-plan may have moved chips between data and
+    # model axes, so the raw process count no longer tracks batch shards
+    base_dp = int(os.environ.get(constants.ENV_ELASTIC_BASE_DP, "0") or 0)
+    mesh_axes = os.environ.get(constants.ENV_MESH_AXES, "")
+    if base_dp > 0 and mesh_axes:
+        from kubedl_tpu.api.topology import MeshSpec
+        from kubedl_tpu.elastic.resize import data_parallel_world
+
+        base_world = base_dp
+        world = data_parallel_world(MeshSpec.from_env(mesh_axes))
     if base_world > 0 and world != base_world:
         from kubedl_tpu.elastic.resize import grad_accum_for_world
 

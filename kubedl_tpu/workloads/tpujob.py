@@ -20,15 +20,17 @@ TFJob's evaluator-outside-the-cluster-spec behavior (tensorflow.go:112-116).
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import List, Optional, Union
 
 from kubedl_tpu.api import constants
 from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadController
-from kubedl_tpu.api.topology import MeshSpec
+from kubedl_tpu.api.topology import MeshSpec, validate_mesh_for_slice
 from kubedl_tpu.api.types import ElasticSpec, ReplicaType
 from kubedl_tpu.core.objects import Pod
 from kubedl_tpu.engine.job_controller import replica_name
+from kubedl_tpu.planner.costmodel import ModelDesc
 
 
 @dataclass
@@ -36,13 +38,27 @@ class TPUJob(JobObject):
     KIND = "TPUJob"
     #: Number of slices (multislice over DCN when > 1).
     num_slices: int = 1
-    #: Logical mesh requested by the user; defaults to pure data-parallel
-    #: over all chips.
-    mesh: Optional[MeshSpec] = None
+    #: Logical mesh requested by the user, or the string ``"auto"`` to let
+    #: the cost-model planner choose (requires ``model_desc``). Unset
+    #: defaults to pure data-parallel over all chips — unless ``model_desc``
+    #: is present, which also turns planning on (docs/planning.md).
+    mesh: Optional[Union[MeshSpec, str]] = None
+    #: What the job trains — enough architecture shape for the planner's
+    #: analytical cost model (params/layers/hidden/seq_len/batch/dtype).
+    model_desc: Optional[ModelDesc] = None
     #: Opt-in elastic slice scaling: num_slices becomes a runtime variable
     #: in [elastic.min_slices, elastic.max_slices] managed by the
     #: ElasticPolicy (kubedl_tpu/elastic/, docs/elasticity.md).
     elastic: Optional[ElasticSpec] = None
+
+    def explicit_mesh(self) -> Optional[MeshSpec]:
+        """The user-pinned mesh, if any (``mesh: auto`` is not a pin)."""
+        return self.mesh if isinstance(self.mesh, MeshSpec) else None
+
+    def wants_planning(self) -> bool:
+        return self.mesh == "auto" or (
+            self.model_desc is not None and self.explicit_mesh() is None
+        )
 
 
 class TPUJobController(WorkloadController):
@@ -58,6 +74,34 @@ class TPUJobController(WorkloadController):
         assert isinstance(job, TPUJob)
         if job.elastic is not None:
             errs.extend(job.elastic.validate("spec.elastic"))
+        # --- mesh admission checks (docs/planning.md) ---------------------
+        # Runs pre-defaulting, so clamp num_slices the way apply_defaults
+        # will — a mesh must tile the shape the job will actually run at.
+        ns = (
+            job.elastic.clamp(max(job.num_slices, 1))
+            if job.elastic is not None
+            else max(job.num_slices, 1)
+        )
+        if isinstance(job.mesh, str) and job.mesh != "auto":
+            errs.append(
+                f'mesh: {job.mesh!r} is not a mesh; use axis sizes or "auto"'
+            )
+        if job.mesh == "auto" and job.model_desc is None:
+            errs.append("mesh: auto requires a modelDesc to plan from")
+        if job.model_desc is not None:
+            errs.extend(job.model_desc.validate("modelDesc"))
+        spec = job.spec.replica_specs.get(ReplicaType.WORKER)
+        topo = spec.topology if spec is not None else None
+        if topo is not None:
+            for where, mesh in (
+                ("mesh", job.explicit_mesh()),
+                ("worker.mesh", spec.mesh if spec else None),
+            ):
+                if mesh is None:
+                    continue
+                bad = validate_mesh_for_slice(mesh, topo, num_slices=ns)
+                if bad:
+                    errs.append(f"{where}: {bad}")
         return errs
 
     def apply_defaults(self, job: JobObject) -> None:
@@ -97,6 +141,61 @@ class TPUJobController(WorkloadController):
     def set_num_slices(self, job: JobObject, n: int) -> None:
         assert isinstance(job, TPUJob)
         job.num_slices = job.elastic.clamp(n) if job.elastic else max(n, 1)
+
+    # ---- auto-parallelism planning (kubedl_tpu/planner/) --------------
+
+    def plan_mesh(self, job: JobObject):
+        """Compute a fresh plan when auto-mode is on and the cached verdict
+        is stale for the current (topology, num_slices) — i.e. at first
+        admission and after every elastic resize."""
+        assert isinstance(job, TPUJob)
+        spec = job.spec.replica_specs.get(ReplicaType.WORKER)
+        if (
+            not job.wants_planning()
+            or spec is None
+            or spec.topology is None
+            or (spec.mesh is not None and job.mesh != "auto")
+            or job.model_desc is None
+        ):
+            return None
+        topo = spec.topology
+        ns = max(job.num_slices, 1)
+        cached = job.metadata.annotations.get(constants.ANNOTATION_PLANNED_MESH)
+        if cached:
+            try:
+                c = json.loads(cached)
+                if c.get("topology") == topo.name and c.get("slices") == ns:
+                    return None  # plan still valid for this world size
+            except (ValueError, TypeError):
+                pass  # corrupt annotation: re-plan
+        from kubedl_tpu.planner import plan as compute_plan
+
+        p = compute_plan(job.model_desc, topo, num_slices=ns)
+        # First plan pins the base data-parallel degree (grad-accum rescale
+        # on resize works in DP units once a planner owns the mesh,
+        # elastic/resize.py data_parallel_world)
+        from kubedl_tpu.elastic.resize import data_parallel_world
+
+        job.metadata.annotations.setdefault(
+            constants.ANNOTATION_ELASTIC_BASE_DP,
+            str(data_parallel_world(p.mesh)),
+        )
+        return p
+
+    def _planned_mesh(self, job: "TPUJob", topo) -> Optional[MeshSpec]:
+        """The annotation-cached plan, iff it matches the current shape."""
+        cached = job.metadata.annotations.get(constants.ANNOTATION_PLANNED_MESH)
+        if not cached:
+            return None
+        try:
+            c = json.loads(cached)
+            if c.get("topology") == topo.name and c.get("slices") == max(
+                job.num_slices, 1
+            ):
+                return MeshSpec.from_env(c["axes"])
+        except (ValueError, TypeError, KeyError):
+            return None
+        return None
 
     def reconcile_orders(self) -> List[ReplicaType]:
         return [ReplicaType.WORKER, ReplicaType.EVALUATOR]
@@ -154,12 +253,17 @@ class TPUJobController(WorkloadController):
             main.set_env(
                 constants.ENV_TPU_SLICE_TOPOLOGY, f"{topo.name}:{shape}"
             )
-            mesh = job.mesh or spec.mesh or MeshSpec.for_slice(
-                topo, num_slices=job.num_slices
+            # resolution order: user pin on the job, pin on the replica
+            # spec, the planner's cached verdict, then the naive default
+            mesh = (
+                job.explicit_mesh()
+                or spec.mesh
+                or self._planned_mesh(job, topo)
+                or MeshSpec.for_slice(topo, num_slices=job.num_slices)
             )
             main.set_env(constants.ENV_MESH_AXES, mesh.to_env())
-        elif job.mesh is not None:
-            main.set_env(constants.ENV_MESH_AXES, job.mesh.to_env())
+        elif job.explicit_mesh() is not None:
+            main.set_env(constants.ENV_MESH_AXES, job.explicit_mesh().to_env())
         if job.elastic is not None:
             base = job.metadata.annotations.get(
                 constants.ANNOTATION_ELASTIC_BASE_WORLD
@@ -168,6 +272,14 @@ class TPUJobController(WorkloadController):
                 # workers rescale grad accumulation against the world size
                 # the job was tuned at (training/entry.py, elastic/resize.py)
                 main.set_env(constants.ENV_ELASTIC_BASE_WORLD, base)
+            base_dp = job.metadata.annotations.get(
+                constants.ANNOTATION_ELASTIC_BASE_DP
+            )
+            if base_dp:
+                # planner-owned meshes rescale in data-parallel units: a
+                # re-plan may move chips between data and model axes, so
+                # raw process counts over/under-shoot (training/entry.py)
+                main.set_env(constants.ENV_ELASTIC_BASE_DP, base_dp)
         if job.num_slices > 1:
             main.set_env(constants.ENV_MEGASCALE_COORDINATOR, self._coordinator(job))
             main.set_env(constants.ENV_MEGASCALE_NUM_SLICES, str(job.num_slices))

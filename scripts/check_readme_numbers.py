@@ -26,6 +26,8 @@ PREFIX_ARTIFACT = "BENCH_r06_prefix.json"
 ROUTER_ARTIFACT = "BENCH_r07_router.json"
 #: paged-KV + speculative rows (r8): separate artifact, same runs[] shape
 PAGED_ARTIFACT = "BENCH_r08.json"
+#: auto-parallelism planner row (r9): separate artifact, same runs[] shape
+PLANNER_ARTIFACT = "BENCH_r09_planner.json"
 
 
 def _runs_median(runs, *path) -> float:
@@ -142,6 +144,26 @@ def expected_paged_strings(artifact: dict) -> dict:
     }
 
 
+def expected_planner_strings(artifact: dict) -> dict:
+    """README auto-planner row strings from BENCH_r09_planner.json."""
+    runs = artifact["runs"]
+    tgt = ("targets", "planner")
+    p95 = _runs_median(runs, *tgt, "plan_ms_p95")
+    plans = _runs_median(runs, *tgt, "plans")
+    cand = _runs_median(runs, *tgt, "candidates_evaluated")
+    pred = _runs_median(runs, *tgt, "predicted_step_ms")
+    meas = _runs_median(runs, *tgt, "measured_step_ms")
+    return {
+        f"plan p95 **{p95:.1f} ms**":
+            "median of runs[].targets.planner.plan_ms_p95",
+        f"{plans:.0f} plans / {cand:,.0f} layouts priced":
+            "medians of runs[].targets.planner.plans/candidates_evaluated",
+        f"predicted {pred:.1f} vs measured {meas:.1f} ms/step":
+            "medians of runs[].targets.planner.predicted_step_ms/"
+            "measured_step_ms",
+    }
+
+
 def check(repo: Path = REPO) -> list:
     """Returns a list of mismatch descriptions (empty = README is clean)."""
     artifact = json.loads((repo / ARTIFACT).read_text())
@@ -160,6 +182,11 @@ def check(repo: Path = REPO) -> list:
     expected.update(
         expected_paged_strings(
             json.loads((repo / PAGED_ARTIFACT).read_text())
+        )
+    )
+    expected.update(
+        expected_planner_strings(
+            json.loads((repo / PLANNER_ARTIFACT).read_text())
         )
     )
     problems = []
