@@ -1144,6 +1144,78 @@ def bench_planner(on_tpu: bool) -> dict:
     return out
 
 
+def bench_training(runs: int = 3) -> list:
+    """Sharded weight update + comm/compute overlap (docs/performance.md
+    "Sharded weight update & overlap"): per-phase step decomposition for
+    the replicated / sharded / sharded_overlap arms, measured by
+    kubedl_tpu/training/stepbench.py in a SUBPROCESS so the device-count
+    env lands before jax initializes. Each run's flattened medians land
+    in runs[].detail.targets.training; the acceptance proxies the CPU CI
+    gate compares (exposed comm+update and optimizer-state bytes/replica,
+    both vs the replicated baseline arm) ride every run."""
+    import subprocess
+    import tempfile
+
+    out_runs = []
+    for _ in range(runs):
+        with tempfile.NamedTemporaryFile(suffix=".json") as f:
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)  # stepbench sets the device count
+            proc = subprocess.run(
+                [sys.executable, "-m", "kubedl_tpu.training.stepbench",
+                 "--devices", "4", "--json", f.name],
+                env=env, capture_output=True, text=True, timeout=1800,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"stepbench failed rc={proc.returncode}: "
+                    f"{proc.stderr[-2000:]}"
+                )
+            r = json.loads(open(f.name).read())
+        rep = r["arms"]["replicated"]
+        ovl = r["arms"]["sharded_overlap"]
+        best = r["arms"][r["proxy"]["best_arm"]]
+        out_runs.append({
+            "devices": r["devices"],
+            "mesh": r["mesh"],
+            "model_params": r["model_params"],
+            "grad_accum": r["grad_accum"],
+            "compute_ms": round(r["compute_ms"], 2),
+            "step_ms_replicated": round(rep["step_ms"], 2),
+            "step_ms_overlap": round(ovl["step_ms"], 2),
+            "update_ms_replicated": round(rep["update_ms"], 2),
+            "update_ms_overlap": round(ovl["update_ms"], 2),
+            "exposed_comm_ms_replicated": round(rep["exposed_comm_ms"], 2),
+            "exposed_comm_ms_overlap": round(ovl["exposed_comm_ms"], 2),
+            # the proxy the acceptance gate compares: everything that is
+            # NOT arm-invariant compute (collectives + optimizer apply),
+            # replicated baseline vs the best sharded arm (XLA:CPU has no
+            # async-collective engine, so the overlap schedule's extra
+            # in-loop scatters are not free here — see stepbench.py)
+            "best_arm": r["proxy"]["best_arm"],
+            "noncompute_ms_replicated": round(
+                rep["exposed_comm_ms"] + rep["update_ms"], 2
+            ),
+            "noncompute_ms_overlap": round(
+                ovl["exposed_comm_ms"] + ovl["update_ms"], 2
+            ),
+            "noncompute_ms_best": round(
+                best["exposed_comm_ms"] + best["update_ms"], 2
+            ),
+            "opt_state_bytes_replicated":
+                rep["opt_state_bytes_per_device"],
+            "opt_state_bytes_sharded":
+                best["opt_state_bytes_per_device"],
+            "grad_buckets": best["grad_buckets"],
+            "max_loss_delta": r["proxy"]["max_loss_delta"],
+            "exposed_comm_reduced": r["proxy"]["exposed_comm_reduced"],
+            "opt_state_bytes_reduced":
+                r["proxy"]["opt_state_bytes_reduced"],
+            "arms": r["arms"],
+        })
+    return out_runs
+
+
 def bench_flash_numerics(on_tpu: bool) -> dict:
     """Numerics gate (ADVICE r4): the fused single-pass flash backward and
     the classic split two-kernel backward must agree ON CHIP. The fused
@@ -1414,6 +1486,17 @@ def main() -> int:
             "runs": [{"detail": {"targets": {
                 "planner": bench_planner(_on_tpu)
             }}}],
+        }, indent=2))
+        return 0
+    if "--training" in sys.argv[1:]:
+        # standalone training-update round (BENCH_r10_training.json):
+        # per-phase sharded-update/overlap medians in the same runs[]
+        # shape check_readme_numbers reads
+        print(json.dumps({
+            "runs": [
+                {"detail": {"targets": {"training": r}}}
+                for r in bench_training()
+            ],
         }, indent=2))
         return 0
     from kubedl_tpu.operator import Operator, OperatorOptions

@@ -26,7 +26,9 @@ single-sourced so the unit tests pin the formulas, not magic numbers.
 
 from __future__ import annotations
 
+import glob
 import math
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -43,11 +45,28 @@ DTYPE_BYTES = {
 #: opt_moment_dtype="float32").
 OPT_BYTES_PER_PARAM = 8
 
-#: Fraction of peak flops an honest dense step achieves. Calibrated
-#: against the measured bench MFU (BENCH_r05: 0.425 dense); constant
-#: across candidates so it scales step-time predictions without touching
-#: the ranking.
+#: Fraction of peak flops an honest dense step achieves. The FALLBACK
+#: when no committed bench artifact carries a measured MFU —
+#: :func:`calibrated_flops_efficiency` reads the real number from
+#: BENCH_*.json history and ``workloads/tpujob.py`` feeds it to
+#: :func:`plan` at admission; this constant keeps ``estimate()``
+#: deterministic for the formula-pinning unit tests.
 MODEL_FLOPS_EFFICIENCY = 0.4
+
+#: Price the trainer's ZeRO-style cross-replica sharded weight update
+#: (arXiv 2004.13336; TrainConfig.shard_update, on by default): gradient
+#: reduce-scatter + param all-gather on the data axis move the same bytes
+#: as the all-reduce they replace, but optimizer state and the update
+#: compute drop to 1/data per chip.
+UPDATE_SHARDING = True
+
+#: Fraction of the data/replica-axis gradient collective hidden under
+#: backward compute by the overlapped microbatch loop
+#: (TrainConfig.overlap_comm; arXiv 2011.03641 measures TPU collectives
+#: hiding 70-90% under compute once scheduled concurrently — 0.7 is the
+#: conservative end). Only the non-hidden remainder counts toward step
+#: time; hiding is capped by the compute it hides under.
+OVERLAP_FRACTION = 0.7
 
 #: Fraction of HBM the planner may budget; the rest covers the XLA
 #: runtime, collective scratch, and fragmentation.
@@ -174,6 +193,10 @@ class CostBreakdown:
     comm_ms: float = 0.0
     #: per-axis comm cost, e.g. {"data": 1.2, "fsdp": 3.4} (ms)
     comm_ms_by_axis: Dict[str, float] = field(default_factory=dict)
+    #: comm left on the critical path after overlap hides part of the
+    #: data/replica gradient collective under backward compute;
+    #: == comm_ms when the update is not sharded/overlapped
+    exposed_comm_ms: float = 0.0
     hbm_gib: float = 0.0
     feasible: bool = False
     reason: str = ""  # why infeasible, when it is
@@ -188,17 +211,24 @@ def _axis_sizes(mesh: MeshSpec) -> Dict[str, int]:
     }
 
 
-def hbm_per_chip_gib(model: ModelDesc, mesh: MeshSpec) -> float:
+def hbm_per_chip_gib(
+    model: ModelDesc,
+    mesh: MeshSpec,
+    update_sharding: bool = UPDATE_SHARDING,
+) -> float:
     """Per-chip HBM under the candidate sharding: model state sharded over
     (fsdp x tensor), activations over (batch axes x sp), logits over
-    tensor."""
+    tensor. With ``update_sharding`` the gradient accumulator and Adam
+    moments additionally shard over the data axis (the trainer's
+    cross-replica update; params stay gathered between steps)."""
     ax = _axis_sizes(mesh)
     p = model.num_params()
     state_shard = p / (ax["fsdp"] * ax["tensor"])
+    upd = ax["data"] if update_sharding else 1
     state = state_shard * (
-        model.bytes_per_param()  # params
-        + model.bytes_per_param()  # grads (accumulated in param dtype)
-        + OPT_BYTES_PER_PARAM
+        model.bytes_per_param()  # params (gathered between steps)
+        + model.bytes_per_param() / upd  # grads (scattered accumulator)
+        + OPT_BYTES_PER_PARAM / upd  # Adam moments track the update shard
     )
     seq_local = model.seq_len / ax["sp"]
     act_bytes = DTYPE_BYTES[model.dtype]
@@ -219,18 +249,29 @@ def estimate(
     topo: SliceTopology,
     mesh: MeshSpec,
     num_slices: int = 1,
+    update_sharding: bool = UPDATE_SHARDING,
+    overlap_fraction: float = OVERLAP_FRACTION,
+    efficiency: Optional[float] = None,
 ) -> CostBreakdown:
     """Price one candidate layout: modeled step time + per-chip HBM.
 
     The replica axis is the only one allowed to cross slices (search
     guarantees replica == num_slices when num_slices > 1), so it is priced
     at DCN bandwidth; every other axis rides ICI.
+
+    ``update_sharding``/``overlap_fraction`` mirror the trainer's sharded
+    weight update and comm/compute overlap: the data/replica gradient
+    collective (reduce-scatter + all-gather, same ring bytes as the
+    all-reduce it replaces) is partially hidden under backward compute, so
+    ``step_ms = compute_ms + exposed_comm_ms``. ``efficiency`` overrides
+    MODEL_FLOPS_EFFICIENCY (pass
+    ``calibrated_flops_efficiency()[0]`` to price with measured MFU).
     """
     ax = _axis_sizes(mesh)
     out = CostBreakdown(mesh=mesh)
 
     # ---- memory feasibility ------------------------------------------
-    out.hbm_gib = hbm_per_chip_gib(model, mesh)
+    out.hbm_gib = hbm_per_chip_gib(model, mesh, update_sharding)
     budget = topo.hbm_gib_per_chip * HBM_USABLE_FRACTION
     if out.hbm_gib > budget:
         out.reason = (
@@ -243,8 +284,9 @@ def estimate(
     chips = topo.chips * num_slices
     tokens = model.global_batch * model.seq_len
     flops_per_chip = model.flops_per_token() * tokens / chips
+    eff = MODEL_FLOPS_EFFICIENCY if efficiency is None else efficiency
     out.compute_ms = flops_per_chip / (
-        topo.peak_bf16_tflops * 1e12 * MODEL_FLOPS_EFFICIENCY
+        topo.peak_bf16_tflops * 1e12 * eff
     ) * 1e3
 
     # ---- communication ------------------------------------------------
@@ -286,6 +328,89 @@ def estimate(
         )
     out.comm_ms_by_axis = {k: v * 1e3 for k, v in by_axis.items() if v > 0}
     out.comm_ms = sum(out.comm_ms_by_axis.values())
-    out.step_ms = out.compute_ms + out.comm_ms
+    # ---- overlap ------------------------------------------------------
+    # The sharded update turns the data/replica grad all-reduce into
+    # reduce-scatter + all-gather (same ring bytes); the overlapped
+    # microbatch loop hides overlap_fraction of it under backward compute,
+    # capped by the compute actually available to hide under. fsdp/tensor/
+    # sp collectives stay on the critical path (they gate the very next
+    # matmul).
+    hidden_ms = 0.0
+    if update_sharding and overlap_fraction > 0.0:
+        grad_coll_ms = out.comm_ms_by_axis.get("data", 0.0) + (
+            out.comm_ms_by_axis.get("replica", 0.0)
+        )
+        hidden_ms = min(overlap_fraction * grad_coll_ms, out.compute_ms)
+    out.exposed_comm_ms = out.comm_ms - hidden_ms
+    out.step_ms = out.compute_ms + out.exposed_comm_ms
     out.feasible = True
     return out
+
+
+# ---- efficiency calibration from bench history ---------------------------
+
+
+def _walk_mfu(node) -> List[float]:
+    """Every dense-MFU number in an artifact, any vintage of layout:
+    ``summary.mfu.median``, ``summary.mfu`` (plain float),
+    ``parsed.detail.mfu``, ``runs[i].detail.mfu`` — the key is always
+    literally "mfu"; long_context_mfu is NOT calibration input (the
+    efficiency constant anchors the dense regime)."""
+    found: List[float] = []
+    if isinstance(node, dict):
+        for k, v in node.items():
+            if k == "mfu":
+                if isinstance(v, (int, float)):
+                    found.append(float(v))
+                elif isinstance(v, dict) and isinstance(
+                    v.get("median"), (int, float)
+                ):
+                    found.append(float(v["median"]))
+            else:
+                found.extend(_walk_mfu(v))
+    elif isinstance(node, list):
+        for v in node:
+            found.extend(_walk_mfu(v))
+    return found
+
+
+def calibrated_flops_efficiency(repo_root: Optional[str] = None):
+    """(efficiency, source): dense MFU measured by the NEWEST committed
+    BENCH_*.json that carries a plausible one, else
+    (MODEL_FLOPS_EFFICIENCY, "default").
+
+    Plausible means 0.05 < mfu <= 1.0 — CPU-CI artifacts report mfu ~0
+    and must not drag admission-time step estimates to garbage. Medians
+    win over single runs (``_walk_mfu``); multiple values in one artifact
+    reduce by median. Reads are cheap (a handful of small json files) but
+    the result is cached per repo_root for the admission hot path.
+    """
+    import json
+    import statistics
+
+    root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    key = os.path.abspath(root)
+    if key in _EFFICIENCY_CACHE:
+        return _EFFICIENCY_CACHE[key]
+    result = (MODEL_FLOPS_EFFICIENCY, "default")
+    try:
+        arts = sorted(glob.glob(os.path.join(root, "BENCH_*.json")))
+    except OSError:
+        arts = []
+    for path in reversed(arts):  # newest naming first (BENCH_rNN sorts)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        vals = [v for v in _walk_mfu(doc) if 0.05 < v <= 1.0]
+        if vals:
+            result = (statistics.median(vals), os.path.basename(path))
+            break
+    _EFFICIENCY_CACHE[key] = result
+    return result
+
+
+_EFFICIENCY_CACHE: Dict[str, tuple] = {}

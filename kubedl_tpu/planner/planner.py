@@ -35,6 +35,10 @@ class Plan:
     step_time_ms: float
     compute_ms: float
     comm_ms: float
+    #: comm still on the critical path after the sharded-update overlap
+    #: hides part of the gradient collective (== comm_ms when overlap is
+    #: not priced); step_time_ms = compute_ms + exposed_comm_ms
+    exposed_comm_ms: float
     hbm_gib: float
     #: modeled step time of the naive pure-data-parallel layout; None when
     #: DP is infeasible (memory or batch divisibility) on this shape
@@ -62,20 +66,24 @@ class Plan:
         return (
             f"mesh [{self.mesh.to_env()}] on {self.num_slices}x"
             f"{self.topology}: predicted step {self.step_time_ms:.1f} ms "
-            f"({self.compute_ms:.1f} compute + {self.comm_ms:.1f} comm), "
+            f"({self.compute_ms:.1f} compute + {self.exposed_comm_ms:.1f} "
+            f"exposed of {self.comm_ms:.1f} comm), "
             f"{self.hbm_gib:.1f} GiB/chip HBM; {base}; "
             f"{self.candidates_evaluated} candidates in {self.plan_ms:.1f} ms"
         )
 
 
 def dp_baseline(
-    model: ModelDesc, topo: SliceTopology, num_slices: int = 1
+    model: ModelDesc,
+    topo: SliceTopology,
+    num_slices: int = 1,
+    efficiency: Optional[float] = None,
 ) -> CostBreakdown:
     """Price the naive layout planning replaces: pure data parallel over
     every chip (replica across slices) — exactly what
     ``MeshSpec.for_slice`` defaults to."""
     mesh = MeshSpec.for_slice(topo, num_slices=num_slices)
-    cost = estimate(model, topo, mesh, num_slices)
+    cost = estimate(model, topo, mesh, num_slices, efficiency=efficiency)
     if cost.feasible and model.global_batch % (topo.chips * num_slices):
         # structurally illegal (each gradient replica needs >= 1 sequence):
         # the search would never emit it, so the baseline must not claim it
@@ -88,18 +96,26 @@ def dp_baseline(
 
 
 def plan(
-    model: ModelDesc, topo: SliceTopology, num_slices: int = 1
+    model: ModelDesc,
+    topo: SliceTopology,
+    num_slices: int = 1,
+    efficiency: Optional[float] = None,
 ) -> Plan:
     """Search the layout space and return the best feasible plan.
 
-    Raises :class:`PlanError` when nothing fits — the model cannot train
-    on this slice shape under any supported sharding.
+    ``efficiency`` overrides the cost model's flops-efficiency constant —
+    the controller passes ``calibrated_flops_efficiency()[0]`` so
+    admission-time estimates track measured bench MFU. Raises
+    :class:`PlanError` when nothing fits — the model cannot train on this
+    slice shape under any supported sharding.
     """
     t0 = time.perf_counter()
     errs = model.validate()
     if errs:
         raise PlanError("; ".join(errs))
-    res: SearchResult = search(model, topo, max(num_slices, 1))
+    res: SearchResult = search(
+        model, topo, max(num_slices, 1), efficiency=efficiency
+    )
     plan_ms = (time.perf_counter() - t0) * 1e3
     if not res.ranked:
         worst = min(
@@ -112,7 +128,7 @@ def plan(
             f"needs {worst:.1f} GiB/chip)"
         )
     best = res.best
-    base = dp_baseline(model, topo, max(num_slices, 1))
+    base = dp_baseline(model, topo, max(num_slices, 1), efficiency=efficiency)
     return Plan(
         mesh=best.mesh,
         topology=topo.name,
@@ -120,6 +136,7 @@ def plan(
         step_time_ms=best.step_ms,
         compute_ms=best.compute_ms,
         comm_ms=best.comm_ms,
+        exposed_comm_ms=best.exposed_comm_ms,
         hbm_gib=best.hbm_gib,
         baseline_dp_ms=base.step_ms if base.feasible else None,
         candidates_evaluated=res.evaluated,

@@ -15,7 +15,7 @@ deterministic tie-break that prefers simpler, more data-parallel layouts.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List
+from typing import List, Optional
 
 from kubedl_tpu.api.topology import MeshSpec, SliceTopology
 from kubedl_tpu.planner.costmodel import CostBreakdown, ModelDesc, estimate
@@ -120,12 +120,16 @@ def _rank_key(c: CostBreakdown):
 
 
 def search(
-    model: ModelDesc, topo: SliceTopology, num_slices: int = 1
+    model: ModelDesc,
+    topo: SliceTopology,
+    num_slices: int = 1,
+    efficiency: Optional[float] = None,
 ) -> SearchResult:
-    """Enumerate, price, prune, rank."""
+    """Enumerate, price, prune, rank. ``efficiency`` overrides the cost
+    model's MODEL_FLOPS_EFFICIENCY (bench-calibrated MFU at admission)."""
     res = SearchResult()
     for mesh in enumerate_layouts(model, topo, num_slices):
-        cost = estimate(model, topo, mesh, num_slices)
+        cost = estimate(model, topo, mesh, num_slices, efficiency=efficiency)
         res.evaluated += 1
         (res.ranked if cost.feasible else res.infeasible).append(cost)
     res.ranked.sort(key=_rank_key)

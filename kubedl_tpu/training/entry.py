@@ -93,6 +93,21 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
     # here and must deserialize, not recompile, the unchanged train step
     cache_dir = enable_compilation_cache()
     cache_before = cache_entry_count(cache_dir)
+    # comm/compute overlap (docs/performance.md "Sharded weight update &
+    # overlap"): the sharded update leans on XLA's latency-hiding
+    # scheduler to run the gradient reduce-scatter concurrently with
+    # backward compute. TPU-only knobs, appended — never overwrite flags
+    # the operator or user already set (their copy wins on conflict
+    # because libtpu parses left to right, last occurrence winning).
+    if "cpu" not in os.environ.get("JAX_PLATFORMS", "").lower():
+        cur = os.environ.get("LIBTPU_INIT_ARGS", "")
+        if "latency_hiding_scheduler" not in cur:
+            os.environ["LIBTPU_INIT_ARGS"] = (
+                "--xla_tpu_enable_latency_hiding_scheduler=true "
+                "--xla_tpu_enable_async_collective_fusion=true "
+                "--xla_tpu_enable_async_collective_fusion_fuse_all_gather"
+                "=true " + cur
+            ).strip()
     t0 = time.time()
     import jax
 
@@ -152,6 +167,11 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
         ckpt_every=int(opts.get("ckpt_every", 0)),
         ckpt_async=bool(opts.get("ckpt_async", True)),
         opt_moment_dtype=opts.get("opt_moment_dtype", "float32"),
+        shard_update=bool(opts.get("shard_update", True)),
+        overlap_comm=bool(opts.get("overlap_comm", True)),
+        grad_bucket_mb=float(opts.get("grad_bucket_mb", 4.0)),
+        log_every=int(opts.get("log_every", 0)),
+        long_context_policy=opts.get("long_context_policy", "auto"),
     )
     # elastic resize (docs/elasticity.md): when the gang restarted at a
     # world size different from the one the job was tuned at, rescale

@@ -8,6 +8,15 @@ TPU-first mechanics:
 - Sharding is enforced with `lax.with_sharding_constraint` *inside* the
   step (on params and activations' entry points) so compiler propagation
   handles optimizer state without hand-listing its tree structure.
+- Cross-replica sharded weight update (arXiv 2004.13336, default on): the
+  data-axis gradient collective lowers to a reduce-scatter, each replica
+  runs the optimizer on the 1/dp param shard it owns (adam moments live
+  partitioned across data for the whole run — see `_update_shardings`),
+  and the updated params are all-gathered. With `overlap_comm`, the
+  microbatch `lax.scan` accumulates SCATTERED gradients so each
+  microbatch's reduce-scatter overlaps the next microbatch's backward
+  (arXiv 2011.03641); `training/buckets.py` plans which leaves scatter
+  in-loop.
 - Attention hot path: the pallas flash kernel on TPU (ring/Ulysses context
   attention when the mesh has an "sp" axis; dense oracle on CPU) — selected
   once at build time and recorded in ``Trainer.attn_impl``.
@@ -148,6 +157,36 @@ class TrainConfig:
     #: north-star metric (reference: pkg/metrics/job_metrics.go:139-194).
     #: "" = jax default (threefry).
     init_rng_impl: str = "rbg"
+    #: ZeRO-style cross-replica sharded weight update (arXiv 2004.13336):
+    #: reduce-scatter gradients over the "data" mesh axis, run the
+    #: optimizer on the 1/dp shard it owns, all-gather the updated params.
+    #: Optimizer state (adam mu/nu) then lives partitioned across
+    #: data-parallel replicas even when fsdp=1. False = the replicated
+    #: update (grad all-reduce + full optax apply on every replica).
+    shard_update: bool = True
+    #: overlap gradient collectives with backward compute: accumulate
+    #: SCATTERED per-microbatch gradients inside the ``lax.scan``
+    #: microbatch loop, so each microbatch's reduce-scatter overlaps the
+    #: next microbatch's backward (arXiv 2011.03641). Takes effect with
+    #: shard_update on a >1 "data" axis; grad_accum > 1 is where it pays
+    #: (the in-loop accumulator is also dp x smaller).
+    overlap_comm: bool = True
+    #: gradient bucket size (MiB) for the overlap scatter plan
+    #: (training/buckets.py); leaves below the plan's minimum accumulate
+    #: replicated in-loop and scatter once after the loop
+    grad_bucket_mb: float = 4.0
+    #: fetch the loss scalar to host every N steps in ``fit`` (plus the
+    #: first and final step). Every fetch is a true device barrier that
+    #: drains the async dispatch pipeline, so 0 (= only first/final) is
+    #: the perf default; set small values only for debugging visibility.
+    log_every: int = 0
+    #: long-context policy pass: "auto" upgrades a remat'ing Llama config
+    #: whose seq_len >= long_context_threshold to the blockwise-attention
+    #: remat policy ("flash_rope": backward reconstructs nothing on the
+    #: attention path) and chunks the LM loss head so the [B, S, V] fp32
+    #: logits never materialize. "off" = leave the model config alone.
+    long_context_policy: str = "auto"
+    long_context_threshold: int = 4096
     seed: int = 0
 
 
@@ -166,10 +205,31 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     )
 
 
+#: process-wide count of _fetch_scalar barriers — the regression test for
+#: the log_every cadence asserts steps between logs issue NO blocking
+#: transfer, and this counter is the single choke point they all go through
+SCALAR_FETCHES = 0
+
+
 def _fetch_scalar(x) -> float:
     """True device barrier: transfer a scalar to host. On the axon tunnel
     platform `block_until_ready` can return early; `device_get` cannot."""
+    global SCALAR_FETCHES
+    SCALAR_FETCHES += 1
     return float(jax.device_get(x))
+
+
+def state_bytes_per_device(state, key: str = "opt_state") -> int:
+    """Bytes of ``state[key]`` resident on the busiest device — the
+    artifact-grade proof that the sharded update actually partitioned the
+    optimizer state (1/dp of the replicated layout), measured from the
+    real buffers, not the sharding annotations."""
+    per_dev: Dict[Any, int] = {}
+    for leaf in jax.tree_util.tree_leaves(state[key] if key else state):
+        if isinstance(leaf, jax.Array):
+            for sh in leaf.addressable_shards:
+                per_dev[sh.device] = per_dev.get(sh.device, 0) + sh.data.nbytes
+    return max(per_dev.values(), default=0)
 
 
 class Trainer:
@@ -187,6 +247,7 @@ class Trainer:
                 cfg, model=dataclasses.replace(cfg.model, fuse_projections=False)
             )
             self.cfg = cfg
+        cfg = self._apply_long_context_policy(cfg)
         self.family = family_for(cfg.model)
         self.tx = make_optimizer(cfg)
         self.pipe_size = meshlib.axis_size(self.mesh, "pipe")
@@ -222,6 +283,133 @@ class Trainer:
         self.state_shardings = self._state_shardings()
         self._build_fns()
 
+    def _apply_long_context_policy(self, cfg: TrainConfig) -> TrainConfig:
+        """Long-context remat/blockwise-attention policy pass.
+
+        At seq_len >= long_context_threshold the activation bill, not the
+        matmuls, owns HBM: a remat'ing Llama config is upgraded to the
+        "flash_rope" policy (save only the blockwise-attention kernel's
+        residuals + inputs — backward reconstructs nothing on the
+        attention path, and nothing O(S^2) is ever resident) and the LM
+        loss is chunked so the [B, S, V] fp32 logits never materialize.
+        Records what changed in ``self.long_context_policy_applied`` (rides
+        the fit summary) so a bench run is attributable.
+        """
+        self.long_context_policy_applied = ""
+        if (
+            cfg.long_context_policy != "auto"
+            or cfg.seq_len < cfg.long_context_threshold
+            or not isinstance(cfg.model, llama.LlamaConfig)
+        ):
+            return cfg
+        m = cfg.model
+        changes: Dict[str, Any] = {}
+        if m.remat and m.remat_policy not in ("flash", "flash_rope"):
+            changes["remat_policy"] = "flash_rope"
+        if m.loss_chunk == 0:
+            changes["loss_chunk"] = 512
+        if not changes:
+            return cfg
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(m, **changes)
+        )
+        self.cfg = cfg
+        self.long_context_policy_applied = ",".join(
+            f"{k}={v}" for k, v in sorted(changes.items())
+        )
+        return cfg
+
+    def _update_axes(self) -> Tuple[str, ...]:
+        """Mesh axes the weight update shards over: "data" (pure ICI).
+        The "replica" axis crosses slices over DCN, where a per-step param
+        all-gather would dominate — replicas keep whole optimizer shards."""
+        if not self.cfg.shard_update or self.pipe_size > 1:
+            return ()
+        return tuple(
+            a for a in ("data",) if meshlib.axis_size(self.mesh, a) > 1
+        )
+
+    def _update_shardings(self, params_sds, scatter_mask):
+        """ZeRO-style update shardings (arXiv 2004.13336): each scattered
+        param leaf's pruned spec, additionally partitioned over the data
+        axis on the first dimension that divides evenly — composing with
+        whatever fsdp/tensor sharding the leaf already has.
+
+        The bucket plan's ``scatter_mask`` governs the WHOLE update layout,
+        not just the in-loop collectives: a leaf it skips (norm vectors,
+        anything below MIN_SCATTER_BYTES) keeps the replicated update.
+        Scattering those few hundred bytes saves nothing, and the sharding
+        constraint on e.g. a norm-weight gradient propagates into the
+        backward graph as a feature-dim activation sharding the SPMD
+        partitioner can only resolve by fully rematerializing the
+        activation (measured: 4 involuntary-remat warnings per compile on
+        the CPU mesh). Big matmul leaves are safe — their grad constraint
+        resolves to a free slice of the already-replicated activations.
+
+        Returns None when the update is replicated (shard_update off, no
+        >1 data axis, or pipeline mode — the GPipe stage body owns its own
+        collectives)."""
+        axes = self._update_axes()
+        if not axes:
+            return None
+        dsize = 1
+        for a in axes:
+            dsize *= self.mesh.shape[a]
+        # On a pure data/replica mesh any free dim may carry the scatter.
+        # When the model itself is sharded (fsdp/tensor), only the leading
+        # dim of STACKED-LAYER leaves is safe — it is the scan axis, never
+        # an activation dim. Scattering a feature/vocab dim there makes the
+        # SPMD partitioner reshard backward activations through an
+        # "involuntary full rematerialization" that this XLA build
+        # miscompiles (forward loss visibly wrong on a data=4 x fsdp=2
+        # mesh; embed/lm_head leading-dim scatters stay exact but still
+        # force the remat path, so they are excluded too).
+        model_sharded = any(
+            meshlib.axis_size(self.mesh, a) > 1
+            for a in ("fsdp", "tensor", "sp", "expert")
+        )
+        lk = self.family.layers_key
+
+        def extend(spec: P, shape, stacked: bool) -> P:
+            parts = list(spec) + [None] * (len(shape) - len(spec))
+            dims = []
+            for d, p in enumerate(parts):
+                cur = tuple(
+                    a for a in (
+                        tuple(p) if isinstance(p, (tuple, list)) else (p,)
+                    ) if a
+                )
+                if any(a in axes for a in cur):
+                    return P(*parts)  # already data-sharded, nothing to add
+                dims.append((d, cur))
+            if model_sharded:
+                dims = dims[:1] if stacked else []
+            # first-fit over eligible dims; never compose onto a dim the
+            # model already shards (same involuntary-remat miscompile)
+            for d, cur in dims:
+                if cur:
+                    continue
+                if shape[d] % dsize == 0:
+                    parts[d] = axes[0] if len(axes) == 1 else axes
+                    break
+            return P(*parts)
+
+        def leaf_sharding(path, spec, sds, m):
+            stacked = bool(lk) and any(
+                getattr(k, "key", None) == lk for k in path[:1]
+            )
+            return NamedSharding(
+                self.mesh, extend(spec, sds.shape, stacked) if m else spec
+            )
+
+        return jax.tree_util.tree_map_with_path(
+            leaf_sharding,
+            self.pspecs,
+            params_sds,
+            scatter_mask,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
     def _state_shardings(self):
         """Explicit shardings for the WHOLE train state, not just params.
 
@@ -240,8 +428,50 @@ class Trainer:
         rep = NamedSharding(self.mesh, P())
         key = jax.random.PRNGKey(0)
         params_sds = jax.eval_shape(self.family.init, key)
+        leaf_sds = jax.tree_util.tree_leaves(params_sds)
+        leaf_bytes = [
+            int(np.prod(s.shape)) * jnp.dtype(s.dtype).itemsize
+            for s in leaf_sds
+        ]
+        from kubedl_tpu.training.buckets import plan_grad_buckets
+
+        self.grad_bucket_plan = plan_grad_buckets(
+            leaf_bytes, int(self.cfg.grad_bucket_mb * 2**20)
+        )
+        #: per-leaf: does this gradient participate in the sharded update?
+        #: (tree of bools, same structure as params)
+        self._scatter_mask = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(params_sds),
+            list(self.grad_bucket_plan.scatter),
+        )
+        #: ZeRO update layout (None = replicated update). Adam moments are
+        #: matched to the UPDATE sharding below: the optimizer only ever
+        #: touches the 1/dp shard each replica owns, so its state lives
+        #: partitioned across the data axis for the whole run (params
+        #: still live gathered between steps — they are all-gathered at
+        #: the end of each step).
+        self.update_shardings = self._update_shardings(
+            params_sds, self._scatter_mask
+        )
+        if self.update_shardings is not None and all(
+            u.spec == p.spec
+            for u, p in zip(
+                jax.tree_util.tree_leaves(self.update_shardings),
+                jax.tree_util.tree_leaves(self.param_shardings),
+            )
+        ):
+            # nothing actually scatters on this mesh (e.g. the stacked
+            # layer dim does not divide the data axis): drop to the seed
+            # replicated-update path so the in-loop constraints do not
+            # trip the partitioner for zero benefit
+            self.update_shardings = None
+        moment_shardings = (
+            self.update_shardings
+            if self.update_shardings is not None
+            else self.param_shardings
+        )
         p_leaves = jax.tree_util.tree_flatten_with_path(
-            self.param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding)
+            moment_shardings, is_leaf=lambda x: isinstance(x, NamedSharding)
         )[0]
         s_leaves = jax.tree_util.tree_flatten_with_path(params_sds)[0]
         # (path-as-strings, shape) -> sharding for every param
@@ -406,11 +636,18 @@ class Trainer:
                 self.param_shardings,
             )
 
-        def init_fn(key):
-            params = family.init(key)
-            params = constrain_params(params)
-            opt_state = self.tx.init(params)
-            return {"params": params, "opt_state": opt_state,
+        # params and optimizer state initialize in SEPARATE jits: rbg rng
+        # bits depend on how the program is partitioned, and tx.init's
+        # zeros_like(params) would back-propagate the (shard_update-
+        # dependent) moment shardings into the param rng — making initial
+        # params differ between sharded and replicated update modes. With
+        # params as a plain *input* to the opt init, the update layout
+        # cannot reach the rng.
+        def init_params_fn(key):
+            return constrain_params(family.init(key))
+
+        def init_opt_fn(params):
+            return {"opt_state": self.tx.init(params),
                     "step": jnp.zeros((), jnp.int32)}
 
         if self.pipe_size > 1:
@@ -418,6 +655,22 @@ class Trainer:
         else:
             def loss_fn(params, batch):
                 return family.loss(params, batch, attn_fn=attn_fn)
+
+        update_shardings = self.update_shardings  # None = replicated update
+
+        def constrain_update(tree):
+            """Reduce-scatter point: constraining a data-replicated value
+            to the data-sharded update layout makes GSPMD lower the grad
+            psum to a reduce-scatter (and slicing params is free)."""
+            return jax.tree_util.tree_map(
+                lambda x, s: lax.with_sharding_constraint(x, s),
+                tree,
+                update_shardings,
+            )
+
+        overlap = (
+            update_shardings is not None and cfg.overlap_comm
+        )
 
         def train_step(state, batch):
             params = constrain_params(state["params"])
@@ -428,6 +681,14 @@ class Trainer:
 
                 def acc(carry, mb):
                     loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+                    if overlap:
+                        # scatter where backward produced them: each
+                        # microbatch's grad collective is a reduce-scatter
+                        # that overlaps the NEXT microbatch's backward
+                        # (and the carried accumulator is dp x smaller).
+                        # Leaves the bucket plan skips keep their param
+                        # sharding here — the constraint is a no-op.
+                        grads = constrain_update(grads)
                     g, l = carry
                     return (
                         jax.tree_util.tree_map(jnp.add, g, grads),
@@ -435,6 +696,8 @@ class Trainer:
                     ), None
 
                 zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+                if overlap:
+                    zeros = constrain_update(zeros)
                 (grads, loss), _ = lax.scan(acc, (zeros, 0.0), micro)
                 grads = jax.tree_util.tree_map(
                     lambda g: g / cfg.grad_accum, grads
@@ -442,10 +705,29 @@ class Trainer:
                 loss = loss / cfg.grad_accum
             else:
                 loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-            grads = constrain_params(grads)
-            updates, opt_state = self.tx.update(grads, state["opt_state"], params)
-            params = optax.apply_updates(params, updates)
-            params = constrain_params(params)
+            if update_shardings is not None:
+                # ZeRO-style sharded weight update (arXiv 2004.13336):
+                # reduce-scatter grads -> each replica updates only the
+                # 1/dp param shard it owns (optimizer state never exists
+                # replicated) -> all-gather the updated params. The math
+                # is IDENTICAL to all-reduce + replicated apply; only the
+                # placement changes.
+                grads = constrain_update(grads)
+                params_sc = constrain_update(params)
+                updates, opt_state = self.tx.update(
+                    grads, state["opt_state"], params_sc
+                )
+                params = optax.apply_updates(params_sc, updates)
+                params = constrain_params(params)  # the all-gather
+            else:
+                grads = constrain_params(grads)
+                updates, opt_state = self.tx.update(
+                    grads, state["opt_state"], params
+                )
+                params = optax.apply_updates(params, updates)
+                params = constrain_params(params)
+            # on scattered grads GSPMD inserts the psum-of-squares — the
+            # norm is exact and replicated either way
             gnorm = optax.global_norm(grads)
             new_state = {
                 "params": params,
@@ -459,7 +741,17 @@ class Trainer:
             # train step's input signature is then independent of what
             # GSPMD would have propagated, so the AOT warm compile and the
             # dispatch compile produce the same program (same cache key)
-            self.init_fn = jax.jit(init_fn, out_shardings=self.state_shardings)
+            self.init_params_fn = jax.jit(
+                init_params_fn, out_shardings=self.state_shardings["params"]
+            )
+            self.init_opt_fn = jax.jit(
+                init_opt_fn,
+                in_shardings=(self.state_shardings["params"],),
+                out_shardings={
+                    "opt_state": self.state_shardings["opt_state"],
+                    "step": self.state_shardings["step"],
+                },
+            )
             self.train_step = jax.jit(
                 train_step,
                 donate_argnums=(0,),
@@ -528,7 +820,20 @@ class Trainer:
 
     def init_state(self) -> Dict[str, Any]:
         with self.mesh:
-            return self.init_fn(self._init_key())
+            params = self.init_params_fn(self._init_key())
+            state = {"params": params}
+            state.update(self.init_opt_fn(params))
+            return state
+
+    def init_fn(self, key):
+        """Whole-state init as one callable, for abstract-eval consumers
+        (``jax.eval_shape(trainer.init_fn, key)``). Concrete init goes
+        through ``init_state``'s split jits so the rbg param rng cannot
+        see the (update-layout-dependent) opt-state shardings."""
+        params = self.init_params_fn(key)
+        state = {"params": params}
+        state.update(self.init_opt_fn(params))
+        return state
 
     def warm_compile_async(self) -> None:
         """AOT-compile the train step in a background thread, overlapping
@@ -646,7 +951,15 @@ class Trainer:
         start = int(jax.device_get(state["step"]))
         pre_loop_sync_s = time.perf_counter() - t_sync
         tokens_per_step = self.cfg.global_batch * self.cfg.seq_len
-        losses: List[Any] = []
+        # dispatch-pipeline discipline: the loop retains ONLY the newest
+        # loss array (not a per-step list — the old list pinned every
+        # step's device buffer for the whole run) and fetches a scalar at
+        # the log_every cadence. Steps between logs issue NO blocking
+        # transfer; the counter on _fetch_scalar is the regression proof.
+        log_every = self.cfg.log_every
+        loss_log: List[Tuple[int, float]] = []
+        steps_run = 0
+        last_loss_arr = None
         t0 = time.perf_counter()
         first_step_s = 0.0
         first_loss = None
@@ -675,13 +988,20 @@ class Trainer:
                             state, metrics = step_fn(state, batch)
                     else:
                         state, metrics = step_fn(state, batch)
-                    losses.append(metrics["loss"])
+                    last_loss_arr = metrics["loss"]
+                    steps_run += 1
                     if i == start:
                         # true barrier: scalar fetch (block_until_ready lies on
                         # the tunnel platform — see module docstring)
                         first_loss = _fetch_scalar(metrics["loss"])
                         first_step_s = time.perf_counter() - t0
                         t_run = time.perf_counter()
+                    elif (
+                        log_every
+                        and (i + 1) % log_every == 0
+                        and i + 1 < steps  # final step fetches below anyway
+                    ):
+                        loss_log.append((i + 1, _fetch_scalar(metrics["loss"])))
                     if on_step is not None:
                         on_step(i, metrics)
                     if (
@@ -698,8 +1018,8 @@ class Trainer:
                         ckpt_overhead += time.perf_counter() - t_ck
                 # stop the clock on a true barrier: the last loss transitively
                 # depends on every dispatched step via the donated state chain
-                if losses:
-                    last_loss = _fetch_scalar(losses[-1])
+                if steps_run:
+                    last_loss = _fetch_scalar(last_loss_arr)
                 else:  # resume found nothing left to do
                     last_loss = first_loss = float("nan")
         except BaseException:
@@ -722,7 +1042,7 @@ class Trainer:
             raise
         total = time.perf_counter() - t_run - ckpt_overhead
         n_chips = jax.device_count()
-        steady_steps = len(losses) - 1
+        steady_steps = steps_run - 1
         tps = tokens_per_step * steady_steps / total if total > 0 and steady_steps > 0 else 0.0
         summary = {
             "warm_compile_join_s": self._warm_join_s,
@@ -730,7 +1050,7 @@ class Trainer:
             "warm_join_timed_out": self._warm_join_timed_out,
             "pre_loop_sync_s": pre_loop_sync_s,
             "first_step_seconds": first_step_s,
-            "steps": len(losses),
+            "steps": steps_run,
             "total_steps": steps,
             "start_step": start,
             "first_loss": first_loss,
@@ -743,6 +1063,18 @@ class Trainer:
             "attn_impl": self.attn_impl,
             "model_family": self.family.name,
             "n_params": self.family.num_params,
+            # update-layout attribution (sharded weight update + overlap):
+            # which path compiled, what the long-context pass changed, and
+            # the measured per-device optimizer-state residency
+            "shard_update": self.update_shardings is not None,
+            "overlap_comm": (
+                self.update_shardings is not None and self.cfg.overlap_comm
+            ),
+            "long_context_policy": self.long_context_policy_applied,
+            "grad_buckets": self.grad_bucket_plan.n_buckets,
+            "opt_state_bytes_per_device": state_bytes_per_device(state),
+            "log_every": self.cfg.log_every,
+            "loss_log": loss_log,
         }
         # cross-process gate data: bench workers may run as subprocesses,
         # so the "pallas kernel really traced" proof rides the summary
@@ -750,7 +1082,7 @@ class Trainer:
 
         summary["flash_trace_count"] = _fa.TRACE_COUNT
         summary["sanity_violations"] = self.sanity_check(summary)
-        if ckpt_dir and losses:
+        if ckpt_dir and steps_run:
             # label with the state's REAL counter, not the `steps` budget: a
             # restored state that had nothing left to train must not write a
             # mislabeled dir that misorders restore-from-newest (and when no

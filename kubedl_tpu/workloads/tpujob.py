@@ -169,8 +169,14 @@ class TPUJobController(WorkloadController):
             except (ValueError, TypeError):
                 pass  # corrupt annotation: re-plan
         from kubedl_tpu.planner import plan as compute_plan
+        from kubedl_tpu.planner.costmodel import calibrated_flops_efficiency
 
-        p = compute_plan(job.model_desc, topo, num_slices=ns)
+        # Admission-time estimates price compute at the MFU the newest
+        # committed bench artifact measured (fallback: the cost model's
+        # constant); estimate() itself stays deterministic for the
+        # formula-pinning tests.
+        eff, _eff_src = calibrated_flops_efficiency()
+        p = compute_plan(job.model_desc, topo, num_slices=ns, efficiency=eff)
         # First plan pins the base data-parallel degree (grad-accum rescale
         # on resize works in DP units once a planner owns the mesh,
         # elastic/resize.py data_parallel_world)

@@ -28,6 +28,9 @@ ROUTER_ARTIFACT = "BENCH_r07_router.json"
 PAGED_ARTIFACT = "BENCH_r08.json"
 #: auto-parallelism planner row (r9): separate artifact, same runs[] shape
 PLANNER_ARTIFACT = "BENCH_r09_planner.json"
+#: sharded weight update + overlap row (r10): separate artifact, same
+#: runs[] shape (CPU proxy — see docs/performance.md)
+TRAINING_ARTIFACT = "BENCH_r10_training.json"
 
 
 def _runs_median(runs, *path) -> float:
@@ -164,6 +167,29 @@ def expected_planner_strings(artifact: dict) -> dict:
     }
 
 
+def expected_training_strings(artifact: dict) -> dict:
+    """README sharded-update row strings from BENCH_r10_training.json."""
+    runs = artifact["runs"]
+    tgt = ("targets", "training")
+    rep_b = _runs_median(runs, *tgt, "opt_state_bytes_replicated")
+    sh_b = _runs_median(runs, *tgt, "opt_state_bytes_sharded")
+    nc_rep = _runs_median(runs, *tgt, "noncompute_ms_replicated")
+    nc_best = _runs_median(runs, *tgt, "noncompute_ms_best")
+    delta = _runs_median(runs, *tgt, "max_loss_delta")
+    return {
+        f"optimizer state **{rep_b / sh_b:.1f}x** smaller per replica":
+            "ratio of runs[].targets.training.opt_state_bytes_"
+            "replicated/_sharded medians",
+        f"{sh_b / 2**20:.1f} vs {rep_b / 2**20:.1f} MiB/device":
+            "medians of runs[].targets.training.opt_state_bytes_*",
+        f"non-compute step time **{nc_rep:.0f} -> {nc_best:.0f} ms**":
+            "medians of runs[].targets.training.noncompute_ms_"
+            "replicated/_best",
+        f"max loss delta {delta:.1e}":
+            "median of runs[].targets.training.max_loss_delta",
+    }
+
+
 def check(repo: Path = REPO) -> list:
     """Returns a list of mismatch descriptions (empty = README is clean)."""
     artifact = json.loads((repo / ARTIFACT).read_text())
@@ -187,6 +213,11 @@ def check(repo: Path = REPO) -> list:
     expected.update(
         expected_planner_strings(
             json.loads((repo / PLANNER_ARTIFACT).read_text())
+        )
+    )
+    expected.update(
+        expected_training_strings(
+            json.loads((repo / TRAINING_ARTIFACT).read_text())
         )
     )
     problems = []

@@ -62,6 +62,15 @@ PAGED_BUDGET_MS = 5.0
 #: accidental combinatorial blow-up or per-candidate allocation storm.
 PLANNER_BUDGET_MS = 50.0
 
+#: p95 budget (ms) for planning the gradient-bucket scatter layout
+#: (kubedl_tpu/training/buckets.py): plan_grad_buckets runs on the host
+#: inside Trainer.__init__ for every (re)build — greedy first-fit over a
+#: few hundred parameter leaves, pure Python arithmetic, no jax. 5 ms
+#: leaves ~50x headroom on a shared CI machine while catching an
+#: accidental O(leaves^2) pass or a stray device round-trip sneaking
+#: into trainer construction.
+BUCKET_BUDGET_MS = 5.0
+
 
 def build_stub_engine(max_batch: int = 4, max_seq: int = 128,
                       kv_layout: str = "contiguous"):
@@ -325,15 +334,53 @@ def run_planner_microbench() -> dict:
     }
 
 
+def run_bucket_microbench(iters: int = 200) -> dict:
+    """Host overhead of the gradient-bucket scatter plan: price a
+    realistic large-model leaf census (a few hundred leaves spanning
+    norm-scale bytes to embedding GiBs) ``iters`` times and report the
+    per-plan percentiles against BUCKET_BUDGET_MS."""
+    from kubedl_tpu.training.buckets import plan_grad_buckets
+
+    # ~8B-class census: 80 stacked layers x (7 matmul leaves + 2 norms)
+    # + embed/head/final-norm, fp32 grad bytes
+    leaf_bytes = []
+    for _ in range(80):
+        leaf_bytes += [4 * 4096 * 4096] * 4   # attention projections
+        leaf_bytes += [4 * 4096 * 14336] * 3  # ffn
+        leaf_bytes += [4 * 4096] * 2          # rms norms
+    leaf_bytes += [4 * 128256 * 4096] * 2 + [4 * 4096]
+    times = []
+    plan = None
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        plan = plan_grad_buckets(leaf_bytes)
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    p50 = times[len(times) // 2]
+    p95 = times[int(len(times) * 0.95)]
+    return {
+        "leaves": len(leaf_bytes),
+        "buckets": plan.n_buckets,
+        "scattered_fraction": round(plan.scattered_fraction, 4),
+        "plan_ms_p50": round(p50, 4),
+        "plan_ms_p95": round(p95, 4),
+        "plan_ms_max": round(times[-1], 4),
+        "budget_ms": BUCKET_BUDGET_MS,
+        "within_budget": p95 <= BUCKET_BUDGET_MS,
+    }
+
+
 def main() -> int:
     out = run_microbench()
     out["prefix"] = run_prefix_microbench()
     out["paged"] = run_paged_microbench()
     out["planner"] = run_planner_microbench()
+    out["buckets"] = run_bucket_microbench()
     print(json.dumps(out, indent=2))
     ok = (out["within_budget"] and out["prefix"]["within_budget"]
           and out["paged"]["within_budget"]
-          and out["planner"]["within_budget"])
+          and out["planner"]["within_budget"]
+          and out["buckets"]["within_budget"])
     return 0 if ok else 1
 
 

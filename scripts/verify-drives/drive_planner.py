@@ -37,12 +37,13 @@ from kubedl_tpu.workloads.tpujob import TPUJob
 
 tmp = tempfile.mkdtemp(prefix="kdl-planner-drive-")
 
-# 1. the planner library itself: llama-1b cannot pure-DP on 16 GiB v5e
-#    chips (DP wants ~15 GiB of optimizer state alone) — fsdp appears and
-#    nothing slower than the (infeasible) baseline is ever chosen
+# 1. the planner library itself: a REPLICATED update could not pure-DP
+#    llama-1b on 16 GiB v5e chips (~15 GiB of optimizer state per chip);
+#    the cross-replica sharded update divides that state by the data axis,
+#    so plain DP fits and the simplicity tie-break keeps it
 p = plan(MODEL_ZOO["llama-1b"], get_slice("v5e-8"))
-check("llama-1b on v5e-8 plans fsdp where DP is memory-infeasible",
-      p.baseline_dp_ms is None and p.mesh.axes.get("fsdp", 1) > 1,
+check("llama-1b on v5e-8 fits pure DP under the sharded update",
+      p.baseline_dp_ms is not None and p.mesh.axes == {"data": 8},
       p.mesh.to_env())
 try:
     plan(MODEL_ZOO["llama-1b"], get_slice("cpu-1"))
@@ -152,9 +153,13 @@ with Operator(opts, runtime=ThreadRuntime(), inventory=inv) as op:
 
     # 5. live elastic resize re-plans: tiny model on cpu-1 slices, grow
     #    1 -> 2 mid-run; the new gang must carry the re-planned mesh
+    # max_slices starts at 1 so the ElasticPolicy cannot auto-grow into
+    # the free second slice before we read the 1-slice plan (a fresh job
+    # has no cooldown stamp, so grow-at-RUNNING is otherwise immediate);
+    # the explicit grow below raises the ceiling and the size together
     el = _auto_job("el", "cpu-1", 1, "__drive_planner__:_gated_worker",
                    model=MODEL_ZOO["tiny"])
-    el.elastic = ElasticSpec(min_slices=1, max_slices=2,
+    el.elastic = ElasticSpec(min_slices=1, max_slices=1,
                              cooldown_seconds=0.1)
     op.submit(el)
     op.wait_for_phase("TPUJob", "el", JobConditionType.RUNNING, timeout=60)
@@ -165,6 +170,8 @@ with Operator(opts, runtime=ThreadRuntime(), inventory=inv) as op:
           ann1["slices"] == 1 and base_dp == "1", json.dumps(ann1))
 
     def grow(j):
+        j.elastic = ElasticSpec(min_slices=1, max_slices=2,
+                                cooldown_seconds=0.1)
         j.num_slices = 2
     op.store.update_with_retry("TPUJob", "el", "default", grow)
 
