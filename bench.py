@@ -938,6 +938,151 @@ def bench_speculative(on_tpu: bool) -> dict:
     }
 
 
+def bench_decode(on_tpu: bool) -> dict:
+    """Blocked paged-attention decode + model-draft speculation
+    (docs/serving.md "Blocked paged attention" / "Model drafts").
+
+    Raw sweep: greedy `paged_decode_segment` at 1/4/12-way concurrency
+    over a 512-slot block table, gather vs blocked kernels INTERLEAVED
+    (alternating which goes first each trial, min-of-trials per kernel)
+    so neither systematically rides a warmer allocator. Acceptance:
+    greedy token streams bit-identical between kernels at every width,
+    the blocked path actually traced into the compiled graph, and
+    blocked tokens/s strictly above gather at 12-way (the CPU proxy for
+    the gather's O(max_seq) data movement dominating wide decode).
+
+    Spec arms: one long greedy generation on the tiny-deep pairing
+    (2-layer early-exit draft == 4-layer target at init — the honest CPU
+    stand-in for a trained draft/target pair), ngram vs model drafts and
+    single- vs multi-candidate verification. Acceptance: all arms emit
+    the no-spec oracle stream, model-draft acceptance > 0.5, and
+    multi-candidate accepts at least as many draft tokens as single."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.models import paged_attention as pa
+
+    preset = "gemma-2b" if on_tpu else "tiny"
+    cfg = llama.preset(preset)
+    max_seq = 512
+    bs = 16
+    mb = max_seq // bs
+    steps = 32
+    trials = 5
+    params = llama.llama_init(jax.random.PRNGKey(0), cfg)
+    out = {"model": preset, "max_seq": max_seq, "kv_block_size": bs,
+           "segment_steps": steps}
+    gates = {}
+    raw = {}
+    trace0 = pa.TRACE_COUNT["lax"] + pa.TRACE_COUNT["pallas"]
+    for B in (1, 4, 12):
+        nb = 1 + B * mb
+        cache0 = llama.init_paged_cache(cfg, B, max_seq, nb, bs)
+        cache0["bt"] = jnp.arange(
+            1, 1 + B * mb, dtype=jnp.int32
+        ).reshape(B, mb)
+        toks = np.tile(np.array([[5, 9, 13]], np.int32), (B, 1))
+        toks[:, 2] += np.arange(B)  # distinct rows
+        lens = jnp.full((B,), 3, jnp.int32)
+        logits, cache0 = llama.paged_prefill_batched(
+            params, cache0, jnp.asarray(toks), lens, cfg
+        )
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)[:, None]
+        temps = jnp.zeros((B,), jnp.float32)
+        key = jax.random.PRNGKey(1)
+        fns, ids = {}, {}
+        for kern in ("gather", "blocked"):
+            fn = jax.jit(functools.partial(
+                llama.paged_decode_segment, cfg=cfg, n_steps=steps,
+                greedy=True, kv_attention=kern,
+            ))
+            t, _, _, _ = fn(params, cache0, nxt, temps, key)  # compile
+            ids[kern] = np.asarray(t)
+            fns[kern] = fn
+        gates[f"greedy_identical_b{B}"] = bool(
+            np.array_equal(ids["gather"], ids["blocked"])
+        )
+        best = {"gather": float("inf"), "blocked": float("inf")}
+        for trial in range(trials):
+            order = (("gather", "blocked") if trial % 2 == 0
+                     else ("blocked", "gather"))
+            for kern in order:
+                t0 = time.perf_counter()
+                t, _, _, _ = fns[kern](params, cache0, nxt, temps, key)
+                jax.block_until_ready(t)
+                best[kern] = min(best[kern], time.perf_counter() - t0)
+        raw[f"b{B}"] = {
+            "gather_tokens_per_sec": round(B * steps / best["gather"], 1),
+            "blocked_tokens_per_sec": round(B * steps / best["blocked"], 1),
+            "blocked_speedup": round(best["gather"] / best["blocked"], 3),
+        }
+    out["raw"] = raw
+    out["blocked_traced"] = (
+        pa.TRACE_COUNT["lax"] + pa.TRACE_COUNT["pallas"] - trace0
+    )
+    gates["blocked_traced"] = out["blocked_traced"] > 0
+    gates["blocked_faster_b12"] = raw["b12"]["blocked_speedup"] > 1.0
+
+    # --- speculation arms (engine path) --------------------------------
+    from kubedl_tpu.serving.server import LlamaEngine
+
+    spec_preset = preset if on_tpu else "tiny-deep"
+    prompt = [7, 7, 7]
+    max_tokens = 96
+
+    def spec_arm(**kw):
+        eng = LlamaEngine(preset=spec_preset, max_batch=1, max_seq=256,
+                          kv_layout="paged", prefix_cache_mb=0, **kw)
+        try:
+            eng.generate(prompt, max_tokens=8)  # warm compiles
+            t0 = time.perf_counter()
+            r = eng.generate(prompt, max_tokens=max_tokens)
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            st = eng.stats().get("speculative") or {}
+            return r.get("token_ids", []), st, round(wall_ms, 1)
+        finally:
+            eng.close()
+
+    base_ids, _, base_wall = spec_arm(kv_attention="blocked")
+    ng_ids, ng, ng_wall = spec_arm(spec_k=4, spec_draft="ngram",
+                                   kv_attention="blocked")
+    md_ids, md, md_wall = spec_arm(spec_k=4, spec_draft="model",
+                                   spec_draft_layers=2,
+                                   kv_attention="blocked")
+    mc_ids, mc, mc_wall = spec_arm(spec_k=4, spec_draft="model",
+                                   spec_draft_layers=2, spec_candidates=2,
+                                   kv_attention="blocked")
+    out["spec"] = {
+        "model": spec_preset,
+        "max_tokens": max_tokens,
+        "wall_ms_no_spec": base_wall,
+        "wall_ms_ngram": ng_wall,
+        "wall_ms_model": md_wall,
+        "wall_ms_model_multi": mc_wall,
+        "ngram_acceptance": ng.get("acceptance_rate", 0.0),
+        "model_acceptance": md.get("acceptance_rate", 0.0),
+        "model_draft_ms_p50": md.get("draft_ms_p50"),
+        "single_accepted": md.get("accepted", 0),
+        "multi_accepted": mc.get("accepted", 0),
+        "multi_candidates_scored": mc.get("candidates_scored", 0),
+        "outputs_identical": base_ids == ng_ids == md_ids == mc_ids,
+    }
+    gates["spec_outputs_identical"] = out["spec"]["outputs_identical"]
+    gates["model_acceptance_gt_half"] = (
+        md.get("acceptance_rate", 0.0) > 0.5
+    )
+    gates["multi_accepts_ge_single"] = (
+        mc.get("accepted", 0) >= md.get("accepted", 0)
+    )
+    out["gates"] = gates
+    out["ok"] = all(gates.values())
+    return out
+
+
 def bench_router_availability(on_tpu: bool) -> dict:
     """Serving-router availability through a replica kill (docs/serving.md
     "Router"): three engine replicas behind the router under steady client
@@ -1488,6 +1633,22 @@ def main() -> int:
             }}}],
         }, indent=2))
         return 0
+    if "--decode" in sys.argv[1:]:
+        # standalone decode round (BENCH_r11_decode.json): blocked vs
+        # gather kernel sweep + draft-speculation arms in the same
+        # runs[] shape check_readme_numbers reads; its own gates decide
+        # the exit code (a blocked kernel that loses to the gather, or
+        # any arm diverging from the oracle stream, fails loudly)
+        from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested
+
+        ensure_cpu_if_requested()
+        import jax as _jax
+
+        d = bench_decode(_jax.default_backend() == "tpu")
+        print(json.dumps({
+            "runs": [{"detail": {"targets": {"decode": d}}}],
+        }, indent=2))
+        return 0 if d["ok"] else 1
     if "--training" in sys.argv[1:]:
         # standalone training-update round (BENCH_r10_training.json):
         # per-phase sharded-update/overlap medians in the same runs[]

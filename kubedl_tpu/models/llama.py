@@ -33,6 +33,8 @@ from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
+from kubedl_tpu.models import paged_attention as blocked_attention
+
 
 def remat_policy_for(name: str):
     """Map a config string to a jax.checkpoint policy (None = save
@@ -135,6 +137,13 @@ class LlamaConfig:
     embed_scale: bool = False
     #: fixed head dim decoupled from dim/n_heads (Gemma: 256); 0 = dim/heads
     head_dim_fixed: int = 0
+    #: zero-init the residual OUTPUT projections (wo, w_down) of layers
+    #: with index >= this value (0 = off). ReZero/GPT-2-style depth init:
+    #: the deep layers start as exact identity residuals, so an early-exit
+    #: draft sliced at this depth (serving.speculative.ModelDraft
+    #: .from_target) agrees with the full target at init — the tiny-deep
+    #: draft/target pairing the speculative bench measures honestly.
+    zero_init_deep_from: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -190,6 +199,11 @@ GEMMA_2B = LlamaConfig(
     ffn_dim=16384, max_seq=8192, rope_theta=10000.0, tie_embeddings=True,
     act="gelu", norm_plus_one=True, embed_scale=True, head_dim_fixed=256,
 )
+#: tiny's 4-layer sibling for the draft/target MODEL_ZOO pairing: layers
+#: >= 2 start as identity residuals (zero_init_deep_from), so the 2-layer
+#: early-exit draft carved out of its own weights proposes what the full
+#: target would emit — a CPU-scale proxy for a trained draft/target pair.
+TINY_DEEP = dataclasses.replace(TINY, n_layers=4, zero_init_deep_from=2)
 TINY_GEMMA = LlamaConfig(
     vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=1, ffn_dim=128,
     max_seq=128, dtype=jnp.float32, remat=False, tie_embeddings=True,
@@ -205,6 +219,7 @@ def preset(name: str) -> LlamaConfig:
         "gemma-2b": GEMMA_2B,
         "tiny-gemma": TINY_GEMMA,
         "tiny": TINY,
+        "tiny-deep": TINY_DEEP,
     }
     return table[name]
 
@@ -239,6 +254,13 @@ def llama_init(key: jax.Array, cfg: LlamaConfig) -> Params:
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = dense(next(k), (D, V), D)
+    if cfg.zero_init_deep_from:
+        deep = jnp.arange(L) >= cfg.zero_init_deep_from
+        lyr = params["layers"]
+        for name in ("wo", "w_down"):
+            lyr[name] = jnp.where(
+                deep[:, None, None], 0.0, lyr[name]
+            ).astype(cfg.dtype)
     return params
 
 
@@ -1089,15 +1111,31 @@ def _paged_view(pool: jax.Array, bt: jax.Array) -> jax.Array:
     return pool[bt].reshape(B, MB * BS, pool.shape[2], pool.shape[3])
 
 
+def _check_kv_attention(kv_attention: str) -> None:
+    if kv_attention not in ("gather", "blocked"):
+        raise ValueError(
+            f"kv_attention must be 'gather' or 'blocked', got "
+            f"{kv_attention!r}"
+        )
+
+
 def paged_decode_step_batched(
-    params: Params, cache: Params, tokens: jax.Array, cfg: LlamaConfig
+    params: Params, cache: Params, tokens: jax.Array, cfg: LlamaConfig,
+    kv_attention: str = "gather",
 ) -> Tuple[jax.Array, Params]:
     """Block-table twin of :func:`decode_step_batched`: scatter the new
     K/V into each row's current block at ``(bt[b, pos//BS], pos%BS)``,
     then attend over the gathered view with the identical per-row
     validity mask. Rows whose table entry is unmapped write to the trash
     block (vacant rows keep advancing pos exactly like the contiguous
-    path — their writes just land in garbage)."""
+    path — their writes just land in garbage).
+
+    ``kv_attention`` picks the attention implementation: ``"gather"``
+    (the default bit-exactness oracle — materialize the logical view,
+    dense masked attention) or ``"blocked"`` (the
+    :mod:`kubedl_tpu.models.paged_attention` online-softmax kernel that
+    walks the block table; fp-close, greedy-token-identical)."""
+    _check_kv_attention(kv_attention)
     B = tokens.shape[0]
     hd = cfg.head_dim
     pos = cache["pos"]  # [B]
@@ -1129,10 +1167,13 @@ def paged_decode_step_batched(
         v = (h @ deq(lp["wv"])).reshape(B, 1, cfg.n_kv_heads, hd)
         ckp = ckp.at[blk, off].set(k[:, 0])
         cvp = cvp.at[blk, off].set(v[:, 0])
-        attn = attention(
-            q, _paged_view(ckp, bt), _paged_view(cvp, bt),
-            causal=False, mask=mask,
-        )
+        if kv_attention == "blocked":
+            attn = blocked_attention.paged_attention(q, ckp, cvp, bt, pos)
+        else:
+            attn = attention(
+                q, _paged_view(ckp, bt), _paged_view(cvp, bt),
+                causal=False, mask=mask,
+            )
         x = x + attn.reshape(B, 1, cfg.n_heads * hd) @ deq(lp["wo"])
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps, cfg.norm_plus_one)
         gate = _act(cfg)((h @ deq(lp["w_gate"])).astype(jnp.float32)).astype(h.dtype)
@@ -1161,17 +1202,26 @@ def paged_decode_segment(
     cfg: LlamaConfig,
     n_steps: int,
     greedy: bool = False,
+    kv_attention: str = "gather",
 ) -> Tuple[jax.Array, jax.Array, jax.Array, Params]:
     """Block-table twin of :func:`decode_segment` — same on-device
     sample->feed chain and return contract, over the paged step. The
     engine reserves blocks covering ``pos + n_steps`` for every decoding
-    row BEFORE dispatch, so in-segment writes never need a host trip."""
+    row BEFORE dispatch, so in-segment writes never need a host trip.
+
+    The gumbel sample chain is keyed off ``key`` alone — per step, one
+    split shared by every row — so for a fixed seed the sampled path is
+    deterministic and IDENTICAL across ``kv_attention`` kernels (the
+    regression gate for the blocked kernel: kernel choice may only
+    perturb logits at fp tolerance, never the randomness)."""
     keys = jax.random.split(key, n_steps + 1)
     next_key, gumbel_keys = keys[0], keys[1:]
 
     def body(carry, step_key):
         cache, toks = carry
-        logits, cache = paged_decode_step_batched(params, cache, toks, cfg)
+        logits, cache = paged_decode_step_batched(
+            params, cache, toks, cfg, kv_attention=kv_attention
+        )
         if greedy:
             z = logits
         else:
@@ -1195,6 +1245,8 @@ def _paged_suffix_forward(
     lengths: jax.Array,  # [B] suffix lengths; 0 = row untouched
     starts: jax.Array,  # [B] per-row global start offset
     cfg: LlamaConfig,
+    kv_attention: str = "gather",
+    self_contained: bool = False,
 ) -> Tuple[jax.Array, Params]:
     """Shared body of paged prefill and speculative verify: run suffix
     tokens at global positions ``starts[b] + s`` against the gathered
@@ -1204,7 +1256,16 @@ def _paged_suffix_forward(
     their writes to the trash block — which retires the contiguous
     path's dispatch-time graft-overflow fixup for paged engines: a
     clamped write can only ever land in garbage, never inside a row.
-    Returns (final-norm hidden states [B, S, D], updated cache)."""
+    Returns (final-norm hidden states [B, S, D], updated cache).
+
+    ``self_contained=True`` is the READ-ONLY scoring mode behind
+    :func:`paged_verify_multi`: the pool is never written (so several
+    candidate suffixes can share one row's blocks in a single forward)
+    — each query attends committed pool history (``t < starts``) merged
+    with the suffix's own fresh K/V under an in-suffix causal mask,
+    which is the same key set the write path would have seen. The
+    returned cache is the input cache, untouched."""
+    _check_kv_attention(kv_attention)
     B, S = tokens.shape
     hd = cfg.head_dim
     bt = cache["bt"]
@@ -1220,9 +1281,22 @@ def _paged_suffix_forward(
     )  # [B, S]
     cos_t = cos_full[posq][:, :, None, :]
     sin_t = sin_full[posq][:, :, None, :]
-    mask = (
-        jnp.arange(max_s)[None, None, :] <= posq[:, :, None]
-    )[:, None, None]  # [B, 1, 1, S, T]
+    if self_contained:
+        # pool history (t < starts) ++ in-suffix causal block: the same
+        # key set the write path exposes, without the writes
+        hist = jnp.broadcast_to(
+            jnp.arange(max_s)[None, None, :] < starts[:, None, None],
+            (B, S, max_s),
+        )
+        causal_self = jnp.broadcast_to(
+            (jnp.arange(S)[None, :] <= jnp.arange(S)[:, None])[None],
+            (B, S, S),
+        )
+        mask = jnp.concatenate([hist, causal_self], axis=-1)[:, None, None]
+    else:
+        mask = (
+            jnp.arange(max_s)[None, None, :] <= posq[:, :, None]
+        )[:, None, None]  # [B, 1, 1, S, T]
     # scatter targets: pad/inactive positions write to the trash block
     writable = active[:, None] & (jnp.arange(S)[None, :] < lengths[:, None])
     blk = jnp.where(writable, bt[jnp.arange(B)[:, None], posq // BS], 0)
@@ -1240,12 +1314,27 @@ def _paged_suffix_forward(
         q = rot((h @ deq(lp["wq"])).reshape(B, S, cfg.n_heads, hd))
         k = rot((h @ deq(lp["wk"])).reshape(B, S, cfg.n_kv_heads, hd))
         v = (h @ deq(lp["wv"])).reshape(B, S, cfg.n_kv_heads, hd)
-        ckp = ckp.at[blk, off].set(k)
-        cvp = cvp.at[blk, off].set(v)
-        attn = attention(
-            q, _paged_view(ckp, bt), _paged_view(cvp, bt),
-            causal=False, mask=mask,
-        )
+        if not self_contained:
+            ckp = ckp.at[blk, off].set(k)
+            cvp = cvp.at[blk, off].set(v)
+        if kv_attention == "blocked":
+            attn = blocked_attention.paged_attention(
+                q, ckp, cvp, bt, starts,
+                self_k=k if self_contained else None,
+                self_v=v if self_contained else None,
+            )
+        elif self_contained:
+            attn = attention(
+                q,
+                jnp.concatenate([_paged_view(ckp, bt), k], axis=1),
+                jnp.concatenate([_paged_view(cvp, bt), v], axis=1),
+                causal=False, mask=mask,
+            )
+        else:
+            attn = attention(
+                q, _paged_view(ckp, bt), _paged_view(cvp, bt),
+                causal=False, mask=mask,
+            )
         x = x + attn.reshape(B, S, cfg.n_heads * hd) @ deq(lp["wo"])
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps, cfg.norm_plus_one)
         gate = _act(cfg)((h @ deq(lp["w_gate"])).astype(jnp.float32)).astype(h.dtype)
@@ -1256,6 +1345,8 @@ def _paged_suffix_forward(
         body, x, (params["layers"], cache["k"], cache["v"])
     )
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.norm_plus_one)
+    if self_contained:
+        return x, cache
     pos = jnp.where(
         active, jnp.minimum(starts + lengths, max_s - 1), cache["pos"]
     )
@@ -1332,11 +1423,13 @@ def paged_prefill_from(
     lengths: jax.Array,
     starts: jax.Array,
     cfg: LlamaConfig,
+    kv_attention: str = "gather",
 ) -> Tuple[jax.Array, Params]:
     """Block-table twin of :func:`prefill_batched_from` (suffix-only
     prefill over a grafted prefix): last-token logits + updated cache."""
     x, cache = _paged_suffix_forward(
-        params, cache, tokens, lengths, starts, cfg
+        params, cache, tokens, lengths, starts, cfg,
+        kv_attention=kv_attention,
     )
     idx = jnp.maximum(lengths - 1, 0)
     x_last = jnp.take_along_axis(
@@ -1353,6 +1446,7 @@ def paged_verify(
     lengths: jax.Array,  # [B] k+1 for verifying rows, 0 = untouched
     starts: jax.Array,  # [B] row position before the verify
     cfg: LlamaConfig,
+    kv_attention: str = "gather",
 ) -> Tuple[jax.Array, Params]:
     """Speculative verify: score a draft-extended suffix in ONE forward
     and return the target model's GREEDY token after every position —
@@ -1365,11 +1459,47 @@ def paged_verify(
     (the engine rewinds its host pos mirror and frees now-unneeded
     blocks)."""
     x, cache = _paged_suffix_forward(
-        params, cache, tokens, lengths, starts, cfg
+        params, cache, tokens, lengths, starts, cfg,
+        kv_attention=kv_attention,
     )
     logits = (x @ lm_head_of(params, cfg)).astype(jnp.float32)  # [B, S, V]
     ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return ids, cache
+
+
+def paged_verify_multi(
+    params: Params,
+    cache: Params,
+    tokens: jax.Array,  # [B, N, S]: N candidate suffixes per row
+    lengths: jax.Array,  # [B] suffix length (shared by a row's candidates)
+    starts: jax.Array,  # [B] row position before the verify
+    cfg: LlamaConfig,
+    kv_attention: str = "gather",
+) -> jax.Array:
+    """Score N candidate continuations per row in ONE read-only forward:
+    returns the target's greedy ids ``[B, N, S]`` (``ids[b, n, j]`` =
+    argmax after consuming ``tokens[b, n, j]``). Candidates are flattened
+    to ``B*N`` rows SHARING each row's block table and start — legal only
+    because the self-contained suffix forward never writes the pool, so
+    candidate n cannot leak K/V into candidate m's view. The host picks
+    the candidate with the longest agreeing prefix and re-runs the
+    standard write-path :func:`paged_verify` on the winner alone, which
+    keeps every emitted token the target's own argmax over committed
+    history (bit-exact vs the single-candidate path). No cache is
+    returned: with nothing donated, XLA drops all cache updates."""
+    B, N, S = tokens.shape
+    rep = lambda a: jnp.repeat(a, N, axis=0)  # noqa: E731
+    flat_cache = {
+        "k": cache["k"], "v": cache["v"],
+        "pos": rep(cache["pos"]), "bt": rep(cache["bt"]),
+    }
+    x, _ = _paged_suffix_forward(
+        params, flat_cache, tokens.reshape(B * N, S), rep(lengths),
+        rep(starts), cfg, kv_attention=kv_attention, self_contained=True,
+    )
+    logits = (x @ lm_head_of(params, cfg)).astype(jnp.float32)
+    ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return ids.reshape(B, N, S)
 
 
 def copy_kv_block(cache: Params, src, dst) -> Params:

@@ -462,6 +462,13 @@ class ServingMetrics:
             "kubedl_tpu_serving_spec_acceptance_rate",
             "Lifetime accepted/proposed draft-token ratio",
         )
+        self.spec_draft_ms = r.histogram(
+            "kubedl_tpu_serving_spec_draft_ms",
+            "Per-round draft proposal wall time (host ngram lookup or "
+            "draft-model forward), ms — labeled by draft kind so model "
+            "drafts can be costed against their acceptance gain",
+            buckets=_TICK_MS_BUCKETS,
+        )
         self.ttft_ms = r.histogram(
             "kubedl_tpu_serving_ttft_ms",
             "Per-request time to first token (admission queue + prefill "

@@ -71,6 +71,17 @@ def _jax_setter(
             if pred.batching else None
         ),
     }
+    # decode-path knobs ride along only when the predictor sets them, so
+    # a default Predictor keeps the engine's own defaults (and template
+    # JSON below can still override either way)
+    if pred.attention_kernel:
+        serve_cfg["kv_attention"] = pred.attention_kernel
+    if pred.spec_k:
+        serve_cfg["spec_k"] = pred.spec_k
+    if pred.spec_draft:
+        serve_cfg["spec_draft"] = pred.spec_draft
+    if pred.spec_candidates:
+        serve_cfg["spec_candidates"] = pred.spec_candidates
     # template-provided keys win (e.g. a custom port or preset)
     existing = main.get_env("KUBEDL_SERVE_CONFIG")
     if existing:

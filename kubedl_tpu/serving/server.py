@@ -100,7 +100,10 @@ class LlamaEngine:
                  kv_layout: str = "paged", kv_block_size: int = 16,
                  kv_blocks: int = 0, kv_low_watermark: float = 0.05,
                  kv_high_watermark: float = 0.15,
-                 spec_k: int = 0, spec_draft: str = "ngram") -> None:
+                 spec_k: int = 0, spec_draft: str = "ngram",
+                 kv_attention: str = "gather",
+                 spec_candidates: int = 1,
+                 spec_draft_layers: int = 0) -> None:
         import jax
 
         from kubedl_tpu.models import llama
@@ -108,6 +111,11 @@ class LlamaEngine:
 
         if kv_layout not in ("paged", "contiguous"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}")
+        if kv_attention not in ("gather", "blocked"):
+            raise ValueError(
+                f"unknown kv_attention {kv_attention!r} "
+                "(have: gather, blocked)"
+            )
         if mesh_axes and kv_layout == "paged":
             # megatron-sharded serving keeps the CONTIGUOUS layout: the
             # paged pool gather reorders attention reductions enough to
@@ -118,7 +126,14 @@ class LlamaEngine:
             spec_k = 0
         self.kv_layout = kv_layout
         self._paged = kv_layout == "paged"
+        #: which paged-attention implementation the jitted hot paths
+        #: compile in: "gather" (the bit-exactness oracle, default) or
+        #: "blocked" (models.paged_attention — the online-softmax kernel
+        #: that never materializes the [B, max_seq] view; fp-close,
+        #: greedy-token-identical). Contiguous engines ignore it.
+        self.kv_attention = kv_attention if self._paged else "gather"
         self.spec_k = int(spec_k)
+        self.spec_candidates = max(1, int(spec_candidates))
         if self.spec_k and not self._paged:
             raise ValueError(
                 "speculative decoding requires kv_layout='paged' (the "
@@ -167,10 +182,12 @@ class LlamaEngine:
         if self._paged:
             self._decode = jax.jit(
                 lambda p, c, t: llama.paged_decode_step_batched(
-                    p, c, t, self.cfg
+                    p, c, t, self.cfg, kv_attention=self.kv_attention
                 ),
                 donate_argnums=(1,),
             )
+            # whole-prompt prefill is LOCAL causal attention (no pool
+            # read), so there is nothing for the blocked kernel to do
             self._prefill = jax.jit(
                 lambda p, c, t, l: llama.paged_prefill_batched(
                     p, c, t, l, self.cfg
@@ -179,7 +196,8 @@ class LlamaEngine:
             )
             self._prefill_from = jax.jit(
                 lambda p, c, t, l, st: llama.paged_prefill_from(
-                    p, c, t, l, st, self.cfg
+                    p, c, t, l, st, self.cfg,
+                    kv_attention=self.kv_attention,
                 ),
                 donate_argnums=(1,),
             )
@@ -296,23 +314,49 @@ class LlamaEngine:
             )
             self.spec_draft = spec_draft
             if self.spec_k:
-                self._draft = make_draft(spec_draft)
+                if spec_draft == "model":
+                    from kubedl_tpu.serving.speculative import ModelDraft
+
+                    # early-exit draft carved out of the target's own
+                    # stacked weights (views, no copies); depth defaults
+                    # to half the target
+                    n_draft = spec_draft_layers or max(
+                        1, self.cfg.n_layers // 2
+                    )
+                    self._draft = ModelDraft.from_target(
+                        self.params, self.cfg, n_layers=n_draft,
+                        max_context=self.max_seq,
+                    )
+                else:
+                    self._draft = make_draft(spec_draft)
                 self._spec_stats = SpecStats()
                 self._verify = jax.jit(
                     lambda p, c, t, l, st: llama.paged_verify(
-                        p, c, t, l, st, self.cfg
+                        p, c, t, l, st, self.cfg,
+                        kv_attention=self.kv_attention,
                     ),
                     donate_argnums=(1,),
                 )
+                #: multi-candidate scorer: READ-ONLY (cache NOT donated
+                #: and not returned, so XLA drops every cache write) —
+                #: the winner goes back through the standard _verify
+                self._verify_multi = jax.jit(
+                    lambda p, c, t, l, st: llama.paged_verify_multi(
+                        p, c, t, l, st, self.cfg,
+                        kv_attention=self.kv_attention,
+                    ),
+                ) if self.spec_candidates > 1 else None
             else:
                 self._draft = None
                 self._spec_stats = None
+                self._verify_multi = None
         else:
             self._cache = llama.init_batched_cache(
                 self.cfg, self.max_batch, self.max_seq
             )
             self._draft = None
             self._spec_stats = None
+            self._verify_multi = None
         from collections import deque as _deque
 
         self._slots: list = [None] * self.max_batch
@@ -600,8 +644,13 @@ class LlamaEngine:
             out["prefix_cache"] = self._pcache.stats()
         if self._paged:
             out["kv_blocks"] = self._alloc.stats()
+            out["kv_blocks"]["attention_kernel"] = self.kv_attention
         if self._spec_stats is not None:
             out["speculative"] = self._spec_stats.snapshot()
+            out["speculative"]["draft_kind"] = getattr(
+                self._draft, "name", self.spec_draft
+            )
+            out["speculative"]["candidates"] = self.spec_candidates
         out["pipeline"] = self.pipeline_stats()
         return out
 
@@ -1063,9 +1112,11 @@ class LlamaEngine:
                 self._llama.paged_decode_segment if self._paged
                 else self._llama.decode_segment
             )
+            kw = {"kv_attention": self.kv_attention} if self._paged else {}
             fn = self._jax.jit(
                 functools.partial(
                     seg, cfg=self.cfg, n_steps=n_steps, greedy=greedy,
+                    **kw,
                 ),
                 donate_argnums=(1,),
             )
@@ -1172,7 +1223,19 @@ class LlamaEngine:
         accepted history, so output is bit-identical to plain decode (the
         tier-1 gate); speculation only changes how many sequential
         forwards it takes. The pos mirror then rewinds past the rejected
-        suffix and `_trim_row_locked` frees its KV blocks in place."""
+        suffix and `_trim_row_locked` frees its KV blocks in place.
+
+        With ``spec_candidates > 1`` the draft proposes N candidate
+        continuations per row (`propose_candidates`; candidate 0 is
+        always the plain greedy proposal). A READ-ONLY scoring forward
+        (`llama.paged_verify_multi`) ranks all N against the target in
+        one batched call, the longest-agreeing candidate is swapped into
+        the verify window, and the standard write-path verify runs on
+        the winner — so multi-candidate never emits anything but target
+        argmaxes, and never accepts fewer tokens than candidate 0 would
+        have. Draft proposal wall time is measured per round
+        (`spec_draft_ms`) so dashboards can attribute decode time to
+        draft vs verify."""
         import numpy as np
         import jax.numpy as jnp
 
@@ -1180,24 +1243,69 @@ class LlamaEngine:
 
         k = self.spec_k
         S = k + 1
+        N = self.spec_candidates
+        multi = N > 1 and self._verify_multi is not None
+        draft_kind = getattr(self._draft, "name", self.spec_draft)
+        # phase 1 — snapshot contexts under the lock, DRAFT OUTSIDE IT:
+        # a model draft's forward must not stall admission/finalize.
+        # Only this scheduler thread mutates prompt/out_ids/fed, so the
+        # snapshot stays coherent; vacated rows are re-checked by slot
+        # identity before anything is committed.
+        with self._cv:
+            cand = [
+                (i, s, list(s.prompt) + list(s.out_ids), s.next_input())
+                for i, s in decoding if self._slots[i] is s
+            ]
+        if not cand:
+            return
+        t_d = time.perf_counter()
+        if multi:
+            cand_lists = [
+                self._draft.propose_candidates(ctx, k, N)
+                for _, _, ctx, _ in cand
+            ]
+        else:
+            cand_lists = [
+                [p] for p in self._draft.propose_batch(
+                    [ctx for _, _, ctx, _ in cand], k
+                )
+            ]
+        draft_ms = (time.perf_counter() - t_d) * 1e3
+        self._spec_stats.record_draft_ms(draft_ms)
+        self.metrics.spec_draft_ms.observe(draft_ms, draft=draft_kind)
+
+        def _pad(drafts, ctx):
+            d = [int(t) for t in drafts][:k]
+            if len(d) < k:
+                pad = d[-1] if d else int(ctx[-1])
+                d = d + [pad] * (k - len(d))
+            return d
+
         toks = np.zeros((self.max_batch, S), np.int32)
         lens = np.zeros((self.max_batch,), np.int32)
         starts = np.zeros((self.max_batch,), np.int32)
+        cand_toks = (
+            np.zeros((self.max_batch, N, S), np.int32) if multi else None
+        )
         with self._cv:
             rows = []
-            for i, s in decoding:
+            for (i, s, ctx, nxt), clists in zip(cand, cand_lists):
                 if self._slots[i] is not s:
                     continue
-                ctx = s.prompt + s.out_ids
-                drafts = [int(t) for t in self._draft.propose(ctx, k)][:k]
-                if len(drafts) < k:
-                    pad = drafts[-1] if drafts else int(ctx[-1])
-                    drafts = drafts + [pad] * (k - len(drafts))
-                toks[i, 0] = s.next_input()
-                toks[i, 1:] = drafts
+                dl = [_pad(d, ctx) for d in clists[:N]]
+                if not dl:
+                    dl = [[int(ctx[-1])] * k]
+                while len(dl) < N:
+                    dl.append(dl[0])
+                toks[i, 0] = nxt
+                toks[i, 1:] = dl[0]
                 lens[i] = S
                 starts[i] = self._pos_host[i]
-                rows.append((i, s, drafts))
+                if multi:
+                    cand_toks[i, :, 0] = nxt
+                    for c_n, c_d in enumerate(dl):
+                        cand_toks[i, c_n, 1:] = c_d
+                rows.append((i, s, dl))
             # coverage for S appends per row, preempting on exhaustion;
             # rows the reserve drops sit this verify out entirely
             surviving = self._reserve_decode_locked(
@@ -1213,6 +1321,23 @@ class LlamaEngine:
         self._cache["pos"] = self._upload_mirror(self._pos_host)
         self._cache["bt"] = self._upload_mirror(self._bt_host)
         t0 = time.perf_counter()
+        if multi:
+            # read-only ranking pass (cache neither donated nor written)
+            ids_multi = np.array(self._jax.device_get(self._verify_multi(
+                self.params, self._cache, jnp.asarray(cand_toks),
+                jnp.asarray(lens), jnp.asarray(starts),
+            )))  # [B, N, S]
+            for i, s, dl in rows:
+                best = 0
+                best_a = accept_length(dl[0], ids_multi[i, 0][:k])
+                for c_n in range(1, N):
+                    a_n = accept_length(dl[c_n], ids_multi[i, c_n][:k])
+                    if a_n > best_a:
+                        best, best_a = c_n, a_n
+                self._spec_stats.record_candidates(N, best != 0)
+                if best:
+                    dl[0] = dl[best]  # the accept loop reads dl[0]
+                    toks[i, 1:] = dl[0]
         ids_dev, self._cache = self._verify(
             self.params, self._cache, jnp.asarray(toks),
             jnp.asarray(lens), jnp.asarray(starts),
@@ -1223,7 +1348,8 @@ class LlamaEngine:
         acct["harvest_ms"] += (time.perf_counter() - t1) * 1e3
         t2 = time.perf_counter()
         with self._cv:
-            for i, s, drafts in rows:
+            for i, s, dl in rows:
+                drafts = dl[0]
                 a = accept_length(drafts, ids[i][:k])
                 if self._slots[i] is not s:
                     continue  # vacated mid-verify; writes land in trash
@@ -1238,8 +1364,8 @@ class LlamaEngine:
                 )
                 self._trim_row_locked(i, int(self._pos_host[i]))
                 self._spec_stats.record(k, a, take)
-                self.metrics.spec_proposed.inc(k)
-                self.metrics.spec_accepted.inc(a)
+                self.metrics.spec_proposed.inc(k, draft=draft_kind)
+                self.metrics.spec_accepted.inc(a, draft=draft_kind)
                 self._maybe_finalize_locked(i, s)
             self._admit_locked()
             self._cv.notify_all()
@@ -1288,9 +1414,10 @@ class LlamaEngine:
         m.queue_depth.set(float(queued))
         if self._paged:
             st = self._alloc.stats()
-            m.kv_blocks_total.set(float(st["total"]))
-            m.kv_blocks_free.set(float(st["free"]))
-            m.kv_blocks_shared.set(float(st["shared"]))
+            kern = {"attention_kernel": self.kv_attention}
+            m.kv_blocks_total.set(float(st["total"]), **kern)
+            m.kv_blocks_free.set(float(st["free"]), **kern)
+            m.kv_blocks_shared.set(float(st["shared"]), **kern)
         if self._spec_stats is not None:
             m.spec_acceptance_rate.set(self._spec_stats.acceptance_rate())
 
@@ -1741,7 +1868,20 @@ def engine_kwargs(cfg: Dict, ckpt_dir: str) -> Dict:
         "spec_k": int(
             cfg.get("spec_k", os.environ.get("KUBEDL_SERVE_SPEC_K", "0"))
         ),
-        "spec_draft": cfg.get("spec_draft", "ngram"),
+        "spec_draft": cfg.get(
+            "spec_draft", os.environ.get("KUBEDL_SERVE_SPEC_DRAFT", "ngram")
+        ),
+        "kv_attention": cfg.get(
+            "kv_attention",
+            os.environ.get("KUBEDL_SERVE_KV_ATTENTION", "gather"),
+        ),
+        "spec_candidates": int(
+            cfg.get(
+                "spec_candidates",
+                os.environ.get("KUBEDL_SERVE_SPEC_CANDIDATES", "1"),
+            )
+        ),
+        "spec_draft_layers": int(cfg.get("spec_draft_layers", 0)),
     }
 
 

@@ -27,6 +27,24 @@ works. Shipped drafts:
   degenerate baseline that still wins on run-length-heavy output.
 - :class:`ScriptedDraft`: tests force exact proposal streams to pin the
   acceptance-length distribution.
+- :class:`ModelDraft` ("model"): a real small-model draft — greedy
+  decode k tokens from its own (smaller) weights in its own contiguous
+  cache, batched across verifying rows in one prefill + one decode
+  segment. :meth:`ModelDraft.from_target` carves an early-exit draft out
+  of the target's own stacked layer weights (first n layers + shared
+  embed/norm/head) — with the `tiny-deep` preset's zero-init deep
+  residuals that pairing agrees with the target at init, the CPU-scale
+  proxy for a trained draft/target pair.
+
+Multi-candidate verification rides on :meth:`DraftModel
+.propose_candidates`: N candidate continuations per row, scored by the
+target in ONE read-only forward (`llama.paged_verify_multi`); the engine
+re-verifies only the winner through the standard write path, so emitted
+tokens stay the target's own argmax. The default implementation returns
+the single `propose()` list; ModelDraft branches candidates at the first
+token (top-N draft logits, greedy continuations), with candidate 0
+always the pure-greedy proposal — which is why multi-candidate accepts
+at least as much as single-candidate on the same seeds.
 
 A wrong draft can never corrupt output — it only wastes the verify
 forward — so draft quality is purely a throughput knob, measured by the
@@ -52,6 +70,22 @@ class DraftModel:
         allowed — the engine pads the verify window with repeats of the
         last proposal and simply accepts less."""
         raise NotImplementedError
+
+    def propose_batch(
+        self, contexts: Sequence[Sequence[int]], k: int
+    ) -> List[List[int]]:
+        """Batched :meth:`propose` (one call per verify tick). Model
+        drafts override this to amortize their forward across rows."""
+        return [self.propose(ctx, k) for ctx in contexts]
+
+    def propose_candidates(
+        self, context: Sequence[int], k: int, n: int
+    ) -> List[List[int]]:
+        """Up to ``n`` candidate continuations for multi-candidate
+        verify. Candidate 0 MUST be the plain :meth:`propose` output —
+        the engine relies on that to guarantee multi-candidate never
+        accepts fewer tokens than the single-candidate path."""
+        return [self.propose(context, k)]
 
 
 class RepeatDraft(DraftModel):
@@ -111,6 +145,159 @@ class ScriptedDraft(DraftModel):
         return RepeatDraft().propose(context, k)
 
 
+class ModelDraft(DraftModel):
+    """Small-model draft: greedy-decode ``k`` tokens from its own
+    weights. Each proposal round is one batched prefill over the rows'
+    recent context windows plus one greedy decode segment in a FRESH
+    contiguous cache (the draft is small enough that re-prefilling a
+    bounded window every round beats keeping per-row draft caches
+    coherent with the target's accept/rewind churn). All jitted closures
+    cache by shape; context lengths are padded to ``pad_to`` multiples
+    and batch to powers of two so the engine's varying row counts reuse
+    a handful of compiles."""
+
+    name = "model"
+
+    def __init__(self, params, cfg, max_context: int = 512,
+                 pad_to: int = 32) -> None:
+        import jax
+
+        from kubedl_tpu.models import llama
+
+        self.params = params
+        self.cfg = cfg
+        self.max_context = min(int(max_context), cfg.max_seq)
+        self.pad_to = max(8, int(pad_to))
+        self._llama = llama
+        self._jnp = jax.numpy
+        self._prefill = jax.jit(
+            lambda p, c, t, l: llama.prefill_batched(p, c, t, l, cfg)
+        )
+        self._segments: Dict[int, object] = {}
+        self._key = jax.random.PRNGKey(0)  # greedy: never consumed
+
+    @classmethod
+    def from_target(cls, params, cfg, n_layers: int,
+                    **kwargs) -> "ModelDraft":
+        """Early-exit draft: the target's first ``n_layers`` decoder
+        layers (sliced off the stacked [L, ...] arrays — views, no
+        copies) with the shared embedding / final norm / lm head. With
+        `zero_init_deep_from <= n_layers` the deep layers are identity
+        residuals and the slice IS the target; in general it is the
+        standard early-exit approximation."""
+        import dataclasses
+
+        import jax
+
+        n = max(1, min(int(n_layers), cfg.n_layers))
+        draft_params = {k: v for k, v in params.items() if k != "layers"}
+        # tree_map, not a dict comprehension: quantized layer leaves are
+        # nested {"w", "scale"} dicts, all stacked [L, ...] on axis 0
+        draft_params["layers"] = jax.tree_util.tree_map(
+            lambda a: a[:n], params["layers"]
+        )
+        draft_cfg = dataclasses.replace(cfg, n_layers=n)
+        return cls(draft_params, draft_cfg, **kwargs)
+
+    def _segment_fn(self, n_steps: int):
+        import jax
+
+        llama, cfg = self._llama, self.cfg
+        fn = self._segments.get(n_steps)
+        if fn is None:
+            fn = jax.jit(
+                lambda p, c, t, z, key: llama.decode_segment(
+                    p, c, t, z, key, cfg, n_steps, greedy=True
+                )
+            )
+            self._segments[n_steps] = fn
+        return fn
+
+    def _prefill_padded(self, contexts: Sequence[Sequence[int]], k: int):
+        """Left-truncate each context to the draft window, right-pad to
+        a shape bucket, run one batched prefill. Returns (last-token
+        logits [Bp, V], cache, B)."""
+        jnp, llama = self._jnp, self._llama
+        B = len(contexts)
+        Bp = 1
+        while Bp < B:
+            Bp *= 2
+        win = max(1, self.max_context - k - 1)
+        ctxs = [list(map(int, c))[-win:] for c in contexts]
+        P = max(max((len(c) for c in ctxs), default=1), 1)
+        P = ((P + self.pad_to - 1) // self.pad_to) * self.pad_to
+        toks = [c + [0] * (P - len(c)) for c in ctxs]
+        toks += [[0] * P] * (Bp - B)
+        lens = [len(c) for c in ctxs] + [0] * (Bp - B)
+        cache = llama.init_batched_cache(self.cfg, Bp, self.max_context)
+        logits, cache = self._prefill(
+            self.params, cache,
+            jnp.asarray(toks, jnp.int32), jnp.asarray(lens, jnp.int32),
+        )
+        return logits, cache, B
+
+    def propose(self, context: Sequence[int], k: int) -> List[int]:
+        return self.propose_batch([context], k)[0]
+
+    def propose_batch(
+        self, contexts: Sequence[Sequence[int]], k: int
+    ) -> List[List[int]]:
+        if k <= 0 or not contexts:
+            return [[] for _ in contexts]
+        jnp = self._jnp
+        logits, cache, B = self._prefill_padded(contexts, k)
+        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [Bp]
+        if k == 1:
+            import numpy as np
+
+            return [[int(t)] for t in np.asarray(first)[:B]]
+        Bp = first.shape[0]
+        toks, _, _, _ = self._segment_fn(k - 1)(
+            self.params, cache, first[:, None],
+            jnp.zeros((Bp,), jnp.float32), self._key,
+        )
+        import numpy as np
+
+        first_h = np.asarray(first)
+        toks_h = np.asarray(toks)
+        return [
+            [int(first_h[b])] + [int(t) for t in toks_h[b]]
+            for b in range(B)
+        ]
+
+    def propose_candidates(
+        self, context: Sequence[int], k: int, n: int
+    ) -> List[List[int]]:
+        """Branch at the first token: the draft's top-``n`` first tokens
+        (descending — candidate 0 is the greedy proposal), each continued
+        greedily. One prefill over n identical rows, one segment."""
+        if n <= 1 or k <= 0:
+            return [self.propose(context, k)]
+        import numpy as np
+
+        import jax
+
+        jnp = self._jnp
+        logits, cache, _ = self._prefill_padded([context] * n, k)
+        _, top = jax.lax.top_k(logits[0], n)
+        firsts = top.astype(jnp.int32)  # [n], descending score
+        Bp = logits.shape[0]
+        firsts_full = jnp.concatenate(
+            [firsts, jnp.zeros((Bp - n,), jnp.int32)]
+        )
+        if k == 1:
+            return [[int(t)] for t in np.asarray(firsts)]
+        toks, _, _, _ = self._segment_fn(k - 1)(
+            self.params, cache, firsts_full[:, None],
+            jnp.zeros((Bp,), jnp.float32), self._key,
+        )
+        firsts_h, toks_h = np.asarray(firsts), np.asarray(toks)
+        return [
+            [int(firsts_h[i])] + [int(t) for t in toks_h[i]]
+            for i in range(n)
+        ]
+
+
 _DRAFTS = {
     "ngram": NgramDraft,
     "repeat": RepeatDraft,
@@ -118,7 +305,15 @@ _DRAFTS = {
 
 
 def make_draft(name: str, **kwargs) -> DraftModel:
-    """Draft factory for the engine's ``spec_draft`` knob."""
+    """Draft factory for the engine's ``spec_draft`` knob. "model"
+    needs weights — the engine constructs :class:`ModelDraft` itself
+    (`ModelDraft.from_target`) instead of going through here."""
+    if name == "model":
+        raise ValueError(
+            "draft 'model' needs target weights: use "
+            "ModelDraft.from_target(...) (the engine's spec_draft="
+            "'model' path does this)"
+        )
     try:
         return _DRAFTS[name](**kwargs)
     except KeyError:
@@ -154,7 +349,11 @@ class SpecStats:
         self.accepted = 0
         self.verifies = 0
         self.emitted = 0
+        self.candidates_scored = 0
+        self.candidate_switches = 0
+        self.draft_ms_total = 0.0
         self.window: "deque[int]" = deque(maxlen=maxlen)
+        self.draft_ms_window: "deque[float]" = deque(maxlen=maxlen)
 
     def record(self, proposed: int, accepted: int, emitted: int) -> None:
         with self._lock:
@@ -163,6 +362,19 @@ class SpecStats:
             self.verifies += 1
             self.emitted += int(emitted)
             self.window.append(int(accepted))
+
+    def record_draft_ms(self, ms: float) -> None:
+        """Wall time of one draft proposal round (all rows)."""
+        with self._lock:
+            self.draft_ms_total += float(ms)
+            self.draft_ms_window.append(float(ms))
+
+    def record_candidates(self, scored: int, switched: bool) -> None:
+        """One multi-candidate verify: ``scored`` candidates ranked,
+        ``switched`` = the winner was NOT the greedy candidate 0."""
+        with self._lock:
+            self.candidates_scored += int(scored)
+            self.candidate_switches += 1 if switched else 0
 
     def acceptance_rate(self) -> float:
         with self._lock:
@@ -176,7 +388,13 @@ class SpecStats:
                 "accepted": self.accepted,
                 "verifies": self.verifies,
                 "emitted": self.emitted,
+                "candidates_scored": self.candidates_scored,
+                "candidate_switches": self.candidate_switches,
+                "draft_ms_total": round(self.draft_ms_total, 3),
             }
+            dwin = list(self.draft_ms_window)
+        if dwin:
+            out["draft_ms_p50"] = sorted(dwin)[len(dwin) // 2]
         out["acceptance_rate"] = round(
             out["accepted"] / out["proposed"], 4
         ) if out["proposed"] else 0.0
@@ -192,5 +410,5 @@ class SpecStats:
 
 __all__ = [
     "DraftModel", "NgramDraft", "RepeatDraft", "ScriptedDraft",
-    "make_draft", "accept_length", "SpecStats",
+    "ModelDraft", "make_draft", "accept_length", "SpecStats",
 ]

@@ -65,6 +65,18 @@ class Predictor:
     #: docs/serving.md). A canary predictor can A/B it against full
     #: precision behind the same endpoint.
     quantize: str = ""
+    #: paged decode attention kernel: "" (engine default, gather oracle)
+    #: or "blocked" (flash-style online softmax over the block table —
+    #: docs/serving.md "Blocked paged attention"). Greedy outputs are
+    #: bit-identical either way, so a canary can A/B kernels safely.
+    attention_kernel: str = ""
+    #: speculative decoding: draft tokens per verify forward (0 = off),
+    #: draft kind ("ngram" host lookup or "model" early-exit slice of
+    #: the target), and candidate continuations ranked per verify round
+    #: (1 = single-candidate).
+    spec_k: int = 0
+    spec_draft: str = ""
+    spec_candidates: int = 0
 
 
 @dataclass

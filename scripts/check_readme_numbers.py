@@ -31,6 +31,9 @@ PLANNER_ARTIFACT = "BENCH_r09_planner.json"
 #: sharded weight update + overlap row (r10): separate artifact, same
 #: runs[] shape (CPU proxy — see docs/performance.md)
 TRAINING_ARTIFACT = "BENCH_r10_training.json"
+#: blocked paged-attention decode + model-draft row (r11): separate
+#: artifact, same runs[] shape (CPU proxy — see docs/serving.md)
+DECODE_ARTIFACT = "BENCH_r11_decode.json"
 
 
 def _runs_median(runs, *path) -> float:
@@ -190,6 +193,28 @@ def expected_training_strings(artifact: dict) -> dict:
     }
 
 
+def expected_decode_strings(artifact: dict) -> dict:
+    """README blocked-decode row strings from BENCH_r11_decode.json."""
+    runs = artifact["runs"]
+    tgt = ("targets", "decode")
+    g12 = _runs_median(runs, *tgt, "raw", "b12", "gather_tokens_per_sec")
+    b12 = _runs_median(runs, *tgt, "raw", "b12", "blocked_tokens_per_sec")
+    speedup = _runs_median(runs, *tgt, "raw", "b12", "blocked_speedup")
+    macc = _runs_median(runs, *tgt, "spec", "model_acceptance")
+    nacc = _runs_median(runs, *tgt, "spec", "ngram_acceptance")
+    return {
+        f"**{speedup:.2f}x** 12-way decode":
+            "median of runs[].targets.decode.raw.b12.blocked_speedup",
+        f"{g12:,.0f} -> {b12:,.0f} tokens/s":
+            "medians of runs[].targets.decode.raw.b12."
+            "gather/blocked_tokens_per_sec",
+        f"model-draft acceptance {macc * 100:.0f}% vs ngram "
+        f"{nacc * 100:.0f}%":
+            "medians of runs[].targets.decode.spec."
+            "model/ngram_acceptance",
+    }
+
+
 def check(repo: Path = REPO) -> list:
     """Returns a list of mismatch descriptions (empty = README is clean)."""
     artifact = json.loads((repo / ARTIFACT).read_text())
@@ -218,6 +243,11 @@ def check(repo: Path = REPO) -> list:
     expected.update(
         expected_training_strings(
             json.loads((repo / TRAINING_ARTIFACT).read_text())
+        )
+    )
+    expected.update(
+        expected_decode_strings(
+            json.loads((repo / DECODE_ARTIFACT).read_text())
         )
     )
     problems = []

@@ -52,6 +52,17 @@ PREFIX_BUDGET_MS = 5.0
 #: scheduler overhead.
 PAGED_BUDGET_MS = 5.0
 
+#: p50 per-tick budget (ms) for the paged engine running the BLOCKED
+#: attention kernel (kubedl_tpu/models/paged_attention.py): the kernel
+#: is pure device compute, so the scheduler tick — mirror uploads, slot
+#: bookkeeping, the kv_attention plumbing itself — must cost exactly
+#: what the gather tick costs. A separate per-dispatch timing guards the
+#: compiled kernel's HOST dispatch cost: the lax path lowers to a
+#: scan-heavy executable with far more XLA ops than one gather, and an
+#: accidental re-trace per call (e.g. a non-hashable kwarg breaking the
+#: jit cache) would show up here as milliseconds, not microseconds.
+BLOCKED_BUDGET_MS = 5.0
+
 #: p95 per-plan budget (ms) for the auto-parallelism planner (kubedl_tpu/
 #: planner/): plan() runs inside reconcile_job, so it must stay a rounding
 #: error next to the engine's per-pass work. The search space is the
@@ -73,7 +84,8 @@ BUCKET_BUDGET_MS = 5.0
 
 
 def build_stub_engine(max_batch: int = 4, max_seq: int = 128,
-                      kv_layout: str = "contiguous"):
+                      kv_layout: str = "contiguous",
+                      kv_attention: str = "gather"):
     """A real LlamaEngine whose device calls are instant stubs: the
     scheduler loop, slot machinery, chain/pending bookkeeping, and
     accounting all run for real; only the model math is elided."""
@@ -83,7 +95,7 @@ def build_stub_engine(max_batch: int = 4, max_seq: int = 128,
     from kubedl_tpu.serving.server import LlamaEngine
 
     eng = LlamaEngine(preset="tiny", max_batch=max_batch, max_seq=max_seq,
-                      kv_layout=kv_layout)
+                      kv_layout=kv_layout, kv_attention=kv_attention)
     # freeze the background scheduler: the bench thread drives ticks
     with eng._cv:
         eng._stop = True
@@ -297,6 +309,82 @@ def run_paged_microbench(requests: int = 32, max_tokens: int = 32,
         eng.close()
 
 
+def run_blocked_attention_microbench(requests: int = 32,
+                                     max_tokens: int = 32,
+                                     max_batch: int = 4,
+                                     iters: int = 200) -> dict:
+    """Host overhead of the blocked paged-attention path: (1) drive the
+    stub paged engine with ``kv_attention="blocked"`` — the tick must fit
+    the same envelope as the gather tick, proving the kernel selection
+    plumbing adds no per-tick host work; (2) time one dispatch of the
+    COMPILED blocked kernel at a trivial shape where device compute is
+    negligible, so per-call wall is the host dispatch + jit-cache-lookup
+    cost of the scan-heavy executable."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.serving.server import _Slot
+
+    eng = build_stub_engine(max_batch=max_batch, kv_layout="paged",
+                            kv_attention="blocked")
+    try:
+        assert eng.kv_attention == "blocked"
+        slots = [
+            _Slot([1, 2, 3 + j], max_tokens, 0.0)
+            for j in range(requests)
+        ]
+        wall_ms, tokens, pipe = _drive(
+            eng, slots, requests * max_tokens + 100
+        )
+        assert all(
+            len(s.out_ids) == max_tokens for s in slots
+        ), "stub blocked pipeline dropped tokens"
+        st = eng._alloc.stats()
+        assert st["used"] == 0, f"block leak: {st}"
+        tick_p50 = pipe.get("tick_ms_p50", 0.0)
+    finally:
+        eng.close()
+
+    # isolated compiled-kernel dispatch at a tiny decode shape: S=1,
+    # 4 rows, 8 blocks/row of 16 — microseconds of compute on any host
+    from kubedl_tpu.models import paged_attention as pa
+
+    B, S, H, KV, hd, BS, MB = 4, 1, 4, 2, 16, 16, 8
+    NB = 1 + B * MB
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B, S, H, hd), jnp.float32)
+    kp = jax.random.normal(key, (NB, BS, KV, hd), jnp.float32)
+    vp = jax.random.normal(key, (NB, BS, KV, hd), jnp.float32)
+    bt = jnp.arange(1, 1 + B * MB, dtype=jnp.int32).reshape(B, MB)
+    starts = jnp.full((B,), BS * MB - 2, jnp.int32)
+    fn = jax.jit(lambda q, kp, vp, bt, st: pa.paged_attention(
+        q, kp, vp, bt, st, kernel="lax"))
+    jax.block_until_ready(fn(q, kp, vp, bt, starts))  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn(q, kp, vp, bt, starts)
+    jax.block_until_ready(r)
+    dispatch_ms = (time.perf_counter() - t0) * 1e3 / iters
+
+    return {
+        "requests": requests,
+        "max_tokens": max_tokens,
+        "max_batch": max_batch,
+        "ticks": pipe["ticks"],
+        "tokens": tokens,
+        "wall_ms": round(wall_ms, 2),
+        "tick_ms_p50": tick_p50,
+        "host_ms_p50": pipe.get("host_ms_p50", 0.0),
+        "kernel_dispatch_ms": round(dispatch_ms, 4),
+        "blocks_leaked": st["used"],
+        "budget_ms": BLOCKED_BUDGET_MS,
+        "within_budget": (
+            tick_p50 <= BLOCKED_BUDGET_MS
+            and dispatch_ms <= BLOCKED_BUDGET_MS
+        ),
+    }
+
+
 def run_planner_microbench() -> dict:
     """Host overhead of plan(): every catalog topology x every zoo model
     (the full admission matrix), reporting per-plan wall-time percentiles
@@ -374,11 +462,13 @@ def main() -> int:
     out = run_microbench()
     out["prefix"] = run_prefix_microbench()
     out["paged"] = run_paged_microbench()
+    out["blocked_attention"] = run_blocked_attention_microbench()
     out["planner"] = run_planner_microbench()
     out["buckets"] = run_bucket_microbench()
     print(json.dumps(out, indent=2))
     ok = (out["within_budget"] and out["prefix"]["within_budget"]
           and out["paged"]["within_budget"]
+          and out["blocked_attention"]["within_budget"]
           and out["planner"]["within_budget"]
           and out["buckets"]["within_budget"])
     return 0 if ok else 1

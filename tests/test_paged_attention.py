@@ -1,0 +1,514 @@
+"""Blocked paged-attention kernel + model-draft speculation tests.
+
+Four layers, mirroring the subsystem's split:
+
+- Kernel-level: the lax chunked scan and the pallas kernel (interpret
+  mode) against a dense masked-softmax reference over the gathered view,
+  across ragged rows, partial tail blocks, and trash-block rows — the
+  garbage-contributes-exact-0.0 contract.
+- Model-level: blocked vs gather through `paged_decode_step_batched` /
+  `paged_verify` — logits fp-close, greedy argmax identical, and the
+  read-only `paged_verify_multi` scoring pass agrees with the write-path
+  verify (candidate 0 IS the verify).
+- Engine-level: greedy token streams bit-identical between a gather and
+  a blocked engine over ragged prompts AND prefix-grafted rows; the
+  multi-candidate model-draft engine emits the same oracle stream while
+  accepting at least as many draft tokens as single-candidate.
+- Regression: `paged_decode_segment` at temperature > 0 is keyed off the
+  gumbel chain alone, so the SAMPLED stream is deterministic per seed and
+  identical across kernels (a kernel that perturbed the sampling path
+  would break per-seed reproducibility silently).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+
+def _dense_reference(q, k_pool, v_pool, bt, starts, max_s):
+    """Gather + masked dense softmax — the oracle the kernels chase."""
+    B, S, H, hd = q.shape
+    BS, KV = k_pool.shape[1], k_pool.shape[2]
+    group = H // KV
+    kf = k_pool[bt].reshape(B, max_s, KV, hd)
+    vf = v_pool[bt].reshape(B, max_s, KV, hd)
+    posq = np.minimum(starts[:, None] + np.arange(S)[None, :], max_s - 1)
+    qg = q.reshape(B, S, KV, group, hd).astype(np.float64)
+    scores = np.einsum("bskgh,btkh->bkgst", qg, kf.astype(np.float64))
+    scores /= math.sqrt(hd)
+    mask = np.arange(max_s)[None, None, :] <= posq[:, :, None]  # [B,S,T]
+    scores = np.where(mask[:, None, None], scores, -1e30)
+    scores -= scores.max(axis=-1, keepdims=True)
+    p = np.exp(scores)
+    p /= p.sum(axis=-1, keepdims=True)
+    out = np.einsum("bkgst,btkh->bskgh", p, vf.astype(np.float64))
+    return out.reshape(B, S, H, hd)
+
+
+def _random_pool(seed, B, MB, BS, KV, hd, trash_garbage=True):
+    rng = np.random.RandomState(seed)
+    NB = 1 + B * MB
+    kp = rng.randn(NB, BS, KV, hd).astype(np.float32)
+    vp = rng.randn(NB, BS, KV, hd).astype(np.float32)
+    if trash_garbage:
+        # poison the trash block with huge values: any leak through the
+        # mask would blow the comparison instead of hiding in noise
+        kp[0] = 37.0
+        vp[0] = -29.0
+    bt = np.arange(1, 1 + B * MB, dtype=np.int32).reshape(B, MB)
+    return kp, vp, bt
+
+
+class TestKernelParity:
+    """lax + pallas-interpret vs the dense oracle."""
+
+    B, MB, BS, KV, H, hd = 4, 4, 16, 2, 4, 16
+
+    def _case(self, starts, S=1, seed=0, trash_rows=()):
+        import jax.numpy as jnp
+
+        from kubedl_tpu.models import paged_attention as pa
+
+        kp, vp, bt = _random_pool(seed, self.B, self.MB, self.BS,
+                                  self.KV, self.hd)
+        for r in trash_rows:
+            bt[r, :] = 0  # a freshly-admitted row: all entries trash
+        max_s = self.MB * self.BS
+        rng = np.random.RandomState(seed + 1)
+        q = rng.randn(self.B, S, self.H, self.hd).astype(np.float32)
+        starts = np.asarray(starts, np.int32)
+        ref = _dense_reference(q, kp, vp, bt, starts, max_s)
+        outs = {}
+        for kern in ("lax", "pallas"):
+            outs[kern] = np.asarray(pa.paged_attention(
+                jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(bt), jnp.asarray(starts), kernel=kern,
+            ))
+        for kern, got in outs.items():
+            d = np.abs(got.astype(np.float64) - ref).max()
+            assert d < 1e-5, f"{kern} maxdiff {d}"
+        return outs
+
+    def test_ragged_rows_decode(self):
+        # positions spread across the table, including block boundaries
+        self._case([0, 15, 16, 47])
+
+    def test_partial_tail_block(self):
+        # every row's position lands mid-block (partial tail occupancy)
+        self._case([3, 19, 35, 60])
+
+    def test_trash_block_rows(self):
+        """A fresh row whose table is still all trash entries: position 0
+        sees only its own slot-0 key through the <= posq mask; poisoned
+        trash values beyond it must contribute exactly nothing."""
+        outs = self._case([0, 0, 22, 63], trash_rows=(0, 1))
+        assert np.isfinite(outs["lax"]).all()
+        assert np.isfinite(outs["pallas"]).all()
+
+    def test_suffix_queries(self):
+        # verify-shaped: S=8 queries per row walking forward from starts
+        self._case([0, 5, 17, 40], S=8)
+
+    def test_self_contained_mode_matches_concat_oracle(self):
+        """Read-only mode: pool history (t < starts) + fresh causal
+        suffix must equal dense attention over [history ++ suffix] —
+        including a starts=0 row with NO pool history at all (the
+        fully-masked-chunk case the -1e29 clamp exists for)."""
+        import jax.numpy as jnp
+
+        from kubedl_tpu.models import paged_attention as pa
+
+        B, MB, BS, KV, H, hd, S = 3, 4, 16, 2, 4, 16, 4
+        kp, vp, bt = _random_pool(3, B, MB, BS, KV, hd)
+        max_s = MB * BS
+        rng = np.random.RandomState(5)
+        q = rng.randn(B, S, H, hd).astype(np.float32)
+        sk = rng.randn(B, S, KV, hd).astype(np.float32)
+        sv = rng.randn(B, S, KV, hd).astype(np.float32)
+        starts = np.array([0, 7, 33], np.int32)
+        got = np.asarray(pa.paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(bt), jnp.asarray(starts),
+            self_k=jnp.asarray(sk), self_v=jnp.asarray(sv), kernel="lax",
+        ))
+        assert np.isfinite(got).all()
+        # oracle: dense over the gathered history + suffix, per row
+        kf = kp[bt].reshape(B, max_s, KV, hd)
+        vf = vp[bt].reshape(B, max_s, KV, hd)
+        group = H // KV
+        for b in range(B):
+            n = int(starts[b])
+            kcat = np.concatenate([kf[b, :n], sk[b]], axis=0)
+            vcat = np.concatenate([vf[b, :n], sv[b]], axis=0)
+            qg = q[b].reshape(S, KV, group, hd).astype(np.float64)
+            sc = np.einsum("skgh,tkh->kgst", qg, kcat.astype(np.float64))
+            sc /= math.sqrt(hd)
+            causal = np.arange(n + S)[None, :] <= (n + np.arange(S))[:, None]
+            sc = np.where(causal[None, None], sc, -1e30)
+            sc -= sc.max(axis=-1, keepdims=True)
+            p = np.exp(sc)
+            p /= p.sum(axis=-1, keepdims=True)
+            ref = np.einsum("kgst,tkh->skgh", p, vcat.astype(np.float64))
+            d = np.abs(got[b].astype(np.float64)
+                       - ref.reshape(S, H, hd)).max()
+            assert d < 1e-5, f"row {b} maxdiff {d}"
+
+    def test_blocks_per_chunk(self):
+        from kubedl_tpu.models.paged_attention import blocks_per_chunk
+
+        assert blocks_per_chunk(32, 16, 256) == 16
+        assert blocks_per_chunk(4, 16, 256) == 4
+        assert blocks_per_chunk(5, 16, 64) == 1  # 5 has no divisor <= 4
+        assert blocks_per_chunk(1, 512, 256) == 1  # never below 1
+
+    def test_unknown_kernel_rejected(self):
+        import jax.numpy as jnp
+
+        from kubedl_tpu.models import paged_attention as pa
+
+        kp, vp, bt = _random_pool(0, 1, 2, 16, 2, 16)
+        q = jnp.zeros((1, 1, 4, 16), jnp.float32)
+        with pytest.raises(ValueError):
+            pa.paged_attention(q, jnp.asarray(kp), jnp.asarray(vp),
+                               jnp.asarray(bt), jnp.zeros((1,), jnp.int32),
+                               kernel="dense")
+
+
+class TestModelParity:
+    """Blocked vs gather through the llama paged twins."""
+
+    def _setup(self, preset="tiny", batch=2, max_seq=64, block_size=16):
+        import jax
+        import jax.numpy as jnp
+
+        from kubedl_tpu.models import llama
+
+        cfg = llama.preset(preset)
+        params = llama.llama_init(jax.random.PRNGKey(0), cfg)
+        nb = 1 + batch * (max_seq // block_size)
+        cache = llama.init_paged_cache(cfg, batch, max_seq, nb, block_size)
+        mb = max_seq // block_size
+        bt = jnp.arange(1, 1 + batch * mb, dtype=jnp.int32).reshape(batch, mb)
+        cache["bt"] = bt
+        return llama, cfg, params, cache
+
+    def _prefilled(self):
+        import jax.numpy as jnp
+
+        llama, cfg, params, cache = self._setup()
+        toks = jnp.asarray(np.array([[5, 9, 13, 0], [1, 2, 0, 0]], np.int32))
+        lens = jnp.asarray(np.array([3, 2], np.int32))
+        logits, cache = llama.paged_prefill_batched(
+            params, cache, toks, lens, cfg
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+        return llama, cfg, params, cache, nxt
+
+    def test_decode_chain_greedy_identical_logits_close(self):
+        import jax
+        import jax.numpy as jnp
+
+        llama, cfg, params, cache, nxt = self._prefilled()
+        temps = jnp.zeros((2,), jnp.float32)
+        key = jax.random.PRNGKey(1)
+        streams = {}
+        for kern in ("gather", "blocked"):
+            t, _, _, _ = llama.paged_decode_segment(
+                params, dict(cache), nxt, temps, key, cfg, n_steps=8,
+                greedy=True, kv_attention=kern,
+            )
+            streams[kern] = np.asarray(t)
+        assert np.array_equal(streams["gather"], streams["blocked"])
+        # single-step logits: fp-close (online softmax reorders the sum)
+        lg, _ = llama.paged_decode_step_batched(
+            params, dict(cache), nxt, cfg, kv_attention="gather"
+        )
+        lb, _ = llama.paged_decode_step_batched(
+            params, dict(cache), nxt, cfg, kv_attention="blocked"
+        )
+        d = float(jnp.max(jnp.abs(lg - lb)))
+        assert d < 1e-4, d
+        assert np.array_equal(np.asarray(jnp.argmax(lg, -1)),
+                              np.asarray(jnp.argmax(lb, -1)))
+
+    def test_verify_ids_identical_across_kernels(self):
+        import jax.numpy as jnp
+
+        llama, cfg, params, cache, nxt = self._prefilled()
+        toks = np.zeros((2, 4), np.int32)
+        toks[:, 0] = np.asarray(nxt)[:, 0]
+        toks[:, 1:] = [[7, 7, 7], [9, 9, 9]]
+        lens = jnp.asarray(np.array([4, 4], np.int32))
+        starts = cache["pos"]
+        ids = {}
+        for kern in ("gather", "blocked"):
+            got, _ = llama.paged_verify(
+                params, dict(cache), jnp.asarray(toks), lens, starts, cfg,
+                kv_attention=kern,
+            )
+            ids[kern] = np.asarray(got)
+        assert np.array_equal(ids["gather"], ids["blocked"])
+
+    def test_verify_multi_candidate0_equals_write_path(self):
+        """The read-only scoring pass on candidate 0 must produce the
+        SAME ids the standard write-path verify emits — that equivalence
+        is what lets the engine rank candidates without writing and still
+        stay bit-exact on the winner."""
+        import jax.numpy as jnp
+
+        llama, cfg, params, cache, nxt = self._prefilled()
+        N, S = 2, 4
+        cands = np.zeros((2, N, S), np.int32)
+        cands[:, :, 0] = np.asarray(nxt)
+        cands[0, 0, 1:] = [7, 7, 7]
+        cands[0, 1, 1:] = [3, 5, 8]
+        cands[1, 0, 1:] = [9, 9, 9]
+        cands[1, 1, 1:] = [2, 4, 6]
+        lens = jnp.asarray(np.array([S, S], np.int32))
+        starts = cache["pos"]
+        for kern in ("gather", "blocked"):
+            multi = np.asarray(llama.paged_verify_multi(
+                params, dict(cache), jnp.asarray(cands), lens, starts, cfg,
+                kv_attention=kern,
+            ))
+            write, _ = llama.paged_verify(
+                params, dict(cache), jnp.asarray(cands[:, 0]), lens,
+                starts, cfg, kv_attention=kern,
+            )
+            assert np.array_equal(multi[:, 0], np.asarray(write)), kern
+
+    def test_pallas_interpret_through_decode_step(self):
+        """Force DEFAULT_KERNEL=pallas (interpret on CPU) through the
+        full model stack: same greedy argmax as the lax path."""
+        import jax.numpy as jnp
+
+        from kubedl_tpu.models import paged_attention as pa
+
+        llama, cfg, params, cache, nxt = self._prefilled()
+        lg, _ = llama.paged_decode_step_batched(
+            params, dict(cache), nxt, cfg, kv_attention="blocked"
+        )
+        old = pa.DEFAULT_KERNEL
+        pa.DEFAULT_KERNEL = "pallas"
+        try:
+            before = pa.TRACE_COUNT["pallas"]
+            lp, _ = llama.paged_decode_step_batched(
+                params, dict(cache), nxt, cfg, kv_attention="blocked"
+            )
+            assert pa.TRACE_COUNT["pallas"] > before
+        finally:
+            pa.DEFAULT_KERNEL = old
+        d = float(jnp.max(jnp.abs(lg - lp)))
+        assert d < 1e-4, d
+        assert np.array_equal(np.asarray(jnp.argmax(lg, -1)),
+                              np.asarray(jnp.argmax(lp, -1)))
+
+    def test_tiny_deep_early_exit_slice_matches_target_at_init(self):
+        """The tiny-deep preset zero-inits residual outputs (wo/w_down)
+        for layers >= 2, so its 2-layer early-exit slice is bit-identical
+        to the 4-layer target at init — the honest CPU proxy for a
+        trained draft/target pair that the model-draft bench relies on."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        from kubedl_tpu.models import llama
+
+        cfg = llama.preset("tiny-deep")
+        assert cfg.n_layers == 4 and cfg.zero_init_deep_from == 2
+        params = llama.llama_init(jax.random.PRNGKey(0), cfg)
+        sliced = dict(params)
+        sliced["layers"] = jax.tree_util.tree_map(
+            lambda a: a[:2], params["layers"]
+        )
+        cfg2 = dataclasses.replace(cfg, n_layers=2)
+        toks = jnp.asarray(np.array([[5, 9, 13, 2]], np.int32))
+        full = llama.llama_forward(params, toks, cfg)
+        part = llama.llama_forward(sliced, toks, cfg2)
+        assert np.array_equal(np.asarray(full), np.asarray(part))
+
+
+class TestEngineParity:
+    """Greedy token streams must be identical between kernels through the
+    full engine — ragged prompts, trash rows (fresh admissions), and
+    prefix-grafted rows."""
+
+    PROMPTS = [[5, 9, 13], [7, 3, 3, 11, 2], [1], [2, 4, 6, 8, 10, 12, 14]]
+
+    def _run(self, **kw):
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        eng = LlamaEngine(preset="tiny", max_batch=2, max_seq=64,
+                          kv_layout="paged", kv_block_size=4, kv_blocks=40,
+                          **kw)
+        try:
+            return [eng.generate(p, max_tokens=10)["token_ids"]
+                    for p in self.PROMPTS]
+        finally:
+            eng.close()
+
+    def test_greedy_streams_identical(self):
+        assert self._run() == self._run(kv_attention="blocked")
+
+    def test_prefix_grafted_rows_identical(self):
+        """Shared-prefix traffic: later requests decode from a grafted
+        block table (shared history blocks + COW tail) — the blocked
+        kernel must walk that table to the same tokens."""
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        shared = list(range(3, 19))
+        prompts = [shared + [100 + j] for j in range(4)]
+
+        def arm(kern):
+            eng = LlamaEngine(preset="tiny", max_batch=2, max_seq=64,
+                              kv_layout="paged", kv_block_size=4,
+                              kv_blocks=60, prefix_min_len=4,
+                              kv_attention=kern)
+            try:
+                outs = [eng.generate(p, max_tokens=8)["token_ids"]
+                        for p in prompts]
+                hits = eng.stats()["prefix_cache"]["hits"]
+                return outs, hits
+            finally:
+                eng.close()
+
+        g_outs, _ = arm("gather")
+        b_outs, b_hits = arm("blocked")
+        assert g_outs == b_outs
+        assert b_hits > 0  # the blocked arm really decoded grafted rows
+
+    def test_invalid_kernel_rejected(self):
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        with pytest.raises(ValueError):
+            LlamaEngine(preset="tiny", max_batch=2, max_seq=64,
+                        kv_layout="paged", kv_attention="dense")
+
+
+class TestModelDraftSpeculation:
+    """ModelDraft + multi-candidate verification through the engine."""
+
+    def _run(self, **kw):
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        eng = LlamaEngine(preset="tiny-deep", max_batch=2, max_seq=64,
+                          kv_layout="paged", kv_block_size=4, kv_blocks=40,
+                          **kw)
+        try:
+            outs = [eng.generate(p, max_tokens=10)["token_ids"]
+                    for p in ([5, 9, 13], [7, 3, 3, 11, 2])]
+            return outs, eng.stats()["speculative"] if eng.spec_k else None
+        finally:
+            eng.close()
+
+    def test_model_draft_exact_and_accepting(self):
+        oracle, _ = self._run()
+        outs, sp = self._run(spec_k=3, spec_draft="model",
+                             spec_draft_layers=2, kv_attention="blocked")
+        assert outs == oracle
+        assert sp["draft_kind"] == "model"
+        # tiny-deep's 2-layer slice IS the target at init: near-total
+        # acceptance is the expected signal, not a lucky roll
+        assert sp["acceptance_rate"] > 0.5, sp
+        assert sp["draft_ms_total"] > 0
+
+    def test_multi_candidate_accepts_at_least_single(self):
+        oracle, _ = self._run()
+        m_outs, m_sp = self._run(spec_k=3, spec_draft="model",
+                                 spec_draft_layers=2, spec_candidates=2)
+        s_outs, s_sp = self._run(spec_k=3, spec_draft="model",
+                                 spec_draft_layers=2, spec_candidates=1)
+        assert m_outs == oracle and s_outs == oracle
+        assert m_sp["accepted"] >= s_sp["accepted"], (m_sp, s_sp)
+        assert m_sp["candidates_scored"] > 0
+        assert s_sp["candidates_scored"] == 0
+
+    def test_model_draft_propose_candidates_contract(self):
+        """Candidate 0 must be the plain greedy proposal — the invariant
+        the multi>=single guarantee rests on."""
+        import jax
+
+        from kubedl_tpu.models import llama
+        from kubedl_tpu.serving.speculative import ModelDraft
+
+        cfg = llama.preset("tiny-deep")
+        params = llama.llama_init(jax.random.PRNGKey(0), cfg)
+        draft = ModelDraft.from_target(params, cfg, n_layers=2,
+                                       max_context=64)
+        ctx = [5, 9, 13, 2, 7]
+        plain = draft.propose(ctx, 3)
+        cands = draft.propose_candidates(ctx, 3, 2)
+        assert cands[0] == plain
+        assert len(cands) == 2 and cands[1] != cands[0]
+        # batch path consistent with the single path
+        assert draft.propose_batch([ctx, ctx[:3]], 3)[0] == plain
+
+
+class TestSampledDeterminismRegression:
+    """Temperature > 0: `paged_decode_segment`'s gumbel chain is keyed
+    off the PRNG key alone, so a given seed must reproduce the same
+    sampled stream on repeat runs AND across attention kernels (fp-close
+    logits never flip a gumbel argmax at tiny scale in practice — and a
+    kernel that DID perturb sampling would break per-seed repro, which is
+    exactly what this pins)."""
+
+    def _sample(self, seed, kern):
+        import jax
+        import jax.numpy as jnp
+
+        from kubedl_tpu.models import llama
+
+        cfg = llama.preset("tiny")
+        params = llama.llama_init(jax.random.PRNGKey(0), cfg)
+        batch, max_seq, bs = 2, 64, 16
+        nb = 1 + batch * (max_seq // bs)
+        cache = llama.init_paged_cache(cfg, batch, max_seq, nb, bs)
+        mb = max_seq // bs
+        cache["bt"] = jnp.arange(1, 1 + batch * mb,
+                                 dtype=jnp.int32).reshape(batch, mb)
+        toks = jnp.asarray(np.array([[5, 9, 13, 0], [1, 2, 0, 0]], np.int32))
+        lens = jnp.asarray(np.array([3, 2], np.int32))
+        logits, cache = llama.paged_prefill_batched(
+            params, cache, toks, lens, cfg
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+        temps = jnp.full((batch,), 0.8, jnp.float32)
+        t, _, _, _ = llama.paged_decode_segment(
+            params, cache, nxt, temps, jax.random.PRNGKey(seed), cfg,
+            n_steps=12, greedy=False, kv_attention=kern,
+        )
+        return np.asarray(t)
+
+    def test_sampled_stream_deterministic_per_seed_across_kernels(self):
+        for seed in (1, 7):
+            a = self._sample(seed, "gather")
+            b = self._sample(seed, "gather")
+            c = self._sample(seed, "blocked")
+            assert np.array_equal(a, b), f"seed {seed} not reproducible"
+            assert np.array_equal(a, c), f"seed {seed} differs by kernel"
+        # different seeds actually differ (the test has teeth)
+        assert not np.array_equal(self._sample(1, "gather"),
+                                  self._sample(7, "gather"))
+
+
+class TestBlockedHostBudget:
+    def test_blocked_attention_within_budget(self):
+        """Tier-1 gate on the blocked path's HOST cost: scheduler ticks
+        with kv_attention="blocked" fit the same envelope as gather, and
+        one compiled-kernel dispatch at a trivial shape stays far from
+        per-tick scale (a jit-cache miss per call would blow this)."""
+        from scripts.scheduler_microbench import (
+            BLOCKED_BUDGET_MS,
+            run_blocked_attention_microbench,
+        )
+
+        out = run_blocked_attention_microbench(
+            requests=8, max_tokens=16, max_batch=4, iters=50
+        )
+        assert out["tokens"] == 8 * 16
+        assert out["blocks_leaked"] == 0, out
+        assert out["tick_ms_p50"] <= BLOCKED_BUDGET_MS, out
+        assert out["kernel_dispatch_ms"] <= BLOCKED_BUDGET_MS, out
+        assert out["within_budget"], out

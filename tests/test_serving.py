@@ -736,7 +736,9 @@ class TestShardedServing:
                       "max_queue_depth": 8, "max_queue_age_s": 5.0,
                       "prefix_cache_mb": 64.0,
                       "kv_layout": "paged", "kv_block_size": 16,
-                      "kv_blocks": 0, "spec_k": 0, "spec_draft": "ngram"}
+                      "kv_blocks": 0, "spec_k": 0, "spec_draft": "ngram",
+                      "kv_attention": "gather", "spec_candidates": 1,
+                      "spec_draft_layers": 0}
         defaults = engine_kwargs({}, "")
         assert defaults["mesh_axes"] is None
         # load-shedding budget defaults ride the config too
