@@ -20,6 +20,7 @@ Injection sites wired in this repo::
     remote.request                               blob-server transport
     serving.dispatch                             device segment dispatch
     serving.kv_alloc                             KV block allocation failure
+    serving.kv_handoff                           KV handoff transfer failure
     checkpoint.torn                              die between shard + manifest
     store.wal_append                             torn WAL record (half-write)
     store.wal_fsync                              fail the WAL fsync syscall
@@ -65,6 +66,7 @@ SITES: Dict[str, str] = {
     "remote.request": "blob-server transport",
     "serving.dispatch": "device segment dispatch",
     "serving.kv_alloc": "KV block allocation failure",
+    "serving.kv_handoff": "KV handoff transfer failure",
     "checkpoint.torn": "die between shard + manifest",
     "store.wal_append": "torn WAL record (half-write)",
     "store.wal_fsync": "fail the WAL fsync syscall",

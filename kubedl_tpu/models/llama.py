@@ -1548,6 +1548,31 @@ def paged_graft_prefix(
     }
 
 
+def export_kv_blocks(
+    cache: Params, blocks
+) -> Tuple[jax.Array, jax.Array]:
+    """Gather ``blocks``' K/V payloads out of the pool for a disaggregated
+    handoff: (k, v) each [L, n_blocks, BS, KV, hd]. A fresh gather, not a
+    view — the result stays valid after the source cache is donated into
+    later dispatches or the blocks are freed back to the allocator."""
+    idx = jnp.asarray(blocks, jnp.int32)
+    return cache["k"][:, idx], cache["v"][:, idx]
+
+
+def import_kv_blocks(cache: Params, k, v, blocks) -> Params:
+    """Scatter a handoff's K/V payloads ([L, n, BS, KV, hd]) into ``blocks``
+    of the adopting engine's pool. Inverse of :func:`export_kv_blocks`; the
+    block ids come from the adopter's OWN allocator — block numbering never
+    survives the transfer, only payloads and the logical table order do."""
+    idx = jnp.asarray(blocks, jnp.int32)
+    return {
+        "k": cache["k"].at[:, idx].set(jnp.asarray(k, cache["k"].dtype)),
+        "v": cache["v"].at[:, idx].set(jnp.asarray(v, cache["v"].dtype)),
+        "pos": cache["pos"],
+        "bt": cache["bt"],
+    }
+
+
 def decode_step(
     params: Params, cache: Params, tokens: jax.Array, cfg: LlamaConfig
 ) -> Tuple[jax.Array, Params]:

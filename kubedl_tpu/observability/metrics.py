@@ -469,6 +469,25 @@ class ServingMetrics:
             "drafts can be costed against their acceptance gain",
             buckets=_TICK_MS_BUCKETS,
         )
+        # disaggregated prefill/decode family (kubedl_tpu/serving/disagg.py):
+        # KV-block handoff traffic, labeled direction="export"|"adopt"
+        self.handoff_total = r.counter(
+            "kubedl_tpu_serving_handoff_total",
+            "KV handoffs completed, by direction (export on the prefill "
+            "pool, adopt on the decode pool)",
+        )
+        self.handoff_bytes = r.counter(
+            "kubedl_tpu_serving_handoff_bytes",
+            "KV payload bytes moved across the prefill->decode handoff "
+            "seam, by direction",
+        )
+        self.handoff_ms = r.histogram(
+            "kubedl_tpu_serving_handoff_ms",
+            "Per-handoff wall time (export: block gather + device_get + "
+            "serialize; adopt: admission + scatter into the local pool), "
+            "ms, by direction",
+            buckets=_TICK_MS_BUCKETS,
+        )
         self.ttft_ms = r.histogram(
             "kubedl_tpu_serving_ttft_ms",
             "Per-request time to first token (admission queue + prefill "
@@ -563,6 +582,28 @@ class RouterMetrics:
             "kubedl_tpu_router_request_ms",
             "End-to-end router latency per request (all attempts), ms",
             buckets=_TTFT_MS_BUCKETS,
+        )
+        # per-tenant QoS family (kubedl_tpu/serving/disagg.py
+        # WeightedFairQueue), labeled qos_class="..."
+        self.qos_queue_depth = r.gauge(
+            "kubedl_tpu_router_qos_queue_depth",
+            "Requests waiting in the weighted-fair dispatch queue, "
+            "by QoS class",
+        )
+        self.qos_sheds = r.counter(
+            "kubedl_tpu_router_qos_sheds",
+            "Requests shed by the QoS arbiter (queue overflow evicts the "
+            "lowest class first; queue-deadline expiry counts), by class",
+        )
+        # disaggregated dispatch family
+        self.disagg_requests = r.counter(
+            "kubedl_tpu_router_disagg_requests",
+            "Requests dispatched as two-leg prefill->adopt flows",
+        )
+        self.disagg_fallbacks = r.counter(
+            "kubedl_tpu_router_disagg_fallbacks",
+            "Disagg-eligible requests that fell back to role-blind "
+            "colocated dispatch (a leg failed or a pool was empty)",
         )
 
 

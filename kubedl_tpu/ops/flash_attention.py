@@ -639,7 +639,8 @@ def _compiler_params():
     grid dims) + the raised VMEM ceiling."""
     from jax.experimental.pallas import tpu as pltpu
 
-    return pltpu.CompilerParams(
+    params_cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return params_cls(
         dimension_semantics=("arbitrary",) * 4,
         vmem_limit_bytes=_VMEM_LIMIT_BYTES,
     )
@@ -1008,8 +1009,9 @@ def make_flash_attention(
     parallel over both, so the body needs no collectives. On a trivial mesh
     the kernel is called directly.
     """
-    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubedl_tpu.utils.shardmap import shard_map
 
     bt = tuple(
         a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1

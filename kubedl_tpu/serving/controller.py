@@ -38,6 +38,9 @@ log = logging.getLogger("kubedl_tpu.serving")
 
 LABEL_INFERENCE = constants.API_GROUP + "/inference-name"
 LABEL_PREDICTOR = constants.API_GROUP + "/predictor-name"
+#: disaggregated serving role (prefill|decode|colocated) — the router's
+#: sync_from_store partitions its replica pools by this label
+LABEL_ROLE = constants.API_GROUP + "/serving-role"
 
 #: entry service ports (reference: :279-336 — 8080 http / 9000 grpc)
 HTTP_PORT = 8080
@@ -474,6 +477,8 @@ class InferenceController:
             LABEL_PREDICTOR: pred.name,
             constants.LABEL_REPLICA_INDEX: str(index),
         }
+        if getattr(pred, "role", ""):
+            pod.metadata.labels[LABEL_ROLE] = pred.role
         pod.metadata.owner_refs.append(self._owner(inf))
         apply_setter(inf, pred, pod, mv, HTTP_PORT)
         if self.compile_cache_dir:

@@ -82,6 +82,8 @@ def _jax_setter(
         serve_cfg["spec_draft"] = pred.spec_draft
     if pred.spec_candidates:
         serve_cfg["spec_candidates"] = pred.spec_candidates
+    if getattr(pred, "role", ""):
+        serve_cfg["role"] = pred.role
     # template-provided keys win (e.g. a custom port or preset)
     existing = main.get_env("KUBEDL_SERVE_CONFIG")
     if existing:

@@ -311,6 +311,17 @@ class PrefixCache:
 
     # -- introspection -----------------------------------------------------
 
+    def prefix_keys(self, limit: int = 256) -> List[Tuple[int, ...]]:
+        """Snapshot of stored prefix token keys, most-recently-used first
+        (bounded by ``limit``). Feeds the engine's advertised-prefix map:
+        the router's block-aware affinity steers shared prompts onto
+        replicas whose caches already hold their blocks."""
+        with self._lock:
+            keys = sorted(
+                self._entries.values(), key=lambda e: -e.last_use
+            )[: max(0, int(limit))]
+            return [e.tokens for e in keys]
+
     def stats(self) -> Dict:
         with self._lock:
             s = dict(self._stats)

@@ -28,10 +28,28 @@ severs in-flight streams. The router owns the tail-at-scale mechanics
   receiving new work but keep their in-flight streams.
 - **Prefix affinity** — consistent hashing on the observed prompt prefix
   (falling back to least-loaded) keeps PR 4's per-engine prefix KV cache
-  hot across the fleet.
+  hot across the fleet; replicas that ADVERTISE a prompt's prefix digest
+  (``stats()["prefix_cache"]["advertised"]``) outrank the ring owner —
+  block-aware affinity routes to where the KV is resident, not where it
+  would hash.
+- **Disaggregated dispatch** — when the fleet has both ``prefill``- and
+  ``decode``-role replicas (Predictor ``role:``, docs/serving.md), a
+  request runs as two legs: ``/v1/prefill`` on the prefill pool returns
+  a serialized ``KVHandoff``; ``/v1/adopt`` on a decode replica resumes
+  it. Any leg failure falls back to the role-blind colocated path —
+  prefill/decode roles are advisory, every engine still serves
+  ``/v1/generate`` — so a decode-pool outage degrades, never 503s the
+  fleet.
+- **Per-tenant QoS** — the ``X-Tenant`` header maps to a class
+  (``qos:`` config block); a weighted-fair queue arbitrates dispatch
+  slots (smooth weighted round-robin) and sheds lowest-priority-first
+  on overflow with a distinguishable 503 (``reason: qos_shed``),
+  composing with the engines' own KV-watermark sheds.
 
 Routing and hedging never change RESULTS: greedy outputs through the
-router are bit-identical to direct engine calls (tier-1 enforced).
+router are bit-identical to direct engine calls (tier-1 enforced), and
+the disagg path is bit-identical by the handoff-seam argument
+(kubedl_tpu/serving/disagg.py).
 
 Chaos sites (kubedl_tpu/chaos/plan.py): ``router.forward`` fails a
 request forward at the transport, ``router.probe`` fails a health probe,
@@ -57,6 +75,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from kubedl_tpu import chaos
 from kubedl_tpu.observability.metrics import RouterMetrics
 from kubedl_tpu.serving import router_policy as policy
+from kubedl_tpu.serving.disagg import QoSShed, qos_from_config
 
 log = logging.getLogger("kubedl_tpu.serving.router")
 
@@ -94,11 +113,14 @@ class Replica:
 
     def __init__(self, name: str, host: str, port: int, weight: int = 100,
                  fail_threshold: int = 3, cooldown_s: float = 2.0,
+                 role: str = "colocated", model: str = "",
                  clock=time.monotonic) -> None:
         self.name = name
         self.host = host
         self.port = int(port)
         self.weight = int(weight)
+        self.role = role or "colocated"
+        self.model = model
         self.breaker = policy.CircuitBreaker(
             fail_threshold=fail_threshold, cooldown_s=cooldown_s, clock=clock
         )
@@ -108,6 +130,7 @@ class Replica:
         self.shed_until = 0.0       # honor Retry-After: no dispatch before
         self.probe_failures = 0     # consecutive
         self.stats: Dict = {}       # last /v1/stats snapshot
+        self.advertised: set = set()  # prefix digests the replica holds
 
     def base_url(self) -> str:
         return f"http://{self.host}:{self.port}"
@@ -150,6 +173,9 @@ class ServingRouter:
         max_retries: int = 1,
         default_deadline_ms: float = 30_000.0,
         affinity_prefix_len: int = 8,
+        qos: Optional[Dict] = None,
+        disagg_enabled: bool = True,
+        qos_timeout_s: float = 30.0,
         metrics: Optional[RouterMetrics] = None,
         clock=time.monotonic,
     ) -> None:
@@ -165,6 +191,14 @@ class ServingRouter:
         self.max_retries = int(max_retries)
         self.default_deadline_ms = float(default_deadline_ms)
         self.affinity_prefix_len = int(affinity_prefix_len)
+        self.disagg_enabled = bool(disagg_enabled)
+        self.qos_timeout_s = float(qos_timeout_s)
+        #: per-tenant QoS: None means no arbitration (every request is
+        #: dispatched immediately, exactly the pre-QoS behavior)
+        self.qos = qos_from_config(qos)
+        self.qos_tenants: Dict[str, str] = dict(
+            (qos or {}).get("tenants") or {}
+        )
         self.metrics = metrics or RouterMetrics()
         self.clock = clock
         self.retry_budget = policy.RetryBudget(ratio=retry_budget_ratio)
@@ -184,60 +218,95 @@ class ServingRouter:
     def set_replicas(self, specs: Sequence) -> None:
         """Declare the replica set. Existing replicas keep their breaker/
         health state (a resync must not mass-readmit ejected replicas);
-        removed names are deregistered, new ones start CLOSED."""
-        parsed: List[Tuple[str, str, int, int]] = []
+        removed names are deregistered, new ones start CLOSED. Dict specs
+        may carry ``role`` (prefill|decode|colocated) and ``model``;
+        tuple specs are always colocated."""
+        parsed: List[Tuple[str, str, int, int, str, str]] = []
         for s in specs:
             if isinstance(s, dict):
                 parsed.append((s["name"], s.get("host", "127.0.0.1"),
-                               int(s["port"]), int(s.get("weight", 100))))
+                               int(s["port"]), int(s.get("weight", 100)),
+                               str(s.get("role", "") or "colocated"),
+                               str(s.get("model", ""))))
             else:
                 name, host, port = s[0], s[1], int(s[2])
                 weight = int(s[3]) if len(s) > 3 else 100
-                parsed.append((name, host, port, weight))
+                parsed.append((name, host, port, weight, "colocated", ""))
         with self._lock:
             keep = {p[0] for p in parsed}
             for name in [n for n in self._replicas if n not in keep]:
                 del self._replicas[name]
-            for name, host, port, weight in parsed:
+            for name, host, port, weight, role, model in parsed:
                 rep = self._replicas.get(name)
                 if rep is None:
                     self._replicas[name] = Replica(
                         name, host, port, weight,
                         fail_threshold=self.eject_threshold,
                         cooldown_s=self.readmit_cooldown_s,
+                        role=role, model=model,
                         clock=self.clock,
                     )
                 else:
                     rep.host, rep.port, rep.weight = host, port, weight
-            self._ring.rebuild(sorted(self._replicas))
+                    rep.role, rep.model = role, model
+            # only DECODE-capable replicas join the affinity ring: a
+            # prefix pinned to a prefill-pool replica would never serve
+            # a decode there
+            self._ring.rebuild(sorted(
+                n for n, r in self._replicas.items()
+                if r.role != "prefill"
+            ))
 
     def sync_from_store(self, store, inference_name: str,
                         namespace: str = "default") -> int:
         """Build the replica set from the control plane: RUNNING predictor
         pods of an Inference, weighted by its TrafficPolicy canary routes
-        (a predictor at weight 0 stays registered but unroutable). Returns
-        the number of replicas registered."""
+        (a predictor at weight 0 stays registered but unroutable),
+        PARTITIONED by (model, role) — each pod carries its Predictor's
+        ``role:`` as a pod label (serving controller) and its model preset
+        in KUBEDL_SERVE_CONFIG, so the router knows its prefill/decode
+        pools without probing. Duplicate (host, port) endpoints are
+        deduped (first pod wins — a restarted pod must not register its
+        address twice). Returns the number of replicas registered."""
         from kubedl_tpu.core.objects import PodPhase
-        from kubedl_tpu.serving.controller import LABEL_INFERENCE, LABEL_PREDICTOR
+        from kubedl_tpu.serving.controller import (
+            LABEL_INFERENCE, LABEL_PREDICTOR, LABEL_ROLE,
+        )
 
         weights: Dict[str, int] = {}
         tp = store.try_get("TrafficPolicy", inference_name, namespace)
         if tp is not None:
             weights = {r.predictor: r.weight for r in tp.routes}
         specs = []
+        seen_endpoints: set = set()
         for pod in store.list("Pod", namespace,
                               {LABEL_INFERENCE: inference_name}):
             if pod.status.phase != PodPhase.RUNNING:
                 continue
             pred = pod.metadata.labels.get(LABEL_PREDICTOR, "")
+            role = pod.metadata.labels.get(LABEL_ROLE, "") or "colocated"
             port = 8080
+            model = ""
             main = pod.spec.main_container()
             cfg = main.get_env("KUBEDL_SERVE_CONFIG")
             if cfg:
-                port = int(json.loads(cfg).get("port", port))
-            host = getattr(pod.status, "pod_ip", "") or "127.0.0.1"
-            specs.append((pod.metadata.name, host, port,
-                          weights.get(pred, 100) if weights else 100))
+                parsed = json.loads(cfg)
+                port = int(parsed.get("port", port))
+                model = str(parsed.get("preset", ""))
+                role = str(parsed.get("role", role) or role)
+            pod_ip = getattr(pod.status, "pod_ip", "")
+            host = pod_ip or "127.0.0.1"
+            # dedupe real endpoints only: process pods without a pod_ip
+            # all share loopback but are still distinct replicas
+            if pod_ip:
+                if (host, port) in seen_endpoints:
+                    continue
+                seen_endpoints.add((host, port))
+            specs.append({
+                "name": pod.metadata.name, "host": host, "port": port,
+                "weight": weights.get(pred, 100) if weights else 100,
+                "role": role, "model": model,
+            })
         self.set_replicas(specs)
         return len(specs)
 
@@ -318,6 +387,12 @@ class ServingRouter:
             rep.probe_failures = 0
             rep.stats = st
             rep.draining = bool(st.get("draining", False))
+            st_role = st.get("role")
+            if st_role:  # the engine's own view of its role wins
+                rep.role = str(st_role)
+            rep.advertised = set(
+                st.get("prefix_cache", {}).get("advertised", []) or ()
+            )
             readmitted = br.readmissions
             br.record_success()
             if br.readmissions > readmitted:
@@ -340,11 +415,15 @@ class ServingRouter:
 
     # -- request path ------------------------------------------------------
 
-    def _select(self, body: Dict, tried: set) -> Optional[Replica]:
+    def _select(self, body: Dict, tried: set,
+                role: Optional[str] = None) -> Optional[Replica]:
         """Next replica for this request: routable (breaker CLOSED, not
         draining, not inside a Retry-After window, weight > 0, not
-        already tried), ordered prefix-affinity-first then least-loaded
-        (router_policy.pick_replicas)."""
+        already tried), ordered block-aware-affinity-first (replicas
+        advertising the prompt's prefix digest), then ring owner, then
+        least-loaded (router_policy.pick_replicas). ``role`` restricts
+        to one pool (disagg legs); None is role-blind — the colocated
+        path routes to every replica because roles are advisory."""
         now = self.clock()
         with self._lock:
             reps = list(self._replicas.values())
@@ -355,10 +434,15 @@ class ServingRouter:
             and not r.draining
             and r.shed_until <= now
             and r.breaker.state == policy.CLOSED
+            and (role is None or r.role == role)
+        }
+        advertised = {
+            r.name: r.advertised for r in reps
+            if r.name in candidates and r.advertised
         }
         order = policy.pick_replicas(
             candidates, body.get("prompt_ids", []), self._ring,
-            self.affinity_prefix_len,
+            self.affinity_prefix_len, advertised=advertised or None,
         )
         with self._lock:
             return self._replicas.get(order[0]) if order else None
@@ -439,11 +523,14 @@ class ServingRouter:
         threading.Thread(target=go, daemon=True).start()
 
     def handle_generate(self, body: Dict,
-                        deadline_ms: Optional[float] = None
+                        deadline_ms: Optional[float] = None,
+                        tenant: Optional[str] = None
                         ) -> Tuple[int, Dict, Dict]:
         """Route one generate request. Returns ``(status, payload,
         extra_headers)`` so it serves both the HTTP handler and direct
-        in-process callers (tests/bench)."""
+        in-process callers (tests/bench). ``tenant`` is the ``X-Tenant``
+        header value; with a ``qos`` config it maps to a class whose
+        weighted-fair queue arbitrates the dispatch slot."""
         m = self.metrics
         if self._draining:
             m.drain_rejects.inc()
@@ -451,16 +538,69 @@ class ServingRouter:
                           "reason": "draining"}, {"Retry-After": "1"})
         m.requests.inc()
         self.retry_budget.on_request()
+        qos_cls: Optional[str] = None
+        if self.qos is not None:
+            cls = self.qos.resolve(tenant, self.qos_tenants)
+            budget_s = (float(deadline_ms) / 1000.0
+                        if deadline_ms is not None else self.qos_timeout_s)
+            try:
+                qos_cls = self.qos.acquire(
+                    cls, timeout_s=min(budget_s, self.qos_timeout_s)
+                )
+            except QoSShed as e:
+                m.qos_sheds.inc(qos_class=e.qos_class)
+                self._update_qos_gauges()
+                return (503, {"error": str(e), "shed": True,
+                              "reason": "qos_shed",
+                              "qos_class": e.qos_class},
+                        {"Retry-After": "1"})
+            self._update_qos_gauges()
         with self._lock:
             self._inflight += 1
         t0 = self.clock()
         try:
+            if self._disagg_eligible(body):
+                out = self._run_disagg(body, deadline_ms, t0)
+                if out is not None:
+                    return out
+                # colocated fallback spends the REMAINING budget, not a
+                # fresh one — the failed leg's time is gone
+                m.disagg_fallbacks.inc()
+                if deadline_ms is not None:
+                    deadline_ms = max(
+                        1.0, deadline_ms - (self.clock() - t0) * 1e3
+                    )
             return self._run(body, deadline_ms, t0)
         finally:
+            if qos_cls is not None:
+                self.qos.release(qos_cls)
+                self._update_qos_gauges()
             with self._idle:
                 self._inflight -= 1
                 self._idle.notify_all()
             m.request_ms.observe((self.clock() - t0) * 1e3)
+
+    def _update_qos_gauges(self) -> None:
+        if self.qos is None:
+            return
+        for cls, depth in self.qos.queue_depths().items():
+            self.metrics.qos_queue_depth.set(float(depth), qos_class=cls)
+
+    def _disagg_eligible(self, body: Dict) -> bool:
+        """Two-leg dispatch needs BOTH pools routable right now; anything
+        else (all-colocated fleet, decode-pool outage, missing prompt)
+        uses the role-blind path."""
+        if not self.disagg_enabled or not body.get("prompt_ids"):
+            return False
+        now = self.clock()
+        with self._lock:
+            reps = list(self._replicas.values())
+        roles = {
+            r.role for r in reps
+            if r.weight > 0 and not r.draining and r.shed_until <= now
+            and r.breaker.state == policy.CLOSED
+        }
+        return "prefill" in roles and "decode" in roles
 
     def _run(self, body: Dict, deadline_ms: Optional[float],
              t0: float) -> Tuple[int, Dict, Dict]:
@@ -594,6 +734,127 @@ class ServingRouter:
             return (502, {"error": f"replica {rep.name} unavailable: "
                                    f"{outcome}"}, {})
 
+    # -- disaggregated two-leg dispatch ------------------------------------
+
+    def _post_leg(self, rep: Replica, path: str, data: bytes,
+                  content_type: str, deadline: float) -> Tuple[int, bytes]:
+        """One handoff leg POST. Returns (status, body bytes); raises
+        ReplicaDown on transport failure, DeadlineExceeded on an expired
+        budget. Non-200s come back as (code, body) for the caller to
+        interpret — leg errors fall back, they never retry-storm."""
+        rem = policy.remaining_ms(deadline, self.clock)
+        if rem <= 0:
+            raise DeadlineExceeded("budget expired before dispatch")
+        try:
+            chaos.check("router.forward")
+        except chaos.FaultInjected as e:
+            raise ReplicaDown(str(e))
+        req = urllib.request.Request(
+            f"{rep.base_url()}{path}", data=data,
+            headers={"Content-Type": content_type,
+                     "X-Deadline-Ms": str(int(rem))},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=rem / 1000.0 + 2.0) as r:
+                payload = r.read()
+        except urllib.error.HTTPError as e:
+            rep.breaker.record_success()  # spoke HTTP: alive
+            return e.code, e.read() or b"{}"
+        except (OSError, urllib.error.URLError) as e:
+            raise ReplicaDown(str(e))
+        rep.breaker.record_success()
+        return 200, payload
+
+    def _run_disagg(self, body: Dict, deadline_ms: Optional[float],
+                    t0: float) -> Optional[Tuple[int, Dict, Dict]]:
+        """The two-leg dispatch: ``/v1/prefill`` on the prefill pool
+        streams back a serialized KVHandoff; ``/v1/adopt`` on a
+        block-aware-affine decode replica resumes it. Returns None
+        whenever the colocated path should take over (leg transport
+        failure, pool emptied mid-request, engine-side handoff failure)
+        — the caller counts the fallback and re-runs role-blind. Only a
+        definitive verdict (200 result, 400 bad request, expired budget)
+        is returned from here."""
+        m = self.metrics
+        budget = float(deadline_ms if deadline_ms is not None
+                       else self.default_deadline_ms)
+        deadline = policy.deadline_at(budget, self.clock)
+
+        pre = self._select(body, set(), role="prefill")
+        if pre is None:
+            return None
+        leg1 = json.dumps({
+            k: body[k] for k in
+            ("prompt_ids", "max_tokens", "temperature", "cache_prefix",
+             "request_id") if k in body
+        }).encode()
+        pre.begin()
+        try:
+            code, raw = self._post_leg(
+                pre, "/v1/prefill", leg1, "application/json", deadline)
+        except DeadlineExceeded:
+            m.deadline_exceeded.inc()
+            return 504, {"error": "deadline exceeded"}, {}
+        except ReplicaDown as e:
+            m.transport_errors.inc(replica=pre.name)
+            self._record_failure(pre)
+            log.warning("disagg prefill leg to %s failed: %s", pre.name, e)
+            return None
+        finally:
+            pre.end()
+        if code != 200:
+            self._note_leg_error(pre, code, raw)
+            if code == 400:  # the request is bad, not the fleet
+                return self._leg_payload(code, raw)
+            return None
+
+        dec = self._select(body, set(), role="decode")
+        if dec is None:
+            return None
+        dec.begin()
+        try:
+            code, raw = self._post_leg(
+                dec, "/v1/adopt", raw, "application/octet-stream", deadline)
+        except DeadlineExceeded:
+            m.deadline_exceeded.inc()
+            return 504, {"error": "deadline exceeded"}, {}
+        except ReplicaDown as e:
+            m.transport_errors.inc(replica=dec.name)
+            self._record_failure(dec)
+            log.warning("disagg adopt leg to %s failed: %s", dec.name, e)
+            return None
+        finally:
+            dec.end()
+        if code != 200:
+            self._note_leg_error(dec, code, raw)
+            if code == 400:
+                return self._leg_payload(code, raw)
+            return None
+        m.disagg_requests.inc()
+        self.latency.record((self.clock() - t0) * 1e3)
+        return 200, json.loads(raw), {}
+
+    def _note_leg_error(self, rep: Replica, code: int, raw: bytes) -> None:
+        """Feed a leg's HTTP error into the same health signals the
+        colocated path uses (shed windows, metrics) before falling back."""
+        try:
+            detail = json.loads(raw or b"{}")
+        except Exception:
+            detail = {}
+        if code == 503:
+            self.metrics.upstream_sheds.inc()
+            rep.shed_until = self.clock() + float(
+                detail.get("retry_after_s", 1.0))
+        elif code == 504:
+            self.metrics.deadline_exceeded.inc()
+
+    @staticmethod
+    def _leg_payload(code: int, raw: bytes) -> Tuple[int, Dict, Dict]:
+        try:
+            return code, json.loads(raw or b"{}"), {}
+        except Exception:
+            return code, {"error": raw.decode("utf-8", "replace")}, {}
+
     def _maybe_hedge(self, body: Dict, tried: set, deadline: float,
                      launch) -> None:
         """Fire the tail-latency hedge: a second replica gets a duplicate
@@ -631,6 +892,7 @@ class ServingRouter:
                 self.latency.hedge_delay_ms(self.hedge_floor_ms), 2
             ),
             "replicas": {},
+            "pools": {},
         }
         for r in reps:
             out["replicas"][r.name] = {
@@ -638,11 +900,22 @@ class ServingRouter:
                 "state": r.breaker.state,
                 "draining": r.draining,
                 "weight": r.weight,
+                "role": r.role,
+                "model": r.model,
                 "inflight": r.inflight,
                 "load": r.load(),
+                "advertised_prefixes": len(r.advertised),
                 "probe_failures": r.probe_failures,
                 "ejections": r.breaker.ejections,
                 "readmissions": r.breaker.readmissions,
+            }
+            pool = out["pools"].setdefault(r.role, 0)
+            out["pools"][r.role] = pool + 1
+        if self.qos is not None:
+            out["qos"] = {
+                "queue_depths": self.qos.queue_depths(),
+                "sheds": dict(self.qos.sheds),
+                "admits": dict(self.qos.admits),
             }
         return out
 
@@ -701,7 +974,9 @@ def make_router_handler(router: ServingRouter):
                 deadline_ms = float(hdr)
             elif "deadline_ms" in req:
                 deadline_ms = float(req.pop("deadline_ms"))
-            code, payload, extra = router.handle_generate(req, deadline_ms)
+            tenant = self.headers.get("X-Tenant")
+            code, payload, extra = router.handle_generate(
+                req, deadline_ms, tenant=tenant)
             self._json(code, payload, headers=extra)
 
     return Handler
@@ -717,13 +992,17 @@ def router_kwargs(cfg: Dict) -> Dict:
         ("hedge_enabled", bool), ("hedge_floor_ms", float),
         ("hedge_default_ms", float), ("retry_budget_ratio", float),
         ("max_retries", int), ("default_deadline_ms", float),
-        ("affinity_prefix_len", int),
+        ("affinity_prefix_len", int), ("disagg_enabled", bool),
+        ("qos_timeout_s", float),
     ):
         if key in cfg:
             out[key] = cast(cfg[key])
+    if isinstance(cfg.get("qos"), dict):
+        out["qos"] = cfg["qos"]
     out["replicas"] = [
-        (r["name"], r.get("host", "127.0.0.1"), int(r["port"]),
-         int(r.get("weight", 100)))
+        {"name": r["name"], "host": r.get("host", "127.0.0.1"),
+         "port": int(r["port"]), "weight": int(r.get("weight", 100)),
+         "role": r.get("role", ""), "model": r.get("model", "")}
         for r in cfg.get("replicas", [])
     ]
     return out

@@ -200,6 +200,21 @@ def _stable_hash(data: bytes) -> int:
     return int.from_bytes(hashlib.sha1(data).digest()[:8], "big")
 
 
+def prefix_digest(prompt_ids: Sequence[int],
+                  prefix_len: int) -> Optional[int]:
+    """Stable digest of a prompt's first ``prefix_len`` tokens — the SAME
+    value the ring hashes on, so an engine's advertised-prefix map (built
+    from its prefix-cache keys) and the router's per-request lookup agree
+    by construction. None for prompts shorter than the affinity length or
+    disabled affinity."""
+    if prefix_len <= 0:
+        return None
+    ids = list(prompt_ids)[:prefix_len]
+    if len(ids) < prefix_len:
+        return None
+    return _stable_hash(b",".join(str(int(t)).encode() for t in ids))
+
+
 class ConsistentHashRing:
     """Replica ring with virtual nodes: a prompt-prefix key maps to a
     deterministic PREFERENCE ORDER of replicas (walk clockwise), so when
@@ -231,14 +246,7 @@ class ConsistentHashRing:
         prompt is shorter than the affinity length (no shared prefix
         worth pinning — let least-loaded decide) or affinity is disabled
         (``prefix_len <= 0``)."""
-        if prefix_len <= 0:
-            return None
-        ids = list(prompt_ids)[:prefix_len]
-        if len(ids) < prefix_len:
-            return None
-        return _stable_hash(
-            b",".join(str(int(t)).encode() for t in ids)
-        )
+        return prefix_digest(prompt_ids, prefix_len)
 
     def preference(self, point: int) -> List[str]:
         """Distinct replica names in ring order starting at ``point``."""
@@ -261,22 +269,33 @@ def pick_replicas(
     prompt_ids: Sequence[int],
     ring: ConsistentHashRing,
     prefix_len: int,
+    advertised: Optional[Dict[str, set]] = None,
 ) -> List[str]:
-    """Routing order for one request: prefix-affinity first (consistent
-    hash on the first ``prefix_len`` prompt tokens, filtered to available
-    replicas), least-loaded (by in-flight count, then name for
-    determinism) as tie-break and fallback. ``candidates`` maps available
-    replica name -> current in-flight count; returns every candidate,
-    best first — the caller takes [0] as primary, [1] as hedge/failover."""
+    """Routing order for one request: block-aware affinity first (a
+    replica that ADVERTISES the request's prefix digest has the prefix
+    KV resident right now — stronger signal than ring ownership, which
+    only says where it WOULD be), then the ring's affinity owner, then
+    least-loaded (by in-flight count, then name for determinism).
+    ``candidates`` maps available replica name -> current in-flight
+    count; ``advertised`` maps replica name -> set of prefix digests it
+    reported via ``stats()["prefix_cache"]["advertised"]``. Returns every
+    candidate, best first — the caller takes [0] as primary, [1] as
+    hedge/failover."""
     if not candidates:
         return []
     by_load = sorted(candidates, key=lambda n: (candidates[n], n))
     point = ring.key_for_prefix(prompt_ids, prefix_len)
     if point is None:
         return by_load
+    holders: List[str] = []
+    if advertised:
+        holders = [n for n in by_load if point in advertised.get(n, ())]
     pref = [n for n in ring.preference(point) if n in candidates]
-    # affinity owner first, then the rest by load: the hedge/failover
-    # target is the least-loaded NON-owner, not the ring's second owner,
-    # so a hot prefix cannot overload two replicas in lockstep
-    rest = [n for n in by_load if not pref or n != pref[0]]
-    return ([pref[0]] if pref else []) + rest
+    # advertised holders first (least-loaded among them), then the ring
+    # owner, then the rest by load: the hedge/failover target is the
+    # least-loaded NON-owner, not the ring's second owner, so a hot
+    # prefix cannot overload two replicas in lockstep
+    head = holders + [n for n in ([pref[0]] if pref else [])
+                      if n not in holders]
+    rest = [n for n in by_load if n not in head]
+    return head + rest

@@ -64,6 +64,13 @@ class _Slot:
         self.pinned = None  # PrefixEntry pinned while this row uses it
         self.ttft_ms: Optional[float] = None
         self.out_ids: list = []
+        #: disaggregation (docs/serving.md "Disaggregated serving"):
+        #: ``handoff`` (a meta dict) marks a prefill-pool slot that
+        #: finalizes into a parked KVHandoff after its first token;
+        #: ``adopt`` (a KVHandoff) marks a decode-pool slot that skips
+        #: prefill and resumes from imported blocks
+        self.handoff: Optional[Dict] = None
+        self.adopt = None
         self.done = threading.Event()
         self.result: Optional[Dict] = None
         self.t0 = time.perf_counter()
@@ -103,7 +110,10 @@ class LlamaEngine:
                  spec_k: int = 0, spec_draft: str = "ngram",
                  kv_attention: str = "gather",
                  spec_candidates: int = 1,
-                 spec_draft_layers: int = 0) -> None:
+                 spec_draft_layers: int = 0,
+                 role: str = "colocated",
+                 advertise_prefix_len: int = 8,
+                 handoff_ttl_s: float = 30.0) -> None:
         import jax
 
         from kubedl_tpu.models import llama
@@ -111,6 +121,19 @@ class LlamaEngine:
 
         if kv_layout not in ("paged", "contiguous"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}")
+        if role not in ("", "colocated", "prefill", "decode"):
+            raise ValueError(
+                f"unknown serving role {role!r} "
+                "(have: colocated, prefill, decode)"
+            )
+        #: fleet role, ADVISORY: a prefill/decode engine still serves the
+        #: full /v1/generate path (the router's colocated fallback when
+        #: the peer pool is down depends on it) — the role only tells the
+        #: router how to partition dispatch
+        self.role = role or "colocated"
+        self.preset_name = preset
+        self.advertise_prefix_len = int(advertise_prefix_len)
+        self.handoff_ttl_s = float(handoff_ttl_s)
         if kv_attention not in ("gather", "blocked"):
             raise ValueError(
                 f"unknown kv_attention {kv_attention!r} "
@@ -365,6 +388,14 @@ class LlamaEngine:
         # depth, which showed up in the scheduler microbench under bursts
         self._waiting: "_deque[_Slot]" = _deque()
         self._cv = threading.Condition()
+        #: parked prefill handoffs: id -> {blocks (increfed), pos, meta}.
+        #: The handoff holds its OWN block references across the transfer
+        #: window — the row frees normally, a fetch (or TTL GC / failure)
+        #: decrefs, so conservation holds whatever the transfer does.
+        self._handoffs: Dict[str, Dict] = {}
+        #: export requests serviced by the scheduler thread (it alone may
+        #: touch the donated device cache): (handoff_id, reply box, event)
+        self._export_q: "_deque[tuple]" = _deque()
         #: device-resident prefix KV cache (docs/serving.md "Prefix
         #: cache"): admission grafts the longest cached prefix into the
         #: row and prefills only the suffix. 0 MB disables it.
@@ -412,6 +443,8 @@ class LlamaEngine:
         self._stats = {"requests": 0, "tokens_out": 0, "tokens_in": 0,
                        "shed": 0, "drain_rejects": 0,
                        "kv_preemptions": 0, "kv_sheds": 0,
+                       "handoffs_out": 0, "handoffs_in": 0,
+                       "handoff_failures": 0,
                        "started_at": time.time()}
         #: load-shedding budget: reject (503) instead of queueing once the
         #: queue is deeper than max_queue_depth or its head has waited
@@ -620,7 +653,10 @@ class LlamaEngine:
             active = sum(1 for s in self._slots if s is not None)
             ttft = list(self._ttft_recent)
             draining = self._draining
+            parked_handoffs = len(self._handoffs)
         up = max(now - out["started_at"], 1e-9)
+        out["role"] = self.role
+        out["handoffs_parked"] = parked_handoffs
         # surfaced so both the router (stop picking this replica, don't
         # count its rejections as overload) and the autoscaler see drain
         out["draining"] = draining
@@ -642,9 +678,23 @@ class LlamaEngine:
             )
         if self._pcache is not None:
             out["prefix_cache"] = self._pcache.stats()
+            # block-aware affinity advertisement: digests of the cached
+            # prefixes this replica already holds blocks for, in the same
+            # hash the router's ring keys on — the prober folds these into
+            # an advertised-prefix map and steers repeats here
+            from kubedl_tpu.serving.router_policy import prefix_digest
+
+            plen = self.advertise_prefix_len
+            adv = set()
+            for key in self._pcache.prefix_keys():
+                d = prefix_digest(key, plen)
+                if d is not None:
+                    adv.add(d)
+            out["prefix_cache"]["advertised"] = sorted(adv)
         if self._paged:
             out["kv_blocks"] = self._alloc.stats()
             out["kv_blocks"]["attention_kernel"] = self.kv_attention
+            out["kv_blocks"]["role"] = self.role
         if self._spec_stats is not None:
             out["speculative"] = self._spec_stats.snapshot()
             out["speculative"]["draft_kind"] = getattr(
@@ -958,7 +1008,14 @@ class LlamaEngine:
                 if self._paged:
                     if not self._alloc.admission_open():
                         break  # below low watermark: hysteresis holds
-                    if not self._admit_row_paged_locked(i, self._waiting[0]):
+                    head = self._waiting[0]
+                    if head.adopt is not None:
+                        r = self._admit_row_adopt_locked(i, head)
+                        if r is None:
+                            break  # pool dry: wait for frees
+                        self._waiting.popleft()
+                        continue  # r False: waiter already failed/woken
+                    if not self._admit_row_paged_locked(i, head):
                         break  # pool dry: wait for frees / preemption
                     self._waiting.popleft()
                     continue
@@ -1032,6 +1089,15 @@ class LlamaEngine:
                             # blocks — drop them all (no evict callbacks:
                             # the allocator was just rebuilt)
                             self._pcache.clear()
+                        # parked handoffs reference the dead pool too;
+                        # fail any fetch waiting on them
+                        self._handoffs.clear()
+                        for _hid, box, ev in list(self._export_q):
+                            box["error"] = (
+                                "engine recovered from a scheduler error"
+                            )
+                            ev.set()
+                        self._export_q.clear()
                     else:
                         self._cache = self._llama.init_batched_cache(
                             self.cfg, self.max_batch, self.max_seq
@@ -1080,6 +1146,11 @@ class LlamaEngine:
         at the next harvest. Caller holds cv."""
         if s.pending:
             return
+        if s.handoff is not None and s.fed >= len(s.prompt) and s.out_ids:
+            # prefill-pool slot: instead of decoding, park the row's
+            # blocks under a handoff id and hand the waiter the ticket
+            self._finalize_handoff_locked(i, s)
+            return
         if (
             len(s.out_ids) >= s.max_tokens
             or len(s.prompt) + len(s.out_ids) >= self.max_seq - 1
@@ -1100,6 +1171,378 @@ class LlamaEngine:
             self._free_row_locked(i)
             self._release_prefix_locked(s)
             s.done.set()
+
+    # -- disaggregated prefill/decode (docs/serving.md) --------------------
+
+    def _finalize_handoff_locked(self, i: int, s: _Slot) -> None:
+        """Park row ``i``'s blocks under a fresh handoff id: the handoff
+        takes its OWN reference on every block (incref) so the row can
+        free normally — the blocks stay alive until a fetch exports them
+        (or the TTL GC gives up on the transfer). Caller holds cv."""
+        import uuid
+
+        hid = uuid.uuid4().hex
+        blocks = list(self._row_blocks[i])
+        self._alloc.incref(blocks)
+        self._handoffs[hid] = {
+            "blocks": blocks,
+            "pos": int(self._pos_host[i]),
+            "prompt": list(s.prompt),
+            "first_token": int(s.out_ids[0]),
+            "max_tokens": int(s.handoff["max_tokens"]),
+            "temperature": float(s.temperature),
+            "cache_prefix": bool(s.cache_prefix),
+            "request_id": s.request_id,
+            "ttft_ms": s.ttft_ms,
+            "t": time.time(),
+        }
+        ms = (time.perf_counter() - s.t0) * 1e3
+        s.result = {
+            "handoff_id": hid,
+            "first_token": int(s.out_ids[0]),
+            "prompt_len": len(s.prompt),
+            "pos": int(self._pos_host[i]),
+            "latency_ms": round(ms, 2),
+            "cached_prefix_len": s.cached_len,
+        }
+        if s.ttft_ms is not None:
+            s.result["ttft_ms"] = round(s.ttft_ms, 3)
+        self._stats["handoffs_out"] += 1
+        self._slots[i] = None
+        self._free_row_locked(i)
+        self._release_prefix_locked(s)
+        s.done.set()
+
+    def prefill_handoff(self, prompt_ids, max_tokens: int = 16,
+                        temperature: float = 0.0, timeout_s: float = 600.0,
+                        cache_prefix: bool = False, request_id: str = ""):
+        """Prefill-pool entry: run the whole-prompt prefill + on-device
+        first-token sample exactly like generate(), then export the row's
+        KV blocks instead of decoding. Returns a
+        :class:`~kubedl_tpu.serving.disagg.KVHandoff` ready for a decode
+        replica's :meth:`adopt_handoff`. The handoff point is the
+        colocated engine's own prefill/decode seam, which is what makes
+        disaggregated greedy output bit-identical."""
+        from kubedl_tpu.serving.disagg import HandoffError
+
+        if not self._paged:
+            raise ValueError(
+                "disaggregated prefill requires kv_layout='paged'"
+            )
+        budget = self.max_seq - 1
+        prompt = [int(t) for t in list(prompt_ids)[:budget]]
+        if not prompt:
+            prompt = [0]
+        max_tokens = max(0, min(int(max_tokens), budget - len(prompt)))
+        if max_tokens < 1:
+            raise ValueError(
+                "prompt leaves no token budget to hand off "
+                f"(len {len(prompt)} of max_seq {self.max_seq})"
+            )
+        # the prefill row only ever produces the FIRST token (budget 1);
+        # the request's real decode budget rides in the handoff meta
+        slot = _Slot(prompt, 1, float(temperature), cache_prefix,
+                     request_id=request_id)
+        slot.handoff = {"max_tokens": max_tokens}
+        self._enqueue_slot_locked_checks(slot)
+        if not slot.done.wait(timeout=timeout_s):
+            with self._cv:
+                if slot in self._waiting:
+                    self._waiting.remove(slot)
+                for i, s in enumerate(self._slots):
+                    if s is slot:
+                        self._slots[i] = None
+                        self._free_row_locked(i)
+                self._release_prefix_locked(slot)
+        result = slot.result or {"error": "timed out", "timed_out": True}
+        with self._cv:
+            if request_id:
+                self._requests.pop(request_id, None)
+            self._stats["requests"] += 1
+            self._stats["tokens_in"] += len(prompt)
+            self._recent.append(time.time())
+        hid = result.get("handoff_id")
+        if hid is None:
+            raise HandoffError(result.get("error", "prefill failed"))
+        return self.fetch_handoff(hid, timeout_s=min(timeout_s, 60.0))
+
+    def _enqueue_slot_locked_checks(self, slot: _Slot) -> None:
+        """Admission gate shared by generate()'s disaggregated siblings:
+        drain rejection, queue-depth/age shedding, KV watermark shedding
+        — identical budgets, identical 503 reasons."""
+        with self._cv:
+            if self._draining:
+                self._stats["drain_rejects"] += 1
+                raise EngineOverloaded(
+                    "engine is draining", retry_after_s=1.0,
+                    reason="draining",
+                )
+            depth = len(self._waiting)
+            head_age = (
+                time.perf_counter() - self._waiting[0].t0
+                if self._waiting else 0.0
+            )
+            if depth >= self.max_queue_depth or head_age > self.max_queue_age_s:
+                self._stats["shed"] += 1
+                self._shed_recent.append(time.time())
+                self.metrics.shed_requests.inc()
+                retry = max(1.0, min(self.max_queue_age_s, 0.25 * depth))
+                raise EngineOverloaded(
+                    f"queue depth {depth} (budget {self.max_queue_depth}), "
+                    f"head age {head_age:.1f}s "
+                    f"(budget {self.max_queue_age_s}s)",
+                    retry_after_s=retry,
+                )
+            if self._paged and not self._alloc.admission_open():
+                self._stats["shed"] += 1
+                self._stats["kv_sheds"] += 1
+                self._shed_recent.append(time.time())
+                self.metrics.shed_requests.inc()
+                self.metrics.kv_block_sheds.inc()
+                raise EngineOverloaded(
+                    f"free KV blocks {self._alloc.free_count}/"
+                    f"{self._alloc.total} below low watermark",
+                    retry_after_s=1.0,
+                )
+            self._waiting.append(slot)
+            if slot.request_id:
+                self._requests[slot.request_id] = slot
+            self._cv.notify_all()
+
+    def fetch_handoff(self, hid: str, timeout_s: float = 30.0):
+        """Export a parked handoff's block payloads as a KVHandoff and
+        release the handoff's block references. The device gather runs on
+        the scheduler thread (the only thread that may read the donated
+        cache between dispatches); this call just queues the request and
+        waits. Raises HandoffError on transfer failure — the blocks are
+        freed either way (conservation)."""
+        from kubedl_tpu.serving.disagg import HandoffError
+
+        ev = threading.Event()
+        box: Dict = {}
+        with self._cv:
+            if hid not in self._handoffs:
+                raise HandoffError(f"unknown or expired handoff {hid!r}")
+            self._export_q.append((hid, box, ev))
+            self._cv.notify_all()
+        if not ev.wait(timeout=timeout_s):
+            raise HandoffError(f"handoff export {hid} timed out")
+        if "error" in box:
+            raise HandoffError(box["error"])
+        return box["handoff"]
+
+    def _service_exports(self) -> None:
+        """Scheduler-thread half of fetch_handoff: GC expired parked
+        handoffs, then export each queued request's blocks (gather →
+        host copy → KVHandoff) and free the handoff's references. The
+        chaos site ``serving.kv_handoff`` injects a transfer failure
+        here — the blocks are freed on that path too."""
+        if not self._paged:
+            return
+        import numpy as np
+
+        from kubedl_tpu.serving.disagg import KVHandoff
+
+        with self._cv:
+            if not self._export_q and not self._handoffs:
+                return
+            now = time.time()
+            for hid in [h for h, rec in self._handoffs.items()
+                        if now - rec["t"] > self.handoff_ttl_s]:
+                rec = self._handoffs.pop(hid)
+                self._alloc.free(rec["blocks"])
+                self._stats["handoff_failures"] += 1
+            work = []
+            while self._export_q:
+                hid, box, ev = self._export_q.popleft()
+                work.append((hid, box, ev, self._handoffs.pop(hid, None)))
+        for hid, box, ev, rec in work:
+            if rec is None:
+                box["error"] = f"unknown or expired handoff {hid!r}"
+                ev.set()
+                continue
+            t0 = time.perf_counter()
+            try:
+                chaos.check("serving.kv_handoff")
+                k, v = self._llama.export_kv_blocks(
+                    self._cache, rec["blocks"]
+                )
+                k = np.array(self._jax.device_get(k))
+                v = np.array(self._jax.device_get(v))
+                h = KVHandoff(
+                    model=self.preset_name,
+                    prompt_ids=rec["prompt"],
+                    first_token=rec["first_token"],
+                    pos=rec["pos"],
+                    block_size=self.kv_block_size,
+                    k=k, v=v,
+                    max_tokens=rec["max_tokens"],
+                    temperature=rec["temperature"],
+                    request_id=rec["request_id"],
+                    cache_prefix=rec["cache_prefix"],
+                    ttft_ms=rec["ttft_ms"],
+                )
+                box["handoff"] = h
+                m = self.metrics
+                m.handoff_total.inc(direction="export")
+                m.handoff_bytes.inc(h.nbytes, direction="export")
+                m.handoff_ms.observe(
+                    (time.perf_counter() - t0) * 1e3, direction="export"
+                )
+            except Exception as e:
+                box["error"] = f"handoff export failed: {e}"
+                with self._cv:
+                    self._stats["handoff_failures"] += 1
+            finally:
+                self._alloc.free(rec["blocks"])
+                ev.set()
+
+    def adopt_handoff(self, h, timeout_s: float = 600.0,
+                      request_id: str = "") -> Dict:
+        """Decode-pool entry: adopt a prefill replica's KVHandoff —
+        allocate blocks from THIS engine's pool (all-or-nothing, same
+        watermark admission as generate), scatter the payloads in, and
+        resume decoding from the first token. The returned result has the
+        same shape as generate()'s, and for greedy requests the token ids
+        are bit-identical to a colocated single-engine call."""
+        if not self._paged:
+            raise ValueError(
+                "adopting a KV handoff requires kv_layout='paged'"
+            )
+        if int(h.block_size) != self.kv_block_size:
+            raise ValueError(
+                f"handoff block_size {h.block_size} != engine "
+                f"{self.kv_block_size}"
+            )
+        pool = self._cache["k"].shape
+        if tuple(h.k.shape[0:1]) + tuple(h.k.shape[2:]) != (
+            pool[0], pool[2], pool[3], pool[4]
+        ):
+            raise ValueError(
+                f"handoff KV geometry {h.k.shape} does not fit pool "
+                f"{pool} (model mismatch? handoff model={h.model!r})"
+            )
+        prompt = [int(t) for t in h.prompt_ids]
+        budget = self.max_seq - 1
+        if len(prompt) >= budget:
+            raise ValueError(
+                f"handoff prompt len {len(prompt)} exceeds adopter budget "
+                f"{budget}"
+            )
+        max_tokens = max(1, min(int(h.max_tokens), budget - len(prompt)))
+        slot = _Slot(prompt, max_tokens, float(h.temperature),
+                     h.cache_prefix, request_id=request_id or h.request_id)
+        slot.adopt = h
+        self._enqueue_slot_locked_checks(slot)
+        if not slot.done.wait(timeout=timeout_s):
+            with self._cv:
+                if slot in self._waiting:
+                    self._waiting.remove(slot)
+                for i, s in enumerate(self._slots):
+                    if s is slot:
+                        self._slots[i] = None
+                        self._free_row_locked(i)
+                self._release_prefix_locked(slot)
+        result = slot.result or {"error": "timed out", "timed_out": True}
+        with self._cv:
+            if slot.request_id:
+                self._requests.pop(slot.request_id, None)
+            self._stats["requests"] += 1
+            self._stats["tokens_in"] += len(prompt)
+            self._stats["tokens_out"] += len(result.get("token_ids", []))
+            self._recent.append(time.time())
+        return result
+
+    def _admit_row_adopt_locked(self, i: int, slot: _Slot):
+        """Admit an adopted slot into row ``i``: allocate the handoff's
+        block count from this pool (sharing any prefix-cache match's full
+        blocks by reference instead of re-importing them), scatter the
+        remaining payloads, and seed the slot at the prefill/decode seam
+        (fed = prompt len, out_ids = [first_token], pos = prompt len).
+        Returns True (admitted), None (pool dry — slot stays queued), or
+        False (transfer failed — waiter woken with an error, blocks all
+        returned). Caller holds cv."""
+        h = slot.adopt
+        a = self._alloc
+        bs = self.kv_block_size
+        n_blocks = int(h.k.shape[1])
+        entry, mlen = None, 0
+        if self._pcache is not None:
+            self._pcache.observe(slot.prompt)
+            entry, mlen = self._pcache.match(slot.prompt)
+        entry_blocks = (
+            getattr(entry, "blocks", None) if entry is not None else None
+        )
+        # share only FULL matched blocks: the partial tail needs no COW
+        # here because the handoff carries the payload — importing it
+        # fresh is cheaper than a device block copy
+        shared = list(entry_blocks[:mlen // bs]) if entry_blocks else []
+        if len(shared) > n_blocks:
+            shared = shared[:n_blocks]
+        n_alloc = n_blocks - len(shared)
+        got = a.alloc(n_alloc)
+        if got is None and self._reclaim_prefix_locked():
+            got = a.alloc(n_alloc)
+        if got is None:
+            if entry is not None:
+                self._pcache.unpin(entry)
+            return None
+        a.incref(shared)
+        blocks = shared + got
+        if chaos.should_fail("serving.kv_handoff"):
+            # transfer failure mid-flight: every reference taken above
+            # goes straight back (conservation), the waiter learns why
+            a.free(blocks)
+            if entry is not None:
+                self._pcache.unpin(entry)
+            self._stats["handoff_failures"] += 1
+            slot.result = {
+                "error": "handoff transfer failed (injected)",
+                "handoff_failed": True,
+            }
+            slot.done.set()
+            return False
+        t0 = time.perf_counter()
+        if got:
+            start = len(shared)
+            self._cache = self._llama.import_kv_blocks(
+                self._cache, h.k[:, start:n_blocks],
+                h.v[:, start:n_blocks], got,
+            )
+        self._row_blocks[i] = blocks
+        self._bt_host[i, :] = 0
+        self._bt_host[i, :len(blocks)] = blocks
+        self._pos_host[i] = min(int(h.pos), self.max_seq - 1)
+        slot.fed = len(slot.prompt)
+        slot.out_ids = [int(h.first_token)]
+        slot.cached_len = len(shared) * bs
+        if slot.ttft_ms is None and h.ttft_ms is not None:
+            slot.ttft_ms = float(h.ttft_ms)
+        self._slots[i] = slot
+        # the adopted row's first decode input (h.first_token) exists only
+        # HOST-side — a device chain left by this row's previous tenant
+        # would otherwise pass the chain_ok row check and feed that
+        # tenant's stale sampled id instead
+        self._chain = None
+        if entry is not None:
+            self.metrics.prefix_hits.inc()
+            # the row is self-contained once the shares are increfed —
+            # no prefill will read through the entry, drop the pin now
+            self._pcache.unpin(entry)
+        elif self._pcache is not None:
+            self.metrics.prefix_misses.inc()
+        self._stats["handoffs_in"] += 1
+        m = self.metrics
+        m.handoff_total.inc(direction="adopt")
+        m.handoff_bytes.inc(h.nbytes, direction="adopt")
+        m.handoff_ms.observe(
+            (time.perf_counter() - t0) * 1e3, direction="adopt"
+        )
+        # adopted prompts join this replica's prefix cache so the
+        # router's block-aware affinity can steer repeats here
+        self._maybe_insert_prefix_locked(i, slot)
+        self._maybe_finalize_locked(i, slot)
+        return True
 
     def _segment_fn(self, n_steps: int, greedy: bool):
         """Jitted n-step decode with on-device sampling (cache donated);
@@ -1414,7 +1857,8 @@ class LlamaEngine:
         m.queue_depth.set(float(queued))
         if self._paged:
             st = self._alloc.stats()
-            kern = {"attention_kernel": self.kv_attention}
+            kern = {"attention_kernel": self.kv_attention,
+                    "role": self.role}
             m.kv_blocks_total.set(float(st["total"]), **kern)
             m.kv_blocks_free.set(float(st["free"]), **kern)
             m.kv_blocks_shared.set(float(st["shared"]), **kern)
@@ -1449,13 +1893,18 @@ class LlamaEngine:
 
         with self._cv:
             self._admit_locked()
-            while not self._stop and self._pending is None and not any(
-                s is not None for s in self._slots
+            while (
+                not self._stop and self._pending is None
+                and not self._export_q and not self._handoffs
+                and not any(s is not None for s in self._slots)
             ):
                 self._cv.wait(timeout=0.2)
                 self._admit_locked()
             stop = self._stop
             waiting = bool(self._waiting)
+        # handoff exports run on THIS thread (sole owner of the donated
+        # cache between dispatches) before the tick's own dispatches
+        self._service_exports()
         if stop:
             self._harvest_segment()  # flush: deliver in-flight tokens
             return True
@@ -1806,6 +2255,86 @@ def make_handler(engine: LlamaEngine, model_name: str):
                 engine.drain()
                 self._json(200, {"draining": True})
                 return
+            if self.path == "/v1/prefill":
+                # prefill-pool leg of a disaggregated request: runs the
+                # whole-prompt prefill + first-token sample and answers
+                # with the serialized KVHandoff (octet-stream)
+                from kubedl_tpu.serving.disagg import HandoffError
+
+                try:
+                    req = self._read_json()
+                    timeout_s = 600.0
+                    deadline_hdr = self.headers.get("X-Deadline-Ms")
+                    if deadline_hdr is not None:
+                        timeout_s = float(deadline_hdr) / 1000.0
+                        if timeout_s <= 0:
+                            self._json(504, {"error": "deadline exceeded"})
+                            return
+                    h = engine.prefill_handoff(
+                        req.get("prompt_ids", []),
+                        int(req.get("max_tokens", 16)),
+                        float(req.get("temperature", 0.0)),
+                        timeout_s=timeout_s,
+                        cache_prefix=bool(req.get("cache_prefix", False)),
+                        request_id=str(req.get("request_id", "")),
+                    )
+                    body = h.to_bytes()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/octet-stream"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except EngineOverloaded as e:
+                    self._json(
+                        503,
+                        {"error": str(e), "shed": True, "reason": e.reason},
+                        headers={
+                            "Retry-After": str(int(e.retry_after_s + 0.999))
+                        },
+                    )
+                except HandoffError as e:
+                    self._json(
+                        502, {"error": str(e), "handoff_failed": True}
+                    )
+                except Exception as e:
+                    self._json(400, {"error": str(e)})
+                return
+            if self.path == "/v1/adopt":
+                # decode-pool leg: body is the serialized KVHandoff; the
+                # response is a standard generate() result
+                from kubedl_tpu.serving.disagg import KVHandoff
+
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    h = KVHandoff.from_bytes(self.rfile.read(length))
+                    timeout_s = 600.0
+                    deadline_hdr = self.headers.get("X-Deadline-Ms")
+                    if deadline_hdr is not None:
+                        timeout_s = float(deadline_hdr) / 1000.0
+                        if timeout_s <= 0:
+                            self._json(504, {"error": "deadline exceeded"})
+                            return
+                    result = engine.adopt_handoff(h, timeout_s=timeout_s)
+                    if result.get("handoff_failed"):
+                        self._json(502, result)
+                        return
+                    if result.get("timed_out") and deadline_hdr is not None:
+                        self._json(504, {"error": "deadline exceeded"})
+                        return
+                    self._json(200, result)
+                except EngineOverloaded as e:
+                    self._json(
+                        503,
+                        {"error": str(e), "shed": True, "reason": e.reason},
+                        headers={
+                            "Retry-After": str(int(e.retry_after_s + 0.999))
+                        },
+                    )
+                except Exception as e:
+                    self._json(400, {"error": str(e)})
+                return
             if self.path != "/v1/generate":
                 self._json(404, {"error": "not found"})
                 return
@@ -1882,6 +2411,10 @@ def engine_kwargs(cfg: Dict, ckpt_dir: str) -> Dict:
             )
         ),
         "spec_draft_layers": int(cfg.get("spec_draft_layers", 0)),
+        "role": cfg.get(
+            "role", os.environ.get("KUBEDL_SERVE_ROLE", "colocated")
+        ),
+        "advertise_prefix_len": int(cfg.get("advertise_prefix_len", 8)),
     }
 
 

@@ -77,6 +77,17 @@ class Predictor:
     spec_k: int = 0
     spec_draft: str = ""
     spec_candidates: int = 0
+    #: disaggregated serving role: "" / "colocated" (prefill + decode on
+    #: every replica), "prefill" (fills KV blocks, exports KVHandoffs),
+    #: or "decode" (adopts handoffs into its own block pool). Advisory:
+    #: every engine still serves the full API, so the router degrades a
+    #: pool outage to the colocated path (docs/serving.md
+    #: "Disaggregated serving").
+    role: str = ""
+    #: per-tenant QoS block forwarded to the router config: ``{"classes":
+    #: {name: {"weight": int, "priority": int}}, "tenants": {tenant:
+    #: class}, "default_class": str, "capacity": int, "max_queue": int}``
+    qos: Optional[Dict] = None
 
 
 @dataclass

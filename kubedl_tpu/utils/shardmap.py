@@ -1,0 +1,27 @@
+"""shard_map version compat.
+
+jax >= 0.6 promotes ``shard_map`` to the top-level namespace and renames
+the replication-check kwarg ``check_rep`` -> ``check_vma``. Older jax
+(0.4.x, still what some images bake in) only has
+``jax.experimental.shard_map.shard_map`` with the old kwarg. Call sites
+in this repo are written against the new API; this module papers over
+the difference so they run on both.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map  # jax >= 0.6
+
+    LEGACY = False
+except ImportError:  # pre-promotion location + kwarg name
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    LEGACY = True
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
+
+
+__all__ = ["LEGACY", "shard_map"]

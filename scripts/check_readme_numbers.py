@@ -34,6 +34,9 @@ TRAINING_ARTIFACT = "BENCH_r10_training.json"
 #: blocked paged-attention decode + model-draft row (r11): separate
 #: artifact, same runs[] shape (CPU proxy — see docs/serving.md)
 DECODE_ARTIFACT = "BENCH_r11_decode.json"
+#: disaggregated prefill/decode fleet row (r12): separate artifact, same
+#: runs[] shape (CPU proxy — see docs/serving.md)
+DISAGG_ARTIFACT = "BENCH_r12_disagg.json"
 
 
 def _runs_median(runs, *path) -> float:
@@ -215,6 +218,34 @@ def expected_decode_strings(artifact: dict) -> dict:
     }
 
 
+def expected_disagg_strings(artifact: dict) -> dict:
+    """README disaggregated-fleet row strings from BENCH_r12_disagg.json."""
+    runs = artifact["runs"]
+    tgt = ("targets", "disagg")
+    colo = _runs_median(runs, *tgt, "raw", "b12", "colocated",
+                        "decode_tokens_per_sec")
+    dis = _runs_median(runs, *tgt, "raw", "b12", "disagg",
+                       "decode_tokens_per_sec")
+    speedup = _runs_median(runs, *tgt, "raw", "b12", "disagg_speedup")
+    t_off = _runs_median(runs, *tgt, "raw", "b12", "colocated",
+                         "ttft_ms_p50")
+    t_on = _runs_median(runs, *tgt, "raw", "b12", "disagg", "ttft_ms_p50")
+    gold = _runs_median(runs, *tgt, "qos_burst", "sheds", "gold")
+    bronze = _runs_median(runs, *tgt, "qos_burst", "sheds", "bronze")
+    return {
+        f"**{speedup:.2f}x** 12-way disagg decode":
+            "median of runs[].targets.disagg.raw.b12.disagg_speedup",
+        f"{colo:,.0f} -> {dis:,.0f} tokens/s":
+            "medians of runs[].targets.disagg.raw.b12."
+            "colocated/disagg.decode_tokens_per_sec",
+        f"TTFT p50 {t_off:.0f} -> {t_on:.0f} ms":
+            "medians of runs[].targets.disagg.raw.b12."
+            "colocated/disagg.ttft_ms_p50",
+        f"burst sheds gold {gold:.0f} / bronze {bronze:.0f}":
+            "medians of runs[].targets.disagg.qos_burst.sheds.gold/bronze",
+    }
+
+
 def check(repo: Path = REPO) -> list:
     """Returns a list of mismatch descriptions (empty = README is clean)."""
     artifact = json.loads((repo / ARTIFACT).read_text())
@@ -248,6 +279,11 @@ def check(repo: Path = REPO) -> list:
     expected.update(
         expected_decode_strings(
             json.loads((repo / DECODE_ARTIFACT).read_text())
+        )
+    )
+    expected.update(
+        expected_disagg_strings(
+            json.loads((repo / DISAGG_ARTIFACT).read_text())
         )
     )
     problems = []

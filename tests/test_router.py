@@ -429,7 +429,10 @@ class TestRouterPath:
         })
         assert kw["eject_threshold"] == 4
         assert kw["hedge_floor_ms"] == 25.0
-        assert kw["replicas"] == [("r0", "127.0.0.1", 9000, 50)]
+        assert kw["replicas"] == [
+            {"name": "r0", "host": "127.0.0.1", "port": 9000,
+             "weight": 50, "role": "", "model": ""}
+        ]
 
 
 def test_sync_from_store_builds_fleet_from_control_plane():
