@@ -3,8 +3,8 @@
 
 PY ?= python
 
-.PHONY: test test-fast bench-smoke bench dryrun install lint all render-deploy \
-	validate-deploy docker-build kind-e2e drive-router
+.PHONY: test test-fast test-witness bench-smoke bench dryrun install lint all \
+	render-deploy validate-deploy docker-build kind-e2e drive-router
 
 all: test
 
@@ -59,5 +59,12 @@ drive-router:
 install:
 	$(PY) -m pip install -e .
 
+# bytecode-compile + the project-specific static analyzer (rule catalog:
+# docs/static-analysis.md; findings beyond analysis/baseline.json fail)
 lint:
 	$(PY) -m compileall -q kubedl_tpu bench.py __graft_entry__.py
+	JAX_PLATFORMS=cpu $(PY) -m kubedl_tpu.analysis
+
+# tier-1 suite under the runtime lock-order witness (fails on ABBA cycles)
+test-witness:
+	KUBEDL_CI=true KUBEDL_LOCKWITNESS=1 $(PY) -m pytest tests/ -x -q -m "not slow"

@@ -1,0 +1,16 @@
+"""Project-specific static analysis + runtime lock witness.
+
+``python -m kubedl_tpu.analysis`` runs the lint engine (rule catalog in
+docs/static-analysis.md); :mod:`kubedl_tpu.analysis.lockwitness` provides
+the KUBEDL_LOCKWITNESS=1 runtime lock-order witness tier-1 runs under.
+"""
+
+from kubedl_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    analyze,
+    analyze_file,
+    apply_baseline,
+    load_baseline,
+    run,
+    write_baseline,
+)

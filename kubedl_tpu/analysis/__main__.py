@@ -1,0 +1,6 @@
+import sys
+
+from kubedl_tpu.analysis.engine import run
+
+if __name__ == "__main__":
+    sys.exit(run())

@@ -1,0 +1,276 @@
+"""Project-specific static analysis engine.
+
+Walks every ``.py`` file under ``kubedl_tpu/`` through the rule set in
+:mod:`kubedl_tpu.analysis.rules` — each rule pins one *historical* bug
+class from this repo's postmortems (docs/static-analysis.md has the
+catalog). Findings can be suppressed two ways:
+
+- inline pragma on the flagged line or the line above::
+
+      os.environ["X"] = "y"  # ktl: disable=KTL003 -- fresh subprocess, pre-jax
+
+  (``# ktl: disable-file=KTL003`` in the first 10 lines suppresses the
+  rule for the whole file);
+- the committed ``analysis/baseline.json``: accepted pre-existing
+  findings, keyed by a line-content fingerprint so pure line-number
+  drift never invalidates them. New findings beyond the baseline fail.
+
+``python -m kubedl_tpu.analysis`` is the CLI; tier-1 runs it via
+``tests/test_analysis.py`` the same way ``check_readme_numbers.py`` is
+gated.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+_PRAGMA_RE = re.compile(r"#\s*ktl:\s*disable=([A-Z0-9, ]+)")
+_FILE_PRAGMA_RE = re.compile(r"#\s*ktl:\s*disable-file=([A-Z0-9, ]+)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str
+    snippet: str = ""  # stripped source line, the fingerprint anchor
+
+    def fingerprint(self) -> str:
+        h = hashlib.sha1(
+            f"{self.rule}|{self.path}|{self.snippet or self.message}".encode()
+        )
+        return h.hexdigest()[:16]
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+
+@dataclass
+class FileContext:
+    """Parsed view of one source file handed to AST rules."""
+
+    path: Path
+    relpath: str
+    source: str
+    lines: List[str]
+    tree: ast.AST
+
+    def finding(self, rule: str, node_or_line, message: str) -> Finding:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        snippet = ""
+        if 1 <= line <= len(self.lines):
+            snippet = self.lines[line - 1].strip()
+        return Finding(rule, self.relpath, line, message, snippet)
+
+
+def _rule_modules():
+    from kubedl_tpu.analysis.rules import ALL_RULES
+
+    return ALL_RULES
+
+
+def iter_source_files(root: Path) -> List[Path]:
+    pkg = root / "kubedl_tpu"
+    files = [
+        p for p in sorted(pkg.rglob("*.py"))
+        if "__pycache__" not in p.parts
+    ]
+    return files
+
+
+def parse_file(path: Path, root: Path) -> Optional[FileContext]:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    rel = path.relative_to(root).as_posix()
+    return FileContext(path, rel, source, source.splitlines(), tree)
+
+
+def _apply_pragmas(findings: List[Finding], ctx: FileContext) -> List[Finding]:
+    file_disabled: set = set()
+    for line in ctx.lines[:10]:
+        m = _FILE_PRAGMA_RE.search(line)
+        if m:
+            file_disabled |= {
+                r.strip() for r in m.group(1).split(",") if r.strip()
+            }
+    out = []
+    for f in findings:
+        if f.rule in file_disabled or "ALL" in file_disabled:
+            continue
+        suppressed = False
+        for ln in (f.line, f.line - 1):
+            if 1 <= ln <= len(ctx.lines):
+                m = _PRAGMA_RE.search(ctx.lines[ln - 1])
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",")}
+                    if f.rule in rules or "ALL" in rules:
+                        suppressed = True
+                        break
+        if not suppressed:
+            out.append(f)
+    return out
+
+
+def analyze_file(path: Path, root: Optional[Path] = None) -> List[Finding]:
+    """Run every AST rule over one file (fixture tests use this)."""
+    root = root or REPO_ROOT
+    try:
+        rel_root = root if path.is_relative_to(root) else path.parent
+    except AttributeError:  # <3.9 compat, not expected
+        rel_root = root
+    ctx = parse_file(path, rel_root)
+    if ctx is None:
+        return [Finding("KTL000", str(path), 1, "file does not parse")]
+    findings: List[Finding] = []
+    for rule in _rule_modules():
+        check = getattr(rule, "check_file", None)
+        if check is not None:
+            findings.extend(check(ctx))
+    return _apply_pragmas(findings, ctx)
+
+
+def analyze(root: Optional[Path] = None) -> List[Finding]:
+    """Full-project run: AST rules over every file plus project rules
+    (chaos-site drift, metrics drift, schema drift)."""
+    root = root or REPO_ROOT
+    files = iter_source_files(root)
+    contexts: List[FileContext] = []
+    findings: List[Finding] = []
+    for p in files:
+        ctx = parse_file(p, root)
+        if ctx is None:
+            findings.append(
+                Finding("KTL000", p.relative_to(root).as_posix(), 1,
+                        "file does not parse")
+            )
+            continue
+        contexts.append(ctx)
+        file_findings: List[Finding] = []
+        for rule in _rule_modules():
+            check = getattr(rule, "check_file", None)
+            if check is not None:
+                file_findings.extend(check(ctx))
+        findings.extend(_apply_pragmas(file_findings, ctx))
+    for rule in _rule_modules():
+        check = getattr(rule, "check_project", None)
+        if check is not None:
+            findings.extend(check(root, contexts))
+    return findings
+
+
+# ---- baseline -------------------------------------------------------------
+
+
+def load_baseline(path: Path = BASELINE_PATH) -> Dict[str, int]:
+    """fingerprint -> accepted count."""
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    out: Dict[str, int] = {}
+    for entry in data.get("findings", []):
+        out[entry["fingerprint"]] = out.get(entry["fingerprint"], 0) + 1
+    return out
+
+
+def write_baseline(findings: Sequence[Finding],
+                   path: Path = BASELINE_PATH) -> None:
+    entries = [
+        {
+            "fingerprint": f.fingerprint(),
+            "rule": f.rule,
+            "path": f.path,
+            "snippet": f.snippet,
+        }
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+    ]
+    path.write_text(json.dumps(
+        {"version": 1, "findings": entries}, indent=2
+    ) + "\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, int]
+) -> Tuple[List[Finding], List[str]]:
+    """(new findings beyond the baseline, stale baseline fingerprints)."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+        else:
+            new.append(f)
+    stale = [fp for fp, n in budget.items() if n > 0]
+    return new, stale
+
+
+# ---- CLI ------------------------------------------------------------------
+
+
+def run(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m kubedl_tpu.analysis",
+        description="Project-specific static analysis (rule catalog: "
+                    "docs/static-analysis.md)",
+    )
+    ap.add_argument("--root", default=str(REPO_ROOT),
+                    help="repo root (default: this checkout)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring baseline.json")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept current findings into baseline.json")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    root = Path(args.root).resolve()
+    findings = analyze(root)
+
+    if args.write_baseline:
+        write_baseline(findings)
+        print(f"baseline.json: accepted {len(findings)} finding(s)")
+        return 0
+
+    stale: List[str] = []
+    if not args.no_baseline:
+        findings, stale = apply_baseline(findings, load_baseline())
+
+    if args.json:
+        print(json.dumps({
+            "findings": [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "message": f.message, "fingerprint": f.fingerprint()}
+                for f in findings
+            ],
+            "stale_baseline": stale,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f)
+        if stale:
+            print(f"note: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (fixed findings "
+                  "still listed in analysis/baseline.json — prune with "
+                  "--write-baseline)")
+        if findings:
+            print(f"{len(findings)} new finding(s) — fix, pragma "
+                  "(# ktl: disable=KTLxxx), or accept via --write-baseline")
+        else:
+            print("static analysis clean")
+    return 1 if findings else 0

@@ -1,0 +1,466 @@
+"""Runtime lock-order witness: drop-in Lock/RLock/Condition wrappers.
+
+The static KTL002 rule (docs/static-analysis.md) finds *lexically*
+blocking work under a lock; this module finds *dynamic* ordering bugs the
+AST cannot see — two code paths that acquire the same pair of lock
+classes in opposite orders (a potential deadlock the moment both paths
+run concurrently), and locks held across registered blocking calls.
+
+Design mirrors the chaos layer's disarmed fast path: when
+``KUBEDL_LOCKWITNESS`` is unset the module-level factories return *bare*
+``threading`` primitives — zero wrapper, zero bookkeeping — so
+production code can route lock creation through :func:`Lock` /
+:func:`RLock` / :func:`Condition` at no cost. When armed (env var ``=1``
+or :func:`install`), every lock created from repo code is tagged with its
+*creation site* (file:line — the lock "class" in witness terms, the same
+granularity FreeBSD's witness(4) uses), and each acquisition records a
+``held-site -> acquired-site`` edge in a global order graph. A cycle in
+that graph is a potential deadlock even if the run never actually
+deadlocked; :func:`check` (and the tier-1 conftest hook) fails on any.
+
+``install()`` additionally monkeypatches ``threading.Lock`` /
+``threading.RLock`` / ``threading.Condition`` so *existing* code that
+calls ``threading.Lock()`` directly is witnessed without modification,
+and wraps ``time.sleep`` to flag sleeps executed while a witnessed lock
+is held (the ``_spec_tick`` bug class, at runtime). Locks created from
+outside the repo tree (stdlib, site-packages) pass through unwitnessed —
+their ordering is not ours to police and the noise would drown the graph.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+_ORIG_CONDITION = threading.Condition
+_ORIG_SLEEP = time.sleep
+
+#: repo root: locks created outside this tree are passed through bare.
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+ENV_VAR = "KUBEDL_LOCKWITNESS"
+
+
+def _creation_site() -> Tuple[str, int, bool]:
+    """(filename, lineno, interesting) of the frame that created the lock,
+    skipping this module and threading.py (``Condition()`` creates its
+    default RLock from inside threading)."""
+    f = sys._getframe(2)
+    here = os.path.abspath(__file__)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != here and not fn.endswith("threading.py"):
+            path = os.path.abspath(fn)
+            interesting = path.startswith(_REPO_ROOT) and (
+                "site-packages" not in path
+            )
+            return path, f.f_lineno, interesting
+        f = f.f_back
+    return "<unknown>", 0, False
+
+
+@dataclass
+class OrderCycle:
+    """A cycle in the lock-order graph: potential deadlock."""
+
+    sites: Tuple[str, ...]                  # site names along the cycle
+    edges: Tuple[Tuple[str, str], ...]      # the edges that close it
+
+    def __str__(self) -> str:
+        return "lock-order cycle: " + " -> ".join(self.sites + (self.sites[0],))
+
+
+@dataclass
+class BlockingFinding:
+    """A registered blocking call executed while witnessed locks were held."""
+
+    call: str                               # e.g. "time.sleep"
+    caller: str                             # file:line of the blocking call
+    held: Tuple[str, ...]                   # creation sites of held locks
+
+    def __str__(self) -> str:
+        return (
+            f"{self.call} at {self.caller} while holding "
+            + ", ".join(self.held)
+        )
+
+
+class _TLS(threading.local):
+    def __init__(self) -> None:
+        self.held: List["_WitnessBase"] = []
+        self.seen_edges: Set[Tuple[str, str]] = set()
+
+
+class Witness:
+    """One witness instance: order graph + runtime blocking findings.
+
+    The module singleton (armed via env / :func:`install`) is one of
+    these; tests may build private instances so assertions never touch
+    global state."""
+
+    def __init__(self) -> None:
+        self._mu = _ORIG_LOCK()
+        self._tls = _TLS()
+        # edge -> example (held stack site, acquire site) human context
+        self.edges: Dict[Tuple[str, str], int] = {}
+        self._blocking: List[BlockingFinding] = []
+        self._blocking_seen: Set[Tuple[str, Tuple[str, ...]]] = set()
+
+    # -- factories ---------------------------------------------------------
+
+    def Lock(self):
+        site, line, interesting = _creation_site()
+        if not interesting:
+            return _ORIG_LOCK()
+        return _WitnessLock(self, f"{_rel(site)}:{line}")
+
+    def RLock(self):
+        site, line, interesting = _creation_site()
+        if not interesting:
+            return _ORIG_RLOCK()
+        return _WitnessRLock(self, f"{_rel(site)}:{line}")
+
+    def Condition(self, lock=None):
+        if lock is None:
+            site, line, interesting = _creation_site()
+            if not interesting:
+                return _ORIG_CONDITION()
+            lock = _WitnessRLock(self, f"{_rel(site)}:{line}")
+        return _ORIG_CONDITION(lock)
+
+    # -- bookkeeping (called by wrappers) ----------------------------------
+
+    def note_acquire(self, wrapper: "_WitnessBase") -> None:
+        tls = self._tls
+        for h in tls.held:
+            if h.site == wrapper.site:
+                continue  # same lock class nested: ordered by convention
+            edge = (h.site, wrapper.site)
+            if edge in tls.seen_edges:
+                continue
+            tls.seen_edges.add(edge)
+            with self._mu:
+                self.edges[edge] = self.edges.get(edge, 0) + 1
+        tls.held.append(wrapper)
+
+    def note_release(self, wrapper: "_WitnessBase") -> None:
+        held = self._tls.held
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is wrapper:
+                del held[i]
+                return
+
+    def note_blocking(self, call: str, caller: str) -> None:
+        held = tuple(w.site for w in self._tls.held)
+        if not held:
+            return
+        key = (caller, held)
+        if key in self._blocking_seen:
+            return
+        with self._mu:
+            self._blocking_seen.add(key)
+            self._blocking.append(BlockingFinding(call, caller, held))
+
+    def held_sites(self) -> Tuple[str, ...]:
+        return tuple(w.site for w in self._tls.held)
+
+    # -- analysis ----------------------------------------------------------
+
+    def cycles(self) -> List[OrderCycle]:
+        """Strongly-connected components of the order graph with more
+        than one node — each is a set of lock classes acquired in
+        conflicting orders somewhere in the run."""
+        with self._mu:
+            edge_list = list(self.edges)
+        graph: Dict[str, List[str]] = {}
+        for a, b in edge_list:
+            graph.setdefault(a, []).append(b)
+            graph.setdefault(b, [])
+        # iterative Tarjan SCC
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        for root in graph:
+            if root in index:
+                continue
+            work = [(root, iter(graph[root]))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for nxt in it:
+                    if nxt not in index:
+                        index[nxt] = low[nxt] = counter[0]
+                        counter[0] += 1
+                        stack.append(nxt)
+                        on_stack.add(nxt)
+                        work.append((nxt, iter(graph[nxt])))
+                        advanced = True
+                        break
+                    if nxt in on_stack:
+                        low[node] = min(low[node], index[nxt])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        sccs.append(scc)
+        out = []
+        for scc in sccs:
+            members = set(scc)
+            cyc_edges = tuple(
+                (a, b) for (a, b) in edge_list if a in members and b in members
+            )
+            out.append(OrderCycle(tuple(sorted(members)), cyc_edges))
+        return out
+
+    def blocking_findings(self) -> List[BlockingFinding]:
+        with self._mu:
+            return list(self._blocking)
+
+    def report(self) -> str:
+        lines = []
+        cycles = self.cycles()
+        if cycles:
+            lines.append(f"lockwitness: {len(cycles)} order cycle(s):")
+            lines.extend(f"  {c}" for c in cycles)
+            for c in cycles:
+                for a, b in c.edges:
+                    lines.append(f"    edge {a} -> {b}")
+        blocking = self.blocking_findings()
+        if blocking:
+            lines.append(
+                f"lockwitness: {len(blocking)} blocking call(s) under a lock:"
+            )
+            lines.extend(f"  {b}" for b in blocking)
+        with self._mu:
+            lines.append(
+                f"lockwitness: {len(self.edges)} order edge(s) observed"
+            )
+        return "\n".join(lines)
+
+
+def _rel(path: str) -> str:
+    try:
+        return os.path.relpath(path, _REPO_ROOT)
+    except ValueError:
+        return path
+
+
+class _WitnessBase:
+    __slots__ = ("_witness", "_raw", "site")
+
+
+class _WitnessLock(_WitnessBase):
+    """Drop-in for threading.Lock with acquisition-order recording."""
+
+    def __init__(self, witness: Witness, site: str) -> None:
+        self._witness = witness
+        self._raw = _ORIG_LOCK()
+        self.site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._raw.acquire(blocking, timeout)
+        if got:
+            self._witness.note_acquire(self)
+        return got
+
+    def release(self) -> None:
+        self._witness.note_release(self)
+        self._raw.release()
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __getattr__(self, name):  # _at_fork_reinit etc.
+        return getattr(self._raw, name)
+
+
+class _WitnessRLock(_WitnessBase):
+    """Drop-in for threading.RLock; also Condition-compatible
+    (_is_owned/_release_save/_acquire_restore delegate with the depth
+    bookkeeping the witness needs)."""
+
+    __slots__ = ("_depth",)
+
+    def __init__(self, witness: Witness, site: str) -> None:
+        self._witness = witness
+        self._raw = _ORIG_RLOCK()
+        self.site = site
+        self._depth = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._raw.acquire(blocking, timeout)
+        if got:
+            self._depth += 1
+            if self._depth == 1:
+                self._witness.note_acquire(self)
+        return got
+
+    __enter__ = acquire
+
+    def release(self) -> None:
+        self._depth -= 1
+        if self._depth == 0:
+            self._witness.note_release(self)
+        self._raw.release()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # Condition protocol
+    def _is_owned(self) -> bool:
+        return self._raw._is_owned()
+
+    def _release_save(self):
+        depth, self._depth = self._depth, 0
+        self._witness.note_release(self)
+        return (self._raw._release_save(), depth)
+
+    def _acquire_restore(self, saved) -> None:
+        state, depth = saved
+        self._raw._acquire_restore(state)
+        self._depth = depth
+        self._witness.note_acquire(self)
+
+    def __getattr__(self, name):
+        return getattr(self._raw, name)
+
+
+# ---- module singleton / global install ------------------------------------
+
+_GLOBAL: Optional[Witness] = None
+_INSTALLED = False
+
+
+def active() -> Optional[Witness]:
+    return _GLOBAL
+
+
+def armed() -> bool:
+    return _GLOBAL is not None
+
+
+def Lock():
+    """Factory for production code: bare threading.Lock when disarmed."""
+    w = _GLOBAL
+    if w is None:
+        return _ORIG_LOCK()
+    return w.Lock()
+
+
+def RLock():
+    w = _GLOBAL
+    if w is None:
+        return _ORIG_RLOCK()
+    return w.RLock()
+
+
+def Condition(lock=None):
+    w = _GLOBAL
+    if w is None:
+        return _ORIG_CONDITION(lock)
+    return w.Condition(lock)
+
+
+def _witness_sleep(secs):
+    w = _GLOBAL
+    if w is not None and secs and secs > 0:
+        f = sys._getframe(1)
+        w.note_blocking(
+            "time.sleep", f"{_rel(f.f_code.co_filename)}:{f.f_lineno}"
+        )
+    _ORIG_SLEEP(secs)
+
+
+def install(force: bool = False) -> Optional[Witness]:
+    """Arm the global witness and monkeypatch ``threading.Lock`` /
+    ``RLock`` / ``Condition`` (+ ``time.sleep``) so existing code is
+    witnessed unmodified. No-op unless ``KUBEDL_LOCKWITNESS=1`` or
+    ``force``. Idempotent. Call BEFORE the modules whose locks you want
+    witnessed create them (conftest does this at import)."""
+    global _GLOBAL, _INSTALLED
+    if not force and os.environ.get(ENV_VAR, "") != "1":
+        return None
+    if _GLOBAL is None:
+        _GLOBAL = Witness()
+    if not _INSTALLED:
+        threading.Lock = lambda: _GLOBAL.Lock()
+        threading.RLock = lambda: _GLOBAL.RLock()
+        threading.Condition = lambda lock=None: _GLOBAL.Condition(lock)
+        time.sleep = _witness_sleep
+        atexit.register(_atexit_report)
+        _INSTALLED = True
+    return _GLOBAL
+
+
+def uninstall() -> None:
+    """Disarm and restore the patched primitives (test hygiene). Locks
+    already created stay witnessed but the graph stops growing only for
+    new edges recorded against the old witness."""
+    global _GLOBAL, _INSTALLED
+    threading.Lock = _ORIG_LOCK
+    threading.RLock = _ORIG_RLOCK
+    threading.Condition = _ORIG_CONDITION
+    time.sleep = _ORIG_SLEEP
+    _GLOBAL = None
+    _INSTALLED = False
+
+
+def check(fail_on_blocking: bool = False) -> List[OrderCycle]:
+    """The gate: return order cycles on the global witness (empty when
+    disarmed). ``fail_on_blocking`` folds runtime blocking-under-lock
+    findings in as failures too (default: report-only — the static
+    KTL002 rule owns that class with baseline/pragma workflow)."""
+    w = _GLOBAL
+    if w is None:
+        return []
+    cycles = w.cycles()
+    if fail_on_blocking:
+        cycles = cycles + [
+            OrderCycle((str(b),), ()) for b in w.blocking_findings()
+        ]
+    return cycles
+
+
+def _atexit_report() -> None:
+    w = _GLOBAL
+    if w is None:
+        return
+    cycles = w.cycles()
+    blocking = w.blocking_findings()
+    if cycles or blocking:
+        sys.stderr.write(w.report() + "\n")

@@ -1,0 +1,23 @@
+"""Rule registry. Each module pins one historical bug class; the catalog
+with the postmortem each rule encodes lives in docs/static-analysis.md."""
+
+from kubedl_tpu.analysis.rules import (
+    chaos_sites,
+    donation,
+    envmut,
+    locks,
+    metrics_drift,
+    schema_drift,
+)
+
+#: engine iterates this; order = report order
+ALL_RULES = [
+    donation,        # KTL001
+    locks,           # KTL002
+    envmut,          # KTL003
+    chaos_sites,     # KTL004
+    metrics_drift,   # KTL005
+    schema_drift,    # KTL006
+]
+
+RULE_IDS = {m.RULE_ID: m for m in ALL_RULES}

@@ -1,0 +1,130 @@
+"""KTL004 — chaos-site drift.
+
+Generalizes the PR 6 doc-drift test from "docstring table matches the
+registry" to a machine check across all three surfaces:
+
+1. every string literal at a ``chaos.check(<site>)`` /
+   ``chaos.should_fail(<site>)`` call site must exist in
+   ``chaos.plan.SITES`` (parsed statically — the rule never imports
+   production code);
+2. every registered site must be consulted somewhere (dead registry
+   rows rot into false documentation);
+3. every registered site must have a row in the docs/robustness.md
+   failure-modes table (the `| Site | ... |` table), so the operator
+   runbook can never silently lag the wired surface.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Set, Tuple
+
+from kubedl_tpu.analysis.engine import Finding
+
+RULE_ID = "KTL004"
+
+PLAN_PATH = "kubedl_tpu/chaos/plan.py"
+DOC_PATH = "docs/robustness.md"
+
+
+def _registry_sites(root: Path) -> Tuple[Set[str], int]:
+    """Parse the SITES dict literal out of chaos/plan.py."""
+    plan = root / PLAN_PATH
+    if not plan.exists():
+        return set(), 0
+    tree = ast.parse(plan.read_text())
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "SITES" \
+                    and isinstance(node.value, ast.Dict):
+                keys = {
+                    k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                }
+                return keys, node.lineno
+    return set(), 0
+
+
+def _call_sites(contexts) -> Dict[str, List[Tuple[str, int]]]:
+    """site -> [(relpath, line)] for every chaos.check/should_fail literal."""
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for ctx in contexts:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in ("check", "should_fail")
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "chaos"):
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                site = node.args[0].value
+                out.setdefault(site, []).append((ctx.relpath, node.lineno))
+    return out
+
+
+def _doc_table_sites(root: Path) -> Set[str]:
+    doc = root / DOC_PATH
+    if not doc.exists():
+        return set()
+    sites: Set[str] = set()
+    in_table = False
+    for line in doc.read_text().splitlines():
+        stripped = line.strip()
+        if stripped.startswith("|") and "Site" in stripped \
+                and "Layer" in stripped:
+            in_table = True
+            continue
+        if in_table:
+            if not stripped.startswith("|"):
+                break
+            first_col = stripped.strip("|").split("|")[0]
+            for tok in re.findall(r"`([^`]+)`", first_col):
+                sites.add(tok.strip())
+    return sites
+
+
+def check_project(root: Path, contexts) -> List[Finding]:
+    registered, reg_line = _registry_sites(root)
+    if not registered:
+        return [Finding(RULE_ID, PLAN_PATH, 1,
+                        "could not parse chaos.plan.SITES registry")]
+    consulted = _call_sites(contexts)
+    documented = _doc_table_sites(root)
+    findings: List[Finding] = []
+    for site, where in sorted(consulted.items()):
+        if site not in registered:
+            path, line = where[0]
+            findings.append(Finding(
+                RULE_ID, path, line,
+                f"chaos site '{site}' consulted here but missing from "
+                f"chaos.plan.SITES — register it first",
+                snippet=f"chaos-site:{site}",
+            ))
+    for site in sorted(registered - set(consulted)):
+        findings.append(Finding(
+            RULE_ID, PLAN_PATH, reg_line,
+            f"chaos site '{site}' registered but consulted nowhere "
+            f"(dead registry row)",
+            snippet=f"dead-site:{site}",
+        ))
+    for site in sorted(registered - documented):
+        findings.append(Finding(
+            RULE_ID, DOC_PATH, 1,
+            f"chaos site '{site}' has no row in the {DOC_PATH} "
+            f"failure-modes table (| Site | Layer | ... |)",
+            snippet=f"undocumented-site:{site}",
+        ))
+    return findings

@@ -1,0 +1,85 @@
+"""KTL003 — os.environ mutation outside the sanctioned entry guard.
+
+Historical bug pinned (PR 6): glibc ``setenv``/``putenv`` may realloc the
+process environ block, racing native ``getenv`` from XLA's persistent
+worker threads — one process hosts every gang attempt, so a steady-state
+restart that rewrites an *unchanged* var can corrupt a concurrent read.
+``utils/envguard.py`` owns the sanctioned pattern: set only vars whose
+value actually changes, before JAX wakes its threads; entrypoints call
+``apply_env``. ``training/entry.py`` keeps its pre-jax LIBTPU flag
+append (read-modify-write of one var before the first trace).
+
+Flags ``os.environ[...] = ...``, ``del os.environ[...]``, and
+``os.environ.{update,setdefault,pop,clear}`` / ``os.putenv`` /
+``os.unsetenv`` everywhere under ``kubedl_tpu/`` except the sanctioned
+files. Pre-JAX writes in fresh subprocess entry points are accepted
+with an inline pragma carrying the justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+RULE_ID = "KTL003"
+
+SANCTIONED_FILES = ("training/entry.py", "utils/envguard.py")
+
+_MUTATORS = {"update", "setdefault", "pop", "clear", "__setitem__",
+             "__delitem__"}
+
+
+def _is_os_environ(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "environ"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "os"
+    ) or (isinstance(node, ast.Name) and node.id == "environ")
+
+
+class _EnvVisitor(ast.NodeVisitor):
+    def __init__(self, ctx) -> None:
+        self.ctx = ctx
+        self.findings: List = []
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.findings.append(self.ctx.finding(
+            RULE_ID, node,
+            f"{what} outside training/entry.py's changed-vars guard: "
+            f"setenv can realloc environ under XLA's native getenv "
+            f"(PR 6 race) — route through the entry guard, or pragma "
+            f"with a fresh-subprocess / pre-jax-init justification",
+        ))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            if isinstance(t, ast.Subscript) and _is_os_environ(t.value):
+                self._flag(node, "os.environ[...] assignment")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            if isinstance(t, ast.Subscript) and _is_os_environ(t.value):
+                self._flag(node, "del os.environ[...]")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if _is_os_environ(f.value) and f.attr in _MUTATORS:
+                self._flag(node, f"os.environ.{f.attr}(...)")
+            elif (
+                isinstance(f.value, ast.Name) and f.value.id == "os"
+                and f.attr in ("putenv", "unsetenv")
+            ):
+                self._flag(node, f"os.{f.attr}(...)")
+        self.generic_visit(node)
+
+
+def check_file(ctx) -> List:
+    if any(ctx.relpath.endswith(s) for s in SANCTIONED_FILES):
+        return []
+    v = _EnvVisitor(ctx)
+    v.visit(ctx.tree)
+    return v.findings

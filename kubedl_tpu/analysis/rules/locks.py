@@ -1,0 +1,145 @@
+"""KTL002 — blocking call lexically inside a lock-held body.
+
+Historical bug pinned: speculative-decode draft proposal ran a full model
+forward under the engine cv (fixed by the PR 11 ``_spec_tick`` refactor:
+snapshot under the lock, propose outside, recheck slot identity on
+re-acquire). The same shape — HTTP requests, ``block_until_ready``,
+device ``np.array(...)`` harvests, ``time.sleep``, subprocess waits —
+inside ``with self._cv:`` / ``with self._lock:`` stalls every other
+thread that needs the lock for the duration of device/network latency.
+
+Lexical scope only: calls inside nested ``def``/``lambda`` are deferred
+work, not executed under the lock. ``cv.wait()`` is exempt (it releases
+the subject lock by design). ``np.array`` under a lock is flagged because
+the device-harvest variant blocks on the device stream; host-side uses
+are accepted via pragma or baseline (each carries a justification).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+RULE_ID = "KTL002"
+
+#: ``with <expr>:`` subjects that look like locks/conditions
+_LOCK_NAME_RE = re.compile(r"(^|_)(lock|cv|cond|mu|mutex)(_|$)|lock$|_cv$")
+
+#: receiver names that mark ``.wait()``/``.communicate()`` as subprocess
+_PROC_NAME_RE = re.compile(r"proc|popen|child|pipe", re.I)
+
+
+def _expr_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _is_lock_subject(node: ast.AST) -> bool:
+    name = _expr_name(node)
+    return bool(name and _LOCK_NAME_RE.search(name))
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        recv = _expr_name(f.value)
+        attr = f.attr
+        if attr == "sleep" and recv == "time":
+            return "time.sleep under a held lock"
+        if attr == "block_until_ready":
+            return "block_until_ready (device sync) under a held lock"
+        if attr == "device_get":
+            return "device_get (device->host copy) under a held lock"
+        if attr == "array" and recv in ("np", "numpy"):
+            return ("np.array harvest under a held lock (blocks on the "
+                    "device stream when the source is a device buffer)")
+        if recv == "requests" and attr in ("get", "post", "put", "request"):
+            return f"requests.{attr} (network) under a held lock"
+        if attr == "urlopen":
+            return "urlopen (network) under a held lock"
+        if recv == "subprocess" and attr in (
+            "run", "call", "check_call", "check_output"
+        ):
+            return f"subprocess.{attr} under a held lock"
+        if attr in ("wait", "communicate") and recv \
+                and _PROC_NAME_RE.search(recv) \
+                and not _LOCK_NAME_RE.search(recv):
+            return f"subprocess {attr}() under a held lock"
+    elif isinstance(f, ast.Name):
+        if f.id == "urlopen":
+            return "urlopen (network) under a held lock"
+        if f.id == "sleep":
+            return "sleep under a held lock"
+    return None
+
+
+class _BodyScanner(ast.NodeVisitor):
+    """Scan a lock-held body; stop at nested function boundaries."""
+
+    def __init__(self, ctx, subject: str) -> None:
+        self.ctx = ctx
+        self.subject = subject
+        self.findings: List = []
+
+    def visit_FunctionDef(self, node) -> None:
+        return  # deferred execution: not under the lock
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node) -> None:
+        return
+
+    def visit_Call(self, node: ast.Call) -> None:
+        reason = _blocking_reason(node)
+        if reason:
+            self.findings.append(self.ctx.finding(
+                RULE_ID, node,
+                f"{reason} (with {self.subject}: opened at an enclosing "
+                f"line) — move the blocking work outside the critical "
+                f"section (_spec_tick pattern: snapshot, work, recheck)",
+            ))
+        self.generic_visit(node)
+
+
+class _WithFinder(ast.NodeVisitor):
+    def __init__(self, ctx) -> None:
+        self.ctx = ctx
+        self.findings: List = []
+        self._in_lock: List[str] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        subjects = [
+            item.context_expr for item in node.items
+            if _is_lock_subject(item.context_expr)
+        ]
+        if not subjects:
+            self.generic_visit(node)
+            return
+        name = _expr_name(subjects[0]) or "lock"
+        scanner = _BodyScanner(self.ctx, f"self.{name}"
+                               if isinstance(subjects[0], ast.Attribute)
+                               else name)
+        for stmt in node.body:
+            scanner.visit(stmt)
+        self.findings.extend(scanner.findings)
+        # nested withs inside the body were already visited by scanner's
+        # generic walk; do not recurse again
+        return
+
+
+def check_file(ctx) -> List:
+    finder = _WithFinder(ctx)
+    finder.visit(ctx.tree)
+    # dedupe: nested lock withs can scan the same call twice
+    seen = set()
+    out = []
+    for f in finder.findings:
+        key = (f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out

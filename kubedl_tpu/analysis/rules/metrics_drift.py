@@ -1,0 +1,128 @@
+"""KTL005 — metrics drift.
+
+Two mechanical failure modes around ``observability/metrics.py``:
+
+1. a metric registered in a family (``self.X = r.counter(...)``) that no
+   production code ever touches — it renders forever at zero, which
+   dashboards read as "healthy" instead of "not wired";
+2. label-set drift: the same metric attribute mutated with different
+   label keysets at different call sites (``.inc(reason=...)`` here,
+   bare ``.inc()`` there) — Prometheus treats those as disjoint series,
+   so sums silently split.
+
+Attribute names shared by multiple families (e.g. ``probe_failures`` on
+both ServingMetrics and RouterMetrics) are exempt from the label check —
+call sites can't be attributed to a family statically.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Set, Tuple
+
+from kubedl_tpu.analysis.engine import Finding
+
+RULE_ID = "KTL005"
+
+METRICS_PATH = "kubedl_tpu/observability/metrics.py"
+
+_REG_METHODS = {"counter", "gauge", "histogram"}
+_MUTATORS = {"inc", "observe", "set"}
+#: kwargs of the mutators that are values, not labels
+_VALUE_KWARGS = {"amount", "value"}
+
+
+def _registered_metrics(ctx) -> List[Tuple[str, str, int]]:
+    """[(attr, prom_name, line)] from self.X = r.counter("name", ...)."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                and t.value.id == "self"):
+            continue
+        v = node.value
+        if not (isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute)
+                and v.func.attr in _REG_METHODS):
+            continue
+        if v.args and isinstance(v.args[0], ast.Constant) \
+                and isinstance(v.args[0].value, str):
+            out.append((t.attr, v.args[0].value, node.lineno))
+    return out
+
+
+def _usage_and_labels(
+    contexts, attrs: Set[str]
+) -> Tuple[Set[str], Dict[str, Dict[frozenset, Tuple[str, int]]]]:
+    """(attrs referenced anywhere outside metrics.py,
+    attr -> {label-keyset -> example (path, line)} across mutator calls)."""
+    used: Set[str] = set()
+    labels: Dict[str, Dict[frozenset, Tuple[str, int]]] = {}
+    for ctx in contexts:
+        if ctx.relpath.endswith("observability/metrics.py"):
+            continue
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute) and node.attr in attrs:
+                used.add(node.attr)
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute) and f.attr in _MUTATORS):
+                continue
+            recv = f.value
+            if not (isinstance(recv, ast.Attribute) and recv.attr in attrs):
+                continue
+            keyset = frozenset(
+                kw.arg for kw in node.keywords
+                if kw.arg and kw.arg not in _VALUE_KWARGS
+            )
+            labels.setdefault(recv.attr, {}).setdefault(
+                keyset, (ctx.relpath, node.lineno)
+            )
+    return used, labels
+
+
+def check_project(root: Path, contexts) -> List[Finding]:
+    metrics_ctx = next(
+        (c for c in contexts if c.relpath.endswith("observability/metrics.py")),
+        None,
+    )
+    if metrics_ctx is None:
+        return []
+    registered = _registered_metrics(metrics_ctx)
+    attr_count: Dict[str, int] = {}
+    for attr, _, _ in registered:
+        attr_count[attr] = attr_count.get(attr, 0) + 1
+    attrs = set(attr_count)
+    used, labels = _usage_and_labels(contexts, attrs)
+    findings: List[Finding] = []
+    seen_unused: Set[str] = set()
+    for attr, prom_name, line in registered:
+        if attr not in used and attr not in seen_unused:
+            seen_unused.add(attr)
+            findings.append(Finding(
+                RULE_ID, METRICS_PATH, line,
+                f"metric {prom_name} (attr .{attr}) registered but never "
+                f"referenced outside metrics.py — renders forever at zero",
+                snippet=f"unused-metric:{attr}",
+            ))
+    for attr, keysets in sorted(labels.items()):
+        if attr_count.get(attr, 0) > 1:
+            continue  # shared attr name across families: not attributable
+        if len(keysets) > 1:
+            desc = "; ".join(
+                f"{{{', '.join(sorted(ks)) or 'no labels'}}} at {p}:{ln}"
+                for ks, (p, ln) in sorted(
+                    keysets.items(), key=lambda kv: sorted(kv[0])
+                )
+            )
+            findings.append(Finding(
+                RULE_ID, METRICS_PATH, 1,
+                f"metric attr .{attr} mutated with inconsistent label "
+                f"keysets: {desc} — series split silently",
+                snippet=f"label-drift:{attr}",
+            ))
+    return findings

@@ -1,0 +1,66 @@
+"""KTL006 — rendered-schema drift (the "forgot to re-render" class).
+
+PRs 3, 9, and 12 each changed API dataclasses; the committed
+``deploy/rendered/schemas/*.json`` artifacts lag unless someone remembers
+``make render-deploy``. This rule regenerates the schemas in memory
+(``kubedl_tpu.api.schema.workload_schemas`` — reflection only, no JAX)
+and requires the committed files to be byte-identical, exactly what
+``deploy/render.py`` would write.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import List
+
+from kubedl_tpu.analysis.engine import Finding
+
+RULE_ID = "KTL006"
+
+SCHEMA_DIR = "deploy/rendered/schemas"
+
+
+def check_project(root: Path, contexts) -> List[Finding]:
+    schema_dir = root / SCHEMA_DIR
+    if not schema_dir.exists():
+        return []  # not a full checkout (fixture runs)
+    try:
+        from kubedl_tpu.api.schema import workload_schemas
+
+        expected = {
+            kind: json.dumps(schema, indent=2) + "\n"
+            for kind, schema in workload_schemas().items()
+        }
+    except Exception as e:  # schema generation itself broke
+        return [Finding(
+            RULE_ID, "kubedl_tpu/api/schema.py", 1,
+            f"workload_schemas() failed: {type(e).__name__}: {e}",
+            snippet="schema-generation-failed",
+        )]
+    findings: List[Finding] = []
+    committed = {p.stem: p for p in sorted(schema_dir.glob("*.json"))}
+    for kind, body in sorted(expected.items()):
+        p = committed.get(kind)
+        if p is None:
+            findings.append(Finding(
+                RULE_ID, f"{SCHEMA_DIR}/{kind}.json", 1,
+                f"schema for kind {kind} not committed — run "
+                f"`make render-deploy`",
+                snippet=f"schema-missing:{kind}",
+            ))
+        elif p.read_text() != body:
+            findings.append(Finding(
+                RULE_ID, f"{SCHEMA_DIR}/{kind}.json", 1,
+                f"committed schema for kind {kind} differs from the API "
+                f"dataclasses — run `make render-deploy`",
+                snippet=f"schema-drift:{kind}",
+            ))
+    for kind in sorted(set(committed) - set(expected)):
+        findings.append(Finding(
+            RULE_ID, f"{SCHEMA_DIR}/{kind}.json", 1,
+            f"committed schema for unknown kind {kind} (removed from the "
+            f"API?) — delete it or re-register the kind",
+            snippet=f"schema-orphan:{kind}",
+        ))
+    return findings

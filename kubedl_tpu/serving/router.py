@@ -1013,8 +1013,11 @@ def serve_router_main(env: Optional[Dict[str, str]] = None) -> int:
     KUBEDL_ROUTER_CONFIG: ``{"port": ..., "replicas": [{"name": ...,
     "host": ..., "port": ...}, ...], <router knobs>}``. SIGTERM drains
     gracefully (distinguishable 503, finish in-flight, then exit)."""
-    if env:
-        os.environ.update({k: v for k, v in env.items() if isinstance(v, str)})
+    from kubedl_tpu.utils.envguard import apply_env
+
+    # changed-vars only: unconditional environ writes race native getenv
+    # from XLA threads on gang restart (utils/envguard.py, rule KTL003)
+    apply_env(env)
     cfg = json.loads(os.environ.get("KUBEDL_ROUTER_CONFIG", "{}"))
     router = ServingRouter(**router_kwargs(cfg))
     router.start()

@@ -2420,8 +2420,11 @@ def engine_kwargs(cfg: Dict, ckpt_dir: str) -> Dict:
 
 def serve_main(env: Optional[Dict[str, str]] = None) -> int:
     """Container entrypoint (ThreadRuntime-compatible)."""
-    if env:
-        os.environ.update({k: v for k, v in env.items() if isinstance(v, str)})
+    from kubedl_tpu.utils.envguard import apply_env
+
+    # changed-vars only: unconditional environ writes race native getenv
+    # from XLA threads on gang restart (utils/envguard.py, rule KTL003)
+    apply_env(env)
     from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested
 
     ensure_cpu_if_requested()

@@ -21,6 +21,8 @@ import sys
 import time
 from typing import Dict, Optional
 
+from kubedl_tpu.utils.envguard import apply_env
+
 #: last run's summary, for in-process harnesses (bench.py) to read back
 LAST_SUMMARY: Optional[dict] = None
 
@@ -71,16 +73,12 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
     spawn_ts = float(os.environ.get("KUBEDL_SPAWN_TS", 0) or 0)
     if spawn_ts:
         phases["spawn_to_proc"] = max(t_start - spawn_ts, 0.0)
-    if env:
-        # set each var only when its value actually changes: glibc
-        # setenv/putenv may realloc the process environ block, racing
-        # native getenv from XLA's persistent worker threads (one
-        # process hosts every gang attempt).  A replacement pod
-        # re-enters with an identical env, so the steady-state restart
-        # path must not touch environ at all.
-        for k, v in env.items():
-            if isinstance(v, str) and os.environ.get(k) != v:
-                os.environ[k] = v
+    # changed-vars-only environ writes: glibc setenv/putenv may realloc
+    # the environ block, racing native getenv from XLA's persistent
+    # worker threads (one process hosts every gang attempt).  A
+    # replacement pod re-enters with an identical env, so the
+    # steady-state restart path must not touch environ at all.
+    apply_env(env)
     # import jax only after env is set (JAX_PLATFORMS etc.)
     from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested
 

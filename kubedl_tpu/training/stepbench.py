@@ -318,11 +318,12 @@ def main(argv=None) -> int:
                     "(stdout always gets the JSON too)")
     args = ap.parse_args(argv)
     # device-count env must land before jax initializes; standalone runs
-    # default to the forced-host-device CPU platform bench.py uses
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # default to the forced-host-device CPU platform bench.py uses.
+    # fresh subprocess, pre-jax-init: no XLA threads exist yet
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # ktl: disable=KTL003
     flags = os.environ.get("XLA_FLAGS", "")
     if "host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
+        os.environ["XLA_FLAGS"] = (  # ktl: disable=KTL003
             f"{flags} --xla_force_host_platform_device_count={args.devices}"
         ).strip()
     out = run_stepbench(devices=args.devices, grad_accum=args.grad_accum,

@@ -1,0 +1,28 @@
+"""Changed-vars-only environ writes (the PR 6 env-race guard).
+
+glibc ``setenv``/``putenv`` may realloc the process environ block, racing
+native ``getenv`` from XLA's persistent worker threads — one process hosts
+every gang attempt, so a replacement pod re-enters an entrypoint with an
+identical env and the steady-state restart path must not touch environ at
+all. :func:`apply_env` writes each var only when its value actually
+changes; every ThreadRuntime entrypoint goes through it (static analysis
+rule KTL003 flags any other post-init ``os.environ`` mutation).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+
+def apply_env(env: Optional[Dict[str, str]]) -> int:
+    """Fold ``env`` into ``os.environ``, writing only changed string
+    values. Returns the number of vars actually written."""
+    if not env:
+        return 0
+    written = 0
+    for k, v in env.items():
+        if isinstance(v, str) and os.environ.get(k) != v:
+            os.environ[k] = v
+            written += 1
+    return written

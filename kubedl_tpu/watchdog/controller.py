@@ -38,6 +38,7 @@ from kubedl_tpu.api import constants
 from kubedl_tpu.core.manager import ControllerManager, EventRecorder
 from kubedl_tpu.core.objects import ContainerStatus, Node, Pod, PodPhase
 from kubedl_tpu.core.store import Conflict, NotFound, ObjectStore
+from kubedl_tpu.elastic.resize import goodput as _goodput
 
 log = logging.getLogger("kubedl_tpu.watchdog")
 
@@ -90,6 +91,14 @@ class _Track:
     rate: float = 0.0
     step_changes: int = 0
     straggler: bool = False
+    #: OUR clock at first observation (goodput wall-clock anchor)
+    first_seen: float = 0.0
+    #: EWMA tokens/sec over observed step advances (throughput gauge)
+    token_rate: float = 0.0
+    #: seconds judged spent actually stepping: each observed advance
+    #: contributes min(dt, prior step-time EWMA), so stalls, restarts and
+    #: recompiles count as overhead, not training (goodput numerator)
+    productive: float = 0.0
 
 
 def _blend(ewma: float, sample: float, alpha: float = 0.3) -> float:
@@ -115,6 +124,9 @@ class WatchdogController:
         self._tracks: Dict[str, _Track] = {}  # "ns/pod" -> _Track
         #: per-reason fire counts, for tests/drives without a registry
         self.fired: Dict[str, int] = {"hang": 0, "silent_death": 0}
+        #: jobs whose first-step delay was already observed (once per job,
+        #: same contract as the launch-delay annotations)
+        self._first_step_seen: set = set()
 
     # ------------------------------------------------------------ wiring
 
@@ -159,7 +171,7 @@ class WatchdogController:
                     uid=pod.metadata.uid, node=node.metadata.name,
                     step=beacon.get("step", 0.0), ts=beacon.get("ts", 0.0),
                     tokens=beacon.get("tokens", 0.0),
-                    step_seen=now, ts_seen=now,
+                    step_seen=now, ts_seen=now, first_seen=now,
                 )
                 continue
             tr.node = node.metadata.name
@@ -170,18 +182,93 @@ class WatchdogController:
             step = beacon.get("step", 0.0)
             if step != tr.step:
                 dt = max(now - tr.step_seen, 1e-6)
+                # the PRIOR ewma is the best "pure step time" estimate for
+                # this advance: a stall/restart shows up as dt >> ewma and
+                # only the ewma share counts as productive
+                tr.productive += min(dt, tr.step_ewma) if tr.step_ewma > 0 else dt
                 tr.step_ewma = _blend(tr.step_ewma, dt)
                 # any VALUE change counts as progress — a restarted
                 # worker's counter legitimately jumps backward to its
                 # restored checkpoint step
                 advanced = max(step - tr.step, 1.0)
                 tr.rate = _blend(tr.rate, advanced / dt)
+                tokens = beacon.get("tokens", tr.tokens)
+                if tokens > tr.tokens:
+                    tr.token_rate = _blend(tr.token_rate, (tokens - tr.tokens) / dt)
                 tr.step, tr.step_seen = step, now
                 tr.step_changes += 1
+                if tr.step_changes == 1:
+                    self._observe_first_step(pod, now)
             tr.tokens = beacon.get("tokens", tr.tokens)
 
     def _drop(self, pod_key: str) -> None:
         self._tracks.pop(pod_key, None)
+
+    # ------------------------------------------- north-star metrics wiring
+
+    def _observe_first_step(self, pod: Pod, now: float) -> None:
+        """Job created -> first step advance seen on any replica
+        (kubedl_tpu_jobs_first_step_delay_seconds, BASELINE.md)."""
+        if self.metrics is None:
+            return
+        kind = pod.metadata.labels.get(constants.LABEL_JOB_KIND, "")
+        jname = pod.metadata.labels.get(constants.LABEL_JOB_NAME, "")
+        if not kind or not jname:
+            return
+        key = (pod.metadata.namespace, kind, jname)
+        if key in self._first_step_seen:
+            return
+        job = self.store.try_get(kind, jname, pod.metadata.namespace)
+        if job is None:
+            return
+        self._first_step_seen.add(key)
+        delay = max(now - job.metadata.creation_timestamp, 0.0)
+        self.metrics.first_step_delay.observe(delay, kind=kind)
+
+    @staticmethod
+    def _job_chips(job, fallback: int) -> int:
+        """Total chips in the job's gang; tracked-replica count when no
+        slice topology is pinned (CPU jobs: one host ~ one device)."""
+        chips = 0
+        try:
+            for rs in job.spec.replica_specs.values():
+                if rs.topology is not None:
+                    chips += rs.topology.chips
+        except AttributeError:
+            return fallback
+        return chips or fallback
+
+    def _publish_job_metrics(self) -> None:
+        """Fold beacon-derived throughput into the north-star gauges:
+        per-chip token rate and step-time-weighted goodput (the
+        `1 - overhead of checkpoints/restarts/resizes` headline)."""
+        if self.metrics is None:
+            return
+        now = self.clock()
+        by_job: Dict[Tuple[str, str, str], list] = {}
+        for pod_key, tr in self._tracks.items():
+            ns, _, pname = pod_key.partition("/")
+            pod = self.store.try_get("Pod", pname, ns)
+            if not isinstance(pod, Pod):
+                continue
+            kind = pod.metadata.labels.get(constants.LABEL_JOB_KIND, "")
+            jname = pod.metadata.labels.get(constants.LABEL_JOB_NAME, "")
+            if kind and jname:
+                by_job.setdefault((ns, kind, jname), []).append(tr)
+        for (ns, kind, jname), trs in by_job.items():
+            job = self.store.try_get(kind, jname, ns)
+            if job is None:
+                continue
+            tok_rate = sum(tr.token_rate for tr in trs)
+            if tok_rate > 0:
+                chips = self._job_chips(job, fallback=len(trs))
+                self.metrics.tokens_per_sec_per_chip.set(
+                    tok_rate / max(chips, 1), kind=kind
+                )
+            wall = sum(max(now - tr.first_seen, 0.0) for tr in trs)
+            stepped = sum(tr.productive for tr in trs)
+            if wall > 0 and stepped > 0:
+                self.metrics.goodput.set(_goodput(stepped, wall), kind=kind)
 
     # -------------------------------------------------------- evaluation
 
@@ -226,6 +313,7 @@ class WatchdogController:
                            "EWMA step time; beacons still fresh)")
                 self._drop(pod_key)
         self._flag_stragglers()
+        self._publish_job_metrics()
 
     def _flag_stragglers(self) -> None:
         by_job: Dict[Tuple[str, str], list] = {}

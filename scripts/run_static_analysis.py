@@ -1,0 +1,16 @@
+#!/usr/bin/env python3
+"""CLI wrapper for the project static analyzer (same as
+``python -m kubedl_tpu.analysis``; rule catalog: docs/static-analysis.md).
+
+    python scripts/run_static_analysis.py [--no-baseline] [--write-baseline]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubedl_tpu.analysis.engine import run  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(run())

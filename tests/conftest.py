@@ -15,8 +15,27 @@ os.environ.setdefault("KUBEDL_CI", "true")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Lock-order witness (docs/static-analysis.md): KUBEDL_LOCKWITNESS=1 arms
+# witness-instrumented Lock/RLock/Condition BEFORE any other kubedl_tpu
+# import, so every lock the subsystems create at module/instance init is
+# classified by creation site. Disarmed (the default) this is a no-op and
+# threading primitives stay untouched.
+from kubedl_tpu.analysis import lockwitness  # noqa: E402
+
+lockwitness.install()
+
 # Neutralize force-registered accelerator plugins (sitecustomize may have
 # overridden jax_platforms already) so JAX_PLATFORMS=cpu actually holds.
 from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested  # noqa: E402
 
 ensure_cpu_if_requested()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Witnessed runs fail on any lock-order cycle observed across the
+    whole suite (pytest reads session.exitstatus back after this hook)."""
+    cycles = lockwitness.check()
+    if cycles:
+        w = lockwitness.active()
+        sys.stderr.write("\n" + w.report() + "\n")
+        session.exitstatus = 3

@@ -1,0 +1,25 @@
+"""Seed regression fixture (PR 8 mirror-borrow bug, FIXED form): the
+``_upload_mirror`` pattern — ``jnp.asarray(arr) + 0`` — materializes an
+XLA-owned copy so the donated cache can never alias the host mirror.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _decode_step(cache, block_table):
+    return cache
+
+
+class Decoder:
+    def __init__(self):
+        self._bt_host = np.zeros((4, 4), dtype=np.int32)
+        self._decode = jax.jit(_decode_step, donate_argnums=(0,))
+
+    def _upload_mirror(self, arr):
+        return jnp.asarray(arr) + 0
+
+    def step(self, cache):
+        bt = self._upload_mirror(self._bt_host)
+        return self._decode(cache, bt)

@@ -1,0 +1,25 @@
+"""Seed regression fixture (the PR 6 restore bug, BAD form): checkpoint
+leaves are zero-copy borrowed from the aligned host read buffer
+(np.frombuffer -> jnp.asarray) and then DONATED on the first train step —
+donation frees XLA to recycle the mmap'd heap under the live weights.
+Never imported; parsed by tests/test_analysis.py through analyze_file.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _train_step(params, batch):
+    return params
+
+
+class Restorer:
+    def __init__(self):
+        self._step = jax.jit(_train_step, donate_argnums=(0,))
+
+    def restore_and_step(self, path, batch):
+        raw = open(path, "rb").read()
+        leaves = np.frombuffer(raw, dtype=np.float32)
+        params = jnp.asarray(leaves)
+        return self._step(params, batch)

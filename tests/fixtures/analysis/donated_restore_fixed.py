@@ -1,0 +1,23 @@
+"""Seed regression fixture (PR 6 restore bug, FIXED form): the defensive
+``+ 0`` forces an XLA-owned buffer before donation, so recycling the
+donated input never touches the checkpoint read buffer.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _train_step(params, batch):
+    return params
+
+
+class Restorer:
+    def __init__(self):
+        self._step = jax.jit(_train_step, donate_argnums=(0,))
+
+    def restore_and_step(self, path, batch):
+        raw = open(path, "rb").read()
+        leaves = np.frombuffer(raw, dtype=np.float32)
+        params = jnp.asarray(leaves) + 0
+        return self._step(params, batch)

@@ -1,0 +1,14 @@
+"""Seed regression fixture (the PR 6 env race, BAD form): a worker
+entrypoint unconditionally rewrites ``os.environ`` on every gang-restart
+re-entry. glibc setenv may realloc the environ block, racing native
+getenv from XLA's persistent worker threads in the same process.
+"""
+
+import os
+
+
+def worker_main(env=None):
+    if env:
+        for k, v in env.items():
+            os.environ[k] = v
+    return 0

@@ -1,0 +1,12 @@
+"""Seed regression fixture (PR 6 env race, FIXED form): the entrypoint
+routes through the sanctioned changed-vars guard (utils/envguard.py) —
+steady-state restarts re-enter with an identical env and never touch
+environ at all.
+"""
+
+from kubedl_tpu.utils.envguard import apply_env
+
+
+def worker_main(env=None):
+    apply_env(env)
+    return 0

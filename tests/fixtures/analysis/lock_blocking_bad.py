@@ -1,0 +1,23 @@
+"""Seed regression fixture (the PR 11 stats-harvest shape, BAD form):
+blocking work — a device->host ``np.array`` harvest and a sleep — runs
+lexically inside ``with self._cv:``, stalling every producer/consumer
+parked on that condition for the duration.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+
+class Engine:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._last_batch = None
+
+    def tick(self):
+        with self._cv:
+            harvested = np.array(self._last_batch)
+            time.sleep(0.01)
+            self._cv.notify_all()
+        return harvested

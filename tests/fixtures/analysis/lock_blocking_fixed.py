@@ -1,0 +1,26 @@
+"""Seed regression fixture (PR 11 stats-harvest shape, FIXED form): the
+``_spec_tick`` pattern — snapshot references under the lock, do the
+blocking device harvest outside it, re-acquire to publish.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+
+class Engine:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._last_batch = None
+        self._published = None
+
+    def tick(self):
+        with self._cv:
+            snapshot = self._last_batch
+        harvested = np.array(snapshot)
+        time.sleep(0.01)
+        with self._cv:
+            self._published = harvested
+            self._cv.notify_all()
+        return harvested
