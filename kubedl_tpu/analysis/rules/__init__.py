@@ -8,6 +8,7 @@ from kubedl_tpu.analysis.rules import (
     locks,
     metrics_drift,
     schema_drift,
+    span_names,
 )
 
 #: engine iterates this; order = report order
@@ -18,6 +19,7 @@ ALL_RULES = [
     chaos_sites,     # KTL004
     metrics_drift,   # KTL005
     schema_drift,    # KTL006
+    span_names,      # KTL007
 ]
 
 RULE_IDS = {m.RULE_ID: m for m in ALL_RULES}

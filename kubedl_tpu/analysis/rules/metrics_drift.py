@@ -30,8 +30,9 @@ METRICS_PATH = "kubedl_tpu/observability/metrics.py"
 
 _REG_METHODS = {"counter", "gauge", "histogram"}
 _MUTATORS = {"inc", "observe", "set"}
-#: kwargs of the mutators that are values, not labels
-_VALUE_KWARGS = {"amount", "value"}
+#: kwargs of the mutators that are values, not labels (exemplar is the
+#: optional trace-id payload on Histogram.observe, never a label)
+_VALUE_KWARGS = {"amount", "value", "exemplar"}
 
 
 def _registered_metrics(ctx) -> List[Tuple[str, str, int]]:

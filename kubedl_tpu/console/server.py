@@ -35,6 +35,7 @@ from kubedl_tpu.console.auth import SESSION_COOKIE, SessionAuth
 from kubedl_tpu.console.backends import ApiServerReadBackend, ObjectReadBackend
 from kubedl_tpu.core.objects import ConfigMap, new_uid
 from kubedl_tpu.core.store import AlreadyExists, NotFound
+from kubedl_tpu.observability.tracing import TRACER, trace_for_job
 from kubedl_tpu.operator import ValidationError
 from kubedl_tpu.persist.backends import Query
 from kubedl_tpu.persist.dmo import row_to_dict, rows_to_dicts
@@ -143,6 +144,10 @@ class ConsoleServer:
         r("GET", "/api/v1/tensorboard/status/{ns}/{name}", ConsoleServer._h_tb_status)
         r("POST", "/api/v1/tensorboard/apply/{ns}/{name}", ConsoleServer._h_tb_apply)
         r("DELETE", "/api/v1/tensorboard/{ns}/{name}", ConsoleServer._h_tb_delete)
+        # distributed tracing (docs/observability.md): per-job control-
+        # plane trace + raw trace lookup from the operator process
+        r("GET", "/api/v1/trace/job/{ns}/{name}", ConsoleServer._h_trace_job)
+        r("GET", "/api/v1/trace/{trace_id}", ConsoleServer._h_trace)
         # cluster overview (reference: routers/api/data.go:24-29)
         r("GET", "/api/v1/data/overview", ConsoleServer._h_overview)
         r("GET", "/api/v1/data/charts", ConsoleServer._h_charts)
@@ -465,6 +470,35 @@ class ConsoleServer:
         return {}
 
     # ---- handlers: overview & sources -----------------------------------
+
+    def _h_trace_job(self, req: Request):
+        """A job's control-plane trace (submit → plan → gang bind → pod
+        launch → first beacon): the trace id derives deterministically
+        from the job uid, so no per-span bookkeeping is needed here."""
+        ns, name = req.params["ns"], req.params["name"]
+        job = None
+        for kind in self.operator.engines:
+            job = self.operator.store.try_get(kind, name, ns)
+            if job is not None:
+                break
+        if job is None:
+            raise ApiError(404, "job not found")
+        ctx = trace_for_job(job.metadata.uid or f"{ns}/{name}")
+        return {
+            "trace_id": ctx.trace_id,
+            "enabled": TRACER.enabled,
+            "spans": TRACER.span_tree(ctx.trace_id),
+        }
+
+    def _h_trace(self, req: Request):
+        """Raw trace lookup by id — spans retained in THIS (operator)
+        process; serving-side spans live on the replicas' /v1/trace."""
+        tid = req.params["trace_id"]
+        return {
+            "trace_id": tid,
+            "enabled": TRACER.enabled,
+            "spans": TRACER.span_tree(tid),
+        }
 
     def _h_overview(self, req: Request):
         """Cluster overview (reference: api/data.go:24-29 — node/resource

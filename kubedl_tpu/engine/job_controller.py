@@ -34,7 +34,7 @@ from typing import Dict, List, Optional, Tuple
 
 from kubedl_tpu.api import constants
 from kubedl_tpu.observability.tensorboard import TensorBoardReconciler
-from kubedl_tpu.observability.tracing import TRACER
+from kubedl_tpu.observability.tracing import TRACER, trace_for_job
 from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadController
 from kubedl_tpu.api.types import (
     CleanPodPolicy,
@@ -111,6 +111,9 @@ class JobEngine:
         #: hot-looping the workqueue forever (docs/robustness.md)
         self.quarantine_budget = 5
         self._reconcile_failures: Dict[str, int] = {}
+        #: per-job-uid milestone names already traced (job.submit/plan/
+        #: gang_bind/pod_launch fire once per job, not once per reconcile)
+        self._job_trace_marks: Dict[str, set] = {}
         # per-job TensorBoard lifecycle (reference: tfjob_controller.go:171-177
         # calls ReconcileTensorBoard each pass; generic here — any kind may
         # carry the annotation)
@@ -179,6 +182,35 @@ class JobEngine:
         self._reconcile_failures.pop(job_key(job), None)
         return out
 
+    def _trace_job_milestone(self, job: JobObject, name: str,
+                             end_ts: Optional[float] = None,
+                             **attrs) -> None:
+        """Control-plane milestone span, once per job uid: anchored at
+        the job's creation wall-clock and recorded under the DETERMINISTIC
+        per-job trace (``trace_for_job``), so spans from different
+        processes — engine, watchdog, console — merge into one timeline
+        without any header plumbing. Each span runs creation → milestone,
+        so a trace viewer shows the time-to-X ladder directly."""
+        if not TRACER.enabled:
+            return
+        uid = job.metadata.uid or job_key(job)
+        seen = self._job_trace_marks.setdefault(uid, set())
+        if name in seen:
+            return
+        seen.add(name)
+        ctx = trace_for_job(uid)
+        created = job.metadata.creation_timestamp
+        end = time.time() if end_ts is None else end_ts
+        # job.submit takes the deterministic ROOT span id (self-parented;
+        # build_span_tree treats self-parents as roots), everything else
+        # parents under it
+        TRACER.record(
+            name, duration=max(end - created, 0.0), trace=ctx,
+            span_id=ctx.span_id if name == "job.submit" else "",
+            wall_ts=created, kind=self.controller.KIND,
+            job=job_key(job), **attrs,
+        )
+
     def _quarantine(self, job: JobObject, exc: BaseException, failures: int) -> None:
         """Park a poison-pill job: tear down its pods, free its slices, and
         stamp the Quarantined condition so the hot loop ends while the
@@ -234,6 +266,7 @@ class JobEngine:
             )
             self.metrics.created.inc(kind=self.controller.KIND)
             self.recorder.event(job, "Normal", "JobCreated", "job accepted")
+            self._trace_job_milestone(job, "job.submit")
 
         pods = self.get_pods_for_job(job)
         services = self.get_services_for_job(job)
@@ -328,6 +361,9 @@ class JobEngine:
             )
             self.metrics.planner_plan_ms.observe(new_plan.plan_ms)
             self.recorder.event(job, "Normal", "Planned", new_plan.summary())
+            self._trace_job_milestone(
+                job, "job.plan", plan_ms=round(new_plan.plan_ms, 3)
+            )
 
         # --- gang admission (atomic slice acquisition) --------------------
         if self.gang is not None and self.features.enabled(GANG_SCHEDULING):
@@ -400,6 +436,10 @@ class JobEngine:
                 # mapper (operator._engine_mapper); this slow poll is only
                 # a safety net against missed events
                 return 5.0
+            self._trace_job_milestone(
+                job, "job.gang_bind",
+                slices=gang.num_slices, slice_type=gang.slice_type or "",
+            )
             # Only slice-pinned replica groups get slice placements;
             # topology-less groups (e.g. evaluators) run in the CPU pool.
             for rtype, spec in job.spec.replica_specs.items():
@@ -1039,6 +1079,7 @@ class JobEngine:
                 max(first - created, 0.0), kind=self.controller.KIND
             )
             ann["kubedl-tpu.io/first-pod-launched"] = "true"
+            self._trace_job_milestone(job, "job.pod_launch", end_ts=first)
         total = sum(rs.replicas for rs in job.spec.replica_specs.values())
         if (
             len(running) >= total

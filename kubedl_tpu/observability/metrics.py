@@ -11,6 +11,7 @@ exposed on :8443/metrics (monitor.go:27-36). Same metric family names here
 from __future__ import annotations
 
 import threading
+import time
 from bisect import bisect_left
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -122,8 +123,15 @@ class Histogram:
         self._counts: Dict[LabelKV, List[int]] = {}
         self._sum: Dict[LabelKV, float] = {}
         self._total: Dict[LabelKV, int] = {}
+        #: label-set -> (le-or-"+Inf", trace_id, value, wall ts) — the LAST
+        #: exemplar observed, attached to the bucket its value fell into
+        #: (OpenMetrics-style: a burning latency histogram links straight
+        #: to an offending trace retrievable via /v1/trace)
+        self._exemplars: Dict[LabelKV, Tuple[str, str, float, float]] = {}
 
-    def observe(self, value: float, **labels: str) -> None:
+    def observe(
+        self, value: float, exemplar: Optional[str] = None, **labels: str
+    ) -> None:
         kv = _labels(labels)
         with self._lock:
             counts = self._counts.setdefault(kv, [0] * len(self.buckets))
@@ -132,6 +140,9 @@ class Histogram:
                 counts[i] += 1
             self._sum[kv] = self._sum.get(kv, 0.0) + value
             self._total[kv] = self._total.get(kv, 0) + 1
+            if exemplar:
+                le = repr(self.buckets[i]) if i < len(self.buckets) else "+Inf"
+                self._exemplars[kv] = (le, str(exemplar), value, time.time())
 
     def summary(self, **labels: str) -> Tuple[int, float]:
         kv = _labels(labels)
@@ -157,17 +168,25 @@ class Histogram:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:
             for kv, counts in sorted(self._counts.items()):
+                ex = self._exemplars.get(kv)
                 cum = 0
                 for b, c in zip(self.buckets, counts):
                     cum += c
                     lbl = dict(kv)
                     lbl["le"] = repr(b)
-                    out.append(f"{self.name}_bucket{_fmt_labels(_labels(lbl))} {cum}")
+                    line = f"{self.name}_bucket{_fmt_labels(_labels(lbl))} {cum}"
+                    if ex is not None and ex[0] == repr(b):
+                        line += (f' # {{trace_id="{ex[1]}"}} {ex[2]} '
+                                 f"{ex[3]:.3f}")
+                    out.append(line)
                 lbl = dict(kv)
                 lbl["le"] = "+Inf"
-                out.append(
+                line = (
                     f"{self.name}_bucket{_fmt_labels(_labels(lbl))} {self._total[kv]}"
                 )
+                if ex is not None and ex[0] == "+Inf":
+                    line += f' # {{trace_id="{ex[1]}"}} {ex[2]} {ex[3]:.3f}'
+                out.append(line)
                 out.append(f"{self.name}_sum{_fmt_labels(kv)} {self._sum[kv]}")
                 out.append(f"{self.name}_count{_fmt_labels(kv)} {self._total[kv]}")
         return out
@@ -604,6 +623,40 @@ class RouterMetrics:
             "kubedl_tpu_router_disagg_fallbacks",
             "Disagg-eligible requests that fell back to role-blind "
             "colocated dispatch (a leg failed or a pool was empty)",
+        )
+
+
+class SLOMetrics:
+    """The SLO tracker family (kubedl_tpu/observability/slo.py): rolling
+    good/bad request counts, multi-window error-budget burn rates (SRE
+    burn-rate alerting: page when BOTH the short and long window burn
+    above threshold), and the request-latency histogram whose exemplars
+    carry the last trace id so a burning SLO links directly to an
+    offending trace via /v1/trace."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry or MetricsRegistry()
+        r = self.registry
+        self.slo_requests = r.counter(
+            "kubedl_tpu_slo_requests",
+            "Requests classified against the SLO (result=good|bad: bad is "
+            "a non-200 outcome OR latency above the objective)",
+        )
+        self.slo_burn_rate = r.gauge(
+            "kubedl_tpu_slo_error_budget_burn_rate",
+            "Error-budget burn rate per rolling window (1.0 = burning "
+            "exactly the budget; 14.4 over 5m+1h pages), by window",
+        )
+        self.slo_burning = r.gauge(
+            "kubedl_tpu_slo_burning",
+            "1 when BOTH windows of a burn-rate alert pair exceed their "
+            "threshold (severity=page|ticket), else 0",
+        )
+        self.slo_latency_ms = r.histogram(
+            "kubedl_tpu_slo_latency_ms",
+            "End-to-end request latency classified against the SLO, ms; "
+            "buckets carry last-trace-id exemplars",
+            buckets=_TTFT_MS_BUCKETS,
         )
 
 

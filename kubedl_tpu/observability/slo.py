@@ -1,0 +1,220 @@
+"""Rolling-window SLO tracking with multi-window burn-rate alerting.
+
+Implements the SRE-workbook burn-rate pattern over the router's request
+outcomes: a request is GOOD iff it succeeded (HTTP 200) AND finished
+under the latency objective; the error-budget burn rate over a window is
+
+    burn = bad_fraction(window) / (1 - objective)
+
+so 1.0 means the service spends its budget exactly at the sustainable
+rate. An alert pair fires only when BOTH its short and its long window
+burn above the threshold — the short window gives fast detection, the
+long window suppresses blips (the classic pairs: 5m+1h @ 14.4x pages,
+30m+6h @ 6x tickets).
+
+Outcomes aggregate into fixed-width time buckets (not per-event records):
+the hot path is one increment, and a window sum scans at most
+horizon/bucket_s buckets regardless of request rate. Everything is
+clock-injectable (tests drive a fake clock through a replica outage and
+watch ``kubedl_tpu_slo_*`` flip) and feeds the
+:class:`kubedl_tpu.observability.metrics.SLOMetrics` family; the latency
+histogram carries last-trace-id exemplars so a burning SLO links
+directly to an offending trace retrievable via ``/v1/trace``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from kubedl_tpu.observability.metrics import SLOMetrics
+
+
+@dataclass(frozen=True)
+class BurnAlert:
+    """One multi-window alert pair (SRE workbook table 5-2 defaults)."""
+
+    severity: str  # "page" | "ticket"
+    short_s: float
+    long_s: float
+    threshold: float  # burn rate both windows must exceed
+
+
+#: 99.9% availability defaults: page on 14.4x over 5m AND 1h, ticket on
+#: 6x over 30m AND 6h.
+DEFAULT_ALERTS = (
+    BurnAlert("page", 300.0, 3600.0, 14.4),
+    BurnAlert("ticket", 1800.0, 21600.0, 6.0),
+)
+
+
+def _window_label(seconds: float) -> str:
+    if seconds % 3600 == 0:
+        return f"{int(seconds // 3600)}h"
+    if seconds % 60 == 0:
+        return f"{int(seconds // 60)}m"
+    return f"{int(seconds)}s"
+
+
+class SLOTracker:
+    """Bucketed (ts, total, bad) ring + burn-rate math.
+
+    ``observe()`` is called once per finished request on the router; it
+    classifies the outcome, updates the metric family, and recomputes the
+    burn-rate gauges. ``refresh()`` recomputes without a new event (time
+    passing alone can clear an alert).
+    """
+
+    def __init__(
+        self,
+        objective: float = 0.999,
+        latency_objective_ms: Optional[float] = 30_000.0,
+        alerts: Tuple[BurnAlert, ...] = DEFAULT_ALERTS,
+        bucket_s: float = 5.0,
+        clock=time.time,
+        metrics: Optional[SLOMetrics] = None,
+    ) -> None:
+        if not 0.0 < objective < 1.0:
+            raise ValueError(f"objective must be in (0,1), got {objective}")
+        self.objective = objective
+        self.latency_objective_ms = latency_objective_ms
+        self.alerts = tuple(alerts)
+        self.bucket_s = float(bucket_s)
+        self.clock = clock
+        self.metrics = metrics or SLOMetrics()
+        self._lock = threading.Lock()
+        self._horizon_s = max(a.long_s for a in self.alerts)
+        #: [bucket_start, total, bad], append-only in time order
+        self._buckets: deque = deque()
+        self.last_bad_trace_id = ""
+
+    # ---- feed -------------------------------------------------------------
+
+    def observe(self, ok: bool, latency_ms: float, trace_id: str = "") -> bool:
+        """Classify one finished request. Returns its goodness."""
+        good = bool(ok) and (
+            self.latency_objective_ms is None
+            or latency_ms <= self.latency_objective_ms
+        )
+        now = self.clock()
+        start = now - (now % self.bucket_s)
+        m = self.metrics
+        with self._lock:
+            b = self._buckets
+            if b and b[-1][0] >= start:  # >= tolerates clock jitter
+                b[-1][1] += 1
+                b[-1][2] += not good
+            else:
+                b.append([start, 1, int(not good)])
+            self._prune(now)
+            if not good and trace_id:
+                self.last_bad_trace_id = trace_id
+        m.slo_requests.inc(result="good" if good else "bad")
+        m.slo_latency_ms.observe(latency_ms, exemplar=trace_id or None)
+        self.refresh(now)
+        return good
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self._horizon_s - self.bucket_s
+        b = self._buckets
+        while b and b[0][0] < cutoff:
+            b.popleft()
+
+    # ---- math -------------------------------------------------------------
+
+    def _window_counts(self, window_s: float, now: float) -> Tuple[int, int]:
+        cutoff = now - window_s
+        total = bad = 0
+        for start, t, bd in reversed(self._buckets):
+            if start + self.bucket_s <= cutoff:
+                break
+            total += t
+            bad += bd
+        return total, bad
+
+    def bad_fraction(self, window_s: float, now: Optional[float] = None) -> float:
+        now = self.clock() if now is None else now
+        with self._lock:
+            total, bad = self._window_counts(window_s, now)
+        return bad / total if total else 0.0
+
+    def burn_rate(self, window_s: float, now: Optional[float] = None) -> float:
+        """Error-budget burn over a window (0 when the window is empty)."""
+        return self.bad_fraction(window_s, now) / (1.0 - self.objective)
+
+    def burning(self, alert: BurnAlert, now: Optional[float] = None) -> bool:
+        now = self.clock() if now is None else now
+        return (
+            self.burn_rate(alert.short_s, now) >= alert.threshold
+            and self.burn_rate(alert.long_s, now) >= alert.threshold
+        )
+
+    # ---- export -----------------------------------------------------------
+
+    def _burn_rates(self, now: float) -> Dict[float, float]:
+        """window seconds -> burn rate, each window computed once."""
+        out: Dict[float, float] = {}
+        for a in self.alerts:
+            for w in (a.short_s, a.long_s):
+                if w not in out:
+                    out[w] = self.burn_rate(w, now)
+        return out
+
+    def refresh(self, now: Optional[float] = None) -> None:
+        """Recompute the burn-rate + burning gauges from current state."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            self._prune(now)
+        m = self.metrics
+        rates = self._burn_rates(now)
+        for w, rate in rates.items():
+            m.slo_burn_rate.set(round(rate, 4), window=_window_label(w))
+        for a in self.alerts:
+            hot = (rates[a.short_s] >= a.threshold
+                   and rates[a.long_s] >= a.threshold)
+            m.slo_burning.set(1.0 if hot else 0.0, severity=a.severity)
+
+    def snapshot(self) -> dict:
+        """Structured view for /v1/stats dashboards."""
+        now = self.clock()
+        with self._lock:
+            self._prune(now)
+            total = sum(b[1] for b in self._buckets)
+            bad = sum(b[2] for b in self._buckets)
+            last_bad = self.last_bad_trace_id
+        rates = self._burn_rates(now)
+        out: dict = {
+            "objective": self.objective,
+            "latency_objective_ms": self.latency_objective_ms,
+            "requests": total,
+            "bad": bad,
+            "last_bad_trace_id": last_bad,
+            "burn_rates": {
+                _window_label(w): round(r, 4) for w, r in rates.items()
+            },
+            "burning": {
+                a.severity: (rates[a.short_s] >= a.threshold
+                             and rates[a.long_s] >= a.threshold)
+                for a in self.alerts
+            },
+        }
+        return out
+
+
+def alerts_from_config(cfg: Optional[List[dict]]) -> Tuple[BurnAlert, ...]:
+    """Build alert pairs from router-config dicts
+    (``[{"severity","short_s","long_s","threshold"}, ...]``)."""
+    if not cfg:
+        return DEFAULT_ALERTS
+    return tuple(
+        BurnAlert(
+            severity=str(c.get("severity", "page")),
+            short_s=float(c["short_s"]),
+            long_s=float(c["long_s"]),
+            threshold=float(c["threshold"]),
+        )
+        for c in cfg
+    )

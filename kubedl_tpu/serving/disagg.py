@@ -62,6 +62,10 @@ class KVHandoff:
     request_id: str = ""
     cache_prefix: bool = False
     ttft_ms: Optional[float] = None
+    #: X-Trace-Context header string of the originating trace (parent =
+    #: the prefill request span) — lets an adopter with no HTTP header
+    #: of its own still attach its spans to the caller's trace
+    trace: str = ""
 
     @property
     def nbytes(self) -> int:
@@ -82,6 +86,7 @@ class KVHandoff:
             "request_id": self.request_id,
             "cache_prefix": bool(self.cache_prefix),
             "ttft_ms": self.ttft_ms,
+            "trace": self.trace,
             "dtype": str(self.k.dtype),
             "shape": list(self.k.shape),
         }).encode()
@@ -121,6 +126,7 @@ class KVHandoff:
             request_id=header.get("request_id", ""),
             cache_prefix=bool(header.get("cache_prefix", False)),
             ttft_ms=header.get("ttft_ms"),
+            trace=header.get("trace", ""),
         )
 
 
@@ -343,12 +349,15 @@ class DisaggCoordinator:
 
     def generate(self, prompt_ids, max_tokens: int = 16,
                  temperature: float = 0.0, timeout_s: float = 600.0,
-                 cache_prefix: bool = False, request_id: str = "") -> Dict:
+                 cache_prefix: bool = False, request_id: str = "",
+                 trace=None) -> Dict:
         h = self.prefill.prefill_handoff(
             prompt_ids, max_tokens=max_tokens, temperature=temperature,
             timeout_s=timeout_s, cache_prefix=cache_prefix,
-            request_id=request_id,
+            request_id=request_id, trace=trace,
         )
         if self.serialize:
             h = KVHandoff.from_bytes(h.to_bytes())
+        # no explicit trace here: the handoff's embedded header keeps the
+        # adopt leg on the same trace (server._arm_trace parses it)
         return self.decode.adopt_handoff(h, timeout_s=timeout_s)

@@ -67,13 +67,23 @@ import queue
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from kubedl_tpu import chaos
-from kubedl_tpu.observability.metrics import RouterMetrics
+from kubedl_tpu.observability.metrics import RouterMetrics, SLOMetrics
+from kubedl_tpu.observability.slo import SLOTracker, alerts_from_config
+from kubedl_tpu.observability.tracing import (
+    TRACE_HEADER,
+    TRACER,
+    TraceContext,
+    build_span_tree,
+    parse_trace_header,
+    span_to_dict,
+)
 from kubedl_tpu.serving import router_policy as policy
 from kubedl_tpu.serving.disagg import QoSShed, qos_from_config
 
@@ -176,6 +186,7 @@ class ServingRouter:
         qos: Optional[Dict] = None,
         disagg_enabled: bool = True,
         qos_timeout_s: float = 30.0,
+        slo: Optional[Dict] = None,
         metrics: Optional[RouterMetrics] = None,
         clock=time.monotonic,
     ) -> None:
@@ -201,6 +212,18 @@ class ServingRouter:
         )
         self.metrics = metrics or RouterMetrics()
         self.clock = clock
+        #: rolling SLO view over every handle_generate outcome, exported
+        #: as kubedl_tpu_slo_* in the same registry /metrics renders
+        slo_cfg = slo or {}
+        self.slo = SLOTracker(
+            objective=float(slo_cfg.get("objective", 0.999)),
+            latency_objective_ms=slo_cfg.get(
+                "latency_objective_ms", self.default_deadline_ms
+            ),
+            alerts=alerts_from_config(slo_cfg.get("alerts")),
+            clock=clock,
+            metrics=SLOMetrics(self.metrics.registry),
+        )
         self.retry_budget = policy.RetryBudget(ratio=retry_budget_ratio)
         self.latency = policy.LatencyTracker(default_ms=hedge_default_ms)
         self._lock = threading.Lock()
@@ -448,7 +471,8 @@ class ServingRouter:
             return self._replicas.get(order[0]) if order else None
 
     def _forward(self, rep: Replica, rid: str, body: Dict,
-                 deadline: float) -> Dict:
+                 deadline: float,
+                 trace: Optional[TraceContext] = None) -> Dict:
         rem = policy.remaining_ms(deadline, self.clock)
         if rem <= 0:
             raise DeadlineExceeded("budget expired before dispatch")
@@ -457,14 +481,18 @@ class ServingRouter:
         except chaos.FaultInjected as e:
             raise ReplicaDown(str(e))
         data = json.dumps({**body, "request_id": rid}).encode()
+        headers = {
+            "Content-Type": "application/json",
+            # the engine maps this onto generate(timeout_s=...) — the
+            # whole deadline story end to end
+            "X-Deadline-Ms": str(int(rem)),
+        }
+        if trace is not None:
+            # the forward span's own context: engine-side spans parent
+            # under THIS attempt, so hedges stay distinguishable
+            headers[TRACE_HEADER] = trace.to_header()
         req = urllib.request.Request(
-            f"{rep.base_url()}/v1/generate", data=data,
-            headers={
-                "Content-Type": "application/json",
-                # the engine maps this onto generate(timeout_s=...) — the
-                # whole deadline story end to end
-                "X-Deadline-Ms": str(int(rem)),
-            },
+            f"{rep.base_url()}/v1/generate", data=data, headers=headers,
         )
         try:
             # transport timeout slightly past the deadline: the ENGINE
@@ -496,10 +524,16 @@ class ServingRouter:
         return payload
 
     def _attempt(self, rep: Replica, rid: str, body: Dict, deadline: float,
-                 out: "queue.Queue") -> None:
+                 out: "queue.Queue", span=None) -> None:
         try:
-            out.put((rid, rep, self._forward(rep, rid, body, deadline)))
+            res = self._forward(rep, rid, body, deadline,
+                                trace=span.ctx if span is not None else None)
+            if span is not None:
+                span.finish(result="ok")
+            out.put((rid, rep, res))
         except Exception as e:
+            if span is not None:
+                span.finish(result=type(e).__name__)
             out.put((rid, rep, e))
         finally:
             rep.end()
@@ -524,18 +558,49 @@ class ServingRouter:
 
     def handle_generate(self, body: Dict,
                         deadline_ms: Optional[float] = None,
-                        tenant: Optional[str] = None
+                        tenant: Optional[str] = None,
+                        trace: Optional[TraceContext] = None
                         ) -> Tuple[int, Dict, Dict]:
         """Route one generate request. Returns ``(status, payload,
         extra_headers)`` so it serves both the HTTP handler and direct
         in-process callers (tests/bench). ``tenant`` is the ``X-Tenant``
         header value; with a ``qos`` config it maps to a class whose
-        weighted-fair queue arbitrates the dispatch slot."""
+        weighted-fair queue arbitrates the dispatch slot. ``trace`` is the
+        caller's parsed ``X-Trace-Context``: the whole request runs under
+        a ``router.request`` root span parented beneath it, every leg
+        carries the context onward, and the outcome feeds the SLO tracker
+        (latency exemplar = this trace id)."""
         m = self.metrics
         if self._draining:
             m.drain_rejects.inc()
             return (503, {"error": "router draining", "shed": True,
                           "reason": "draining"}, {"Retry-After": "1"})
+        debug_trace = bool(
+            isinstance(body.get("debug"), dict) and body["debug"].get("trace")
+        )
+        root = TRACER.span("router.request", parent=trace)
+        t0 = self.clock()
+        code = 0
+        try:
+            with root as rattrs:
+                code, payload, extra = self._dispatch(
+                    body, deadline_ms, tenant, root.ctx, t0)
+                rattrs["status"] = code
+            if debug_trace and root.ctx is not None and code == 200:
+                payload = dict(payload)
+                payload["trace"] = self._flight_record(root.ctx.trace_id)
+            return code, payload, extra
+        finally:
+            lat_ms = (self.clock() - t0) * 1e3
+            tid = root.ctx.trace_id if root.ctx is not None else ""
+            self.slo.observe(ok=(code == 200), latency_ms=lat_ms,
+                             trace_id=tid)
+            m.request_ms.observe(lat_ms, exemplar=tid or None)
+
+    def _dispatch(self, body: Dict, deadline_ms: Optional[float],
+                  tenant: Optional[str], ctx: Optional[TraceContext],
+                  t0: float) -> Tuple[int, Dict, Dict]:
+        m = self.metrics
         m.requests.inc()
         self.retry_budget.on_request()
         qos_cls: Optional[str] = None
@@ -557,20 +622,21 @@ class ServingRouter:
             self._update_qos_gauges()
         with self._lock:
             self._inflight += 1
-        t0 = self.clock()
         try:
             if self._disagg_eligible(body):
-                out = self._run_disagg(body, deadline_ms, t0)
+                out = self._run_disagg(body, deadline_ms, t0, ctx)
                 if out is not None:
                     return out
                 # colocated fallback spends the REMAINING budget, not a
                 # fresh one — the failed leg's time is gone
                 m.disagg_fallbacks.inc()
+                TRACER.record("router.fallback", duration=0.0, trace=ctx,
+                              reason="disagg_leg_failed")
                 if deadline_ms is not None:
                     deadline_ms = max(
                         1.0, deadline_ms - (self.clock() - t0) * 1e3
                     )
-            return self._run(body, deadline_ms, t0)
+            return self._run(body, deadline_ms, t0, ctx)
         finally:
             if qos_cls is not None:
                 self.qos.release(qos_cls)
@@ -578,7 +644,6 @@ class ServingRouter:
             with self._idle:
                 self._inflight -= 1
                 self._idle.notify_all()
-            m.request_ms.observe((self.clock() - t0) * 1e3)
 
     def _update_qos_gauges(self) -> None:
         if self.qos is None:
@@ -602,29 +667,45 @@ class ServingRouter:
         }
         return "prefill" in roles and "decode" in roles
 
-    def _run(self, body: Dict, deadline_ms: Optional[float],
-             t0: float) -> Tuple[int, Dict, Dict]:
+    def _run(self, body: Dict, deadline_ms: Optional[float], t0: float,
+             ctx: Optional[TraceContext] = None) -> Tuple[int, Dict, Dict]:
         m = self.metrics
         budget = float(deadline_ms if deadline_ms is not None
                        else self.default_deadline_ms)
         deadline = policy.deadline_at(budget, self.clock)
         results: "queue.Queue" = queue.Queue()
         outstanding: Dict[str, Tuple[Replica, bool]] = {}
+        spans: Dict[str, object] = {}  # rid -> forward span handle
         tried: set = set()
         retries = 0
         hedged = False
         last_shed: Optional[ReplicaShedding] = None
 
-        def launch(rep: Replica, hedge: bool = False) -> None:
+        def launch(rep: Replica, hedge: bool = False, retry: int = 0) -> None:
             rid = uuid.uuid4().hex
+            # span identity exists BEFORE dispatch so the context rides the
+            # forward's X-Trace-Context header; finished in _attempt, and
+            # tagged winner/loser at hedge resolution
+            spans[rid] = TRACER.begin("router.forward", parent=ctx,
+                                      replica=rep.name, hedge=hedge,
+                                      retry=retry)
             outstanding[rid] = (rep, hedge)
             tried.add(rep.name)
             rep.begin()
             threading.Thread(
                 target=self._attempt,
-                args=(rep, rid, body, deadline, results),
+                args=(rep, rid, body, deadline, results, spans[rid]),
                 daemon=True,
             ).start()
+
+        def tag_attempt(rid: str, outcome: str) -> None:
+            sp = spans.get(rid)
+            if sp is None or sp.ctx is None:
+                return
+            # both orders are safe: mutate the live handle (pre-finish)
+            # then patch the recorded span (post-finish)
+            sp.attrs["outcome"] = outcome
+            TRACER.tag(sp.ctx.span_id, outcome=outcome)
 
         first = self._select(body, tried)
         if first is None:
@@ -668,7 +749,10 @@ class ServingRouter:
                 self.latency.record((self.clock() - t0) * 1e3)
                 if was_hedge:
                     m.hedge_wins.inc()
+                if hedged or was_hedge:
+                    tag_attempt(rid, "winner")
                 for orid, (orep, _) in outstanding.items():
+                    tag_attempt(orid, "loser")
                     self._cancel_attempt(orep, orid)
                 return 200, outcome, {}
 
@@ -680,7 +764,7 @@ class ServingRouter:
                     nxt = self._select(body, tried)
                     if (nxt is not None
                             and policy.remaining_ms(deadline, self.clock) > 0):
-                        launch(nxt)
+                        launch(nxt, retry=retries)
                         continue
                 else:
                     m.upstream_sheds.inc()
@@ -693,7 +777,7 @@ class ServingRouter:
                             and self.retry_budget.try_spend()):
                         retries += 1
                         m.retries.inc()
-                        launch(nxt)
+                        launch(nxt, retry=retries)
                         continue
                 if outstanding:
                     continue  # a hedge may still answer
@@ -727,7 +811,7 @@ class ServingRouter:
                     and self.retry_budget.try_spend()):
                 retries += 1
                 m.retries.inc()
-                launch(nxt)
+                launch(nxt, retry=retries)
                 continue
             if outstanding:
                 continue
@@ -737,7 +821,8 @@ class ServingRouter:
     # -- disaggregated two-leg dispatch ------------------------------------
 
     def _post_leg(self, rep: Replica, path: str, data: bytes,
-                  content_type: str, deadline: float) -> Tuple[int, bytes]:
+                  content_type: str, deadline: float,
+                  trace: Optional[TraceContext] = None) -> Tuple[int, bytes]:
         """One handoff leg POST. Returns (status, body bytes); raises
         ReplicaDown on transport failure, DeadlineExceeded on an expired
         budget. Non-200s come back as (code, body) for the caller to
@@ -749,10 +834,12 @@ class ServingRouter:
             chaos.check("router.forward")
         except chaos.FaultInjected as e:
             raise ReplicaDown(str(e))
+        headers = {"Content-Type": content_type,
+                   "X-Deadline-Ms": str(int(rem))}
+        if trace is not None:
+            headers[TRACE_HEADER] = trace.to_header()
         req = urllib.request.Request(
-            f"{rep.base_url()}{path}", data=data,
-            headers={"Content-Type": content_type,
-                     "X-Deadline-Ms": str(int(rem))},
+            f"{rep.base_url()}{path}", data=data, headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=rem / 1000.0 + 2.0) as r:
@@ -766,7 +853,8 @@ class ServingRouter:
         return 200, payload
 
     def _run_disagg(self, body: Dict, deadline_ms: Optional[float],
-                    t0: float) -> Optional[Tuple[int, Dict, Dict]]:
+                    t0: float, ctx: Optional[TraceContext] = None
+                    ) -> Optional[Tuple[int, Dict, Dict]]:
         """The two-leg dispatch: ``/v1/prefill`` on the prefill pool
         streams back a serialized KVHandoff; ``/v1/adopt`` on a
         block-aware-affine decode replica resumes it. Returns None
@@ -789,9 +877,14 @@ class ServingRouter:
              "request_id") if k in body
         }).encode()
         pre.begin()
+        leg = TRACER.span("router.prefill_leg", parent=ctx,
+                          replica=pre.name)
         try:
-            code, raw = self._post_leg(
-                pre, "/v1/prefill", leg1, "application/json", deadline)
+            with leg as la:
+                code, raw = self._post_leg(
+                    pre, "/v1/prefill", leg1, "application/json", deadline,
+                    trace=leg.ctx)
+                la["status"] = code
         except DeadlineExceeded:
             m.deadline_exceeded.inc()
             return 504, {"error": "deadline exceeded"}, {}
@@ -812,9 +905,13 @@ class ServingRouter:
         if dec is None:
             return None
         dec.begin()
+        leg = TRACER.span("router.adopt_leg", parent=ctx, replica=dec.name)
         try:
-            code, raw = self._post_leg(
-                dec, "/v1/adopt", raw, "application/octet-stream", deadline)
+            with leg as la:
+                code, raw = self._post_leg(
+                    dec, "/v1/adopt", raw, "application/octet-stream",
+                    deadline, trace=leg.ctx)
+                la["status"] = code
         except DeadlineExceeded:
             m.deadline_exceeded.inc()
             return 504, {"error": "deadline exceeded"}, {}
@@ -875,6 +972,32 @@ class ServingRouter:
         self.metrics.hedges.inc()
         launch(rep, hedge=True)
 
+    # -- flight recorder ---------------------------------------------------
+
+    def _flight_record(self, trace_id: str) -> Dict:
+        """The request's own span tree, inline: router-side spans from the
+        local ring plus engine-side spans pulled from every replica this
+        trace touched (their names ride the forward/leg span attrs) via
+        ``/v1/trace?trace_id=``. Best-effort — a replica that died mid-
+        request simply contributes no spans."""
+        spans = [span_to_dict(s) for s in TRACER.trace_spans(trace_id)]
+        touched = {
+            s["attrs"].get("replica") for s in spans
+            if s["attrs"].get("replica")
+        }
+        with self._lock:
+            reps = [self._replicas[n] for n in touched if n in self._replicas]
+        for rep in reps:
+            try:
+                with urllib.request.urlopen(
+                    f"{rep.base_url()}/v1/trace?trace_id={trace_id}",
+                    timeout=2.0,
+                ) as r:
+                    spans.extend(json.loads(r.read()).get("spans", []))
+            except Exception:
+                pass
+        return {"trace_id": trace_id, "spans": build_span_tree(spans)}
+
     # -- introspection -----------------------------------------------------
 
     def stats(self) -> Dict:
@@ -917,6 +1040,7 @@ class ServingRouter:
                 "sheds": dict(self.qos.sheds),
                 "admits": dict(self.qos.admits),
             }
+        out["slo"] = self.slo.snapshot()
         return out
 
 
@@ -937,13 +1061,24 @@ def make_router_handler(router: ServingRouter):
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path == "/healthz":
+            path, _, qs = self.path.partition("?")
+            if path == "/healthz":
                 if router.draining:
                     self._json(503, {"status": "draining"})
                 else:
                     self._json(200, {"status": "ok"})
-            elif self.path == "/v1/stats":
+            elif path == "/v1/stats":
                 self._json(200, router.stats())
+            elif path == "/v1/trace":
+                params = urllib.parse.parse_qs(qs)
+                tid = (params.get("trace_id") or [""])[0]
+                limit = int((params.get("limit") or ["1024"])[0])
+                spans = (TRACER.trace_spans(tid) if tid
+                         else TRACER.spans()[-limit:])
+                self._json(200, {
+                    "enabled": TRACER.enabled,
+                    "spans": [span_to_dict(s) for s in spans],
+                })
             elif self.path == "/metrics":
                 body = router.metrics.registry.render().encode()
                 self.send_response(200)
@@ -975,8 +1110,9 @@ def make_router_handler(router: ServingRouter):
             elif "deadline_ms" in req:
                 deadline_ms = float(req.pop("deadline_ms"))
             tenant = self.headers.get("X-Tenant")
+            trace = parse_trace_header(self.headers.get(TRACE_HEADER))
             code, payload, extra = router.handle_generate(
-                req, deadline_ms, tenant=tenant)
+                req, deadline_ms, tenant=tenant, trace=trace)
             self._json(code, payload, headers=extra)
 
     return Handler
@@ -999,6 +1135,8 @@ def router_kwargs(cfg: Dict) -> Dict:
             out[key] = cast(cfg[key])
     if isinstance(cfg.get("qos"), dict):
         out["qos"] = cfg["qos"]
+    if isinstance(cfg.get("slo"), dict):
+        out["slo"] = cfg["slo"]
     out["replicas"] = [
         {"name": r["name"], "host": r.get("host", "127.0.0.1"),
          "port": int(r["port"]), "weight": int(r.get("weight", 100)),

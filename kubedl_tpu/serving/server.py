@@ -22,10 +22,20 @@ import logging
 import os
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 from kubedl_tpu import chaos
+from kubedl_tpu.observability.tracing import (
+    TRACE_HEADER,
+    TRACER,
+    TraceContext,
+    new_span_id,
+    new_trace_id,
+    parse_trace_header,
+    span_to_dict,
+)
 
 log = logging.getLogger("kubedl_tpu.serving.server")
 
@@ -71,6 +81,14 @@ class _Slot:
         #: prefill and resumes from imported blocks
         self.handoff: Optional[Dict] = None
         self.adopt = None
+        #: distributed tracing (docs/observability.md): ``trace`` is the
+        #: caller's context (X-Trace-Context); ``span_id`` is this
+        #: request's PRE-MINTED engine.request id, so scheduler-side
+        #: sub-spans recorded before the request span exists can already
+        #: parent under it
+        self.trace: Optional[TraceContext] = None
+        self.span_id = ""
+        self.prefill_t0: Optional[float] = None
         self.done = threading.Event()
         self.result: Optional[Dict] = None
         self.t0 = time.perf_counter()
@@ -560,9 +578,67 @@ class LlamaEngine:
             self._cv.notify_all()
         return True
 
+    # -- distributed tracing (docs/observability.md) -----------------------
+
+    @staticmethod
+    def _arm_trace(slot: _Slot, trace: Optional[TraceContext],
+                   debug_trace: bool = False) -> None:
+        """Give a slot span identity: the caller sent a context, or asked
+        for a flight recording without one (mint a fresh trace so the
+        recording still has a root). Disarmed tracer: stays a no-op —
+        every scheduler-side record guards on ``slot.span_id``."""
+        if not TRACER.enabled:
+            return
+        if trace is None and debug_trace:
+            trace = TraceContext(new_trace_id(), "")
+        if trace is not None:
+            slot.trace = trace
+            slot.span_id = new_span_id()
+
+    def _trace_admitted_locked(self, s: _Slot, t_adm: float,
+                               row: int) -> None:
+        """Record queue wait (enqueue → admission start) and the admission
+        work itself, parented under the request span. Caller holds cv."""
+        if not s.span_id:
+            return
+        now = time.perf_counter()
+        TRACER.record("engine.queue_wait", start=s.t0,
+                      duration=t_adm - s.t0, trace=s.trace,
+                      parent_id=s.span_id)
+        TRACER.record("engine.admission", start=t_adm,
+                      duration=now - t_adm, trace=s.trace,
+                      parent_id=s.span_id, row=row)
+
+    def _trace_request_locked(self, s: _Slot, kind: str) -> None:
+        """Close the request span (id pre-minted at arm time) BEFORE the
+        waiter wakes, so a flight-recorder read right after done.wait()
+        already sees the whole tree. Caller holds cv."""
+        if s.span_id:
+            TRACER.record("engine.request", start=s.t0,
+                          duration=time.perf_counter() - s.t0,
+                          trace=s.trace, span_id=s.span_id, kind=kind,
+                          tokens=len(s.out_ids))
+
+    @staticmethod
+    def _trace_result(slot: _Slot, result: Dict,
+                      debug_trace: bool) -> Dict:
+        """Stamp the trace id on a finished result; with the flight
+        recorder armed, attach the request's own span tree inline."""
+        if slot.span_id and slot.trace is not None:
+            tid = slot.trace.trace_id
+            result.setdefault("trace_id", tid)
+            if debug_trace:
+                result["trace"] = {
+                    "trace_id": tid,
+                    "spans": TRACER.span_tree(tid),
+                }
+        return result
+
     def generate(self, prompt_ids, max_tokens: int = 16,
                  temperature: float = 0.0, timeout_s: float = 600.0,
-                 cache_prefix: bool = False, request_id: str = "") -> Dict:
+                 cache_prefix: bool = False, request_id: str = "",
+                 trace: Optional[TraceContext] = None,
+                 debug_trace: bool = False) -> Dict:
         budget = self.max_seq - 1
         prompt = [int(t) for t in list(prompt_ids)[:budget]]
         if not prompt:
@@ -570,6 +646,7 @@ class LlamaEngine:
         max_tokens = max(0, min(int(max_tokens), budget - len(prompt)))
         slot = _Slot(prompt, max_tokens, float(temperature), cache_prefix,
                      request_id=request_id)
+        self._arm_trace(slot, trace, debug_trace)
         with self._cv:
             if self._draining:
                 self._stats["drain_rejects"] += 1
@@ -633,7 +710,7 @@ class LlamaEngine:
             self._stats["tokens_in"] += len(prompt)
             self._stats["tokens_out"] += len(result.get("token_ids", []))
             self._recent.append(time.time())
-        return result
+        return self._trace_result(slot, result, debug_trace)
 
     def stats(self) -> Dict:
         """Live serving counters (feeds autoscaling signals + /v1/stats).
@@ -966,6 +1043,7 @@ class LlamaEngine:
             if mlen % bs:
                 tail_src = entry_blocks[full]
         n_alloc = need_total - len(shared)
+        t_alloc = time.perf_counter()
         got = a.alloc(n_alloc)
         if got is None and self._reclaim_prefix_locked():
             got = a.alloc(n_alloc)
@@ -988,6 +1066,11 @@ class LlamaEngine:
         self._bt_host[i, :len(blocks)] = blocks
         self._pos_host[i] = mlen
         self._slots[i] = slot
+        if slot.span_id:
+            TRACER.record("engine.kv_alloc", start=t_alloc,
+                          duration=time.perf_counter() - t_alloc,
+                          trace=slot.trace, parent_id=slot.span_id,
+                          blocks=len(blocks), shared=len(shared))
         if entry is None:
             if self._pcache is not None:
                 self.metrics.prefix_misses.inc()
@@ -1009,18 +1092,24 @@ class LlamaEngine:
                     if not self._alloc.admission_open():
                         break  # below low watermark: hysteresis holds
                     head = self._waiting[0]
+                    t_adm = time.perf_counter()
                     if head.adopt is not None:
                         r = self._admit_row_adopt_locked(i, head)
                         if r is None:
                             break  # pool dry: wait for frees
                         self._waiting.popleft()
+                        if r:
+                            self._trace_admitted_locked(head, t_adm, i)
                         continue  # r False: waiter already failed/woken
                     if not self._admit_row_paged_locked(i, head):
                         break  # pool dry: wait for frees / preemption
                     self._waiting.popleft()
+                    self._trace_admitted_locked(head, t_adm, i)
                     continue
                 slot = self._waiting.popleft()
+                t_adm = time.perf_counter()
                 self._slots[i] = slot
+                self._trace_admitted_locked(slot, t_adm, i)
                 # reset this row's position; stale KV is masked by pos
                 self._cache["pos"] = self._cache["pos"].at[i].set(0)
                 if self._pcache is None:
@@ -1170,6 +1259,9 @@ class LlamaEngine:
             self._slots[i] = None
             self._free_row_locked(i)
             self._release_prefix_locked(s)
+            self._trace_request_locked(
+                s, "adopt" if s.adopt is not None else "generate"
+            )
             s.done.set()
 
     # -- disaggregated prefill/decode (docs/serving.md) --------------------
@@ -1194,6 +1286,8 @@ class LlamaEngine:
             "cache_prefix": bool(s.cache_prefix),
             "request_id": s.request_id,
             "ttft_ms": s.ttft_ms,
+            "trace": s.trace,
+            "span_id": s.span_id,
             "t": time.time(),
         }
         ms = (time.perf_counter() - s.t0) * 1e3
@@ -1211,11 +1305,13 @@ class LlamaEngine:
         self._slots[i] = None
         self._free_row_locked(i)
         self._release_prefix_locked(s)
+        self._trace_request_locked(s, "prefill")
         s.done.set()
 
     def prefill_handoff(self, prompt_ids, max_tokens: int = 16,
                         temperature: float = 0.0, timeout_s: float = 600.0,
-                        cache_prefix: bool = False, request_id: str = ""):
+                        cache_prefix: bool = False, request_id: str = "",
+                        trace: Optional[TraceContext] = None):
         """Prefill-pool entry: run the whole-prompt prefill + on-device
         first-token sample exactly like generate(), then export the row's
         KV blocks instead of decoding. Returns a
@@ -1244,6 +1340,7 @@ class LlamaEngine:
         slot = _Slot(prompt, 1, float(temperature), cache_prefix,
                      request_id=request_id)
         slot.handoff = {"max_tokens": max_tokens}
+        self._arm_trace(slot, trace)
         self._enqueue_slot_locked_checks(slot)
         if not slot.done.wait(timeout=timeout_s):
             with self._cv:
@@ -1369,6 +1466,14 @@ class LlamaEngine:
                 )
                 k = np.array(self._jax.device_get(k))
                 v = np.array(self._jax.device_get(v))
+                # the handoff carries its trace as a header-format string
+                # (parent = the prefill request span) so a decode engine
+                # adopting it WITHOUT an HTTP header still joins the trace
+                th = ""
+                if rec.get("span_id") and rec.get("trace") is not None:
+                    th = TraceContext(
+                        rec["trace"].trace_id, rec["span_id"]
+                    ).to_header()
                 h = KVHandoff(
                     model=self.preset_name,
                     prompt_ids=rec["prompt"],
@@ -1381,6 +1486,7 @@ class LlamaEngine:
                     request_id=rec["request_id"],
                     cache_prefix=rec["cache_prefix"],
                     ttft_ms=rec["ttft_ms"],
+                    trace=th,
                 )
                 box["handoff"] = h
                 m = self.metrics
@@ -1389,6 +1495,13 @@ class LlamaEngine:
                 m.handoff_ms.observe(
                     (time.perf_counter() - t0) * 1e3, direction="export"
                 )
+                if rec.get("span_id"):
+                    TRACER.record(
+                        "engine.handoff_export", start=t0,
+                        duration=time.perf_counter() - t0,
+                        trace=rec["trace"], parent_id=rec["span_id"],
+                        nbytes=h.nbytes,
+                    )
             except Exception as e:
                 box["error"] = f"handoff export failed: {e}"
                 with self._cv:
@@ -1398,7 +1511,9 @@ class LlamaEngine:
                 ev.set()
 
     def adopt_handoff(self, h, timeout_s: float = 600.0,
-                      request_id: str = "") -> Dict:
+                      request_id: str = "",
+                      trace: Optional[TraceContext] = None,
+                      debug_trace: bool = False) -> Dict:
         """Decode-pool entry: adopt a prefill replica's KVHandoff —
         allocate blocks from THIS engine's pool (all-or-nothing, same
         watermark admission as generate), scatter the payloads in, and
@@ -1433,6 +1548,11 @@ class LlamaEngine:
         slot = _Slot(prompt, max_tokens, float(h.temperature),
                      h.cache_prefix, request_id=request_id or h.request_id)
         slot.adopt = h
+        # explicit context (HTTP header) wins; else the handoff's own
+        # embedded trace keeps direct engine→engine adoption on-trace
+        if trace is None:
+            trace = parse_trace_header(getattr(h, "trace", ""))
+        self._arm_trace(slot, trace, debug_trace)
         self._enqueue_slot_locked_checks(slot)
         if not slot.done.wait(timeout=timeout_s):
             with self._cv:
@@ -1451,7 +1571,7 @@ class LlamaEngine:
             self._stats["tokens_in"] += len(prompt)
             self._stats["tokens_out"] += len(result.get("token_ids", []))
             self._recent.append(time.time())
-        return result
+        return self._trace_result(slot, result, debug_trace)
 
     def _admit_row_adopt_locked(self, i: int, slot: _Slot):
         """Admit an adopted slot into row ``i``: allocate the handoff's
@@ -1538,6 +1658,11 @@ class LlamaEngine:
         m.handoff_ms.observe(
             (time.perf_counter() - t0) * 1e3, direction="adopt"
         )
+        if slot.span_id:
+            TRACER.record("engine.handoff_adopt", start=t0,
+                          duration=time.perf_counter() - t0,
+                          trace=slot.trace, parent_id=slot.span_id,
+                          blocks=len(blocks), shared=len(shared))
         # adopted prompts join this replica's prefix cache so the
         # router's block-aware affinity can steer repeats here
         self._maybe_insert_prefix_locked(i, slot)
@@ -1608,6 +1733,7 @@ class LlamaEngine:
         # device buffer, which a later donated dispatch can reuse
         rows = np.array(self._jax.device_get(pend["toks"]))  # [B, k]
         t1 = time.perf_counter()
+        seg_t0 = pend.get("t0", t0)
         with self._cv:
             self._pipe["inflight"] = 0
             for i, s, take in pend["sched"]:
@@ -1615,6 +1741,13 @@ class LlamaEngine:
                 if self._slots[i] is not s:
                     continue  # vacated (request timeout) mid-segment
                 s.out_ids.extend(int(t) for t in rows[i][:take])
+                if s.span_id and take:
+                    # segment wall time is SHARED by every scheduled row
+                    # (one batched dispatch); each row gets its own span
+                    # so per-request trees stay self-contained
+                    TRACER.record("engine.decode_segment", start=seg_t0,
+                                  duration=t1 - seg_t0, trace=s.trace,
+                                  parent_id=s.span_id, tokens=take)
                 self._maybe_finalize_locked(i, s)
             self._admit_locked()
             self._cv.notify_all()
@@ -1645,6 +1778,13 @@ class LlamaEngine:
                     self.metrics.ttft_ms.observe(s.ttft_ms)
                 if budgeted:
                     s.out_ids.append(int(ids[i]))
+                if s.span_id:
+                    p0 = s.prefill_t0 if s.prefill_t0 is not None else t0
+                    TRACER.record("engine.prefill", start=p0,
+                                  duration=now - p0, trace=s.trace,
+                                  parent_id=s.span_id,
+                                  prompt_len=len(s.prompt),
+                                  cached_len=s.cached_len)
                 # the row's prefix KV is now self-contained (prefill has
                 # completed) — the grafted entry no longer needs its pin
                 self._release_prefix_locked(s)
@@ -1809,6 +1949,11 @@ class LlamaEngine:
                 self._spec_stats.record(k, a, take)
                 self.metrics.spec_proposed.inc(k, draft=draft_kind)
                 self.metrics.spec_accepted.inc(a, draft=draft_kind)
+                if s.span_id:
+                    TRACER.record("engine.spec_round", start=t_d,
+                                  duration=time.perf_counter() - t_d,
+                                  trace=s.trace, parent_id=s.span_id,
+                                  k=k, accepted=int(a), emitted=take)
                 self._maybe_finalize_locked(i, s)
             self._admit_locked()
             self._cv.notify_all()
@@ -2027,6 +2172,7 @@ class LlamaEngine:
                     if self._slots[i] is not s:
                         continue  # vacated (request timeout) mid-prefill
                     s.fed = len(s.prompt)
+                    s.prefill_t0 = t0  # dispatch start, for engine.prefill
                     budgeted = (
                         s.max_tokens > 0
                         and len(s.prompt) + len(s.out_ids)
@@ -2169,7 +2315,7 @@ class LlamaEngine:
                             int(self._pos_host[i]) + k, self.max_seq - 1
                         )
                 self._pipe["inflight"] = 1
-            new_pending = {"toks": toks, "sched": sched, "k": k}
+            new_pending = {"toks": toks, "sched": sched, "k": k, "t0": t0}
             acct["segments"] += 1
 
         # ---- harvest: segment N-1's ids (then prefill's first tokens)
@@ -2209,11 +2355,26 @@ def make_handler(engine: LlamaEngine, model_name: str):
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path == "/healthz":
+            path, _, qs = self.path.partition("?")
+            if path == "/healthz":
                 self._json(200, {"status": "ok"})
-            elif self.path == "/v1/stats":
+            elif path == "/v1/stats":
                 self._json(200, engine.stats())
-            elif self.path == "/metrics":
+            elif path == "/v1/trace":
+                # flight-recorder pull: this replica's retained spans,
+                # optionally filtered to one trace (the router's
+                # _flight_record and scripts/tracemerge.py read this)
+                q = urllib.parse.parse_qs(qs)
+                tid = (q.get("trace_id") or [""])[0]
+                limit = int((q.get("limit") or ["0"])[0] or 0)
+                spans = TRACER.trace_spans(tid) if tid else TRACER.spans()
+                if limit > 0:
+                    spans = spans[-limit:]
+                self._json(200, {
+                    "enabled": TRACER.enabled,
+                    "spans": [span_to_dict(s) for s in spans],
+                })
+            elif path == "/metrics":
                 body = engine.metrics.registry.render().encode()
                 self.send_response(200)
                 self.send_header(
@@ -2222,7 +2383,7 @@ def make_handler(engine: LlamaEngine, model_name: str):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
-            elif self.path == "/v1/models":
+            elif path == "/v1/models":
                 self._json(200, {
                     "models": [{
                         "name": model_name,
@@ -2277,6 +2438,9 @@ def make_handler(engine: LlamaEngine, model_name: str):
                         timeout_s=timeout_s,
                         cache_prefix=bool(req.get("cache_prefix", False)),
                         request_id=str(req.get("request_id", "")),
+                        trace=parse_trace_header(
+                            self.headers.get(TRACE_HEADER)
+                        ),
                     )
                     body = h.to_bytes()
                     self.send_response(200)
@@ -2316,7 +2480,12 @@ def make_handler(engine: LlamaEngine, model_name: str):
                         if timeout_s <= 0:
                             self._json(504, {"error": "deadline exceeded"})
                             return
-                    result = engine.adopt_handoff(h, timeout_s=timeout_s)
+                    result = engine.adopt_handoff(
+                        h, timeout_s=timeout_s,
+                        trace=parse_trace_header(
+                            self.headers.get(TRACE_HEADER)
+                        ),
+                    )
                     if result.get("handoff_failed"):
                         self._json(502, result)
                         return
@@ -2350,6 +2519,7 @@ def make_handler(engine: LlamaEngine, model_name: str):
                     if timeout_s <= 0:
                         self._json(504, {"error": "deadline exceeded"})
                         return
+                dbg = req.get("debug")
                 result = engine.generate(
                     req.get("prompt_ids", []),
                     int(req.get("max_tokens", 16)),
@@ -2357,6 +2527,12 @@ def make_handler(engine: LlamaEngine, model_name: str):
                     timeout_s=timeout_s,
                     cache_prefix=bool(req.get("cache_prefix", False)),
                     request_id=str(req.get("request_id", "")),
+                    trace=parse_trace_header(
+                        self.headers.get(TRACE_HEADER)
+                    ),
+                    debug_trace=bool(
+                        isinstance(dbg, dict) and dbg.get("trace")
+                    ),
                 )
                 if result.get("timed_out") and deadline_hdr is not None:
                     self._json(504, {"error": "deadline exceeded"})

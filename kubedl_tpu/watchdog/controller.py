@@ -224,6 +224,18 @@ class WatchdogController:
         self._first_step_seen.add(key)
         delay = max(now - job.metadata.creation_timestamp, 0.0)
         self.metrics.first_step_delay.observe(delay, kind=kind)
+        # control-plane trace milestone: the watchdog runs in a different
+        # process than the job engine, but trace_for_job derives the SAME
+        # ids from the uid, so this span lands in the job's trace
+        from kubedl_tpu.observability.tracing import TRACER, trace_for_job
+
+        if TRACER.enabled:
+            ctx = trace_for_job(job.metadata.uid or f"{key[0]}/{jname}")
+            TRACER.record(
+                "job.first_beacon", duration=delay, trace=ctx,
+                wall_ts=job.metadata.creation_timestamp, kind=kind,
+                job=f"{pod.metadata.namespace}/{jname}",
+            )
 
     @staticmethod
     def _job_chips(job, fallback: int) -> int:

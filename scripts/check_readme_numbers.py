@@ -37,6 +37,9 @@ DECODE_ARTIFACT = "BENCH_r11_decode.json"
 #: disaggregated prefill/decode fleet row (r12): separate artifact, same
 #: runs[] shape (CPU proxy — see docs/serving.md)
 DISAGG_ARTIFACT = "BENCH_r12_disagg.json"
+#: tracing-overhead row (r13): separate artifact, same runs[] shape
+#: (CPU proxy — see docs/observability.md)
+TRACING_ARTIFACT = "BENCH_r13_tracing.json"
 
 
 def _runs_median(runs, *path) -> float:
@@ -246,6 +249,25 @@ def expected_disagg_strings(artifact: dict) -> dict:
     }
 
 
+def expected_tracing_strings(artifact: dict) -> dict:
+    """README tracing-overhead row strings from BENCH_r13_tracing.json."""
+    runs = artifact["runs"]
+    tgt = ("targets", "tracing")
+    disarmed = _runs_median(runs, *tgt, "disarmed_decode_tokens_per_sec")
+    armed = _runs_median(runs, *tgt, "armed_decode_tokens_per_sec")
+    ratio = _runs_median(runs, *tgt, "armed_over_disarmed")
+    span_us = _runs_median(runs, *tgt, "disarmed_call", "span_us")
+    return {
+        f"armed tracing at **{ratio * 100:.0f}%** of disarmed throughput":
+            "median of runs[].targets.tracing.armed_over_disarmed",
+        f"{disarmed:,.0f} -> {armed:,.0f} tokens/s 12-way":
+            "medians of runs[].targets.tracing."
+            "disarmed/armed_decode_tokens_per_sec",
+        f"disarmed span call {span_us:.2f} µs":
+            "median of runs[].targets.tracing.disarmed_call.span_us",
+    }
+
+
 def check(repo: Path = REPO) -> list:
     """Returns a list of mismatch descriptions (empty = README is clean)."""
     artifact = json.loads((repo / ARTIFACT).read_text())
@@ -284,6 +306,11 @@ def check(repo: Path = REPO) -> list:
     expected.update(
         expected_disagg_strings(
             json.loads((repo / DISAGG_ARTIFACT).read_text())
+        )
+    )
+    expected.update(
+        expected_tracing_strings(
+            json.loads((repo / TRACING_ARTIFACT).read_text())
         )
     )
     problems = []

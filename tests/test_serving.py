@@ -1052,6 +1052,22 @@ class TestSchedulerMicrobench:
         assert out["mirror_upload_ms"] <= PAGED_BUDGET_MS, out
         assert out["within_budget"], out
 
+    def test_tracing_disarmed_within_budget(self):
+        """Every hot path calls TRACER unconditionally; with tracing
+        disarmed the call must stay a near-free attribute test — an
+        allocation or lock sneaking onto that path would tax every
+        scheduler tick and router dispatch fleet-wide."""
+        from scripts.scheduler_microbench import (
+            TRACING_DISARMED_US,
+            run_tracing_microbench,
+        )
+
+        out = run_tracing_microbench(calls=50_000)
+        assert out["span_us"] <= TRACING_DISARMED_US, out
+        assert out["begin_finish_us"] <= TRACING_DISARMED_US, out
+        assert out["record_us"] <= TRACING_DISARMED_US, out
+        assert out["within_budget"], out
+
 
 class TestPrefixReuse:
     """Device-resident prefix KV cache (docs/serving.md "Prefix cache"):
