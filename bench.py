@@ -23,6 +23,7 @@ Prints exactly ONE JSON line:
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
@@ -360,6 +361,193 @@ def bench_goodput_under_preemption() -> dict:
                 "resizes": got.status.restart_count,
                 "notices": int(op.metrics.preemption_notices.value()),
             }
+
+
+#: pinned convergence-equivalence tolerance for the PS arm: mean final
+#: worker loss vs the synchronous baseline's final loss. Asynchrony
+#: (decay-weighted stale pushes, one mid-run eviction+rejoin) is allowed
+#: to perturb the trajectory, not to break training.
+PS_LOSS_TOL = 0.5
+
+
+def bench_ps() -> dict:
+    """Preemption-storm bench (docs/elasticity.md "Parameter-service
+    mode", BENCH_r15_ps.json): restart-based elastic vs the PS tier under
+    the SAME seeded storm schedule, plus a convergence-equivalence gate.
+
+    Two sections, two gates:
+
+    - **convergence** — a real synchronous ``fit`` vs two real ``fit_ps``
+      workers racing through a shared ``ParameterService`` (one worker
+      silently evicted mid-run, forcing the MemberEvicted -> re-register
+      -> warm-start path). Gate: mean final worker loss within
+      ``PS_LOSS_TOL`` of the sync baseline. The "asynchrony didn't break
+      training" side of the trade.
+    - **storm goodput** — event-driven accounting over a seeded storm
+      schedule, parameterized ONLY by costs measured in this run (steady
+      step time, cold restore = compile+first-step, PS rejoin RTT). Per
+      event the restart arm stalls the WHOLE gang (restore + redo of
+      work since the last checkpoint); the PS arm pays the victim's
+      outage + warm rejoin while survivors keep stepping. Both arms
+      accumulate into ``GoodputBreakdown`` so the delta is attributable
+      per bucket. Gate: PS goodput strictly above the restart arm.
+    """
+    import random
+    import threading as _th
+
+    import jax
+
+    from kubedl_tpu.api.topology import MeshSpec
+    from kubedl_tpu.core.store import ObjectStore
+    from kubedl_tpu.elastic.resize import GoodputBreakdown
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.observability.metrics import PSMetrics
+    from kubedl_tpu.parallel.mesh import build_mesh
+    from kubedl_tpu.ps import ParameterService, PSConfig
+    from kubedl_tpu.training.data import SyntheticTokens
+    from kubedl_tpu.training.trainer import TrainConfig, Trainer
+
+    STEPS = 24
+
+    def mk_trainer():
+        mesh = build_mesh(MeshSpec({"data": 2}), jax.devices()[:2])
+        cfg = TrainConfig(model=llama.TINY, global_batch=4, seq_len=16,
+                          steps=STEPS, seed=0)
+        return Trainer(cfg, mesh)
+
+    def mk_data(seed):
+        return iter(SyntheticTokens(4, 16, llama.TINY.vocab_size, seed=seed))
+
+    # ---- section 1: convergence equivalence (real training) ----------
+    t_sync = mk_trainer()
+    st0 = t_sync.init_state()
+    _, sync = t_sync.fit(mk_data(1), state=st0, steps=STEPS)
+
+    svc = ParameterService(
+        Trainer._host_params(t_sync.init_state()["params"]),
+        PSConfig(num_shards=2, max_staleness=4, decay=0.5),
+        store=ObjectStore(), metrics=PSMetrics(),
+    )
+    summaries: dict = {}
+    evict_once = _th.Event()
+
+    def ps_worker(wid: str, data_seed: int) -> None:
+        t = mk_trainer()
+        st = t.init_state()
+
+        def on_step(i, _metrics):
+            # the storm, in miniature: halfway through, w1 is declared
+            # silently dead ONCE (the watchdog-fire path); its next push
+            # hits MemberEvicted and fit_ps re-registers + warm-starts
+            # from the aggregate. Tripped from the victim's own step
+            # callback so the rejoin is exercised deterministically, not
+            # subject to which thread finishes first.
+            if wid == "w1" and i == STEPS // 2 and not evict_once.is_set():
+                evict_once.set()
+                svc.evict_silent_death("w1")
+
+        _, summaries[wid] = t.fit_ps(
+            mk_data(data_seed), svc, wid, state=st, steps=STEPS,
+            push_every=2, on_step=on_step,
+        )
+
+    threads = [
+        _th.Thread(target=ps_worker, args=("w0", 1)),
+        _th.Thread(target=ps_worker, args=("w1", 2)),
+    ]
+    t0 = time.time()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    ps_wall = time.time() - t0
+    losses = [summaries[w]["final_loss"] for w in ("w0", "w1")]
+    ps_loss = sum(losses) / len(losses)
+    loss_gap = abs(ps_loss - sync["final_loss"])
+    converged = math.isfinite(ps_loss) and loss_gap <= PS_LOSS_TOL
+
+    # ---- section 2: storm goodput, costs measured above --------------
+    step_s = max(sync["step_time_ms"] / 1000.0, 1e-4)
+    # what one gang restart costs a replica before it trains again:
+    # process cold start = compile + first step (measured, this run)
+    restore_s = max(sync["first_step_seconds"], step_s)
+    # what a PS rejoin costs the victim: a real register+pull RTT
+    t0 = time.time()
+    svc.register("bench-rejoin-probe")
+    rejoin_s = time.time() - t0
+    svc.deregister("bench-rejoin-probe")
+
+    WORKERS, HORIZON, CKPT_EVERY = 8, 600.0, 120.0
+    rng = random.Random(15)
+    # deterministic: a seeded schedule, identical for both arms
+    storm = sorted(
+        (
+            {
+                "t": round(rng.uniform(0.0, HORIZON), 1),
+                "victim": rng.randrange(WORKERS),
+                "outage_s": round(rng.uniform(20.0, 60.0), 1),
+            }
+            for _ in range(8)
+        ),
+        key=lambda e: e["t"],
+    )
+
+    wall = WORKERS * HORIZON
+    restart_bd = GoodputBreakdown()
+    ps_bd = GoodputBreakdown()
+    for ev in storm:
+        # restart arm: one preemption serializes the WHOLE gang — every
+        # worker pays the cold restore, then redoes the (expected) half
+        # checkpoint interval of work the restore rewound
+        restart_bd.restart_seconds += WORKERS * restore_s
+        restart_bd.checkpoint_seconds += WORKERS * (CKPT_EVERY / 2.0)
+        # PS arm: only the victim is out (its staged in-flight handled
+        # per the failure matrix); survivors never stall. Rejoin is a
+        # warm-start pull, measured against the live service above.
+        ps_bd.readmission_seconds += ev["outage_s"] + rejoin_s
+    restart_bd.productive_seconds = max(wall - restart_bd.lost_seconds, 0.0)
+    ps_bd.productive_seconds = max(wall - ps_bd.lost_seconds, 0.0)
+
+    stats = svc.stats()
+    rejoins = int(sum(s["ps_rejoins"] for s in summaries.values()))
+    ok = bool(
+        converged
+        and ps_bd.goodput() > restart_bd.goodput()
+        and rejoins >= 1  # the eviction->warm-rejoin path actually ran
+    )
+    return {
+        "ok": ok,
+        "storm": {
+            "seed": 15, "workers": WORKERS, "horizon_s": HORIZON,
+            "ckpt_every_s": CKPT_EVERY, "events": storm,
+        },
+        "measured": {
+            "step_ms": round(sync["step_time_ms"], 2),
+            "restore_s": round(restore_s, 3),
+            "rejoin_ms": round(rejoin_s * 1000.0, 3),
+        },
+        "restart_goodput": round(restart_bd.goodput(), 3),
+        "ps_goodput": round(ps_bd.goodput(), 3),
+        "restart_arm": restart_bd.to_dict(),
+        "ps_arm": ps_bd.to_dict(),
+        "sync_final_loss": round(sync["final_loss"], 4),
+        "ps_final_loss": round(ps_loss, 4),
+        "loss_gap": round(loss_gap, 4),
+        "loss_tol": PS_LOSS_TOL,
+        "ps_wall_s": round(ps_wall, 2),
+        "ps_counters": {
+            "pushes": int(sum(s["ps_pushes"] for s in summaries.values())),
+            "decayed": int(sum(s["ps_decayed"] for s in summaries.values())),
+            "rejected": int(sum(s["ps_rejected"] for s in summaries.values())),
+            "rejoins": rejoins,
+            # the metrics counter, not stats()["evicted"]: a rejoin
+            # clears the evicted entry, the counter keeps the history
+            "evictions": int(
+                svc.metrics.ps_evictions.value(reason="silent_death")
+            ),
+            "shard_versions": stats["versions"],
+        },
+    }
 
 
 def bench_crash_recovery() -> dict:
@@ -1977,6 +2165,29 @@ def main() -> int:
         d = bench_tracing(_jax.default_backend() == "tpu")
         print(json.dumps({
             "runs": [{"detail": {"targets": {"tracing": d}}}],
+        }, indent=2))
+        return 0 if d["ok"] else 1
+    if "--ps" in sys.argv[1:]:
+        # standalone parameter-service round (BENCH_r15_ps.json): the
+        # preemption-storm restart-vs-PS arms + the convergence-
+        # equivalence gate, in the same runs[] shape
+        # check_readme_numbers reads; the gates (PS goodput strictly
+        # above the restart arm at equal storm schedule, final loss
+        # within PS_LOSS_TOL of sync) decide the exit code
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            # the PS arms want a 2-way data mesh even on a 1-CPU host
+            # (same virtual-device trick as tests/conftest.py); set
+            # before the first jax import so it lands pre-backend-init
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
+        from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested
+
+        ensure_cpu_if_requested()
+        d = bench_ps()
+        print(json.dumps({
+            "runs": [{"detail": {"targets": {"ps": d}}}],
         }, indent=2))
         return 0 if d["ok"] else 1
     if "--training" in sys.argv[1:]:

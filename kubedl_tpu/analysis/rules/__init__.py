@@ -7,6 +7,7 @@ from kubedl_tpu.analysis.rules import (
     envmut,
     locks,
     metrics_drift,
+    ps_chaos_tests,
     schema_drift,
     span_names,
 )
@@ -20,6 +21,7 @@ ALL_RULES = [
     metrics_drift,   # KTL005
     schema_drift,    # KTL006
     span_names,      # KTL007
+    ps_chaos_tests,  # KTL008
 ]
 
 RULE_IDS = {m.RULE_ID: m for m in ALL_RULES}

@@ -1,0 +1,101 @@
+"""KTL008 — PS chaos sites without seeded test coverage.
+
+The parameter-service tier is the one place where an unexercised fault
+path silently costs training progress instead of a request: a ``ps.push``
+drop that nobody has ever injected under a seed means the bounded-
+staleness retry contract is folklore, not a pinned behavior. This rule
+makes the coverage drift-proof the same way KTL004 made the site registry
+drift-proof — by literal cross-reference, never by importing production
+code:
+
+1. collect every string literal at a ``chaos.check(<site>)`` /
+   ``chaos.should_fail(<site>)`` call under ``kubedl_tpu/ps/``;
+2. require each such site to appear as a string literal somewhere in
+   ``tests/test_ps.py`` (a seeded FaultPlan case arms sites by literal,
+   so a missing literal IS a missing case);
+3. a consulted PS site with no test file at all is the degenerate form of
+   the same finding.
+
+The reverse direction (sites named in the test but wired nowhere) is
+already covered by KTL004's dead-registry check, so it is not repeated
+here.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Set, Tuple
+
+from kubedl_tpu.analysis.engine import Finding
+
+RULE_ID = "KTL008"
+
+PS_PREFIX = "kubedl_tpu/ps/"
+TEST_PATH = "tests/test_ps.py"
+
+
+def _ps_call_sites(contexts) -> Dict[str, List[Tuple[str, int]]]:
+    """site -> [(relpath, line)] for chaos literals under kubedl_tpu/ps/."""
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for ctx in contexts:
+        if not ctx.relpath.startswith(PS_PREFIX):
+            continue
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in ("check", "should_fail")
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "chaos"):
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                site = node.args[0].value
+                out.setdefault(site, []).append((ctx.relpath, node.lineno))
+    return out
+
+
+def _test_literals(root: Path) -> Set[str]:
+    """Every string constant in tests/test_ps.py (a seeded case arms its
+    site by literal, so presence-of-literal == presence-of-case)."""
+    test = root / TEST_PATH
+    if not test.exists():
+        return set()
+    try:
+        tree = ast.parse(test.read_text())
+    except SyntaxError:
+        return set()
+    return {
+        node.value
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Constant) and isinstance(node.value, str)
+    }
+
+
+def check_project(root: Path, contexts) -> List[Finding]:
+    consulted = _ps_call_sites(contexts)
+    if not consulted:
+        return []
+    covered = _test_literals(root)
+    findings: List[Finding] = []
+    if not (root / TEST_PATH).exists():
+        path, line = sorted(consulted.values())[0][0]
+        return [Finding(
+            RULE_ID, path, line,
+            f"PS tier consults chaos sites but {TEST_PATH} does not exist "
+            f"— every ps.* injection site needs a seeded case there",
+            snippet="missing-test-file",
+        )]
+    for site, where in sorted(consulted.items()):
+        if site not in covered:
+            path, line = where[0]
+            findings.append(Finding(
+                RULE_ID, path, line,
+                f"chaos site '{site}' is consulted in the PS tier but has "
+                f"no seeded case in {TEST_PATH} (no string literal "
+                f"'{site}' found there)",
+                snippet=f"uncovered-ps-site:{site}",
+            ))
+    return findings

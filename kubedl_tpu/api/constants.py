@@ -49,6 +49,11 @@ ANNOTATION_PLANNED_MESH = API_GROUP + "/planned-mesh"
 #: re-plan may move chips between data and model axes on resize
 ANNOTATION_ELASTIC_BASE_DP = API_GROUP + "/elastic-base-dp"
 
+#: Address of the job's parameter-service tier ("host:port"), stamped by
+#: whoever runs it (the PS front is job-external so worker restarts never
+#: move it); workers in ``aggregation.mode: ps`` read ENV_PS_ADDR from it.
+ANNOTATION_PS_ADDRESS = API_GROUP + "/ps-address"
+
 NETWORK_MODE_HOST = "host"
 
 # ---- Environment variables injected into replicas ------------------------
@@ -77,6 +82,16 @@ ENV_ELASTIC_BASE_DP = "KUBEDL_ELASTIC_BASE_DP"
 ENV_ELASTIC_MIN_SLICES = "KUBEDL_ELASTIC_MIN_SLICES"
 ENV_ELASTIC_MAX_SLICES = "KUBEDL_ELASTIC_MAX_SLICES"
 ENV_ELASTIC_NUM_SLICES = "KUBEDL_ELASTIC_NUM_SLICES"
+
+# Parameter-service aggregation (kubedl_tpu/ps/, docs/elasticity.md
+# "Parameter-service mode"): a TPUJob whose `aggregation.mode` is "ps"
+# stamps the service address and the staleness knobs onto every worker so
+# entry.py takes the asynchronous push/pull arm instead of trainer.fit.
+ENV_PS_ADDR = "KUBEDL_PS_ADDR"
+ENV_PS_SHARDS = "KUBEDL_PS_SHARDS"
+ENV_PS_MAX_STALENESS = "KUBEDL_PS_MAX_STALENESS"
+ENV_PS_DECAY = "KUBEDL_PS_DECAY"
+ENV_PS_PUSH_EVERY = "KUBEDL_PS_PUSH_EVERY"
 
 # Model-output convention (reference: apis/model/v1alpha1/
 # modelversion_types.go:23-33 — KUBEDL_MODEL_PATH + /kubedl-model):

@@ -193,6 +193,46 @@ class ElasticSpec:
 
 
 @dataclass
+class AggregationSpec:
+    """Gradient-aggregation mode (kubedl_tpu/ps/, docs/elasticity.md
+    "Parameter-service mode"). ``mode: "sync"`` is the default synchronous
+    gang — every resize is a whole-gang restart-from-checkpoint.
+    ``mode: "ps"`` hash-partitions the model across ``ps_shards``
+    parameter-service shards; workers push parameter deltas and pull fresh
+    shards asynchronously under a bounded-staleness window, so a worker
+    departure (preemption notice, watchdog eviction, chaos kill) never
+    stops the survivors."""
+
+    #: "sync" (gang restart on every membership change) or "ps"
+    mode: str = "sync"
+    #: parameter-service shards the model is hash-partitioned across
+    ps_shards: int = 2
+    #: bounded staleness: a push whose pulled shard version lags the
+    #: shard head by more than this many aggregate steps is REJECTED and
+    #: the worker re-pulls; pushes within the bound are decay-weighted
+    max_staleness: int = 4
+    #: per-step-of-staleness decay applied to in-bound stale pushes
+    #: (weight = decay ** staleness)
+    decay: float = 0.5
+    #: worker cadence: push the accumulated delta every N local steps
+    push_every: int = 1
+
+    def validate(self, prefix: str = "aggregation") -> List[str]:
+        errs: List[str] = []
+        if self.mode not in ("sync", "ps"):
+            errs.append(f'{prefix}.mode must be "sync" or "ps"')
+        if self.ps_shards < 1:
+            errs.append(f"{prefix}.psShards must be >= 1")
+        if self.max_staleness < 0:
+            errs.append(f"{prefix}.maxStaleness must be >= 0")
+        if not (0.0 < self.decay <= 1.0):
+            errs.append(f"{prefix}.decay must be in (0, 1]")
+        if self.push_every < 1:
+            errs.append(f"{prefix}.pushEvery must be >= 1")
+        return errs
+
+
+@dataclass
 class RunPolicy:
     """Job-level execution policy (reference: types.go:188-217)."""
 

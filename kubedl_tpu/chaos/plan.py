@@ -29,6 +29,9 @@ Injection sites wired in this repo::
     router.forward                               replica forward transport failure
     router.probe                                 router health-probe failure
     router.hedge                                 suppress a hedge dispatch
+    ps.push                                      drop a parameter-service push
+    ps.pull                                      drop a parameter-service pull
+    ps.shard_failover                            kill a PS shard's owner mid-run
 
 Schedules are per-site and deterministic: ``nth(n)`` fails exactly the
 n-th call (1-based), ``first(k)`` fails the first k calls, ``prob(p, k)``
@@ -75,6 +78,9 @@ SITES: Dict[str, str] = {
     "router.forward": "replica forward transport failure",
     "router.probe": "router health-probe failure",
     "router.hedge": "suppress a hedge dispatch",
+    "ps.push": "drop a parameter-service push",
+    "ps.pull": "drop a parameter-service pull",
+    "ps.shard_failover": "kill a PS shard's owner mid-run",
 }
 
 

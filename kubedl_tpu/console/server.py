@@ -151,6 +151,10 @@ class ConsoleServer:
         # cluster overview (reference: routers/api/data.go:24-29)
         r("GET", "/api/v1/data/overview", ConsoleServer._h_overview)
         r("GET", "/api/v1/data/charts", ConsoleServer._h_charts)
+        # per-job goodput with the attributable loss breakdown
+        # (watchdog/controller.py stats(), elastic/resize.py
+        # GoodputBreakdown — checkpoint vs restart vs re-admission)
+        r("GET", "/api/v1/data/goodput", ConsoleServer._h_goodput)
         # model lineage + slice fleet (console views over live objects)
         r("GET", "/api/v1/model/list", ConsoleServer._h_model_list)
         # storage surfaces for job submission (reference: the pvc list at
@@ -518,6 +522,14 @@ class ConsoleServer:
             "jobPhases": self._job_stats(jobs)["statistics"],
             "workloadKinds": sorted(self.operator.engines),
         }
+
+    def _h_goodput(self, req: Request):
+        """Per-job goodput breakdown: productive vs lost seconds with the
+        lost share attributed to checkpoint / restart / re-admission, so
+        a goodput regression is diagnosable from the console alone."""
+        wd = getattr(self.operator, "watchdog", None)
+        jobs = wd.stats() if wd is not None else {}
+        return {"jobs": jobs, "watchdogEnabled": wd is not None}
 
     def _h_model_list(self, req: Request):
         """Model lineage view: every Model with its ModelVersions (phase,

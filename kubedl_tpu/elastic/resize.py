@@ -27,6 +27,8 @@ and re-admission is exactly ``1 - goodput``).
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+
 
 def grad_accum_for_world(
     base_grad_accum: int, base_world: int, world: int, global_batch: int
@@ -75,3 +77,59 @@ def goodput(step_seconds: float, wall_seconds: float) -> float:
     if wall_seconds <= 0:
         return 0.0
     return max(0.0, min(step_seconds / wall_seconds, 1.0))
+
+
+@dataclass
+class GoodputBreakdown:
+    """Attributable goodput: WHERE the non-productive seconds went.
+
+    :func:`goodput` alone is a blind spot — a bench (or an operator
+    staring at a regression) can see goodput dropped but not whether the
+    loss was checkpoint stalls, restart serialization, or scheduler
+    re-admission queueing. This accumulator splits ``1 - goodput`` into
+    those buckets so the preemption-storm bench's restart-vs-PS delta is
+    attributable line by line (BENCH_r15_ps.json, ``bench.py --ps``);
+    the watchdog's ``stats()`` and the console's ``/api/v1/data/goodput``
+    expose the same shape per job.
+    """
+
+    productive_seconds: float = 0.0
+    #: time spent writing checkpoints (the save stall, not async overlap)
+    checkpoint_seconds: float = 0.0
+    #: process death -> replacement running (gang teardown + cold start)
+    restart_seconds: float = 0.0
+    #: replacement running -> training again (queue/reserve/warm-join)
+    readmission_seconds: float = 0.0
+
+    @property
+    def lost_seconds(self) -> float:
+        return (
+            self.checkpoint_seconds
+            + self.restart_seconds
+            + self.readmission_seconds
+        )
+
+    @property
+    def wall_seconds(self) -> float:
+        return self.productive_seconds + self.lost_seconds
+
+    def goodput(self) -> float:
+        return goodput(self.productive_seconds, self.wall_seconds)
+
+    def add(self, other: "GoodputBreakdown") -> "GoodputBreakdown":
+        self.productive_seconds += other.productive_seconds
+        self.checkpoint_seconds += other.checkpoint_seconds
+        self.restart_seconds += other.restart_seconds
+        self.readmission_seconds += other.readmission_seconds
+        return self
+
+    def to_dict(self) -> dict:
+        return {
+            "productive_seconds": round(self.productive_seconds, 6),
+            "checkpoint_seconds": round(self.checkpoint_seconds, 6),
+            "restart_seconds": round(self.restart_seconds, 6),
+            "readmission_seconds": round(self.readmission_seconds, 6),
+            "lost_seconds": round(self.lost_seconds, 6),
+            "wall_seconds": round(self.wall_seconds, 6),
+            "goodput": round(self.goodput(), 6),
+        }

@@ -335,15 +335,61 @@ class JobMetrics:
             "kubedl_tpu_watchdog_restarts",
             "Gang restarts triggered by the progress watchdog, by reason",
         )
-        self.watchdog_stragglers = r.counter(
+        self.watchdog_stragglers = r.gauge(
             "kubedl_tpu_watchdog_stragglers",
-            "Replicas flagged as stragglers (step rate far below the "
-            "gang median); observational — no restart is triggered",
+            "Replicas CURRENTLY flagged as stragglers (step rate far "
+            "below the gang median); observational — no restart is "
+            "triggered, but PS-mode decay-weighting reads this signal "
+            "(a StragglerDetected job event fires once per track)",
         )
         self.watchdog_tracked = r.gauge(
             "kubedl_tpu_watchdog_tracked_replicas",
             "Replicas currently tracked by the progress watchdog "
             "(a replica opts in by emitting its first beacon)",
+        )
+
+
+class PSMetrics:
+    """The parameter-service metric family (kubedl_tpu/ps/,
+    docs/elasticity.md "Parameter-service mode"): asynchronous push/pull
+    aggregation accounting — push outcomes by staleness handling, member
+    churn, and shard failovers."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry or MetricsRegistry()
+        r = self.registry
+        self.ps_pushes = r.counter(
+            "kubedl_tpu_ps_pushes",
+            "Worker delta pushes, by outcome: fresh (staleness 0, full "
+            "weight), decayed (in-bound staleness, decay-weighted), "
+            "rejected (beyond max_staleness — the worker must re-pull)",
+        )
+        self.ps_pulls = r.counter(
+            "kubedl_tpu_ps_pulls",
+            "Shard snapshot pulls served (registration warm-starts "
+            "included)",
+        )
+        self.ps_members = r.gauge(
+            "kubedl_tpu_ps_members",
+            "Workers currently registered in the aggregation group",
+        )
+        self.ps_shard_failovers = r.counter(
+            "kubedl_tpu_ps_shard_failovers",
+            "Shard ownership transfers (lease re-acquired with a bumped "
+            "fencing token, state replayed from the shard WAL)",
+        )
+        self.ps_evictions = r.counter(
+            "kubedl_tpu_ps_evictions",
+            "Members removed from the aggregation group, by reason: "
+            "preemption (notice — in-flight contribution committed), "
+            "silent_death (watchdog — in-flight contribution discarded), "
+            "departed (clean deregister)",
+        )
+        self.ps_push_staleness = r.histogram(
+            "kubedl_tpu_ps_push_staleness_steps",
+            "Aggregate-steps of staleness per accepted push (shard head "
+            "version minus the worker's pulled version)",
+            buckets=(0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0),
         )
 
 
@@ -662,3 +708,6 @@ class SLOMetrics:
 
 #: Process-wide default, mirroring the reference's promauto default registry.
 DEFAULT_JOB_METRICS = JobMetrics()
+
+#: Process-wide default for the parameter-service tier (kubedl_tpu/ps/).
+DEFAULT_PS_METRICS = PSMetrics()

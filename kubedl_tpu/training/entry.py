@@ -346,16 +346,41 @@ def train_main(env: Optional[Dict[str, str]] = None) -> int:
             warm_join_timeout = 30.0  # never let a bad env kill the job
         if warm_join_timeout < 0:
             warm_join_timeout = None
+    # parameter-service mode (docs/elasticity.md "Parameter-service
+    # mode"): instead of the synchronous gang, this worker pushes deltas
+    # to / pulls shards from the PS tier at KUBEDL_PS_ADDR, so peer
+    # preemptions never restart it. The sync path below is untouched.
+    train_mode = opts.get("train_mode", "sync")
+    ps_addr = os.environ.get(constants.ENV_PS_ADDR, "")
     try:
-        state, summary = trainer.fit(
-            iter(data),
-            state=state,
-            on_step=on_step,
-            ckpt_dir=ckpt_dir or None,
-            ckpt_every=cfg.ckpt_every,
-            ckpt_peer=ckpt_peer,
-            warm_join_timeout=warm_join_timeout,
-        )
+        if train_mode == "ps" and ps_addr:
+            from kubedl_tpu.ps.server import PSClient
+
+            worker_id = "worker-" + os.environ.get(
+                constants.ENV_PROCESS_ID, "0"
+            )
+            push_every = int(
+                os.environ.get(constants.ENV_PS_PUSH_EVERY, "0")
+                or opts.get("ps_push_every", 1)
+            )
+            state, summary = trainer.fit_ps(
+                iter(data),
+                PSClient(ps_addr),
+                worker_id,
+                state=state,
+                on_step=on_step,
+                push_every=push_every,
+            )
+        else:
+            state, summary = trainer.fit(
+                iter(data),
+                state=state,
+                on_step=on_step,
+                ckpt_dir=ckpt_dir or None,
+                ckpt_every=cfg.ckpt_every,
+                ckpt_peer=ckpt_peer,
+                warm_join_timeout=warm_join_timeout,
+            )
     finally:
         if beacon is not None:
             beacon.stop()  # flush the final step count

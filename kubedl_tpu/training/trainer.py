@@ -1105,6 +1105,180 @@ class Trainer:
         summary["ckpt_async"] = checkpointer is not None
         return state, summary
 
+    # ---- parameter-service mode -----------------------------------------
+
+    @staticmethod
+    def _host_params(params) -> Dict[str, np.ndarray]:
+        """Flatten the params pytree into the wire-format dict the PS
+        shards by: ``keystr(path) -> float32 host array``."""
+        leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+        return {
+            jax.tree_util.keystr(path): np.asarray(
+                jax.device_get(leaf), dtype=np.float32
+            )
+            for path, leaf in leaves
+        }
+
+    @staticmethod
+    def _load_params(params, host: Dict[str, np.ndarray]):
+        """Overwrite pytree leaves from a PS snapshot (by path name);
+        leaves the snapshot doesn't cover keep their local values."""
+        pairs, treedef = jax.tree_util.tree_flatten_with_path(params)
+        new_leaves = []
+        for path, leaf in pairs:
+            name = jax.tree_util.keystr(path)
+            arr = host.get(name)
+            if arr is None:
+                new_leaves.append(leaf)
+            else:
+                new_leaves.append(
+                    jnp.asarray(arr, dtype=leaf.dtype).reshape(leaf.shape)
+                )
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    def fit_ps(
+        self,
+        data: Iterator,
+        ps,
+        worker_id: str,
+        state: Optional[Dict[str, Any]] = None,
+        steps: Optional[int] = None,
+        on_step: Optional[Callable[[int, Dict[str, Any]], None]] = None,
+        push_every: int = 1,
+    ) -> Tuple[Dict[str, Any], Dict[str, float]]:
+        """The ``train_mode: "ps"`` loop (docs/elasticity.md
+        "Parameter-service mode"): train locally, every ``push_every``
+        steps push the parameter delta since the last pull to ``ps``
+        (a :class:`~kubedl_tpu.ps.service.ParameterService` or the HTTP
+        :class:`~kubedl_tpu.ps.server.PSClient` — same duck type).
+
+        Failure handling IS the protocol:
+
+        - ``PushRejected`` (past the staleness bound): the local delta is
+          DISCARDED, the worker re-pulls the aggregated state and resumes
+          from it — an over-stale contribution never lands half-weighted.
+        - ``PSUnavailable`` / an injected ``ps.push``/``ps.pull`` drop:
+          transient; the anchor is kept so the delta keeps accumulating
+          and rides the next interval's push.
+        - ``MemberEvicted``: the worker was classified dead (or preempted)
+          server-side; it re-registers and warm-starts from the PS
+          snapshot — the late-joiner path, exercised mid-epoch.
+
+        Registration itself warm-starts: a joiner's local params are
+        overwritten from the aggregated snapshot, so a mid-epoch arrival
+        contributes deltas against current state, not step-0 noise.
+        """
+        from kubedl_tpu.chaos import FaultInjected
+        from kubedl_tpu.ps.service import MemberEvicted, PushRejected
+        from kubedl_tpu.ps.server import PSUnavailable
+
+        steps = steps or self.cfg.steps
+        state = state or self.init_state()
+        push_every = max(1, int(push_every))
+        step_fn = self._resolve_step_fn(None)
+        start = int(jax.device_get(state["step"]))
+        tokens_per_step = self.cfg.global_batch * self.cfg.seq_len
+
+        snapshot, versions = ps.register(worker_id)
+        if snapshot:
+            state["params"] = self._load_params(state["params"], snapshot)
+        anchor = self._host_params(state["params"])
+
+        pushes = decayed = rejected = dropped = repulls = rejoins = 0
+        steps_run = 0
+        last_loss_arr = None
+        first_loss = None
+        first_step_s = 0.0
+        t0 = time.perf_counter()
+        t_run = t0
+        with self.mesh:
+            for i in range(start, steps):
+                batch = self.shard_batch(next(data))
+                state, metrics = step_fn(state, batch)
+                last_loss_arr = metrics["loss"]
+                steps_run += 1
+                if i == start:
+                    first_loss = _fetch_scalar(metrics["loss"])
+                    first_step_s = time.perf_counter() - t0
+                    t_run = time.perf_counter()
+                if on_step is not None:
+                    on_step(i, metrics)
+                if (i + 1 - start) % push_every != 0 and i + 1 != steps:
+                    continue
+                current = self._host_params(state["params"])
+                deltas = {
+                    k: current[k] - anchor.get(k, np.zeros_like(current[k]))
+                    for k in current
+                }
+                try:
+                    res = ps.push(worker_id, i + 1, deltas, versions=versions)
+                    pushes += 1
+                    if res.outcome == "decayed":
+                        decayed += 1
+                    versions = list(res.versions)
+                    # the push moved the head; re-anchor on the local
+                    # params so the next delta is disjoint from this one
+                    anchor = current
+                except PushRejected as e:
+                    # past the bound: drop the delta, adopt the aggregate
+                    rejected += 1
+                    repulls += 1
+                    try:
+                        pulled, versions = ps.pull(worker_id)
+                        state["params"] = self._load_params(
+                            state["params"], pulled
+                        )
+                        anchor = self._host_params(state["params"])
+                    except (PSUnavailable, FaultInjected):
+                        versions = list(e.versions) or versions
+                except MemberEvicted:
+                    rejoins += 1
+                    snapshot, versions = ps.register(worker_id)
+                    if snapshot:
+                        state["params"] = self._load_params(
+                            state["params"], snapshot
+                        )
+                    anchor = self._host_params(state["params"])
+                except (PSUnavailable, FaultInjected):
+                    # transient drop: keep the anchor — the delta keeps
+                    # accumulating and rides the next push
+                    dropped += 1
+            if steps_run:
+                last_loss = _fetch_scalar(last_loss_arr)
+            else:
+                last_loss = first_loss = float("nan")
+        total = time.perf_counter() - t_run
+        steady_steps = steps_run - 1
+        tps = (
+            tokens_per_step * steady_steps / total
+            if total > 0 and steady_steps > 0 else 0.0
+        )
+        n_chips = jax.device_count()
+        summary = {
+            "train_mode": "ps",
+            "first_step_seconds": first_step_s,
+            "steps": steps_run,
+            "total_steps": steps,
+            "start_step": start,
+            "first_loss": first_loss,
+            "final_loss": last_loss,
+            "tokens_per_sec": tps,
+            "tokens_per_sec_per_chip": tps / n_chips,
+            "step_time_ms": (
+                (total / steady_steps * 1e3) if steady_steps > 0 else 0.0
+            ),
+            "model_family": self.family.name,
+            "n_params": self.family.num_params,
+            "ps_pushes": pushes,
+            "ps_decayed": decayed,
+            "ps_rejected": rejected,
+            "ps_dropped": dropped,
+            "ps_repulls": repulls,
+            "ps_rejoins": rejoins,
+            "ps_versions": list(versions),
+        }
+        return state, summary
+
     def _mfu(self, tokens_per_sec: float, n_chips: int) -> float:
         """Model FLOPs utilization against per-chip peak (for TPU runs)."""
         peak = _peak_flops_per_chip()

@@ -38,7 +38,7 @@ from kubedl_tpu.api import constants
 from kubedl_tpu.core.manager import ControllerManager, EventRecorder
 from kubedl_tpu.core.objects import ContainerStatus, Node, Pod, PodPhase
 from kubedl_tpu.core.store import Conflict, NotFound, ObjectStore
-from kubedl_tpu.elastic.resize import goodput as _goodput
+from kubedl_tpu.elastic.resize import GoodputBreakdown, goodput as _goodput
 
 log = logging.getLogger("kubedl_tpu.watchdog")
 
@@ -91,6 +91,10 @@ class _Track:
     rate: float = 0.0
     step_changes: int = 0
     straggler: bool = False
+    #: job-level StragglerDetected already emitted for this track — the
+    #: event fires once per track at threshold crossing (flap-proof),
+    #: while the gauge follows the current count
+    straggler_event_fired: bool = False
     #: OUR clock at first observation (goodput wall-clock anchor)
     first_seen: float = 0.0
     #: EWMA tokens/sec over observed step advances (throughput gauge)
@@ -99,6 +103,11 @@ class _Track:
     #: contributes min(dt, prior step-time EWMA), so stalls, restarts and
     #: recompiles count as overhead, not training (goodput numerator)
     productive: float = 0.0
+    #: dead predecessor's step-time EWMA (same-name replacement pod):
+    #: used ONLY to attribute the replacement's long first-advance window
+    #: to re-admission in the goodput breakdown — budgets and the
+    #: productive clock stay exactly as before
+    inherited_ewma: float = 0.0
 
 
 def _blend(ewma: float, sample: float, alpha: float = 0.3) -> float:
@@ -127,6 +136,16 @@ class WatchdogController:
         #: jobs whose first-step delay was already observed (once per job,
         #: same contract as the launch-delay annotations)
         self._first_step_seen: set = set()
+        #: fire subscribers, called as ``fn(pod_name, reason)`` after a
+        #: hang/silent-death pod is failed — the parameter service binds
+        #: one to evict the dead contributor from the aggregation group
+        #: without touching survivors (kubedl_tpu/ps/service.py
+        #: ``bind_watchdog``); listener errors never block the restart
+        self.listeners: list = []
+        #: attributed non-productive seconds per job — the goodput
+        #: breakdown :meth:`stats` / the console's /api/v1/data/goodput
+        #: expose (buckets only; productive/wall come from the tracks)
+        self._job_loss: Dict[Tuple[str, str, str], GoodputBreakdown] = {}
 
     # ------------------------------------------------------------ wiring
 
@@ -162,8 +181,15 @@ class WatchdogController:
                 self._drop(pod_key)
                 continue
             tr = self._tracks.get(pod_key)
+            inherited = 0.0
             if tr is not None and tr.uid != pod.metadata.uid:
-                tr = None  # same-name replacement pod: fresh grace window
+                # same-name replacement pod: fresh grace window. The gap
+                # since the dead pod's last beacon is restart loss, and
+                # its step-time EWMA seeds the breakdown's re-admission
+                # attribution for the replacement's first advance
+                self._lose(pod, max(now - tr.ts_seen, 0.0), "restart")
+                inherited = tr.step_ewma
+                tr = None
             if tr is None:
                 # opt-in by construction: a replica is tracked only once
                 # it has beaconed; first observation starts every clock
@@ -172,6 +198,7 @@ class WatchdogController:
                     step=beacon.get("step", 0.0), ts=beacon.get("ts", 0.0),
                     tokens=beacon.get("tokens", 0.0),
                     step_seen=now, ts_seen=now, first_seen=now,
+                    inherited_ewma=inherited,
                 )
                 continue
             tr.node = node.metadata.name
@@ -185,7 +212,21 @@ class WatchdogController:
                 # the PRIOR ewma is the best "pure step time" estimate for
                 # this advance: a stall/restart shows up as dt >> ewma and
                 # only the ewma share counts as productive
-                tr.productive += min(dt, tr.step_ewma) if tr.step_ewma > 0 else dt
+                if tr.step_ewma > 0:
+                    tr.productive += min(dt, tr.step_ewma)
+                    # in-loop excess on a live replica: checkpoint saves /
+                    # recompiles (the only stalls a synchronous step loop
+                    # pays without dying) — breakdown attribution
+                    self._lose(pod, max(dt - tr.step_ewma, 0.0), "checkpoint")
+                else:
+                    tr.productive += dt
+                    if tr.inherited_ewma > 0:
+                        # replacement's first advance: restore + warm-join
+                        # + queueing, sized against the predecessor's pace
+                        self._lose(
+                            pod, max(dt - tr.inherited_ewma, 0.0),
+                            "readmission",
+                        )
                 tr.step_ewma = _blend(tr.step_ewma, dt)
                 # any VALUE change counts as progress — a restarted
                 # worker's counter legitimately jumps backward to its
@@ -203,6 +244,68 @@ class WatchdogController:
 
     def _drop(self, pod_key: str) -> None:
         self._tracks.pop(pod_key, None)
+
+    def _job_key(self, pod: Pod) -> Optional[Tuple[str, str, str]]:
+        kind = pod.metadata.labels.get(constants.LABEL_JOB_KIND, "")
+        jname = pod.metadata.labels.get(constants.LABEL_JOB_NAME, "")
+        if not kind or not jname:
+            return None
+        return (pod.metadata.namespace, kind, jname)
+
+    def _lose(self, pod: Pod, seconds: float, bucket: str) -> None:
+        """Attribute non-productive seconds to a goodput-breakdown bucket
+        on the pod's job (elastic/resize.py GoodputBreakdown)."""
+        if seconds <= 0:
+            return
+        key = self._job_key(pod)
+        if key is None:
+            return
+        bd = self._job_loss.setdefault(key, GoodputBreakdown())
+        setattr(
+            bd, f"{bucket}_seconds", getattr(bd, f"{bucket}_seconds") + seconds
+        )
+
+    def stats(self) -> Dict[str, dict]:
+        """Per-job goodput WITH the attributable breakdown (satellite of
+        the ``goodput()`` blind spot: a single ratio can't say whether the
+        loss was checkpoint stalls, restart serialization or re-admission
+        queueing). Served by the console at ``/api/v1/data/goodput`` and
+        read by the preemption-storm bench to attribute its delta."""
+        now = self.clock()
+        by_job: Dict[Tuple[str, str, str], list] = {}
+        for pod_key, tr in self._tracks.items():
+            ns, _, pname = pod_key.partition("/")
+            pod = self.store.try_get("Pod", pname, ns)
+            if not isinstance(pod, Pod):
+                continue
+            key = self._job_key(pod)
+            if key is not None:
+                by_job.setdefault(key, []).append(tr)
+        out: Dict[str, dict] = {}
+        for key in set(by_job) | set(self._job_loss):
+            ns, kind, jname = key
+            trs = by_job.get(key, [])
+            wall = sum(max(now - tr.first_seen, 0.0) for tr in trs)
+            productive = sum(tr.productive for tr in trs)
+            loss = self._job_loss.get(key, GoodputBreakdown())
+            lost = max(wall - productive, 0.0)
+            out[f"{ns}/{jname}"] = {
+                "kind": kind,
+                "replicas": len(trs),
+                "stragglers": sum(1 for tr in trs if tr.straggler),
+                "productive_seconds": round(productive, 6),
+                "lost_seconds": round(lost, 6),
+                "checkpoint_seconds": round(loss.checkpoint_seconds, 6),
+                "restart_seconds": round(loss.restart_seconds, 6),
+                "readmission_seconds": round(loss.readmission_seconds, 6),
+                # honesty bucket: loss the heuristics could not classify
+                # (e.g. a stall with no prior EWMA) — sums reconcile
+                "unattributed_seconds": round(
+                    max(lost - loss.lost_seconds, 0.0), 6
+                ),
+                "goodput": round(_goodput(productive, wall), 6),
+            }
+        return out
 
     # ------------------------------------------- north-star metrics wiring
 
@@ -348,8 +451,6 @@ class WatchdogController:
                 slow = tr.rate < self.cfg.straggler_ratio * median
                 if slow and not tr.straggler:
                     tr.straggler = True
-                    if self.metrics is not None:
-                        self.metrics.watchdog_stragglers.inc()
                     self.recorder.event(
                         pod, "Warning", "Straggler",
                         f"step rate {tr.rate:.2f}/s is below "
@@ -357,8 +458,38 @@ class WatchdogController:
                         f"{median:.2f}/s — the whole gang runs at this "
                         "pace (sync training)",
                     )
+                    if not tr.straggler_event_fired:
+                        # once per track: the JOB event is the audit
+                        # record PS-mode decay-weighting decisions point
+                        # at (a flapping replica must not spam it)
+                        tr.straggler_event_fired = True
+                        self._job_event(
+                            pod, "StragglerDetected",
+                            f"{pod.metadata.name}: step rate "
+                            f"{tr.rate:.2f}/s below "
+                            f"{self.cfg.straggler_ratio:g}x gang median "
+                            f"{median:.2f}/s — PS-mode pushes from this "
+                            "replica are decay-weighted",
+                        )
                 elif not slow:
                     tr.straggler = False
+        if self.metrics is not None:
+            # gauge semantics: replicas CURRENTLY flagged, so a recovery
+            # is visible as a drop instead of a forever-rising count
+            self.metrics.watchdog_stragglers.set(
+                float(sum(1 for tr in self._tracks.values() if tr.straggler))
+            )
+
+    def _job_event(self, pod: Pod, reason: str, message: str) -> None:
+        """Record a Warning event on the pod's OWNING JOB (not the pod:
+        pod events die with the pod; per-job audit trails survive)."""
+        kind = pod.metadata.labels.get(constants.LABEL_JOB_KIND, "")
+        jname = pod.metadata.labels.get(constants.LABEL_JOB_NAME, "")
+        if not kind or not jname:
+            return
+        job = self.store.try_get(kind, jname, pod.metadata.namespace)
+        if job is not None:
+            self.recorder.event(job, "Warning", reason, message)
 
     # ------------------------------------------------------------ firing
 
@@ -395,6 +526,11 @@ class WatchdogController:
             f"{reason.replace('_', ' ')}: {detail}",
         )
         self._stamp_job(pod, cond_reason, detail)
+        for listener in list(self.listeners):
+            try:
+                listener(pod.metadata.name, reason)
+            except Exception:
+                log.exception("watchdog fire listener failed")
         log.warning("watchdog failed %s/%s (%s): %s",
                     pod.metadata.namespace, pod.metadata.name, reason, detail)
 

@@ -27,7 +27,7 @@ from typing import List, Optional, Union
 from kubedl_tpu.api import constants
 from kubedl_tpu.api.interface import JobObject, ReconcileContext, WorkloadController
 from kubedl_tpu.api.topology import MeshSpec, validate_mesh_for_slice
-from kubedl_tpu.api.types import ElasticSpec, ReplicaType
+from kubedl_tpu.api.types import AggregationSpec, ElasticSpec, ReplicaType
 from kubedl_tpu.core.objects import Pod
 from kubedl_tpu.engine.job_controller import replica_name
 from kubedl_tpu.planner.costmodel import ModelDesc
@@ -50,6 +50,11 @@ class TPUJob(JobObject):
     #: in [elastic.min_slices, elastic.max_slices] managed by the
     #: ElasticPolicy (kubedl_tpu/elastic/, docs/elasticity.md).
     elastic: Optional[ElasticSpec] = None
+    #: Opt-in gradient-aggregation mode: ``mode: ps`` trains through
+    #: preemption storms via the sharded parameter service instead of
+    #: gang restarts (kubedl_tpu/ps/, docs/elasticity.md
+    #: "Parameter-service mode").
+    aggregation: Optional[AggregationSpec] = None
 
     def explicit_mesh(self) -> Optional[MeshSpec]:
         """The user-pinned mesh, if any (``mesh: auto`` is not a pin)."""
@@ -74,6 +79,8 @@ class TPUJobController(WorkloadController):
         assert isinstance(job, TPUJob)
         if job.elastic is not None:
             errs.extend(job.elastic.validate("spec.elastic"))
+        if job.aggregation is not None:
+            errs.extend(job.aggregation.validate("spec.aggregation"))
         # --- mesh admission checks (docs/planning.md) ---------------------
         # Runs pre-defaulting, so clamp num_slices the way apply_defaults
         # will — a mesh must tile the shape the job will actually run at.
@@ -286,6 +293,22 @@ class TPUJobController(WorkloadController):
                 # re-plan may move chips between data and model axes, so
                 # raw process counts over/under-shoot (training/entry.py)
                 main.set_env(constants.ENV_ELASTIC_BASE_DP, base_dp)
+        if job.aggregation is not None and job.aggregation.mode == "ps":
+            # parameter-service mode (docs/elasticity.md): workers push
+            # deltas to / pull shards from the PS tier instead of running
+            # a synchronous gang — training/entry.py reads these
+            addr = job.metadata.annotations.get(constants.ANNOTATION_PS_ADDRESS)
+            if addr:
+                main.set_env(constants.ENV_PS_ADDR, addr)
+            main.set_env(constants.ENV_PS_SHARDS, str(job.aggregation.ps_shards))
+            main.set_env(
+                constants.ENV_PS_MAX_STALENESS,
+                str(job.aggregation.max_staleness),
+            )
+            main.set_env(constants.ENV_PS_DECAY, str(job.aggregation.decay))
+            main.set_env(
+                constants.ENV_PS_PUSH_EVERY, str(job.aggregation.push_every)
+            )
         if job.num_slices > 1:
             main.set_env(constants.ENV_MEGASCALE_COORDINATOR, self._coordinator(job))
             main.set_env(constants.ENV_MEGASCALE_NUM_SLICES, str(job.num_slices))

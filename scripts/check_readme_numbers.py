@@ -40,6 +40,9 @@ DISAGG_ARTIFACT = "BENCH_r12_disagg.json"
 #: tracing-overhead row (r13): separate artifact, same runs[] shape
 #: (CPU proxy — see docs/observability.md)
 TRACING_ARTIFACT = "BENCH_r13_tracing.json"
+#: parameter-service preemption-storm row (r15): separate artifact, same
+#: runs[] shape (CPU proxy — see docs/elasticity.md)
+PS_ARTIFACT = "BENCH_r15_ps.json"
 
 
 def _runs_median(runs, *path) -> float:
@@ -268,6 +271,24 @@ def expected_tracing_strings(artifact: dict) -> dict:
     }
 
 
+def expected_ps_strings(artifact: dict) -> dict:
+    """README parameter-service row strings from BENCH_r15_ps.json."""
+    runs = artifact["runs"]
+    tgt = ("targets", "ps")
+    restart = _runs_median(runs, *tgt, "restart_goodput")
+    ps = _runs_median(runs, *tgt, "ps_goodput")
+    gap = _runs_median(runs, *tgt, "loss_gap")
+    tol = _runs_median(runs, *tgt, "loss_tol")
+    return {
+        f"goodput **{restart:.2f} -> {ps:.2f}**":
+            "medians of runs[].targets.ps.restart_goodput/ps_goodput",
+        f"{ps / restart:.1f}x":
+            "ratio of the ps_goodput/restart_goodput medians",
+        f"final-loss gap {gap:.3f} vs sync (tol {tol:g})":
+            "medians of runs[].targets.ps.loss_gap/loss_tol",
+    }
+
+
 def check(repo: Path = REPO) -> list:
     """Returns a list of mismatch descriptions (empty = README is clean)."""
     artifact = json.loads((repo / ARTIFACT).read_text())
@@ -311,6 +332,11 @@ def check(repo: Path = REPO) -> list:
     expected.update(
         expected_tracing_strings(
             json.loads((repo / TRACING_ARTIFACT).read_text())
+        )
+    )
+    expected.update(
+        expected_ps_strings(
+            json.loads((repo / PS_ARTIFACT).read_text())
         )
     )
     problems = []

@@ -98,6 +98,82 @@ class TestInventoryDraining:
 
 
 # --------------------------------------------------------------------------
+# Controller: a RETRACTED notice must un-drain (clear_preemption path)
+# --------------------------------------------------------------------------
+
+
+class TestPreemptionRetract:
+    """The mark path is pinned by the E2E drill; this pins the retract
+    path in isolation: clear_preemption -> next beat wipes Node.preempt_at
+    -> reconcile clears the drain -> the slice is reservable again."""
+
+    def _rig(self):
+        from kubedl_tpu.core.nodes import NodeHeartbeater
+        from kubedl_tpu.core.store import ObjectStore
+        from kubedl_tpu.elastic.preemption import PreemptionController
+
+        store = ObjectStore()
+        inv = SliceInventory()
+        inv.add_slice("sa", "cpu-1")
+        hb = NodeHeartbeater(store, ["sa-host-0"], clock=lambda: 100.0)
+        ctl = PreemptionController(store, inv)
+        return store, inv, hb, ctl
+
+    def test_clear_preemption_undrains_and_restores_reservation(self):
+        from kubedl_tpu.core.nodes import NODE_NAMESPACE
+
+        store, inv, hb, ctl = self._rig()
+        hb.announce_preemption("sa-host-0", "spot reclaim in 60s")
+        hb.beat_once()
+        ctl.reconcile(NODE_NAMESPACE, "sa-host-0")
+        assert inv.draining_slices() == ["sa"]
+        assert inv.try_reserve("cpu-1", 1, "ns/j-gang") == []
+
+        hb.clear_preemption("sa-host-0")
+        hb.beat_once()
+        assert store.get("Node", "sa-host-0", NODE_NAMESPACE).preempt_at == 0.0
+        ctl.reconcile(NODE_NAMESPACE, "sa-host-0")
+        assert inv.draining_slices() == []
+        assert inv.try_reserve("cpu-1", 1, "ns/j-gang") == ["sa"]
+        reasons = [e.reason for e in store.list("Event", None)]
+        assert "PreemptionNotice" in reasons
+        assert "PreemptionCleared" in reasons
+
+    def test_multi_host_slice_clears_only_after_last_notice(self):
+        from kubedl_tpu.core.nodes import NODE_NAMESPACE, NodeHeartbeater
+        from kubedl_tpu.core.store import ObjectStore
+        from kubedl_tpu.elastic.preemption import PreemptionController
+
+        store = ObjectStore()
+        inv = SliceInventory()
+        inv.add_slice("sa", "cpu-1", hosts=["sa-host-0", "sa-host-1"])
+        hb = NodeHeartbeater(
+            store, ["sa-host-0", "sa-host-1"], clock=lambda: 100.0
+        )
+        ctl = PreemptionController(store, inv)
+
+        hb.announce_preemption("sa-host-0")
+        hb.announce_preemption("sa-host-1")
+        hb.beat_once()
+        for host in ("sa-host-0", "sa-host-1"):
+            ctl.reconcile(NODE_NAMESPACE, host)
+        assert inv.draining_slices() == ["sa"]
+
+        # first host's withdrawal must NOT return the slice to service
+        hb.clear_preemption("sa-host-0")
+        hb.beat_once()
+        ctl.reconcile(NODE_NAMESPACE, "sa-host-0")
+        assert inv.draining_slices() == ["sa"]
+        assert inv.try_reserve("cpu-1", 1, "ns/j-gang") == []
+
+        hb.clear_preemption("sa-host-1")
+        hb.beat_once()
+        ctl.reconcile(NODE_NAMESPACE, "sa-host-1")
+        assert inv.draining_slices() == []
+        assert inv.try_reserve("cpu-1", 1, "ns/j-gang") == ["sa"]
+
+
+# --------------------------------------------------------------------------
 # Spec validation + defaulting (TPUJob elastic block, ElasticDLJob fields)
 # --------------------------------------------------------------------------
 

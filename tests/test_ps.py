@@ -1,0 +1,492 @@
+"""Parameter-service aggregation tier (kubedl_tpu/ps/): bounded
+staleness, atomic membership departure, lease-fenced shard failover with
+WAL replay, and the seeded chaos cases KTL008 cross-references by
+site literal (`ps.push`, `ps.pull`, `ps.shard_failover`).
+"""
+
+import numpy as np
+import pytest
+
+from kubedl_tpu import chaos
+from kubedl_tpu.chaos import FaultInjected, FaultPlan, FaultSpec
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.observability.metrics import PSMetrics
+from kubedl_tpu.ps import (
+    MemberEvicted,
+    PSConfig,
+    ParameterService,
+    PushRejected,
+    shard_for,
+)
+from kubedl_tpu.ps.shards import FencedOut, ShardState, partition
+from kubedl_tpu.ps.server import PSClient, PSServer, PSUnavailable
+
+
+def make_ps(num_shards=2, max_staleness=2, decay=0.5, wal_root="",
+            lease_ttl=5.0, clock=None, params=None, **kw):
+    cfg = PSConfig(
+        num_shards=num_shards, max_staleness=max_staleness, decay=decay,
+        wal_root=wal_root, lease_ttl=lease_ttl, **kw,
+    )
+    if params is None:
+        params = {"w.a": np.zeros(4, np.float32), "w.b": np.zeros(3, np.float32),
+                  "w.c": np.zeros(2, np.float32)}
+    return ParameterService(
+        params, cfg, store=ObjectStore(), metrics=PSMetrics(),
+        clock=clock or __import__("time").time,
+    )
+
+
+DELTA = {"w.a": np.full(4, 1.0, np.float32),
+         "w.b": np.full(3, 1.0, np.float32),
+         "w.c": np.full(2, 1.0, np.float32)}
+
+
+# --------------------------------------------------------------------------
+# Hash partitioning
+# --------------------------------------------------------------------------
+
+
+class TestSharding:
+    def test_shard_for_is_deterministic_and_in_range(self):
+        for n in (1, 2, 3, 8):
+            for name in ("w.a", "layers.0.q", "emb", ""):
+                s = shard_for(name, n)
+                assert s == shard_for(name, n)
+                assert 0 <= s < n
+
+    def test_partition_covers_every_name_once(self):
+        names = [f"p{i}" for i in range(50)]
+        parts = partition(names, 4)
+        assert sorted(n for p in parts for n in p) == sorted(names)
+
+    def test_service_routes_each_param_to_its_shard(self):
+        svc = make_ps(num_shards=3)
+        for sh in svc.shards:
+            for name in sh.params:
+                assert shard_for(name, 3) == sh.shard_id
+
+
+# --------------------------------------------------------------------------
+# Bounded staleness + decay weighting
+# --------------------------------------------------------------------------
+
+
+class TestStaleness:
+    def test_fresh_push_full_weight(self):
+        svc = make_ps()
+        _, v = svc.register("w0")
+        res = svc.push("w0", 1, DELTA, versions=v)
+        assert res.outcome == "fresh" and res.weight == 1.0
+        assert res.staleness == 0
+        snap = svc.snapshot()
+        np.testing.assert_allclose(snap["w.a"], np.full(4, 1.0))
+
+    def test_stale_push_decay_weighted(self):
+        svc = make_ps(max_staleness=4, decay=0.5)
+        _, v0 = svc.register("slow")
+        svc.register("fast")
+        # fast advances the head twice; slow's anchor is now 2 behind
+        _, vf = svc.pull("fast")
+        vf = svc.push("fast", 1, DELTA, versions=vf).versions
+        svc.push("fast", 2, DELTA, versions=vf)
+        res = svc.push("slow", 1, DELTA, versions=v0)
+        assert res.outcome == "decayed"
+        assert res.staleness == 2
+        assert res.weight == pytest.approx(0.25)  # 0.5 ** 2
+        # the decayed delta landed at quarter weight on top of the 2 fulls
+        np.testing.assert_allclose(svc.snapshot()["w.a"], np.full(4, 2.25))
+
+    def test_push_beyond_bound_rejected_whole_then_repull_succeeds(self):
+        svc = make_ps(max_staleness=1)
+        _, v0 = svc.register("slow")
+        svc.register("fast")
+        _, vf = svc.pull("fast")
+        for step in range(3):  # head moves 3 past slow's anchor
+            vf = svc.push("fast", step, DELTA, versions=vf).versions
+        before = svc.snapshot()
+        with pytest.raises(PushRejected) as ei:
+            svc.push("slow", 1, DELTA, versions=v0)
+        # nothing applied — the reject is all-or-nothing across shards
+        after = svc.snapshot()
+        for k in before:
+            np.testing.assert_array_equal(before[k], after[k])
+        assert ei.value.versions == svc.versions()
+        # the protocol: re-pull, then push at staleness 0
+        _, v1 = svc.pull("slow")
+        assert svc.push("slow", 2, DELTA, versions=v1).outcome == "fresh"
+        assert svc.metrics.ps_pushes.value(outcome="rejected") == 1
+
+    def test_straggler_gets_extra_decay(self):
+        svc = make_ps(straggler_decay=0.5)
+        _, v = svc.register("w0")
+        svc.mark_straggler("w0", True)
+        res = svc.push("w0", 1, DELTA, versions=v)
+        assert res.outcome == "decayed" and res.weight == pytest.approx(0.5)
+        svc.mark_straggler("w0", False)
+        res = svc.push("w0", 2, DELTA, versions=res.versions)
+        assert res.weight == pytest.approx(1.0)
+
+
+# --------------------------------------------------------------------------
+# Event-driven membership: commit vs discard, late join
+# --------------------------------------------------------------------------
+
+
+class TestMembership:
+    def test_preemption_notice_commits_staged_inflight(self):
+        svc = make_ps()
+        svc.register("victim")
+        svc.stage_push("victim", DELTA, weight=1.0)
+        before = svc.versions()
+        svc.handle_preemption_notice("victim")
+        assert "victim" not in svc.members()
+        assert sum(svc.versions()) > sum(before)  # contribution committed
+        np.testing.assert_allclose(svc.snapshot()["w.a"], np.full(4, 1.0))
+        assert svc.metrics.ps_evictions.value(reason="preemption") == 1
+
+    def test_silent_death_discards_staged_inflight(self):
+        svc = make_ps()
+        svc.register("zombie")
+        svc.stage_push("zombie", DELTA, weight=1.0)
+        svc.evict_silent_death("zombie")
+        assert "zombie" not in svc.members()
+        np.testing.assert_allclose(svc.snapshot()["w.a"], np.zeros(4))
+        assert svc.metrics.ps_evictions.value(reason="silent_death") == 1
+        # an evicted member's ops bounce until it re-registers
+        with pytest.raises(MemberEvicted):
+            svc.push("zombie", 5, DELTA)
+        with pytest.raises(MemberEvicted):
+            svc.pull("zombie")
+
+    def test_late_joiner_warm_starts_from_aggregate(self):
+        svc = make_ps()
+        _, v = svc.register("w0")
+        svc.push("w0", 1, DELTA, versions=v)
+        snap, versions = svc.register("late")
+        np.testing.assert_allclose(snap["w.a"], np.full(4, 1.0))
+        assert versions == svc.versions()
+        assert svc.metrics.ps_members.value() == 2.0
+
+    def test_watchdog_fire_evicts_via_listener(self):
+        class FakeWatchdog:
+            def __init__(self):
+                self.listeners = []
+
+        wd = FakeWatchdog()
+        svc = make_ps()
+        svc.register("w0")
+        svc.stage_push("w0", DELTA)
+        svc.bind_watchdog(wd, worker_for_pod=lambda pod: pod.replace("p", "w"))
+        for fn in wd.listeners:
+            fn("p0", "silent_death")
+        assert "w0" not in svc.members()
+        np.testing.assert_allclose(svc.snapshot()["w.a"], np.zeros(4))
+
+
+# --------------------------------------------------------------------------
+# Shard failover: lease fencing + WAL replay
+# --------------------------------------------------------------------------
+
+
+class TestFailover:
+    def test_failover_replays_wal_and_bumps_fence(self, tmp_path):
+        t = {"now": 1000.0}
+        clock = lambda: t["now"]
+        svc = make_ps(wal_root=str(tmp_path), lease_ttl=2.0, clock=clock)
+        _, v = svc.register("w0")
+        v = svc.push("w0", 1, DELTA, versions=v).versions
+        v = svc.push("w0", 2, DELTA, versions=v).versions
+        want = {k: a.copy() for k, a in svc.snapshot().items()}
+        old_fences = [sh.fence for sh in svc.shards]
+        svc.fail_shard(0)
+        t["now"] += 10.0  # fake-clock past the dead owner's lease
+        svc.recover_shard(0)
+        got = svc.snapshot()
+        for k in want:  # replayed to the exact pre-crash state
+            np.testing.assert_allclose(got[k], want[k])
+        assert svc.versions() == v
+        assert svc.shards[0].fence > old_fences[0]
+        assert svc.metrics.ps_shard_failovers.value() == 1
+        # survivors keep pushing through the new owner
+        assert svc.push("w0", 3, DELTA, versions=v).outcome == "fresh"
+
+    def test_deposed_owner_write_is_fenced_out(self, tmp_path):
+        t = {"now": 0.0}
+        store = ObjectStore()
+        sh = ShardState(0, store, wal_dir=str(tmp_path), lease_ttl=2.0,
+                        clock=lambda: t["now"])
+        sh.open("owner-a")
+        sh.init_params({"w.a": np.zeros(2, np.float32)})
+        stale_token = sh.fence
+        sh.kill()
+        t["now"] += 10.0
+        sh.open("owner-b")  # lease steal bumps transitions
+        assert sh.fence > stale_token
+        with pytest.raises(FencedOut):
+            sh.apply("w0", 1.0, {"w.a": np.ones(2, np.float32)},
+                     fence=stale_token)
+
+    def test_dead_shard_without_autorecover_raises(self):
+        from kubedl_tpu.ps import ShardUnavailable
+
+        svc = make_ps(auto_recover=False)
+        svc.register("w0")
+        svc.fail_shard(0)
+        with pytest.raises(ShardUnavailable):
+            svc.pull("w0")
+
+
+# --------------------------------------------------------------------------
+# Seeded chaos (KTL008: one case per ps.* site, armed by literal)
+# --------------------------------------------------------------------------
+
+
+class TestChaos:
+    def test_ps_push_drop_is_all_or_nothing(self):
+        svc = make_ps()
+        _, v = svc.register("w0")
+        with FaultPlan(7, sites={"ps.push": [FaultSpec.nth(1)]}):
+            with pytest.raises(FaultInjected):
+                svc.push("w0", 1, DELTA, versions=v)
+            np.testing.assert_allclose(svc.snapshot()["w.a"], np.zeros(4))
+            # the retry (call #2) lands in full
+            res = svc.push("w0", 1, DELTA, versions=v)
+        assert res.outcome == "fresh"
+        np.testing.assert_allclose(svc.snapshot()["w.a"], np.full(4, 1.0))
+
+    def test_ps_pull_drop_then_retry(self):
+        svc = make_ps()
+        svc.register("w0")
+        with FaultPlan(7, sites={"ps.pull": [FaultSpec.nth(1)]}):
+            with pytest.raises(FaultInjected):
+                svc.pull("w0")
+            params, versions = svc.pull("w0")
+        assert versions == svc.versions()
+
+    def test_ps_shard_failover_mid_run_keeps_survivors_going(self, tmp_path):
+        t = {"now": 0.0}
+        svc = make_ps(wal_root=str(tmp_path), lease_ttl=0.5,
+                      clock=lambda: t["now"])
+        _, v = svc.register("w0")
+        v = svc.push("w0", 1, DELTA, versions=v).versions
+        with FaultPlan(3, sites={"ps.shard_failover": [FaultSpec.nth(1)]}):
+            t["now"] += 5.0  # next op kills a shard AND can steal its lease
+            res = svc.push("w0", 2, DELTA, versions=v)
+        assert res.outcome == "fresh"  # failover was transparent
+        assert svc.stats()["failovers"] == 1
+        np.testing.assert_allclose(svc.snapshot()["w.a"], np.full(4, 2.0))
+
+    def test_seeded_chaos_trace_is_deterministic(self):
+        def drive(plan):
+            svc = make_ps()
+            _, v = svc.register("w0")
+            for step in range(12):
+                try:
+                    v = svc.push("w0", step, DELTA, versions=v).versions
+                except FaultInjected:
+                    pass
+                try:
+                    _, v = svc.pull("w0")
+                except FaultInjected:
+                    pass
+            with plan:
+                pass  # ensure same arm/disarm shape
+            return plan.trace_tuples()
+
+        sites = {"ps.push": [FaultSpec.prob(0.4, 20)],
+                 "ps.pull": [FaultSpec.prob(0.2, 20)]}
+        with FaultPlan(11, sites=sites) as p1:
+            t1 = drive(p1)
+        with FaultPlan(11, sites=sites) as p2:
+            t2 = drive(p2)
+        assert t1 == t2
+        with FaultPlan(12, sites=sites) as p3:
+            t3 = drive(p3)
+        assert t1 != t3
+
+
+# --------------------------------------------------------------------------
+# HTTP front + client (protocol = exception mapping)
+# --------------------------------------------------------------------------
+
+
+class TestServer:
+    def test_register_push_pull_roundtrip(self):
+        svc = make_ps()
+        with PSServer(svc) as srv:
+            c = PSClient(srv.addr)
+            snap, v = c.register("w0")
+            assert set(snap) == {"w.a", "w.b", "w.c"}
+            res = c.push("w0", 1, DELTA, versions=v)
+            assert res.outcome == "fresh"
+            pulled, v2 = c.pull("w0")
+            np.testing.assert_allclose(pulled["w.a"], np.full(4, 1.0))
+            assert v2 == res.versions
+            assert c.stats()["members"] == ["w0"]
+
+    def test_409_maps_to_push_rejected_with_versions(self):
+        svc = make_ps(max_staleness=0)
+        with PSServer(svc) as srv:
+            c = PSClient(srv.addr)
+            _, v0 = c.register("slow")
+            _, vf = c.register("fast")
+            c.push("fast", 1, DELTA, versions=vf)
+            with pytest.raises(PushRejected) as ei:
+                c.push("slow", 1, DELTA, versions=v0)
+            assert ei.value.versions == svc.versions()
+
+    def test_410_maps_to_member_evicted(self):
+        svc = make_ps()
+        with PSServer(svc) as srv:
+            c = PSClient(srv.addr)
+            c.register("w0")
+            svc.evict_silent_death("w0")
+            with pytest.raises(MemberEvicted):
+                c.push("w0", 1, DELTA)
+
+    def test_injected_fault_maps_to_503_ps_unavailable(self):
+        svc = make_ps()
+        with PSServer(svc) as srv:
+            c = PSClient(srv.addr)
+            _, v = c.register("w0")
+            with FaultPlan(7, sites={"ps.push": [FaultSpec.nth(1)]}):
+                with pytest.raises(PSUnavailable):
+                    c.push("w0", 1, DELTA, versions=v)
+            assert c.push("w0", 1, DELTA, versions=v).outcome == "fresh"
+
+    def test_dead_server_maps_to_ps_unavailable(self):
+        svc = make_ps()
+        srv = PSServer(svc).start()
+        addr = srv.addr
+        srv.stop()
+        with pytest.raises(PSUnavailable):
+            PSClient(addr, timeout=0.5).register("w0")
+
+    def test_admin_fail_and_recover_shard(self, tmp_path):
+        t = {"now": 0.0}
+        svc = make_ps(wal_root=str(tmp_path), lease_ttl=0.2,
+                      clock=lambda: t["now"], auto_recover=False)
+        with PSServer(svc) as srv:
+            c = PSClient(srv.addr)
+            _, v = c.register("w0")
+            c.push("w0", 1, DELTA, versions=v)
+            c._post("/ps/admin", {"op": "fail_shard", "shard": 0})
+            with pytest.raises(PSUnavailable):
+                c.pull("w0")
+            t["now"] += 5.0
+            out = c._post("/ps/admin", {"op": "recover_shard", "shard": 0})
+            assert out["fence"] >= 1
+            pulled, _ = c.pull("w0")
+            np.testing.assert_allclose(pulled["w.a"], np.full(4, 1.0))
+
+
+# --------------------------------------------------------------------------
+# fit_ps: the training arm end to end (tiny model, CPU mesh)
+# --------------------------------------------------------------------------
+
+
+class TestFitPS:
+    def _trainer(self, steps=4):
+        import jax
+
+        from kubedl_tpu.api.topology import MeshSpec
+        from kubedl_tpu.models import llama
+        from kubedl_tpu.parallel.mesh import build_mesh
+        from kubedl_tpu.training.trainer import TrainConfig, Trainer
+
+        mesh = build_mesh(MeshSpec({"data": 2}), jax.devices()[:2])
+        cfg = TrainConfig(model=llama.TINY, global_batch=4, seq_len=16,
+                          steps=steps, seed=0)
+        return Trainer(cfg, mesh)
+
+    def _data(self):
+        from kubedl_tpu.models import llama
+        from kubedl_tpu.training.data import SyntheticTokens
+
+        return iter(SyntheticTokens(4, 16, llama.TINY.vocab_size, seed=1))
+
+    def test_fit_ps_trains_and_pushes(self):
+        t = self._trainer(steps=4)
+        st = t.init_state()
+        svc = make_ps(params=t._host_params(st["params"]))
+        st, s = t.fit_ps(self._data(), svc, "w0", state=st, steps=4,
+                         push_every=2)
+        assert s["steps"] == 4
+        assert s["ps_pushes"] == 2
+        assert s["ps_rejected"] == 0
+        assert np.isfinite(s["final_loss"])
+        # the PS aggregate tracked the worker: every non-empty shard
+        # ticked once per push
+        pushes_per_shard = [
+            2 if any(shard_for(k, 2) == sid for k in svc.snapshot()) else 0
+            for sid in range(2)
+        ]
+        assert svc.versions() == pushes_per_shard
+
+    def test_fit_ps_survives_push_drops(self):
+        t = self._trainer(steps=4)
+        st = t.init_state()
+        svc = make_ps(params=t._host_params(st["params"]))
+        with FaultPlan(5, sites={"ps.push": [FaultSpec.nth(1)]}):
+            st, s = t.fit_ps(self._data(), svc, "w0", state=st, steps=4,
+                             push_every=1)
+        assert s["ps_dropped"] == 1
+        assert s["ps_pushes"] == 3  # the other intervals landed
+        assert np.isfinite(s["final_loss"])
+
+    def test_fit_ps_rejected_push_repulls_aggregate(self):
+        t = self._trainer(steps=2)
+        st = t.init_state()
+        svc = make_ps(params=t._host_params(st["params"]), max_staleness=0)
+        # another member races the head ahead so the worker's push is stale
+        _, v = svc.register("rival")
+        rival_delta = {k: np.full_like(a, 0.01)
+                       for k, a in svc.snapshot().items()}
+
+        class RacingPS:
+            """Duck-typed wrapper: the rival advances the head right
+            before every worker push, forcing staleness > 0."""
+
+            def register(self, w):
+                return svc.register(w)
+
+            def pull(self, w):
+                return svc.pull(w)
+
+            def push(self, w, step, deltas, versions=None):
+                nonlocal v
+                v = svc.push("rival", step, rival_delta, versions=v).versions
+                return svc.push(w, step, deltas, versions=versions)
+
+            def deregister(self, *a, **k):
+                return svc.deregister(*a, **k)
+
+        st, s = t.fit_ps(self._data(), RacingPS(), "w0", state=st, steps=2,
+                         push_every=1)
+        assert s["ps_rejected"] >= 1
+        assert s["ps_repulls"] >= 1
+        assert np.isfinite(s["final_loss"])
+
+
+# --------------------------------------------------------------------------
+# Durability detail: recovery keeps survivors' init semantics
+# --------------------------------------------------------------------------
+
+
+class TestWalDetail:
+    def test_recovered_shard_skips_reinit(self, tmp_path):
+        t = {"now": 0.0}
+        store = ObjectStore()
+        sh = ShardState(0, store, wal_dir=str(tmp_path), lease_ttl=1.0,
+                        clock=lambda: t["now"])
+        sh.open("a")
+        sh.init_params({"w.a": np.zeros(2, np.float32)})
+        sh.apply("w0", 1.0, {"w.a": np.ones(2, np.float32)}, fence=sh.fence)
+        sh.kill()
+        t["now"] += 5.0
+        sh.open("b")
+        # init after recovery must NOT reset the replayed state
+        sh.init_params({"w.a": np.zeros(2, np.float32)})
+        assert sh.version == 1
+        np.testing.assert_allclose(sh.params["w.a"], np.ones(2))

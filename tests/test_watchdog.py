@@ -294,9 +294,20 @@ class TestClassification:
             wd.reconcile(NODE_NAMESPACE, "hostX")
         assert store.get("Pod", "p1").status.phase == PodPhase.RUNNING
         assert wd.fired == {"hang": 0, "silent_death": 0}
-        assert metrics.watchdog_stragglers.value() == 1  # flagged ONCE
+        assert metrics.watchdog_stragglers.value() == 1  # gauge: 1 current
         assert any(e.reason == "Straggler" for e in store.list("Event", None))
-        # B recovers: the flag clears (so a later relapse re-counts)
+        # the once-per-track job event is the durable audit record (the
+        # PS tier keys straggler decay off it)
+        job_events = [
+            e for e in store.list("Event", None)
+            if e.reason == "StragglerDetected"
+        ]
+        assert len(job_events) == 1
+        assert job_events[0].involved_kind == "TPUJob"
+        assert job_events[0].involved_name == "job1"
+        assert "p1" in job_events[0].message
+        # B recovers: the flag clears (so a later relapse re-counts) and
+        # the gauge drops back to zero with it
         for _ in range(25):
             sa += 10
             sb += 10
@@ -306,6 +317,61 @@ class TestClassification:
             hb.beat_once()
             wd.reconcile(NODE_NAMESPACE, "hostX")
         assert all(not tr.straggler for tr in wd._tracks.values())
+        assert metrics.watchdog_stragglers.value() == 0.0
+        # recovery does not re-fire the job event
+        assert sum(
+            1 for e in store.list("Event", None)
+            if e.reason == "StragglerDetected"
+        ) == 1
+
+
+class TestGoodputBreakdown:
+    """The goodput() blind spot: one ratio can't say WHERE the time went.
+    stats() must attribute non-productive seconds to checkpoint / restart
+    / re-admission buckets (console /api/v1/data/goodput)."""
+
+    def test_stats_attributes_checkpoint_restart_readmission(self):
+        store, t, hb, wd, _ = _rig()
+        store.create(make_tpujob("job1", workers=1))
+        make_pod(store, "p0")
+        _tick(t, hb, wd, step=1)  # track created
+        for s in range(2, 8):
+            _tick(t, hb, wd, step=s)  # steady 1 s/step -> EWMA ~1 s
+        base = wd.stats()["default/job1"]
+        assert base["checkpoint_seconds"] == 0.0
+        assert base["restart_seconds"] == 0.0
+
+        # one 6 s step on a LIVE replica: the excess over the step-time
+        # EWMA is checkpoint/recompile stall
+        _tick(t, hb, wd, step=8, dt=6.0)
+        got = wd.stats()["default/job1"]
+        assert 3.0 < got["checkpoint_seconds"] <= 5.5
+        assert got["restart_seconds"] == 0.0
+
+        # same-name replacement (gang restart): the beacon gap between
+        # the dead incarnation and its replacement is restart loss
+        store.delete("Pod", "p0")
+        make_pod(store, "p0")
+        _tick(t, hb, wd, step=2, dt=10.0)  # fresh uid detected here
+        got = wd.stats()["default/job1"]
+        assert got["restart_seconds"] == pytest.approx(10.0)
+
+        # the replacement's FIRST advance: restore + warm-join excess
+        # over the predecessor's pace is re-admission loss
+        assert got["readmission_seconds"] == 0.0
+        _tick(t, hb, wd, step=3, dt=5.0)
+        got = wd.stats()["default/job1"]
+        assert 2.0 < got["readmission_seconds"] <= 5.0
+
+        # report shape: every bucket present, goodput a sane ratio
+        for k in (
+            "productive_seconds", "lost_seconds", "unattributed_seconds",
+            "checkpoint_seconds", "restart_seconds", "readmission_seconds",
+            "goodput", "replicas", "stragglers", "kind",
+        ):
+            assert k in got
+        assert 0.0 < got["goodput"] <= 1.0
+        assert got["unattributed_seconds"] >= 0.0
 
 
 # --------------------------------------------------------------------------
