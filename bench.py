@@ -1144,7 +1144,14 @@ def bench_decode(on_tpu: bool) -> dict:
     stand-in for a trained draft/target pair), ngram vs model drafts and
     single- vs multi-candidate verification. Acceptance: all arms emit
     the no-spec oracle stream, model-draft acceptance > 0.5, and
-    multi-candidate accepts at least as many draft tokens as single."""
+    multi-candidate accepts at least as many draft tokens as single.
+
+    Open-loop arms: a seeded Poisson arrival stream against a 12-way
+    engine, slot-granularity vs chunked admission vs chunked +
+    tree-speculation, reading per-request TTFT and queue wait.
+    Acceptance: every arm bit-identical to the non-speculative
+    contiguous engine, chunked p95 TTFT below slot granularity, and the
+    12-way blocked speedup >= 1.25x."""
     import functools
 
     import jax
@@ -1160,7 +1167,7 @@ def bench_decode(on_tpu: bool) -> dict:
     bs = 16
     mb = max_seq // bs
     steps = 32
-    trials = 5
+    trials = 8
     params = llama.llama_init(jax.random.PRNGKey(0), cfg)
     out = {"model": preset, "max_seq": max_seq, "kv_block_size": bs,
            "segment_steps": steps}
@@ -1266,6 +1273,140 @@ def bench_decode(on_tpu: bool) -> dict:
     gates["multi_accepts_ge_single"] = (
         mc.get("accepted", 0) >= md.get("accepted", 0)
     )
+
+    # --- open-loop Poisson admission arms (continuous batching) --------
+    # Closed-loop width sweeps hide admission latency entirely: every
+    # "request" is already in the batch. This arm offers a seeded
+    # Poisson arrival stream (mostly short prompts + periodic 128-token
+    # ones) at ~60% utilization to a 12-way engine and reads each
+    # request's OWN ttft_ms / queue wait. Slot-granularity admission
+    # pays the long prefills as ticks nothing else can ride; chunked
+    # admission (prefill_chunk_tokens) bounds that stall at one chunk,
+    # which is exactly what the TTFT gap of the SHORT-request class
+    # (the requests that queue behind a long prefill) measures.
+    # Acceptance: every arm (slot, chunked, chunked+tree-speculation)
+    # emits tokens bit-identical to a non-speculative CONTIGUOUS
+    # engine, and the chunked arm's short-request p95 TTFT beats slot
+    # granularity.
+    import threading
+
+    rng = np.random.RandomState(16)
+    n_req = 48
+    ol_prompts, ol_mt = [], []
+    for j in range(n_req):
+        if j % 6 == 3:
+            ol_prompts.append(
+                [int(x) for x in rng.randint(1, 250, size=128)]
+            )
+            ol_mt.append(8)
+        else:
+            ol_prompts.append(
+                [int(x) for x in rng.randint(1, 250,
+                                             size=rng.randint(3, 9))]
+            )
+            ol_mt.append(12)
+    mean_gap_s = 0.040
+    arrivals = np.cumsum(rng.exponential(scale=mean_gap_s, size=n_req))
+
+    ref = LlamaEngine(preset=preset, max_batch=12, max_seq=160,
+                      kv_layout="contiguous", prefix_cache_mb=0)
+    try:
+        want_ol = [
+            ref.generate(p, max_tokens=m)["token_ids"]
+            for p, m in zip(ol_prompts, ol_mt)
+        ]
+    finally:
+        ref.close()
+
+    def _pct(vals, q):
+        srt = sorted(vals)
+        return round(srt[min(len(srt) - 1, int(q * len(srt)))], 1)
+
+    def openloop_arm(**kw):
+        eng = LlamaEngine(preset=preset, max_batch=12, max_seq=160,
+                          kv_layout="paged", kv_attention="blocked",
+                          prefix_cache_mb=0, max_queue_depth=256,
+                          max_queue_age_s=120.0, **kw)
+        try:
+            # warm EVERY bucket the stream will hit (short + 128-token
+            # prefill, first decode segments) so measured TTFT is
+            # steady-state dispatch cost, not one-time jit compiles
+            eng.generate(ol_prompts[0], max_tokens=4)
+            eng.generate(ol_prompts[3], max_tokens=4)
+            results = [None] * n_req
+            t0 = time.perf_counter()
+
+            def worker(j):
+                dt = arrivals[j] - (time.perf_counter() - t0)
+                if dt > 0:
+                    time.sleep(dt)
+                results[j] = eng.generate(
+                    ol_prompts[j], max_tokens=ol_mt[j], timeout_s=120
+                )
+
+            threads = [threading.Thread(target=worker, args=(j,))
+                       for j in range(n_req)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=180)
+            wall = time.perf_counter() - t0
+            ttfts = [r["ttft_ms"] for r in results]
+            # the short-request class is what chunked admission exists
+            # for: requests that queue BEHIND a long prefill; the long
+            # prompts themselves trade a bounded TTFT increase for it
+            short = [t for j, t in enumerate(ttfts) if j % 6 != 3]
+            toks = sum(len(r["token_ids"]) for r in results)
+            st = eng.stats()
+            return {
+                "ttft_ms_p50": _pct(ttfts, 0.5),
+                "ttft_ms_p95": _pct(ttfts, 0.95),
+                "short_ttft_ms_p50": _pct(short, 0.5),
+                "short_ttft_ms_p95": _pct(short, 0.95),
+                "queue_wait_ms_p50": st.get("queue_wait_ms_p50"),
+                "queue_wait_ms_p95": st.get("queue_wait_ms_p95"),
+                "tokens_per_sec": round(toks / wall, 1),
+                "outputs": [r["token_ids"] for r in results],
+            }
+        finally:
+            eng.close()
+
+    def _best(arms):
+        # two interleaved rounds per arm (scheduler noise on a shared
+        # box dwarfs the effect size): keep each arm's better round by
+        # p95 TTFT, like min-of-trials in the raw sweep
+        out = dict(arms[0])
+        for a in arms[1:]:
+            assert a["outputs"] == out["outputs"]
+            if a["ttft_ms_p95"] < out["ttft_ms_p95"]:
+                keep = out["outputs"]
+                out = dict(a)
+                out["outputs"] = keep
+        return out
+
+    ol_slot = _best([openloop_arm(), openloop_arm()])
+    ol_chunk = _best([openloop_arm(prefill_chunk_tokens=32),
+                      openloop_arm(prefill_chunk_tokens=32)])
+    ol_tree = openloop_arm(prefill_chunk_tokens=32, spec_k=4,
+                           spec_candidates=2, spec_tree=True)
+    gates["openloop_slot_exact"] = ol_slot.pop("outputs") == want_ol
+    gates["openloop_chunked_exact"] = ol_chunk.pop("outputs") == want_ol
+    gates["openloop_tree_exact"] = ol_tree.pop("outputs") == want_ol
+    gates["chunked_ttft_p95_lower"] = (
+        ol_chunk["short_ttft_ms_p95"] < ol_slot["short_ttft_ms_p95"]
+    )
+    gates["blocked_speedup_b12_ge_1p25"] = (
+        raw["b12"]["blocked_speedup"] >= 1.25
+    )
+    out["openloop"] = {
+        "requests": n_req,
+        "mean_gap_ms": mean_gap_s * 1e3,
+        "max_batch": 12,
+        "chunk_tokens": 32,
+        "slot": ol_slot,
+        "chunked": ol_chunk,
+        "chunked_tree": ol_tree,
+    }
     out["gates"] = gates
     out["ok"] = all(gates.values())
     return out
@@ -2121,11 +2262,13 @@ def main() -> int:
         }, indent=2))
         return 0
     if "--decode" in sys.argv[1:]:
-        # standalone decode round (BENCH_r11_decode.json): blocked vs
-        # gather kernel sweep + draft-speculation arms in the same
-        # runs[] shape check_readme_numbers reads; its own gates decide
-        # the exit code (a blocked kernel that loses to the gather, or
-        # any arm diverging from the oracle stream, fails loudly)
+        # standalone decode round (BENCH_r16_decode.json): blocked vs
+        # gather kernel sweep + draft-speculation arms + open-loop
+        # Poisson admission arms in the same runs[] shape
+        # check_readme_numbers reads; its own gates decide the exit
+        # code (a blocked kernel that loses to the gather, any arm
+        # diverging from the oracle stream, or chunked admission losing
+        # the TTFT race it exists to win, fails loudly)
         from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested
 
         ensure_cpu_if_requested()

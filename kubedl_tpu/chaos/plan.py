@@ -21,6 +21,7 @@ Injection sites wired in this repo::
     serving.dispatch                             device segment dispatch
     serving.kv_alloc                             KV block allocation failure
     serving.kv_handoff                           KV handoff transfer failure
+    serving.chunk_admit                          chunked-prefill admission dispatch
     checkpoint.torn                              die between shard + manifest
     store.wal_append                             torn WAL record (half-write)
     store.wal_fsync                              fail the WAL fsync syscall
@@ -70,6 +71,7 @@ SITES: Dict[str, str] = {
     "serving.dispatch": "device segment dispatch",
     "serving.kv_alloc": "KV block allocation failure",
     "serving.kv_handoff": "KV handoff transfer failure",
+    "serving.chunk_admit": "chunked-prefill admission dispatch",
     "checkpoint.torn": "die between shard + manifest",
     "store.wal_append": "torn WAL record (half-write)",
     "store.wal_fsync": "fail the WAL fsync syscall",

@@ -1134,7 +1134,10 @@ def paged_decode_step_batched(
     (the default bit-exactness oracle — materialize the logical view,
     dense masked attention) or ``"blocked"`` (the
     :mod:`kubedl_tpu.models.paged_attention` online-softmax kernel that
-    walks the block table; fp-close, greedy-token-identical)."""
+    walks the block table; fp-close, greedy-token-identical). The
+    blocked path hands the step's K/V to the kernel (``new_k``/``new_v``)
+    which writes them into the pool block in the same invocation — one
+    dispatch per layer instead of scatter + attend."""
     _check_kv_attention(kv_attention)
     B = tokens.shape[0]
     hd = cfg.head_dim
@@ -1165,11 +1168,16 @@ def paged_decode_step_batched(
         q = rot((h @ deq(lp["wq"])).reshape(B, 1, cfg.n_heads, hd))
         k = rot((h @ deq(lp["wk"])).reshape(B, 1, cfg.n_kv_heads, hd))
         v = (h @ deq(lp["wv"])).reshape(B, 1, cfg.n_kv_heads, hd)
-        ckp = ckp.at[blk, off].set(k[:, 0])
-        cvp = cvp.at[blk, off].set(v[:, 0])
         if kv_attention == "blocked":
-            attn = blocked_attention.paged_attention(q, ckp, cvp, bt, pos)
+            # fused KV write: the kernel lands this step's K/V into the
+            # row's current block itself, retiring the separate scatter
+            # dispatch the gather path still performs
+            attn, ckp, cvp = blocked_attention.paged_attention(
+                q, ckp, cvp, bt, pos, new_k=k[:, 0], new_v=v[:, 0]
+            )
         else:
+            ckp = ckp.at[blk, off].set(k[:, 0])
+            cvp = cvp.at[blk, off].set(v[:, 0])
             attn = attention(
                 q, _paged_view(ckp, bt), _paged_view(cvp, bt),
                 causal=False, mask=mask,
@@ -1247,6 +1255,8 @@ def _paged_suffix_forward(
     cfg: LlamaConfig,
     kv_attention: str = "gather",
     self_contained: bool = False,
+    positions: Optional[jax.Array] = None,  # [B, S] per-token positions
+    self_mask: Optional[jax.Array] = None,  # [B, S, S] in-suffix mask
 ) -> Tuple[jax.Array, Params]:
     """Shared body of paged prefill and speculative verify: run suffix
     tokens at global positions ``starts[b] + s`` against the gathered
@@ -1264,8 +1274,20 @@ def _paged_suffix_forward(
     — each query attends committed pool history (``t < starts``) merged
     with the suffix's own fresh K/V under an in-suffix causal mask,
     which is the same key set the write path would have seen. The
-    returned cache is the input cache, untouched."""
+    returned cache is the input cache, untouched.
+
+    ``positions`` overrides the default consecutive position layout
+    ``starts[b] + s`` — the tree-verify hook, where several trie nodes
+    share a depth (and so a RoPE angle). ``self_mask[b, s, t]`` replaces
+    the in-suffix causal block with an arbitrary visibility mask (the
+    trie's ancestor mask). Both are read-only-mode-only: the write path
+    demands consecutive causal suffixes."""
     _check_kv_attention(kv_attention)
+    if (positions is not None or self_mask is not None) \
+            and not self_contained:
+        raise ValueError(
+            "positions/self_mask require self_contained=True"
+        )
     B, S = tokens.shape
     hd = cfg.head_dim
     bt = cache["bt"]
@@ -1276,9 +1298,12 @@ def _paged_suffix_forward(
     if cfg.embed_scale:
         x = x * math.sqrt(cfg.dim)
     cos_full, sin_full = rope_freqs(cfg, max_s)
-    posq = jnp.minimum(
-        starts[:, None] + jnp.arange(S)[None, :], max_s - 1
-    )  # [B, S]
+    if positions is None:
+        posq = jnp.minimum(
+            starts[:, None] + jnp.arange(S)[None, :], max_s - 1
+        )  # [B, S]
+    else:
+        posq = jnp.minimum(positions, max_s - 1)
     cos_t = cos_full[posq][:, :, None, :]
     sin_t = sin_full[posq][:, :, None, :]
     if self_contained:
@@ -1288,10 +1313,13 @@ def _paged_suffix_forward(
             jnp.arange(max_s)[None, None, :] < starts[:, None, None],
             (B, S, max_s),
         )
-        causal_self = jnp.broadcast_to(
-            (jnp.arange(S)[None, :] <= jnp.arange(S)[:, None])[None],
-            (B, S, S),
-        )
+        if self_mask is None:
+            causal_self = jnp.broadcast_to(
+                (jnp.arange(S)[None, :] <= jnp.arange(S)[:, None])[None],
+                (B, S, S),
+            )
+        else:
+            causal_self = self_mask
         mask = jnp.concatenate([hist, causal_self], axis=-1)[:, None, None]
     else:
         mask = (
@@ -1322,6 +1350,7 @@ def _paged_suffix_forward(
                 q, ckp, cvp, bt, starts,
                 self_k=k if self_contained else None,
                 self_v=v if self_contained else None,
+                self_mask=self_mask,
             )
         elif self_contained:
             attn = attention(
@@ -1500,6 +1529,42 @@ def paged_verify_multi(
     logits = (x @ lm_head_of(params, cfg)).astype(jnp.float32)
     ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return ids.reshape(B, N, S)
+
+
+def paged_verify_tree(
+    params: Params,
+    cache: Params,
+    tokens: jax.Array,  # [B, M] trie-node tokens (node 0 = last accepted)
+    positions: jax.Array,  # [B, M] global position of each node
+    tree_mask: jax.Array,  # [B, M, M] bool: node m sees node t
+    lengths: jax.Array,  # [B] live node count; 0 = row untouched
+    starts: jax.Array,  # [B] row position before the verify
+    cfg: LlamaConfig,
+    kv_attention: str = "gather",
+) -> jax.Array:
+    """Score a prefix-trie of draft continuations in ONE read-only
+    forward: returns the target's greedy ids ``[B, M]`` — ``ids[b, m]``
+    is the argmax continuation after consuming trie node m along its
+    root path. The trie generalizes :func:`paged_verify_multi`'s flat
+    candidate list: candidates sharing a prefix share nodes, so the
+    verify window is the trie size M, not candidates x depth.
+
+    ``tree_mask[b, m, t]`` must be True exactly when t is m itself or an
+    ancestor of m, and ``positions[b, m] = starts[b] + depth(m)`` (node
+    0, the last accepted token, sits at depth 0). Under that mask each
+    node attends committed pool history plus its own root path — the
+    identical key set a chain verify of that path would see, so a
+    single-chain trie reproduces :func:`paged_verify` bit-exactly. The
+    host walks the deepest accepted path and re-runs the write-path
+    verify on it alone; like multi-verify, nothing here writes the pool
+    and no cache is returned."""
+    x, _ = _paged_suffix_forward(
+        params, cache, tokens, lengths, starts, cfg,
+        kv_attention=kv_attention, self_contained=True,
+        positions=positions, self_mask=tree_mask,
+    )
+    logits = (x @ lm_head_of(params, cfg)).astype(jnp.float32)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, M]
 
 
 def copy_kv_block(cache: Params, src, dst) -> Params:

@@ -34,8 +34,23 @@ row b sits at global position ``posq = min(starts[b] + s, max_s - 1)``
 and attends pool keys at positions ``t <= posq``. With ``self_k``/
 ``self_v`` (the read-only multi-candidate verify), pool keys are history
 only (``t < starts[b]``) and the fresh suffix K/V are folded as one extra
-online-softmax step under an in-suffix causal mask — the pool is never
-written, which is what lets XLA drop the scatter entirely.
+online-softmax step under an in-suffix mask — the pool is never
+written, which is what lets XLA drop the scatter entirely. The default
+in-suffix mask is causal; ``self_mask`` (a [B, S, S] bool, True = key
+visible) overrides it for tree-structured verification where node s may
+only see its trie ancestors.
+
+Fused KV-write (decode, S=1): passing ``new_k``/``new_v`` ([B, KV, hd],
+this step's K/V) makes :func:`paged_attention` write them into each
+row's current pool block at ``(bt[b, starts//BS], starts % BS)`` inside
+the same call and return ``(out, k_pool, v_pool)`` — retiring the
+separate per-layer scatter dispatch the decode step used to pay. The
+lax path folds the scatter in front of the chunk scan (identical ops to
+the old scatter-then-attend call-site sequence, so bit-identical); the
+pallas kernel aliases the pools in/out and patches the written row in
+VMEM at the write block, so the fresh token is attended from the
+patched tile and only the ONE dirty block per (row, kv-head) is copied
+back to HBM.
 """
 
 from __future__ import annotations
@@ -65,7 +80,9 @@ DEFAULT_KERNEL = "auto"
 
 #: trace-time counters per implementation — bench asserts the blocked
 #: path is actually in the compiled hot graph, not silently the oracle.
-TRACE_COUNT = {"lax": 0, "pallas": 0}
+#: "fused" counts paged_attention calls that carried the decode step's
+#: K/V write (either implementation).
+TRACE_COUNT = {"lax": 0, "pallas": 0, "fused": 0}
 
 
 def blocks_per_chunk(num_blocks: int, block_size: int,
@@ -107,6 +124,7 @@ def _lax_paged_attention(
     self_k: Optional[jax.Array],  # [B, S, KV, hd] fresh suffix K (or None)
     self_v: Optional[jax.Array],
     tile: int,
+    self_mask: Optional[jax.Array] = None,  # [B, S, S] bool (tree verify)
 ) -> jax.Array:
     TRACE_COUNT["lax"] += 1
     B, S, H, hd = q.shape
@@ -147,8 +165,14 @@ def _lax_paged_attention(
         kb = self_k.reshape(B, S, KV, hd).astype(jnp.float32)
         vb = self_v.reshape(B, S, KV, hd).astype(jnp.float32)
         s = jnp.einsum("bskgh,btkh->bkgst", qg, kb) * scale  # [B,KV,G,S,S]
-        causal = jnp.arange(S)[None, :] <= jnp.arange(S)[:, None]  # [Sq, Sk]
-        s = jnp.where(causal[None, None, None], s, NEG_INF)
+        if self_mask is None:
+            causal = (
+                jnp.arange(S)[None, :] <= jnp.arange(S)[:, None]
+            )  # [Sq, Sk]
+            s = jnp.where(causal[None, None, None], s, NEG_INF)
+        else:
+            # tree verify: node s sees exactly its trie ancestors + itself
+            s = jnp.where(self_mask[:, None, None], s, NEG_INF)
         m, l, acc = _online_fold(m, l, acc, s, vb, "bkgst,btkh->bkgsh")
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, hd).astype(q.dtype)
@@ -263,6 +287,169 @@ def _pallas_paged_attention(
     return out.reshape(B, S, H, hd)
 
 
+def _fused_kernel(
+    bt_ref, st_ref,  # scalar-prefetch: [B, MB] block table, [B] starts
+    q_ref, k_ref, v_ref, nk_ref, nv_ref,
+    o_ref, ok_ref, ov_ref, acc_ref, m_ref, l_ref,
+    *, scale: float, group: int, block_size: int, n_blocks: int,
+    max_s: int,
+):
+    """Decode-step (S=1) blocked kernel with the KV write fused in: at
+    the block holding ``starts[b]`` the kernel patches row ``starts%BS``
+    with this step's K/V in VMEM, attends the patched tile, and writes
+    the patched block through the aliased pool output — the only block
+    whose copy-out the revolving out buffer performs (the out index map
+    is constant in j). Untouched pool blocks survive via the aliasing."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    R = q_ref.shape[2]  # group query rows (S == 1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    start = st_ref[b]
+    jw = start // block_size
+    off = start % block_size
+    q = q_ref[0, 0]  # [R, hd]
+    k = k_ref[0, :, 0]  # [BS, hd]
+    v = v_ref[0, :, 0]
+    sel = (
+        lax.broadcasted_iota(jnp.int32, k.shape, 0) == off
+    ) & (j == jw)  # [BS, hd]
+    kj = jnp.where(sel, nk_ref[0, 0][None, :], k)
+    vj = jnp.where(sel, nv_ref[0, 0][None, :], v)
+
+    @pl.when(j == jw)
+    def _write():
+        ok_ref[0, :, 0] = kj
+        ov_ref[0, :, 0] = vj
+
+    s = lax.dot_general(
+        q, kj, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * (scale * LOG2E)  # [R, BS]
+    qpos = jnp.minimum(start, max_s - 1)
+    t = j * block_size + lax.broadcasted_iota(
+        jnp.int32, (R, block_size), 1
+    )
+    s = jnp.where(t <= qpos, s, NEG_INF)
+    m_prev = m_ref[:, :1]
+    m_new = jnp.maximum(
+        jnp.maximum(m_prev, s.max(axis=-1, keepdims=True)), -1e29
+    )
+    p = jnp.exp2(s - m_new)
+    corr = jnp.exp2(m_prev - m_new)
+    pv = lax.dot_general(
+        p.astype(vj.dtype), vj, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_ref[:] = acc_ref[:] * corr + pv
+    l_ref[:, :1] = l_ref[:, :1] * corr + p.sum(axis=-1, keepdims=True)
+    m_ref[:, :1] = m_new
+
+    @pl.when(j == n_blocks - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _pallas_paged_attention_fused(
+    q: jax.Array,  # [B, 1, H, hd]
+    k_pool: jax.Array,  # [NB, BS, KV, hd]
+    v_pool: jax.Array,
+    bt: jax.Array,  # [B, MB] int32
+    starts: jax.Array,  # [B] int32 (= the written position)
+    new_k: jax.Array,  # [B, KV, hd] this step's K
+    new_v: jax.Array,
+    interpret: bool,
+):
+    from jax.experimental.pallas import tpu as pltpu
+
+    TRACE_COUNT["pallas"] += 1
+    B, S, H, hd = q.shape
+    BS, KV = k_pool.shape[1], k_pool.shape[2]
+    MB = bt.shape[1]
+    group = H // KV
+    R = S * group
+    qr = q.reshape(B, S, KV, group, hd).transpose(0, 2, 1, 3, 4)
+    qr = qr.reshape(B, KV, R, hd)
+    kernel = lambda *refs: _fused_kernel(  # noqa: E731
+        *refs, scale=1.0 / math.sqrt(hd), group=group, block_size=BS,
+        n_blocks=MB, max_s=MB * BS,
+    )
+    pool_spec = pl.BlockSpec(
+        (1, BS, 1, hd), lambda b, g, j, bt, st: (bt[b, j], 0, g, 0)
+    )
+    # write-block spec: CONSTANT in j, so the revolving out buffer only
+    # copies the one dirty block back per (row, kv-head) group. Rows own
+    # their blocks exclusively (unowned entries all point at the trash
+    # block, where colliding writes are garbage by contract).
+    wb_spec = pl.BlockSpec(
+        (1, BS, 1, hd),
+        lambda b, g, j, bt, st: (bt[b, st[b] // BS], 0, g, 0),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KV, MB),
+        in_specs=[
+            pl.BlockSpec((1, 1, R, hd), lambda b, g, j, bt, st: (b, g, 0, 0)),
+            pool_spec,
+            pool_spec,
+            pl.BlockSpec((1, 1, hd), lambda b, g, j, bt, st: (b, g, 0)),
+            pl.BlockSpec((1, 1, hd), lambda b, g, j, bt, st: (b, g, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, R, hd), lambda b, g, j, bt, st: (b, g, 0, 0)
+            ),
+            wb_spec,
+            wb_spec,
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((R, hd), jnp.float32),
+            pltpu.VMEM((R, 128), jnp.float32),
+            pltpu.VMEM((R, 128), jnp.float32),
+        ],
+    )
+    out, kp, vp = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, KV, R, hd), q.dtype),
+            jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+            jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+        ],
+        # inputs count the 2 scalar-prefetch operands: 3/4 = the pools
+        input_output_aliases={3: 1, 4: 2},
+        compiler_params=getattr(
+            pltpu, "CompilerParams", pltpu.TPUCompilerParams
+        )(dimension_semantics=("arbitrary",) * 3),
+        interpret=interpret,
+    )(
+        bt.astype(jnp.int32), starts.astype(jnp.int32), qr, k_pool, v_pool,
+        new_k, new_v,
+    )
+    out = out.reshape(B, KV, S, group, hd).transpose(0, 2, 1, 3, 4)
+    return out.reshape(B, S, H, hd), kp, vp
+
+
+def _fused_write_lax(k_pool, v_pool, bt, starts, new_k, new_v):
+    """The scatter the decode call site used to dispatch separately,
+    folded behind the fused-call interface: write row b's step K/V at
+    ``(bt[b, starts//BS], starts % BS)``. Identical ops in identical
+    order to the old external scatter — bit-identical by construction."""
+    B = starts.shape[0]
+    BS = k_pool.shape[1]
+    blk = bt[jnp.arange(B), starts // BS]
+    off = starts % BS
+    return (
+        k_pool.at[blk, off].set(new_k),
+        v_pool.at[blk, off].set(new_v),
+    )
+
+
 def paged_attention(
     q: jax.Array,  # [B, S, H, hd]
     k_pool: jax.Array,  # [NB, BS, KV, hd] (one layer's pool)
@@ -272,34 +459,65 @@ def paged_attention(
     *,
     self_k: Optional[jax.Array] = None,  # [B, S, KV, hd] (read-only mode)
     self_v: Optional[jax.Array] = None,
+    self_mask: Optional[jax.Array] = None,  # [B, S, S] bool (tree verify)
+    new_k: Optional[jax.Array] = None,  # [B, KV, hd] (fused decode write)
+    new_v: Optional[jax.Array] = None,
     kernel: Optional[str] = None,  # None/"auto" | "lax" | "pallas"
     tile: int = DEFAULT_TILE,
     interpret: Optional[bool] = None,
-) -> jax.Array:
-    """Blocked paged attention over the pool — returns [B, S, H, hd].
+):
+    """Blocked paged attention over the pool — returns [B, S, H, hd],
+    or ``(out, k_pool, v_pool)`` when ``new_k``/``new_v`` carry a fused
+    decode-step KV write (S must be 1; the write lands at ``starts``).
 
     Query s of row b sits at global position ``min(starts[b]+s, max_s-1)``
     and sees pool keys at ``t <= posq`` — identical math to the gather
     oracle's masked dense attention, without ever building the gathered
     view. With ``self_k``/``self_v``, pool keys are restricted to
-    ``t < starts`` and the fresh suffix attends itself causally (the
-    read-only verify mode; lax path only — the pallas kernel serves the
-    write-path decode/verify hot loop).
+    ``t < starts`` and the fresh suffix attends itself under the causal
+    (default) or ``self_mask`` tree mask (the read-only verify modes;
+    lax path only — the pallas kernel serves the write-path decode hot
+    loop).
     """
     if kernel is None:
         kernel = DEFAULT_KERNEL
     if kernel == "auto":
         kernel = "pallas" if jax.default_backend() == "tpu" else "lax"
+    if kernel not in ("lax", "pallas"):
+        raise ValueError(f"unknown paged-attention kernel {kernel!r}")
+    if self_mask is not None and self_k is None:
+        raise ValueError("self_mask requires self_k/self_v")
+    if new_k is not None:
+        if self_k is not None:
+            raise ValueError("fused KV write excludes self_k/self_v")
+        if q.shape[1] != 1:
+            raise ValueError(
+                f"fused KV write is decode-only (S=1), got S={q.shape[1]}"
+            )
+        TRACE_COUNT["fused"] += 1
+        if kernel == "pallas":
+            if interpret is None:
+                interpret = _default_interpret()
+            return _pallas_paged_attention_fused(
+                q, k_pool, v_pool, bt, starts, new_k, new_v,
+                interpret=interpret,
+            )
+        k_pool, v_pool = _fused_write_lax(
+            k_pool, v_pool, bt, starts, new_k, new_v
+        )
+        out = _lax_paged_attention(
+            q, k_pool, v_pool, bt, starts, None, None, tile
+        )
+        return out, k_pool, v_pool
     if kernel == "pallas" and self_k is None:
         if interpret is None:
             interpret = _default_interpret()
         return _pallas_paged_attention(
             q, k_pool, v_pool, bt, starts, interpret=interpret
         )
-    if kernel not in ("lax", "pallas"):
-        raise ValueError(f"unknown paged-attention kernel {kernel!r}")
     return _lax_paged_attention(
-        q, k_pool, v_pool, bt, starts, self_k, self_v, tile
+        q, k_pool, v_pool, bt, starts, self_k, self_v, tile,
+        self_mask=self_mask,
     )
 
 

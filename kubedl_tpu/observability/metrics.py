@@ -559,6 +559,18 @@ class ServingMetrics:
             "+ first sampled id harvested), ms",
             buckets=_TTFT_MS_BUCKETS,
         )
+        self.queue_wait_ms = r.histogram(
+            "kubedl_tpu_serving_queue_wait_ms",
+            "Per-request admission queue wait (enqueue -> batch row "
+            "assigned), ms — the TTFT component chunked prefill bounds",
+            buckets=_TTFT_MS_BUCKETS,
+        )
+        self.admission_chunks = r.counter(
+            "kubedl_tpu_serving_admission_chunks",
+            "Prefill chunk dispatches under chunked admission (one "
+            "count per row per chunk, so chunks/rows ~= prompt_len / "
+            "prefill_chunk_tokens)",
+        )
         # controller-side replica health (the probe-failure satellite:
         # a replica that stops answering its stats probe must SURFACE,
         # not silently drop out of the QPS math)

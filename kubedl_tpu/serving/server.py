@@ -71,6 +71,12 @@ class _Slot:
         self.fed = 0  # inputs consumed (prompt + generated)
         self.pending = 0  # tokens dispatched on device, not yet harvested
         self.cached_len = 0  # prompt tokens grafted from the prefix cache
+        #: chunked-prefill progress: prompt tokens whose KV is committed
+        #: (grafted prefix + dispatched chunks); -1 = chunking not
+        #: started. Stays strictly below len(prompt) until the FINAL
+        #: chunk lands, which is also when ``fed`` jumps to the prompt
+        #: length and the row becomes a decoding row.
+        self.prefill_pos = -1
         self.pinned = None  # PrefixEntry pinned while this row uses it
         self.ttft_ms: Optional[float] = None
         self.out_ids: list = []
@@ -129,6 +135,8 @@ class LlamaEngine:
                  kv_attention: str = "gather",
                  spec_candidates: int = 1,
                  spec_draft_layers: int = 0,
+                 spec_tree: bool = False,
+                 prefill_chunk_tokens: int = 0,
                  role: str = "colocated",
                  advertise_prefix_len: int = 8,
                  handoff_ttl_s: float = 30.0) -> None:
@@ -180,6 +188,14 @@ class LlamaEngine:
                 "speculative decoding requires kv_layout='paged' (the "
                 "verify rollback frees rejected-suffix blocks in place)"
             )
+        #: tree speculation (docs/serving.md "Tree speculation"): fold
+        #: the N candidate chains into a prefix trie and score every
+        #: node in one read-only forward. Needs multi-candidate paged
+        #: speculation to mean anything — silently off otherwise (the
+        #: same normalization style as the mesh/paged interactions).
+        self.spec_tree = (
+            bool(spec_tree) and self.spec_k > 0 and self.spec_candidates > 1
+        )
         self.cfg = llama.preset(preset)
         self.max_seq = max_seq or min(self.cfg.max_seq, 512)
         self.max_batch = batch or max_batch
@@ -189,6 +205,19 @@ class LlamaEngine:
             bs = max(1, int(kv_block_size))
             self.kv_block_size = bs
             self.max_seq = ((self.max_seq + bs - 1) // bs) * bs
+        #: chunked prefill (docs/serving.md "Continuous batching"): > 0
+        #: caps the PROMPT tokens one scheduler tick may prefill, so
+        #: long prompts land block-sized chunk by chunk, interleaved
+        #: with decode segments, instead of stalling the whole running
+        #: batch for one giant forward. Paged-only (chunks must be
+        #: block-aligned to keep every block fully owned by one write).
+        pct = max(0, int(prefill_chunk_tokens))
+        if pct and self._paged:
+            pct = max(self.kv_block_size,
+                      (pct // self.kv_block_size) * self.kv_block_size)
+            self.prefill_chunk_tokens = pct
+        else:
+            self.prefill_chunk_tokens = 0
         params = llama.llama_init(jax.random.PRNGKey(0), self.cfg)
         if ckpt_dir and checkpoint.latest_step(ckpt_dir) is not None:
             state = checkpoint.restore_checkpoint(ckpt_dir, {"params": params})
@@ -368,6 +397,19 @@ class LlamaEngine:
                         self.params, self.cfg, n_layers=n_draft,
                         max_context=self.max_seq,
                     )
+                elif spec_draft.startswith("zoo:"):
+                    # trained small-model draft shaped by the planner
+                    # MODEL_ZOO; KUBEDL_SPEC_DRAFT_CKPT restores weights
+                    # saved after distillation (fresh weights propose
+                    # noise — harmless, just zero acceptance)
+                    from kubedl_tpu.serving.speculative import ModelDraft
+
+                    ckpt = os.environ.get("KUBEDL_SPEC_DRAFT_CKPT", "")
+                    self._draft = ModelDraft.from_zoo(
+                        spec_draft.split(":", 1)[1], self.cfg,
+                        ckpt_path=ckpt or None,
+                        max_context=self.max_seq,
+                    )
                 else:
                     self._draft = make_draft(spec_draft)
                 self._spec_stats = SpecStats()
@@ -387,10 +429,22 @@ class LlamaEngine:
                         kv_attention=self.kv_attention,
                     ),
                 ) if self.spec_candidates > 1 else None
+                #: tree scorer: like _verify_multi, READ-ONLY over the
+                #: trie layout; the walked winner goes back through the
+                #: standard write-path _verify. Fixed node budget
+                #: 1 + N*k -> one compile.
+                self._spec_tree_m = 1 + self.spec_candidates * self.spec_k
+                self._verify_tree = jax.jit(
+                    lambda p, c, t, pos, m, l, st: llama.paged_verify_tree(
+                        p, c, t, pos, m, l, st, self.cfg,
+                        kv_attention=self.kv_attention,
+                    ),
+                ) if self.spec_tree else None
             else:
                 self._draft = None
                 self._spec_stats = None
                 self._verify_multi = None
+                self._verify_tree = None
         else:
             self._cache = llama.init_batched_cache(
                 self.cfg, self.max_batch, self.max_seq
@@ -398,6 +452,7 @@ class LlamaEngine:
             self._draft = None
             self._spec_stats = None
             self._verify_multi = None
+            self._verify_tree = None
         from collections import deque as _deque
 
         self._slots: list = [None] * self.max_batch
@@ -491,6 +546,10 @@ class LlamaEngine:
         self._shed_recent: "deque[float]" = deque(maxlen=100_000)
         #: per-request time-to-first-token samples (ms) for p50/p95
         self._ttft_recent: "deque[float]" = deque(maxlen=4096)
+        #: per-request admission queue wait (enqueue -> admission), ms —
+        #: the half of TTFT chunked prefill is built to shrink, so it
+        #: gets its own p50/p95 in stats() and the Poisson bench arm
+        self._queue_wait_recent: "deque[float]" = deque(maxlen=4096)
         self.qps_window_s = 60.0
         self._warmup()
         self._thread = threading.Thread(
@@ -597,8 +656,15 @@ class LlamaEngine:
 
     def _trace_admitted_locked(self, s: _Slot, t_adm: float,
                                row: int) -> None:
-        """Record queue wait (enqueue → admission start) and the admission
-        work itself, parented under the request span. Caller holds cv."""
+        """Record queue wait (enqueue → admission start) — the stats()
+        percentile sample and metric for EVERY admission, plus the
+        engine.queue_wait/engine.admission spans when the request is
+        traced. Caller holds cv. Chunked admission changes nothing
+        here: a request is admitted once (the wait ends when its row is
+        assigned), however many prefill chunks follow."""
+        wait_ms = (t_adm - s.t0) * 1e3
+        self._queue_wait_recent.append(wait_ms)
+        self.metrics.queue_wait_ms.observe(wait_ms)
         if not s.span_id:
             return
         now = time.perf_counter()
@@ -729,6 +795,7 @@ class LlamaEngine:
             queued = len(self._waiting)
             active = sum(1 for s in self._slots if s is not None)
             ttft = list(self._ttft_recent)
+            qwait = list(self._queue_wait_recent)
             draining = self._draining
             parked_handoffs = len(self._handoffs)
         up = max(now - out["started_at"], 1e-9)
@@ -751,6 +818,12 @@ class LlamaEngine:
             srt = sorted(ttft)
             out["ttft_ms_p50"] = round(srt[len(srt) // 2], 3)
             out["ttft_ms_p95"] = round(
+                srt[min(len(srt) - 1, int(len(srt) * 0.95))], 3
+            )
+        if qwait:
+            srt = sorted(qwait)
+            out["queue_wait_ms_p50"] = round(srt[len(srt) // 2], 3)
+            out["queue_wait_ms_p95"] = round(
                 srt[min(len(srt) - 1, int(len(srt) * 0.95))], 3
             )
         if self._pcache is not None:
@@ -1794,6 +1867,124 @@ class LlamaEngine:
             self._cv.notify_all()
         return (t1 - t0) * 1e3, (time.perf_counter() - t1) * 1e3
 
+    def _prefill_chunks(self, todo, acct: Dict):
+        """Chunked-admission prefill dispatch (docs/serving.md
+        "Continuous batching"): spend at most ``prefill_chunk_tokens``
+        prompt tokens this tick across the not-yet-prefilled rows, FIFO
+        by arrival time so chunk scheduling preserves admission order at
+        chunk granularity. Every chunk goes through the suffix prefill
+        (`llama.paged_prefill_from`) at the row's committed position;
+        intermediate chunks are block-aligned (no KV block is ever
+        written by two dispatches) and touch nothing but the pool and
+        the pos mirror, so decode segments keep dispatching between
+        them. Only rows whose FINAL chunk lands this tick sample a
+        first token, join the device chain, and become decoding rows.
+        When the budget runs out mid-prompt the FIFO head keeps the
+        leftover — later arrivals never overtake it. Returns the
+        ``(pre, prefill_ids)`` pair the caller's deferred
+        `_harvest_prefill` consumes (final rows only)."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        bs = self.kv_block_size
+        left = self.prefill_chunk_tokens
+        sched = []  # (row, slot, base, take, final)
+        for i, s in sorted(todo, key=lambda t: t[1].t0):
+            if left <= 0:
+                break
+            base = s.prefill_pos if s.prefill_pos >= 0 else s.cached_len
+            rem = max(0, len(s.prompt) - base)
+            take = min(rem, left)
+            if take < rem:
+                take = (take // bs) * bs
+                if take <= 0:
+                    break
+            sched.append((i, s, base, take, base + take >= len(s.prompt)))
+            left -= take
+        if not sched:
+            return [], None
+        # injected chunk-dispatch fault: the scheduler must recover
+        # (fail in-flight slots, rebuild the donated cache, keep
+        # serving) exactly as for a decode-segment fault
+        chaos.check("serving.chunk_admit")
+        bucket = self._prefill_bucket(
+            max(max(t for _i, _s, _b, t, _f in sched), 1)
+        )
+        toks = np.zeros((self.max_batch, bucket), np.int32)
+        lens = np.zeros((self.max_batch,), np.int32)
+        starts = np.zeros((self.max_batch,), np.int32)
+        temps0 = np.zeros((self.max_batch,), np.float32)
+        saved = 0
+        for i, s, base, take, _final in sched:
+            toks[i, :take] = s.prompt[base:base + take]
+            lens[i] = take
+            starts[i] = base
+            temps0[i] = max(float(s.temperature), 0.0)
+            if s.prefill_pos < 0 and s.cached_len:
+                saved += s.cached_len  # first chunk rode a grafted prefix
+        self._key, pick_key = self._jax.random.split(self._key)
+        # host mirrors are authoritative — same contract as every dispatch
+        self._cache["pos"] = self._upload_mirror(self._pos_host)
+        self._cache["bt"] = self._upload_mirror(self._bt_host)
+        t0 = time.perf_counter()
+        logits, self._cache = self._prefill_from(
+            self.params, self._cache, jnp.asarray(toks),
+            jnp.asarray(lens), jnp.asarray(starts),
+        )
+        if saved:
+            if self._pcache is not None:
+                self._pcache.add_tokens_saved(saved)
+            self.metrics.prefix_tokens_saved.inc(saved)
+        self.metrics.admission_chunks.inc(len(sched))
+        prefill_ids = self._sample_logits(
+            logits, jnp.asarray(temps0), pick_key
+        )
+        final_rows = tuple(i for i, _s, _b, _t, f in sched if f)
+        if final_rows:
+            # only finishing rows carry a token into the device chain;
+            # intermediate chunks leave the chain (and its generation)
+            # alone, so in-flight decode feeds stay valid between chunks
+            self._prefill_gen += 1
+            mask = np.zeros((self.max_batch,), bool)
+            mask[list(final_rows)] = True
+            if self._chain is not None:
+                merged = self._merge_chain(
+                    self._chain[2], prefill_ids, jnp.asarray(mask)
+                )
+                self._chain = (
+                    self._prefill_gen,
+                    tuple(sorted(set(self._chain[1]) | set(final_rows))),
+                    merged,
+                )
+            else:
+                self._chain = (
+                    self._prefill_gen, final_rows, prefill_ids[:, None]
+                )
+        acct["dispatch_ms"] += (time.perf_counter() - t0) * 1e3
+        pre = []
+        with self._cv:
+            for i, s, base, take, final in sched:
+                # mirror the device's pos advance for dispatched rows
+                # (vacated rows get reset at readmission)
+                self._pos_host[i] = min(base + take, self.max_seq - 1)
+                if self._slots[i] is not s:
+                    continue  # vacated (request timeout) mid-chunk
+                s.prefill_pos = base + take
+                if s.prefill_t0 is None:
+                    s.prefill_t0 = t0  # first chunk starts the TTFT span
+                if not final:
+                    continue
+                s.fed = len(s.prompt)
+                budgeted = (
+                    s.max_tokens > 0
+                    and len(s.prompt) + len(s.out_ids)
+                    < self.max_seq - 1
+                )
+                if budgeted:
+                    s.pending += 1
+                pre.append((i, s, budgeted))
+        return pre, (prefill_ids if pre else None)
+
     def _spec_tick(self, decoding, acct: Dict) -> None:
         """One draft-k/verify-1 round over every greedy decoding row.
 
@@ -1822,12 +2013,13 @@ class LlamaEngine:
         import numpy as np
         import jax.numpy as jnp
 
-        from kubedl_tpu.serving.speculative import accept_length
+        from kubedl_tpu.serving.speculative import accept_length, build_tree
 
         k = self.spec_k
         S = k + 1
         N = self.spec_candidates
         multi = N > 1 and self._verify_multi is not None
+        tree = multi and self._verify_tree is not None
         draft_kind = getattr(self._draft, "name", self.spec_draft)
         # phase 1 — snapshot contexts under the lock, DRAFT OUTSIDE IT:
         # a model draft's forward must not stall admission/finalize.
@@ -1904,7 +2096,42 @@ class LlamaEngine:
         self._cache["pos"] = self._upload_mirror(self._pos_host)
         self._cache["bt"] = self._upload_mirror(self._bt_host)
         t0 = time.perf_counter()
-        if multi:
+        if tree:
+            # trie ranking pass (read-only, like multi): candidates
+            # sharing a prefix share trie nodes, one forward scores
+            # every node under its ancestor mask, and the deepest
+            # accepted root path becomes the write-path verify's draft
+            M = self._spec_tree_m
+            toks_tr = np.zeros((self.max_batch, M), np.int32)
+            pos_tr = np.zeros((self.max_batch, M), np.int32)
+            mask_tr = np.zeros((self.max_batch, M, M), bool)
+            mask_tr[:, np.arange(M), np.arange(M)] = True  # inactive rows
+            lens_tr = np.zeros((self.max_batch,), np.int32)
+            trees = {}
+            for i, s, dl in rows:
+                tr = build_tree(int(toks[i, 0]), dl, k, M)
+                trees[i] = tr
+                t_toks, t_dep, t_mask = tr.arrays(M)
+                toks_tr[i] = t_toks
+                pos_tr[i] = int(starts[i]) + t_dep
+                mask_tr[i] = t_mask
+                lens_tr[i] = tr.size
+            ids_tree = np.array(self._jax.device_get(self._verify_tree(
+                self.params, self._cache, jnp.asarray(toks_tr),
+                jnp.asarray(pos_tr), jnp.asarray(mask_tr),
+                jnp.asarray(lens_tr), jnp.asarray(starts),
+            )))  # [B, M]
+            for i, s, dl in rows:
+                path = trees[i].walk(ids_tree[i])
+                # the walk follows unique-token children, so it only
+                # leaves the greedy chain where that chain already
+                # mismatched — switching can never shorten acceptance
+                switched = bool(path) and path != dl[0][:len(path)]
+                self._spec_stats.record_candidates(trees[i].size, switched)
+                if switched:
+                    dl[0] = _pad(path, [toks[i, 0]])
+                    toks[i, 1:] = dl[0]
+        elif multi:
             # read-only ranking pass (cache neither donated nor written)
             ids_multi = np.array(self._jax.device_get(self._verify_multi(
                 self.params, self._cache, jnp.asarray(cand_toks),
@@ -2081,7 +2308,13 @@ class LlamaEngine:
         prefill_ids = None
         todo = [(i, s) for i, s in enumerate(active)
                 if s is not None and s.fed == 0]
-        if todo:
+        if todo and self.prefill_chunk_tokens:
+            # chunked admission: bounded prefill work per tick, rows
+            # join the running decode batch chunk by chunk
+            pre, prefill_ids = self._prefill_chunks(todo, acct)
+            with self._cv:
+                active = list(self._slots)
+        elif todo:
             # suffix-only prefill: rows with a grafted prefix consume only
             # prompt[cached_len:]. The bucket is sized by the LONGEST
             # suffix; `lax.dynamic_update_slice` CLAMPS out-of-bounds
@@ -2587,6 +2820,18 @@ def engine_kwargs(cfg: Dict, ckpt_dir: str) -> Dict:
             )
         ),
         "spec_draft_layers": int(cfg.get("spec_draft_layers", 0)),
+        "spec_tree": bool(
+            cfg.get(
+                "spec_tree",
+                os.environ.get("KUBEDL_SERVE_SPEC_TREE", "") == "1",
+            )
+        ),
+        "prefill_chunk_tokens": int(
+            cfg.get(
+                "prefill_chunk_tokens",
+                os.environ.get("KUBEDL_SERVE_PREFILL_CHUNK", "0"),
+            )
+        ),
         "role": cfg.get(
             "role", os.environ.get("KUBEDL_SERVE_ROLE", "colocated")
         ),

@@ -46,6 +46,17 @@ token (top-N draft logits, greedy continuations), with candidate 0
 always the pure-greedy proposal — which is why multi-candidate accepts
 at least as much as single-candidate on the same seeds.
 
+Tree speculation (:class:`DraftTree`) folds those N chains into a
+prefix TRIE before verification: chains sharing a prefix share trie
+nodes, so the verify window is the trie size (≤ 1 + N*k, typically far
+smaller) instead of the flat N*(k+1) multi-verify rows. The target
+scores every node in one read-only forward (`llama.paged_verify_tree`,
+per-node ancestor mask), the host walks the deepest accepted root path
+(:meth:`DraftTree.walk` — `accept_length` generalized to trees), and
+the engine re-verifies that winning path through the standard write
+path. Emission always comes from the write-path verify, so greedy
+output stays bit-identical to plain decode at every tree shape.
+
 A wrong draft can never corrupt output — it only wastes the verify
 forward — so draft quality is purely a throughput knob, measured by the
 acceptance rate the engine exports (`stats()["speculative"]` and the
@@ -199,6 +210,76 @@ class ModelDraft(DraftModel):
         draft_cfg = dataclasses.replace(cfg, n_layers=n)
         return cls(draft_params, draft_cfg, **kwargs)
 
+    @classmethod
+    def from_zoo(cls, name: str, target_cfg, seed: int = 0,
+                 ckpt_path: Optional[str] = None, **kwargs) -> "ModelDraft":
+        """A *trainable* small draft shaped by the planner MODEL_ZOO
+        entry ``name`` — its own weights, not a slice of the target's.
+        Vocab / max_seq / dtype come from the target (the draft proposes
+        target tokens); depth and widths from the zoo descriptor. Fresh
+        weights propose noise — ``ckpt_path`` restores a checkpoint
+        saved by :meth:`save` (e.g. after :func:`distill_draft`), which
+        is what makes this the trained-draft arm of the decode bench."""
+        import jax
+
+        from kubedl_tpu.models import llama
+        from kubedl_tpu.planner.costmodel import MODEL_ZOO
+
+        try:
+            desc = MODEL_ZOO[name]
+        except KeyError:
+            raise ValueError(
+                f"unknown zoo draft {name!r} (have: {sorted(MODEL_ZOO)})"
+            ) from None
+        heads = max(1, desc.hidden // 64)
+        cfg = llama.LlamaConfig(
+            vocab_size=target_cfg.vocab_size, dim=desc.hidden,
+            n_layers=desc.layers, n_heads=heads, n_kv_heads=heads,
+            ffn_dim=desc.ffn, max_seq=target_cfg.max_seq,
+            dtype=target_cfg.dtype, remat=False,
+        )
+        params = llama.llama_init(jax.random.PRNGKey(seed), cfg)
+        draft = cls(params, cfg, **kwargs)
+        draft.name = f"zoo:{name}"
+        if ckpt_path:
+            draft.load(ckpt_path)
+        return draft
+
+    def save(self, path: str) -> None:
+        """Flat-npz draft checkpoint (leaves in tree order). The draft
+        is one process's worth of small arrays — the sharded trainer
+        checkpoint machinery would be pure overhead here."""
+        import numpy as np
+
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(self.params)
+        np.savez(path, **{
+            f"leaf_{i}": np.asarray(jax.device_get(l))
+            for i, l in enumerate(leaves)
+        })
+
+    def load(self, path: str) -> None:
+        """Restore :meth:`save` output into the existing param tree
+        (shapes must match — the zoo descriptor pins them)."""
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        leaves, treedef = jax.tree_util.tree_flatten(self.params)
+        with np.load(path) as z:
+            new = []
+            for i, old in enumerate(leaves):
+                arr = z[f"leaf_{i}"]
+                if tuple(arr.shape) != tuple(old.shape):
+                    raise ValueError(
+                        f"draft checkpoint leaf {i} shape {arr.shape} != "
+                        f"model shape {tuple(old.shape)}"
+                    )
+                new.append(jnp.asarray(arr, old.dtype))
+        self.params = jax.tree_util.tree_unflatten(treedef, new)
+
     def _segment_fn(self, n_steps: int):
         import jax
 
@@ -296,6 +377,156 @@ class ModelDraft(DraftModel):
             [int(firsts_h[i])] + [int(t) for t in toks_h[i]]
             for i in range(n)
         ]
+
+
+class DraftTree:
+    """Prefix trie over candidate draft chains for tree speculation.
+
+    Node 0 is the ROOT: the row's next verify input (its last accepted
+    token), depth 0. Every other node is one proposed draft token; a
+    node's root path spells one draft prefix, and chains that share a
+    prefix share nodes — the whole reason the trie beats the flat
+    multi-candidate layout. :meth:`arrays` emits the fixed-size
+    (tokens, depth, ancestor-mask) layout `llama.paged_verify_tree`
+    consumes; :meth:`walk` follows the target's greedy ids down the
+    trie to the deepest accepted path."""
+
+    __slots__ = ("tokens", "parents", "depth", "children")
+
+    def __init__(self, root_token: int) -> None:
+        self.tokens: List[int] = [int(root_token)]
+        self.parents: List[int] = [-1]
+        self.depth: List[int] = [0]
+        self.children: List[Dict[int, int]] = [{}]
+
+    @property
+    def size(self) -> int:
+        return len(self.tokens)
+
+    def insert(self, chain: Sequence[int], m_max: int) -> None:
+        """Merge one candidate chain into the trie, capped at ``m_max``
+        total nodes (excess suffix tokens are dropped — never verified,
+        never emitted, so the cap only costs acceptance length)."""
+        cur = 0
+        for t in chain:
+            t = int(t)
+            nxt = self.children[cur].get(t)
+            if nxt is None:
+                if len(self.tokens) >= m_max:
+                    return
+                nxt = len(self.tokens)
+                self.tokens.append(t)
+                self.parents.append(cur)
+                self.depth.append(self.depth[cur] + 1)
+                self.children.append({})
+                self.children[cur][t] = nxt
+            cur = nxt
+
+    def arrays(self, m_max: int):
+        """Fixed-shape verify inputs: ``(tokens [m_max], depth [m_max],
+        mask [m_max, m_max])`` numpy arrays. ``mask[m, t]`` is True iff
+        t is m or an ancestor of m. Pad nodes repeat the root token as
+        depth-1 children of the root: well-formed rows whose outputs the
+        walk never reads, and — the masks being per-node — invisible to
+        every live node's attention."""
+        import numpy as np
+
+        M = len(self.tokens)
+        if M > m_max:
+            raise ValueError(f"trie size {M} exceeds m_max {m_max}")
+        toks = np.full((m_max,), self.tokens[0], np.int32)
+        dep = np.ones((m_max,), np.int32)
+        mask = np.zeros((m_max, m_max), bool)
+        toks[:M] = self.tokens
+        dep[:M] = self.depth
+        for m in range(M):
+            a = m
+            while a != -1:
+                mask[m, a] = True
+                a = self.parents[a]
+        for m in range(M, m_max):
+            mask[m, m] = True
+            mask[m, 0] = True
+        return toks, dep, mask
+
+    def walk(self, ids: Sequence[int]) -> List[int]:
+        """Deepest accepted path: starting at the root, repeatedly step
+        to the child whose token equals the target's greedy continuation
+        ``ids[cur]`` at the current node; stop when no child matches.
+        Returns the accepted DRAFT tokens along that path (root
+        excluded) — `accept_length` over a chain trie, exactly."""
+        path: List[int] = []
+        cur = 0
+        while True:
+            nxt = self.children[cur].get(int(ids[cur]))
+            if nxt is None:
+                return path
+            path.append(self.tokens[nxt])
+            cur = nxt
+
+
+def build_tree(
+    root_token: int, chains: Sequence[Sequence[int]], k: int, m_max: int
+) -> DraftTree:
+    """Fold candidate ``chains`` (each ≤ k draft tokens) into one
+    :class:`DraftTree`, inserting in order so candidate 0 — the greedy
+    proposal — is never the one truncated by the node cap."""
+    tree = DraftTree(root_token)
+    for c in chains:
+        tree.insert([int(t) for t in c][:k], m_max)
+    return tree
+
+
+def distill_draft(
+    draft: "ModelDraft",
+    target_params,
+    target_cfg,
+    prompts: Sequence[Sequence[int]],
+    gen_len: int = 16,
+    steps: int = 40,
+    lr: float = 1e-2,
+) -> List[float]:
+    """Train ``draft`` to imitate the target's GREEDY rollouts: generate
+    continuations with the target from each prompt, then fit the draft
+    with the standard next-token loss on the concatenated sequences
+    (hard-label distillation — exactly the objective that maximizes
+    greedy acceptance, which is all a draft is scored on). Mutates
+    ``draft.params`` in place and returns the per-step losses. CPU-scale
+    by design: the zoo drafts this trains are tiny."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubedl_tpu.models import llama
+
+    # the teacher IS a ModelDraft over the target weights: one batched
+    # prefill + greedy segment gives every rollout
+    teacher = ModelDraft(target_params, target_cfg,
+                         max_context=target_cfg.max_seq)
+    conts = teacher.propose_batch(prompts, gen_len)
+    seqs = [list(map(int, p)) + c for p, c in zip(prompts, conts)]
+    L = min(len(s) for s in seqs)
+    toks = jnp.asarray([s[:L] for s in seqs], jnp.int32)
+
+    opt = optax.adam(lr)
+    params = draft.params
+    opt_state = opt.init(params)
+    cfg = draft.cfg
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.value_and_grad(
+            lambda p: llama.llama_loss(p, toks, cfg)
+        )(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(max(1, int(steps))):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    draft.params = params
+    return losses
 
 
 _DRAFTS = {
@@ -411,4 +642,5 @@ class SpecStats:
 __all__ = [
     "DraftModel", "NgramDraft", "RepeatDraft", "ScriptedDraft",
     "ModelDraft", "make_draft", "accept_length", "SpecStats",
+    "DraftTree", "build_tree", "distill_draft",
 ]

@@ -31,9 +31,10 @@ PLANNER_ARTIFACT = "BENCH_r09_planner.json"
 #: sharded weight update + overlap row (r10): separate artifact, same
 #: runs[] shape (CPU proxy — see docs/performance.md)
 TRAINING_ARTIFACT = "BENCH_r10_training.json"
-#: blocked paged-attention decode + model-draft row (r11): separate
-#: artifact, same runs[] shape (CPU proxy — see docs/serving.md)
-DECODE_ARTIFACT = "BENCH_r11_decode.json"
+#: blocked paged-attention decode + model-draft + chunked-admission
+#: row (r16): separate artifact, same runs[] shape (CPU proxy — see
+#: docs/serving.md)
+DECODE_ARTIFACT = "BENCH_r16_decode.json"
 #: disaggregated prefill/decode fleet row (r12): separate artifact, same
 #: runs[] shape (CPU proxy — see docs/serving.md)
 DISAGG_ARTIFACT = "BENCH_r12_disagg.json"
@@ -203,7 +204,7 @@ def expected_training_strings(artifact: dict) -> dict:
 
 
 def expected_decode_strings(artifact: dict) -> dict:
-    """README blocked-decode row strings from BENCH_r11_decode.json."""
+    """README blocked-decode row strings from BENCH_r16_decode.json."""
     runs = artifact["runs"]
     tgt = ("targets", "decode")
     g12 = _runs_median(runs, *tgt, "raw", "b12", "gather_tokens_per_sec")
@@ -211,6 +212,10 @@ def expected_decode_strings(artifact: dict) -> dict:
     speedup = _runs_median(runs, *tgt, "raw", "b12", "blocked_speedup")
     macc = _runs_median(runs, *tgt, "spec", "model_acceptance")
     nacc = _runs_median(runs, *tgt, "spec", "ngram_acceptance")
+    t_slot = _runs_median(runs, *tgt, "openloop", "slot",
+                          "short_ttft_ms_p95")
+    t_chunk = _runs_median(runs, *tgt, "openloop", "chunked",
+                           "short_ttft_ms_p95")
     return {
         f"**{speedup:.2f}x** 12-way decode":
             "median of runs[].targets.decode.raw.b12.blocked_speedup",
@@ -221,6 +226,9 @@ def expected_decode_strings(artifact: dict) -> dict:
         f"{nacc * 100:.0f}%":
             "medians of runs[].targets.decode.spec."
             "model/ngram_acceptance",
+        f"p95 TTFT {t_slot:,.0f} -> {t_chunk:,.0f} ms":
+            "medians of runs[].targets.decode.openloop."
+            "slot/chunked.short_ttft_ms_p95",
     }
 
 

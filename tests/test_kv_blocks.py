@@ -511,3 +511,126 @@ class TestPagedEngine:
 
         with pytest.raises(ValueError):
             LlamaEngine(preset="tiny", kv_layout="interleaved")
+
+
+class TestChunkedAdmission:
+    """Continuous batching: prompts prefill in block-sized chunks
+    interleaved with decode, so admission latency is bounded by the
+    chunk budget instead of the longest queued prompt. The exactness
+    gate is unchanged — chunking may only change WHEN work happens."""
+
+    def test_chunked_bit_identical_to_slot_granularity(self):
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        prompts = [
+            [5, 9, 13],
+            list(range(1, 19)),
+            [7],
+            list(range(3, 40)),  # spans 3 chunks at budget 16
+        ]
+        results = {}
+        for name, kw in (("plain", {}),
+                         ("chunked", {"prefill_chunk_tokens": 16})):
+            eng = LlamaEngine(preset="tiny", max_batch=2, max_seq=64,
+                              kv_layout="paged", **kw)
+            try:
+                results[name] = [
+                    eng.generate(p, max_tokens=8)["token_ids"]
+                    for p in prompts
+                ]
+            finally:
+                eng.close()
+        assert results["chunked"] == results["plain"]
+
+    def test_chunk_budget_rounds_to_blocks(self):
+        """The knob is block-aligned (intermediate chunks must never
+        split a KV block across two dispatches) and paged-only."""
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        eng = LlamaEngine(preset="tiny", max_batch=2, max_seq=64,
+                          kv_layout="paged", kv_block_size=16,
+                          prefill_chunk_tokens=25)
+        try:
+            assert eng.prefill_chunk_tokens == 16  # floor to block size
+        finally:
+            eng.close()
+        eng = LlamaEngine(preset="tiny", max_batch=2, max_seq=64,
+                          kv_layout="paged", kv_block_size=16,
+                          prefill_chunk_tokens=7)
+        try:
+            assert eng.prefill_chunk_tokens == 16  # never below a block
+        finally:
+            eng.close()
+        eng = LlamaEngine(preset="tiny", max_batch=2, max_seq=64,
+                          kv_layout="contiguous", prefill_chunk_tokens=16)
+        try:
+            assert eng.prefill_chunk_tokens == 0  # paged-only
+        finally:
+            eng.close()
+
+    def test_admission_metrics_and_stats(self):
+        """Chunk dispatches are counted, and per-request queue wait
+        surfaces as p50/p95 in stats() — the number the chunk budget
+        exists to bound."""
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        eng = LlamaEngine(preset="tiny", max_batch=2, max_seq=64,
+                          kv_layout="paged", prefill_chunk_tokens=16)
+        try:
+            # 37 prompt tokens at budget 16 -> 3 chunks
+            eng.generate(list(range(3, 40)), max_tokens=4)
+            eng.generate([5, 9, 13], max_tokens=4)  # 1 chunk
+            body = eng.metrics.registry.render()
+            line = [l for l in body.splitlines()
+                    if l.startswith("kubedl_tpu_serving_admission_chunks ")]
+            assert line and float(line[0].split()[-1]) == 4.0, line
+            assert "kubedl_tpu_serving_queue_wait_ms" in body
+            st = eng.stats()
+            assert st["queue_wait_ms_p50"] >= 0.0
+            assert st["queue_wait_ms_p95"] >= st["queue_wait_ms_p50"]
+        finally:
+            eng.close()
+
+    def test_chunked_with_spec_and_prefix_cache(self):
+        """Chunked admission composes with speculation and prefix reuse
+        without perturbing outputs (repeat prompts ride the cache; their
+        FIRST chunk starts at the grafted length)."""
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        prompts = [list(range(1, 19)), list(range(1, 19)), [5, 9, 13]]
+        results = {}
+        for name, kw in (
+            ("plain", {}),
+            ("chunked", {"prefill_chunk_tokens": 16, "spec_k": 3}),
+        ):
+            eng = LlamaEngine(preset="tiny", max_batch=2, max_seq=64,
+                              kv_layout="paged", **kw)
+            try:
+                results[name] = [
+                    eng.generate(p, max_tokens=6)["token_ids"]
+                    for p in prompts
+                ]
+            finally:
+                eng.close()
+        assert results["chunked"] == results["plain"]
+
+    def test_chaos_chunk_admit_scheduler_survives(self):
+        """An injected fault at the chunk dispatch fails the in-flight
+        request loudly and the engine keeps serving — same contract as
+        `serving.dispatch` (docs/robustness.md)."""
+        from kubedl_tpu import chaos
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        eng = LlamaEngine(preset="tiny", max_batch=2, max_seq=64,
+                          kv_layout="paged", prefill_chunk_tokens=16)
+        try:
+            with chaos.FaultPlan(seed=7, sites={
+                "serving.chunk_admit": [chaos.FaultSpec.nth(1)],
+            }):
+                r1 = eng.generate([5, 9, 13], max_tokens=4)
+            assert "error" in r1, r1
+            r2 = eng.generate([5, 9, 13], max_tokens=4)
+            assert len(r2["token_ids"]) == 4
+            assert eng.stats()["kv_blocks"]["used"] == 0
+        finally:
+            eng.close()

@@ -1,6 +1,7 @@
 """End-to-end: operator + real pod processes (reference analogue: the kind
 e2e running a distributed TF mnist job, scripts/run_tf_test_job.sh)."""
 
+import os
 import sys
 import time
 
@@ -13,6 +14,21 @@ from kubedl_tpu.operator import Operator, OperatorOptions
 from kubedl_tpu.runtime.executor import SubprocessRuntime, ThreadRuntime
 
 from tests.helpers import make_tpujob
+
+def _phase_deadline(base: float) -> float:
+    """CPU-adaptive wait_for_phase deadline: multi-process worker gangs
+    (each a full python + jax import + compile) serialize on starved
+    boxes, so a deadline sized for a multi-core CI host times out on a
+    1-core one while the gang is still making progress. Scale the base
+    deadline by how far below 4 cores the box sits (measured: the
+    2-worker jax.distributed jobs finish in ~100s at 4 cores but need
+    ~5x that wall time at 1 core)."""
+    try:  # cgroup/affinity-aware (cpu_count ignores container quotas)
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cores = os.cpu_count() or 1
+    return base * (5 if cores < 2 else (2 if cores < 4 else 1))
+
 
 CHECK_ENV = (
     "import os,sys;"
@@ -217,7 +233,7 @@ def test_two_process_jax_distributed_rendezvous(tmp_path):
         got = op.wait_for_phase(
             "TPUJob", "dist2",
             [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
-            timeout=120,
+            timeout=_phase_deadline(120),
         )
         assert got.status.phase == JobConditionType.SUCCEEDED, [
             c.message for c in got.status.conditions
@@ -326,7 +342,7 @@ def test_shared_storage_two_worker_train_build_serve(tmp_path):
         got = op.wait_for_phase(
             "TPUJob", "shared2",
             [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
-            timeout=120,
+            timeout=_phase_deadline(120),
         )
         assert got.status.phase == JobConditionType.SUCCEEDED, [
             c.message for c in got.status.conditions

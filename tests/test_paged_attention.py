@@ -330,6 +330,317 @@ class TestModelParity:
         assert np.array_equal(np.asarray(full), np.asarray(part))
 
 
+class TestFusedKVWrite:
+    """The fused KV-write path: attention + current-step pool write in
+    one dispatch must be BIT-identical to the legacy scatter-then-attend
+    pair — output and pools — on both kernels. Anything weaker would let
+    the fused fast path drift from the semantics every other paged test
+    pins down."""
+
+    B, MB, BS, KV, H, hd = 4, 4, 16, 2, 4, 16
+
+    def _case(self, starts, seed=0):
+        import jax.numpy as jnp
+
+        from kubedl_tpu.models import paged_attention as pa
+
+        kp, vp, bt = _random_pool(seed, self.B, self.MB, self.BS,
+                                  self.KV, self.hd)
+        rng = np.random.RandomState(seed + 1)
+        q = rng.randn(self.B, 1, self.H, self.hd).astype(np.float32)
+        nk = rng.randn(self.B, self.KV, self.hd).astype(np.float32)
+        nv = rng.randn(self.B, self.KV, self.hd).astype(np.float32)
+        starts = np.asarray(starts, np.int32)
+        # reference: external scatter first, then the plain read path
+        kp2, vp2 = kp.copy(), vp.copy()
+        for b in range(self.B):
+            blk = bt[b, starts[b] // self.BS]
+            off = starts[b] % self.BS
+            kp2[blk, off] = nk[b]
+            vp2[blk, off] = nv[b]
+        for kern in ("lax", "pallas"):
+            ref = np.asarray(pa.paged_attention(
+                jnp.asarray(q), jnp.asarray(kp2), jnp.asarray(vp2),
+                jnp.asarray(bt), jnp.asarray(starts), kernel=kern,
+            ))
+            before = pa.TRACE_COUNT["fused"]
+            out, kpo, vpo = pa.paged_attention(
+                jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(bt), jnp.asarray(starts), kernel=kern,
+                new_k=jnp.asarray(nk), new_v=jnp.asarray(nv),
+            )
+            assert pa.TRACE_COUNT["fused"] == before + 1
+            assert np.array_equal(np.asarray(kpo), kp2), kern
+            assert np.array_equal(np.asarray(vpo), vp2), kern
+            assert np.array_equal(np.asarray(out), ref), kern
+
+    def test_ragged_rows_block_boundaries(self):
+        # positions spread across the table, including block boundaries
+        # (write lands in slot 0 of a block and slot BS-1)
+        self._case([0, 15, 16, 47])
+
+    def test_partial_tail_blocks(self):
+        self._case([3, 19, 35, 60], seed=7)
+
+    def test_fused_requires_single_query(self):
+        import jax.numpy as jnp
+
+        from kubedl_tpu.models import paged_attention as pa
+
+        kp, vp, bt = _random_pool(0, 1, 2, 16, 2, 16)
+        q = jnp.zeros((1, 2, 4, 16), jnp.float32)  # S=2: no fused form
+        with pytest.raises(ValueError):
+            pa.paged_attention(
+                q, jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt),
+                jnp.zeros((1,), jnp.int32),
+                new_k=jnp.zeros((1, 2, 16), jnp.float32),
+                new_v=jnp.zeros((1, 2, 16), jnp.float32),
+            )
+
+    def test_decode_step_uses_fused_write(self):
+        """`paged_decode_step_batched` on the blocked path must go
+        through the fused write — the whole point is retiring the
+        separate scatter dispatch per decode step — and still match the
+        gather path's greedy argmax."""
+        import jax
+        import jax.numpy as jnp
+
+        from kubedl_tpu.models import llama
+        from kubedl_tpu.models import paged_attention as pa
+
+        cfg = llama.preset("tiny")
+        params = llama.llama_init(jax.random.PRNGKey(0), cfg)
+        cache = llama.init_paged_cache(cfg, 2, 64, 9, 16)
+        cache["bt"] = jnp.arange(1, 9, dtype=jnp.int32).reshape(2, 4)
+        toks = jnp.asarray(np.array([[5, 9, 13, 0], [1, 2, 0, 0]], np.int32))
+        lens = jnp.asarray(np.array([3, 2], np.int32))
+        logits, cache = llama.paged_prefill_batched(
+            params, cache, toks, lens, cfg
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+        before = pa.TRACE_COUNT["fused"]
+        lb, cb = llama.paged_decode_step_batched(
+            params, dict(cache), nxt, cfg, kv_attention="blocked"
+        )
+        assert pa.TRACE_COUNT["fused"] > before
+        lg, cg = llama.paged_decode_step_batched(
+            params, dict(cache), nxt, cfg, kv_attention="gather"
+        )
+        assert np.array_equal(np.asarray(jnp.argmax(lb, -1)),
+                              np.asarray(jnp.argmax(lg, -1)))
+        # both paths committed the same K/V into the same pool slots
+        for f in ("k", "v"):
+            d = float(jnp.max(jnp.abs(cb[f] - cg[f])))
+            assert d < 1e-5, (f, d)
+
+
+class TestTreeVerify:
+    """`paged_verify_tree` vs the flat multi-candidate scorer, plus the
+    `paged_verify_multi` edges the tree path leans on. The pinned
+    equivalence is tree == multi (both self-contained read-only
+    forwards, so bit-exact agreement is a hard contract); the write-path
+    cross-check is at the id level, which is what the engine consumes."""
+
+    def _prefilled(self, batch=2):
+        import jax
+        import jax.numpy as jnp
+
+        from kubedl_tpu.models import llama
+
+        cfg = llama.preset("tiny")
+        params = llama.llama_init(jax.random.PRNGKey(0), cfg)
+        nb = 1 + batch * 4
+        cache = llama.init_paged_cache(cfg, batch, 64, nb, 16)
+        cache["bt"] = jnp.arange(1, nb, dtype=jnp.int32).reshape(batch, 4)
+        toks = np.zeros((batch, 4), np.int32)
+        toks[0, :3] = [5, 9, 13]
+        toks[1, :2] = [1, 2]
+        lens = jnp.asarray(np.array([3, 2] + [1] * (batch - 2), np.int32))
+        logits, cache = llama.paged_prefill_batched(
+            params, cache, jnp.asarray(toks), lens, cfg
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return llama, cfg, params, cache, np.asarray(nxt)
+
+    @staticmethod
+    def _tree_inputs(trees, starts, m_max):
+        from kubedl_tpu.serving.speculative import DraftTree  # noqa: F401
+
+        B = len(trees)
+        toks = np.zeros((B, m_max), np.int32)
+        pos = np.zeros((B, m_max), np.int32)
+        mask = np.zeros((B, m_max, m_max), bool)
+        lens = np.zeros((B,), np.int32)
+        for b, tr in enumerate(trees):
+            t, d, m = tr.arrays(m_max)
+            toks[b], mask[b] = t, m
+            pos[b] = starts[b] + d
+            lens[b] = tr.size
+        return toks, pos, mask, lens
+
+    @pytest.mark.parametrize("kern", ["gather", "blocked"])
+    def test_chain_trie_equals_multi(self, kern):
+        """A trie that IS a single chain must reproduce the flat
+        multi-verify scorer bit-exactly, node by node."""
+        import jax.numpy as jnp
+
+        from kubedl_tpu.serving.speculative import build_tree
+
+        llama, cfg, params, cache, nxt = self._prefilled()
+        chains = [[7, 7, 7], [9, 2, 4]]
+        starts = np.asarray(cache["pos"])
+        trees = [build_tree(int(nxt[b]), [chains[b]], k=3, m_max=4)
+                 for b in range(2)]
+        toks, pos, mask, lens = self._tree_inputs(trees, starts, 4)
+        tree_ids = np.asarray(llama.paged_verify_tree(
+            params, dict(cache), jnp.asarray(toks), jnp.asarray(pos),
+            jnp.asarray(mask), jnp.asarray(lens), jnp.asarray(starts),
+            cfg, kv_attention=kern,
+        ))
+        cands = np.stack([
+            np.concatenate([[int(nxt[b])], chains[b]]) for b in range(2)
+        ]).astype(np.int32)[:, None]  # [B, 1, 4]
+        multi = np.asarray(llama.paged_verify_multi(
+            params, dict(cache), jnp.asarray(cands),
+            jnp.asarray(np.full((2,), 4, np.int32)), jnp.asarray(starts),
+            cfg, kv_attention=kern,
+        ))
+        assert np.array_equal(tree_ids, multi[:, 0])
+
+    @pytest.mark.parametrize("kern", ["gather", "blocked"])
+    def test_branching_trie_leaf_paths_equal_per_chain_multi(self, kern):
+        """Chains sharing a prefix share trie nodes; every root->leaf
+        path's ids must still equal the flat per-chain verify of that
+        same path — sibling branches are invisible under the ancestor
+        mask."""
+        import jax.numpy as jnp
+
+        from kubedl_tpu.serving.speculative import build_tree
+
+        llama, cfg, params, cache, nxt = self._prefilled()
+        # candidates share first token 7: trie is 1 root + 5 nodes
+        chains = [[7, 3, 8], [7, 3, 2], [7, 5]]
+        starts = np.asarray(cache["pos"])
+        tr = build_tree(int(nxt[0]), chains, k=3, m_max=8)
+        assert tr.size == 6  # root + {7, 3, 8, 2, 5}
+        trees = [tr, build_tree(int(nxt[1]), [[9]], k=3, m_max=8)]
+        toks, pos, mask, lens = self._tree_inputs(trees, starts, 8)
+        tree_ids = np.asarray(llama.paged_verify_tree(
+            params, dict(cache), jnp.asarray(toks), jnp.asarray(pos),
+            jnp.asarray(mask), jnp.asarray(lens), jnp.asarray(starts),
+            cfg, kv_attention=kern,
+        ))
+        # flat comparison: all 3 chains of row 0 as padded candidates
+        S = 4
+        cands = np.zeros((2, 3, S), np.int32)
+        for n, c in enumerate(chains):
+            cands[0, n, 0] = int(nxt[0])
+            cands[0, n, 1:1 + len(c)] = c
+        cands[1, :, 0] = int(nxt[1])
+        cands[1, :, 1] = 9
+        multi = np.asarray(llama.paged_verify_multi(
+            params, dict(cache), jnp.asarray(cands),
+            jnp.asarray(np.array([S, 2], np.int32)), jnp.asarray(starts),
+            cfg, kv_attention=kern,
+        ))
+        # walk each chain through the trie, node ids must match the
+        # flat candidate's ids position-for-position
+        def node_path(tree, chain):
+            cur, out = 0, [0]
+            for t in chain:
+                cur = tree.children[cur][int(t)]
+                out.append(cur)
+            return out
+
+        for n, c in enumerate(chains):
+            for j, node in enumerate(node_path(tr, c)):
+                assert tree_ids[0, node] == multi[0, n, j], (n, j)
+        assert tree_ids[1, 0] == multi[1, 0, 0]
+        assert tree_ids[1, 1] == multi[1, 0, 1]
+
+    def test_multi_ragged_row_lengths(self):
+        """Rows verifying different suffix lengths in one batch: each
+        row's live prefix must match its own single-row verify — padding
+        on the short row cannot bleed into the long one."""
+        import jax.numpy as jnp
+
+        llama, cfg, params, cache, nxt = self._prefilled()
+        starts = np.asarray(cache["pos"])
+        cands = np.zeros((2, 2, 4), np.int32)
+        cands[:, :, 0] = nxt[:, None]
+        cands[0, 0, 1:] = [7, 7, 7]
+        cands[0, 1, 1:] = [3, 5, 8]
+        cands[1, 0, 1] = 9  # row 1 verifies only 2 live positions
+        cands[1, 1, 1] = 2
+        lens = np.array([4, 2], np.int32)
+        multi = np.asarray(llama.paged_verify_multi(
+            params, dict(cache), jnp.asarray(cands), jnp.asarray(lens),
+            jnp.asarray(starts), cfg,
+        ))
+        for b in range(2):
+            solo_cache = {
+                "k": cache["k"], "v": cache["v"],
+                "pos": cache["pos"][b:b + 1], "bt": cache["bt"][b:b + 1],
+            }
+            solo = np.asarray(llama.paged_verify_multi(
+                params, solo_cache, jnp.asarray(cands[b:b + 1]),
+                jnp.asarray(lens[b:b + 1]), jnp.asarray(starts[b:b + 1]),
+                cfg,
+            ))
+            L = int(lens[b])
+            assert np.array_equal(multi[b, :, :L], solo[0, :, :L]), b
+
+    def test_multi_duplicate_prefix_candidates(self):
+        """Two candidates agreeing on their first j tokens must score
+        identical ids at those positions (the determinism build_tree's
+        node sharing silently assumes)."""
+        import jax.numpy as jnp
+
+        llama, cfg, params, cache, nxt = self._prefilled()
+        starts = np.asarray(cache["pos"])
+        cands = np.zeros((2, 3, 4), np.int32)
+        cands[:, :, 0] = nxt[:, None]
+        cands[0, 0, 1:] = [7, 3, 8]
+        cands[0, 1, 1:] = [7, 3, 2]  # shares 2-token prefix with cand 0
+        cands[0, 2, 1:] = [7, 3, 8]  # full duplicate of cand 0
+        cands[1, 0, 1:] = [9, 9, 9]
+        cands[1, 1, 1:] = [9, 9, 9]
+        cands[1, 2, 1:] = [2, 4, 6]
+        lens = np.full((2,), 4, np.int32)
+        multi = np.asarray(llama.paged_verify_multi(
+            params, dict(cache), jnp.asarray(cands), jnp.asarray(lens),
+            jnp.asarray(starts), cfg,
+        ))
+        assert np.array_equal(multi[0, 0, :3], multi[0, 1, :3])
+        assert np.array_equal(multi[0, 0], multi[0, 2])
+        assert np.array_equal(multi[1, 0], multi[1, 1])
+
+    @pytest.mark.parametrize("kern", ["gather", "blocked"])
+    def test_multi_n1_degenerates_to_verify(self, kern):
+        """N=1 multi-verify must emit the same greedy ids as the
+        write-path `paged_verify` — the degenerate case where ranking
+        buys nothing and the engine behaves as plain speculation."""
+        import jax.numpy as jnp
+
+        llama, cfg, params, cache, nxt = self._prefilled()
+        starts = np.asarray(cache["pos"])
+        cands = np.zeros((2, 1, 4), np.int32)
+        cands[:, 0, 0] = nxt
+        cands[0, 0, 1:] = [7, 7, 7]
+        cands[1, 0, 1:] = [9, 9, 9]
+        lens = np.full((2,), 4, np.int32)
+        multi = np.asarray(llama.paged_verify_multi(
+            params, dict(cache), jnp.asarray(cands), jnp.asarray(lens),
+            jnp.asarray(starts), cfg, kv_attention=kern,
+        ))
+        write, _ = llama.paged_verify(
+            params, dict(cache), jnp.asarray(cands[:, 0]),
+            jnp.asarray(lens), jnp.asarray(starts), cfg,
+            kv_attention=kern,
+        )
+        assert np.array_equal(multi[:, 0], np.asarray(write)), kern
+
+
 class TestEngineParity:
     """Greedy token streams must be identical between kernels through the
     full engine — ragged prompts, trash rows (fresh admissions), and

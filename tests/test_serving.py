@@ -738,7 +738,9 @@ class TestShardedServing:
                       "kv_layout": "paged", "kv_block_size": 16,
                       "kv_blocks": 0, "spec_k": 0, "spec_draft": "ngram",
                       "kv_attention": "gather", "spec_candidates": 1,
-                      "spec_draft_layers": 0}
+                      "spec_draft_layers": 0, "spec_tree": False,
+                      "prefill_chunk_tokens": 0,
+                      "advertise_prefix_len": 8, "role": "colocated"}
         defaults = engine_kwargs({}, "")
         assert defaults["mesh_axes"] is None
         # load-shedding budget defaults ride the config too
@@ -1050,6 +1052,25 @@ class TestSchedulerMicrobench:
         assert out["blocks_leaked"] == 0, out
         assert out["tick_ms_p50"] <= PAGED_BUDGET_MS, out
         assert out["mirror_upload_ms"] <= PAGED_BUDGET_MS, out
+        assert out["within_budget"], out
+
+    def test_chunked_admission_within_budget(self):
+        """The FIFO chunk scheduler (continuous batching) is pure host
+        arithmetic on top of the paged tick — it must fit the same
+        per-tick envelope, dispatch exactly ceil(len/budget) chunks per
+        request, and leak no blocks."""
+        from scripts.scheduler_microbench import (
+            CHUNKED_BUDGET_MS,
+            run_chunked_admission_microbench,
+        )
+
+        out = run_chunked_admission_microbench(
+            requests=8, prompt_len=48, max_tokens=8, max_batch=4
+        )
+        assert out["tokens"] == 8 * 8
+        assert out["chunks"] == 8 * 3  # 48 tokens / 16-token budget
+        assert out["blocks_leaked"] == 0, out
+        assert out["tick_ms_p50"] <= CHUNKED_BUDGET_MS, out
         assert out["within_budget"], out
 
     def test_tracing_disarmed_within_budget(self):

@@ -249,3 +249,167 @@ class TestSpeculativeEngine:
             assert [r["token_ids"] for r in results] == want
         finally:
             eng.close()
+
+
+class TestDraftTree:
+    """Host-side trie unit tests: insert/dedup/cap, the fixed-shape
+    array layout, and the greedy walk."""
+
+    def _tree(self):
+        from kubedl_tpu.serving.speculative import build_tree
+
+        # chains sharing the 7 -> 3 prefix + one divergent chain
+        return build_tree(42, [[7, 3, 8], [7, 3, 2], [9, 1]], k=3, m_max=16)
+
+    def test_insert_dedups_shared_prefixes(self):
+        tr = self._tree()
+        # root + {7, 3, 8, 2, 9, 1}: the 7->3 prefix is stored once
+        assert tr.size == 7
+        assert tr.tokens[0] == 42 and tr.depth[0] == 0
+        n7 = tr.children[0][7]
+        n3 = tr.children[n7][3]
+        assert sorted(tr.children[n3]) == [2, 8]
+        assert tr.depth[n3] == 2
+
+    def test_cap_drops_excess_suffix_only(self):
+        from kubedl_tpu.serving.speculative import build_tree
+
+        tr = build_tree(42, [[7, 3, 8], [9, 1, 2]], k=3, m_max=5)
+        # candidate 0 fits whole (4 nodes); candidate 1 gets one node
+        assert tr.size == 5
+        assert 9 in tr.children[0]
+        n9 = tr.children[0][9]
+        assert tr.children[n9] == {}  # 1, 2 dropped by the cap
+
+    def test_k_truncates_chains(self):
+        from kubedl_tpu.serving.speculative import build_tree
+
+        tr = build_tree(42, [[7, 3, 8, 5, 6]], k=2, m_max=16)
+        assert tr.size == 3  # root + 7 + 3
+
+    def test_arrays_layout_and_pad_nodes(self):
+        import numpy as np
+
+        tr = self._tree()
+        toks, dep, mask = tr.arrays(10)
+        assert toks.shape == (10,) and mask.shape == (10, 10)
+        assert list(toks[:2]) == [42, 7]
+        # ancestor mask: leaf 8 sees root -> 7 -> 3 -> itself, nothing else
+        n8 = tr.children[tr.children[tr.children[0][7]][3]][8]
+        assert mask[n8].sum() == 4
+        assert mask[n8, 0] and mask[n8, n8]
+        # pad nodes: depth-1 root children repeating the root token,
+        # masked to themselves + root only
+        for m in range(tr.size, 10):
+            assert toks[m] == 42 and dep[m] == 1
+            assert mask[m].sum() == 2 and mask[m, 0] and mask[m, m]
+        # no live node attends a pad node
+        assert not mask[:tr.size, tr.size:].any()
+        with pytest.raises(ValueError):
+            tr.arrays(tr.size - 1)
+
+    def test_walk_follows_greedy_chain(self):
+        tr = self._tree()
+        ids = [0] * tr.size
+        n7 = tr.children[0][7]
+        n3 = tr.children[n7][3]
+        ids[0] = 7       # root's continuation matches child 7
+        ids[n7] = 3      # then 3
+        ids[n3] = 2      # then the 2 branch (not 8)
+        assert tr.walk(ids) == [7, 3, 2]
+        ids[n3] = 5      # no child matches: path stops at depth 2
+        assert tr.walk(ids) == [7, 3]
+        ids[0] = 1       # no root child matches at all
+        assert tr.walk(ids) == []
+
+
+class TestTreeSpeculativeEngine:
+    def test_tree_spec_bit_identical_to_plain_greedy(self):
+        """THE tree exactness gate: spec_tree=True changes how drafts
+        are scored, never the emitted tokens — outputs match the oracle
+        and the flat multi-candidate engine bit-for-bit."""
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        prompts = [[5, 9, 13], [1, 2, 3, 4, 5, 6, 7, 8, 9], [7]]
+        eng = LlamaEngine(preset="tiny", max_batch=2, max_seq=64,
+                          kv_layout="paged", spec_k=4, spec_candidates=3,
+                          spec_tree=True)
+        try:
+            assert eng._verify_tree is not None
+            for p in prompts:
+                got = eng.generate(p, max_tokens=10)
+                assert got["token_ids"] == _oracle(eng, p, 10), p
+            snap = eng.stats()["speculative"]
+            assert snap["verifies"] > 0
+            assert snap["candidates_scored"] > 0
+        finally:
+            eng.close()
+
+    def test_tree_needs_candidates(self):
+        """spec_tree quietly degrades to flat verify when there is
+        nothing to branch on (one candidate) or no speculation at all."""
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        eng = LlamaEngine(preset="tiny", max_batch=2, max_seq=64,
+                          kv_layout="paged", spec_k=4, spec_tree=True)
+        try:
+            assert eng.spec_tree is False
+            assert eng._verify_tree is None
+        finally:
+            eng.close()
+
+
+class TestZooDraft:
+    def test_from_zoo_and_engine_exactness(self):
+        """A trained-architecture draft from MODEL_ZOO drives the engine
+        and stays bit-exact (acceptance may be poor at random init; the
+        accept rule keeps the output the target's own)."""
+        from kubedl_tpu.serving.server import LlamaEngine
+
+        eng = LlamaEngine(preset="tiny", max_batch=2, max_seq=64,
+                          kv_layout="paged", spec_k=3,
+                          spec_draft="zoo:tiny")
+        try:
+            assert eng._draft.name == "zoo:tiny"
+            p = [5, 9, 13]
+            assert eng.generate(p, max_tokens=8)["token_ids"] == \
+                _oracle(eng, p, 8)
+        finally:
+            eng.close()
+
+    def test_save_load_roundtrip(self, tmp_path):
+        import jax
+        import numpy as np
+
+        from kubedl_tpu.models import llama
+        from kubedl_tpu.serving.speculative import ModelDraft
+
+        cfg = llama.preset("tiny")
+        d = ModelDraft.from_zoo("tiny", cfg, seed=3, max_context=64)
+        path = str(tmp_path / "draft.npz")
+        d.save(path)
+        d2 = ModelDraft.from_zoo("tiny", cfg, seed=9, ckpt_path=path,
+                                 max_context=64)
+        for a, b in zip(jax.tree_util.tree_leaves(d.params),
+                        jax.tree_util.tree_leaves(d2.params)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_distill_reduces_loss(self):
+        """A few hard-label distillation steps against the target's own
+        rollouts must drive the draft's loss down — the training loop
+        that turns a zoo architecture into a useful draft."""
+        import jax
+
+        from kubedl_tpu.models import llama
+        from kubedl_tpu.serving.speculative import (
+            ModelDraft,
+            distill_draft,
+        )
+
+        cfg = llama.preset("tiny")
+        target = llama.llama_init(jax.random.PRNGKey(0), cfg)
+        d = ModelDraft.from_zoo("tiny", cfg, max_context=64)
+        losses = distill_draft(d, target, cfg, [[5, 9, 13], [1, 2, 3]],
+                               gen_len=4, steps=3)
+        assert len(losses) == 3
+        assert losses[-1] < losses[0]
