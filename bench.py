@@ -1711,6 +1711,141 @@ def bench_tracing(on_tpu: bool) -> dict:
     return out
 
 
+def bench_rollout(on_tpu: bool) -> dict:
+    """Model-lifecycle round (docs/serving.md "Model lifecycle"): weight
+    hot-swap cost and two-version co-residency overhead on one engine.
+
+    Arms: (1) hot-load a second version while measuring nothing — the
+    build runs off the dispatch path, and the serving outputs before/
+    after must stay bit-identical; (2) single-version decode tokens/s at
+    B-way concurrency vs the SAME offered load split 50/50 across the
+    two co-resident versions. The scheduler dispatches one version per
+    tick (a mixed batch would blend weights), so the mix pays a real
+    throughput price — this bench pins how much, and the gate keeps it
+    from silently regressing into unusability. Best-of-2 per arm.
+
+    Gates: outputs bit-identical through load and retire; mixed-version
+    throughput >= 25% of single-version (per-tick alternation costs
+    about half at small batch; below a quarter the canary path would be
+    too slow to actually roll out through)."""
+    import tempfile as _tf
+    import threading as _th
+
+    import numpy as _np
+
+    from kubedl_tpu.serving.server import LlamaEngine
+
+    preset = "gemma-2b" if on_tpu else "tiny"
+    max_seq = 256
+    gen = 48
+    prompt_len = 12
+    B = 8
+    n_req = 3 * B
+    out = {"model": preset, "max_seq": max_seq, "gen_tokens": gen,
+           "prompt_len": prompt_len, "concurrency": B}
+    gates = {}
+
+    rng = _np.random.default_rng(0)
+    prompts = [
+        [int(t) for t in rng.integers(1, 200, size=prompt_len)]
+        for _ in range(n_req)
+    ]
+
+    def drive(eng, versions):
+        done = []
+        lock = _th.Lock()
+        nxt = [0]
+
+        def worker():
+            while True:
+                with lock:
+                    if nxt[0] >= len(prompts):
+                        return
+                    i = nxt[0]
+                    nxt[0] += 1
+                r = eng.generate(list(prompts[i]), max_tokens=gen,
+                                 temperature=0.0, timeout_s=600,
+                                 model_version=versions[i])
+                with lock:
+                    done.append((versions[i], r))
+
+        ths = [_th.Thread(target=worker, daemon=True) for _ in range(B)]
+        t0 = time.perf_counter()
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        wall = time.perf_counter() - t0
+        toks = sum(len(r["token_ids"]) for _, r in done)
+        return round(toks / wall, 1), done
+
+    single_vers = [""] * n_req
+    mixed_vers = ["" if i % 2 == 0 else "v2" for i in range(n_req)]
+
+    eng = LlamaEngine(preset=preset, max_batch=B, max_seq=max_seq,
+                      prefix_cache_mb=0)
+    with _tf.TemporaryDirectory() as tmp:
+        try:
+            import jax as _jax
+
+            from kubedl_tpu.models import llama as _llama
+            from kubedl_tpu.training.checkpoint import save_checkpoint
+
+            drive(eng, single_vers)  # untimed warm pass
+            ref = eng.generate(list(prompts[0]), max_tokens=gen,
+                               temperature=0.0, timeout_s=600)
+
+            # arm 1: the hot swap itself (restore -> quantize -> commit)
+            p2 = _llama.llama_init(_jax.random.PRNGKey(0), eng.cfg)
+            p2 = _jax.tree_util.tree_map(lambda x: x * 1.5, p2)
+            save_checkpoint(tmp, {"params": p2}, 1)
+            t0 = time.perf_counter()
+            eng.load_version("v2", tmp)
+            out["hot_swap_load_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 1)
+            after = eng.generate(list(prompts[0]), max_tokens=gen,
+                                 temperature=0.0, timeout_s=600)
+            gates["bit_identical_through_load"] = (
+                after["token_ids"] == ref["token_ids"]
+            )
+            ref_v2 = eng.generate(list(prompts[0]), max_tokens=gen,
+                                  temperature=0.0, timeout_s=600,
+                                  model_version="v2")
+
+            # arm 2: single-version vs 50/50 two-version mix
+            single = max(drive(eng, single_vers)[0] for _ in range(2))
+            mixed_best = max(drive(eng, mixed_vers)[0] for _ in range(2))
+            # bit-identity under mixed traffic: after co-resident load,
+            # each version still reproduces its own reference output
+            mix0 = eng.generate(list(prompts[0]), max_tokens=gen,
+                                temperature=0.0, timeout_s=600)
+            mix2 = eng.generate(list(prompts[0]), max_tokens=gen,
+                                temperature=0.0, timeout_s=600,
+                                model_version="v2")
+            mix_identical = (mix0["token_ids"] == ref["token_ids"]
+                             and mix2["token_ids"] == ref_v2["token_ids"])
+
+            # drain-then-evict: retire v2, base still bit-identical
+            eng.retire_version("v2")
+            eng.generate([2], max_tokens=1)  # admission pass evicts
+            final = eng.generate(list(prompts[0]), max_tokens=gen,
+                                 temperature=0.0, timeout_s=600)
+            gates["bit_identical_through_retire"] = (
+                final["token_ids"] == ref["token_ids"]
+            )
+            gates["mix_bit_identical"] = mix_identical
+        finally:
+            eng.close()
+
+    out["single_version_tokens_per_sec"] = single
+    out["mixed_version_tokens_per_sec"] = mixed_best
+    out["mixed_over_single"] = round(mixed_best / single, 4)
+    gates["mix_at_least_quarter"] = mixed_best >= 0.25 * single
+    out["gates"] = gates
+    out["ok"] = all(gates.values())
+    return out
+
+
 def bench_router_availability(on_tpu: bool) -> dict:
     """Serving-router availability through a replica kill (docs/serving.md
     "Router"): three engine replicas behind the router under steady client
@@ -2308,6 +2443,23 @@ def main() -> int:
         d = bench_tracing(_jax.default_backend() == "tpu")
         print(json.dumps({
             "runs": [{"detail": {"targets": {"tracing": d}}}],
+        }, indent=2))
+        return 0 if d["ok"] else 1
+    if "--rollout" in sys.argv[1:]:
+        # standalone model-lifecycle round (BENCH_r17_rollout.json):
+        # weight hot-swap wall-time plus single-version vs 50/50
+        # two-version decode throughput on one engine, in the same
+        # runs[] shape check_readme_numbers reads; the gates (bit-
+        # identity through load/mix/retire, mix >= 25% of single)
+        # decide the exit code
+        from kubedl_tpu.utils.jaxenv import ensure_cpu_if_requested
+
+        ensure_cpu_if_requested()
+        import jax as _jax
+
+        d = bench_rollout(_jax.default_backend() == "tpu")
+        print(json.dumps({
+            "runs": [{"detail": {"targets": {"rollout": d}}}],
         }, indent=2))
         return 0 if d["ok"] else 1
     if "--ps" in sys.argv[1:]:

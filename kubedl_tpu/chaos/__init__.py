@@ -14,6 +14,7 @@ from kubedl_tpu.chaos.plan import (
     arm,
     check,
     disarm,
+    plan_from_config,
     should_fail,
     sites,
 )
@@ -30,6 +31,7 @@ __all__ = [
     "arm",
     "check",
     "disarm",
+    "plan_from_config",
     "should_fail",
     "sites",
 ]

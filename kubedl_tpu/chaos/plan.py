@@ -19,9 +19,11 @@ Injection sites wired in this repo::
     client.http                                  console client transport
     remote.request                               blob-server transport
     serving.dispatch                             device segment dispatch
+    serving.canary_dispatch                      non-default-version dispatch tick
     serving.kv_alloc                             KV block allocation failure
     serving.kv_handoff                           KV handoff transfer failure
     serving.chunk_admit                          chunked-prefill admission dispatch
+    serving.weight_swap                          corrupt/torn weight load or mid-swap crash
     checkpoint.torn                              die between shard + manifest
     store.wal_append                             torn WAL record (half-write)
     store.wal_fsync                              fail the WAL fsync syscall
@@ -69,9 +71,11 @@ SITES: Dict[str, str] = {
     "client.http": "console client transport",
     "remote.request": "blob-server transport",
     "serving.dispatch": "device segment dispatch",
+    "serving.canary_dispatch": "non-default-version dispatch tick",
     "serving.kv_alloc": "KV block allocation failure",
     "serving.kv_handoff": "KV handoff transfer failure",
     "serving.chunk_admit": "chunked-prefill admission dispatch",
+    "serving.weight_swap": "corrupt/torn weight load or mid-swap crash",
     "checkpoint.torn": "die between shard + manifest",
     "store.wal_append": "torn WAL record (half-write)",
     "store.wal_fsync": "fail the WAL fsync syscall",
@@ -223,6 +227,45 @@ class FaultPlan:
 
     def __exit__(self, *exc) -> None:
         disarm()
+
+
+def plan_from_config(cfg: Dict,
+                     sleep: Callable[[float], None] = time.sleep,
+                     ) -> FaultPlan:
+    """Build a :class:`FaultPlan` from a JSON-shaped dict, so a replica
+    SUBPROCESS can arm a seeded schedule it cannot receive as an
+    in-process context manager (``KUBEDL_SERVE_CONFIG["chaos"]`` — the
+    rollout drill seeds a latency fault on a canary replica this way)::
+
+        {"seed": 7, "sites": {"serving.canary_dispatch":
+            [{"mode": "latency", "latency_ms": 250, "every": 1}]}}
+
+    Unknown sites and modes raise ``ValueError`` at build time — a
+    typo'd drill must fail at arm, not silently never fire."""
+    seed = int(cfg.get("seed", 0))
+    plan = FaultPlan(seed, sleep=sleep)
+    for site, specs in dict(cfg.get("sites") or {}).items():
+        if site not in SITES:
+            raise ValueError(f"unknown chaos site {site!r}")
+        for raw in specs:
+            mode = str(raw.get("mode", ""))
+            if mode == "latency":
+                spec = FaultSpec.latency(float(raw["latency_ms"]),
+                                         every=int(raw.get("every", 1)))
+            elif mode == "nth":
+                spec = FaultSpec.nth(int(raw["n"]))
+            elif mode == "first":
+                spec = FaultSpec.first(int(raw["k"]))
+            elif mode == "prob":
+                spec = FaultSpec.prob(float(raw["p"]), int(raw["k"]))
+            elif mode == "always":
+                spec = FaultSpec.always()
+            else:
+                raise ValueError(
+                    f"unknown chaos spec mode {mode!r} at {site!r}"
+                )
+            plan.add(site, spec)
+    return plan
 
 
 # ---- module-level registry (the near-zero-cost fast path) ----------------

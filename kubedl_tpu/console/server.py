@@ -549,6 +549,11 @@ class ConsoleServer:
                 "storage_root": mv.storage_root,
                 "created_by": mv.created_by,
                 "created_at": mv.metadata.creation_timestamp,
+                # rollout provenance: which version this one supersedes
+                # and the weight-artifact identity the canary actually
+                # served (a rollback postmortem starts from these two)
+                "parent_version": mv.parent_version,
+                "checkpoint_fingerprint": mv.checkpoint_fingerprint,
             })
         models = []
         for m in self.operator.store.list("Model", namespace=None):

@@ -1026,13 +1026,32 @@ class JobEngine:
             return
         spec_ref = job.spec.model_version
         assert spec_ref is not None
+        model_name = spec_ref.model_name or job.metadata.name
+        storage_root = spec_ref.storage_root or constants.DEFAULT_MODEL_PATH
+        # lineage is recorded AT registration: the parent is whatever the
+        # Model pointed at when this version was published, and the
+        # fingerprint pins the artifact bytes the training run produced
+        # (best-effort — a remote root fingerprints at build time instead)
+        parent = ""
+        model = self.store.try_get("Model", model_name, job.metadata.namespace)
+        if model is not None:
+            parent = getattr(model, "latest_version", "") or ""
+        fingerprint = ""
+        try:
+            from kubedl_tpu.training.checkpoint import checkpoint_fingerprint
+
+            fingerprint = checkpoint_fingerprint(storage_root)
+        except OSError:
+            pass
         mv = ModelVersion(
-            model_name=spec_ref.model_name or job.metadata.name,
+            model_name=model_name,
             image_repo=spec_ref.image_repo,
-            storage_root=spec_ref.storage_root or constants.DEFAULT_MODEL_PATH,
+            storage_root=storage_root,
             storage_provider=spec_ref.storage_provider,
             created_by=f"{self.controller.KIND}/{job.metadata.name}",
             node_name=self.controller.get_node_for_model_output(ctx.pods) or "",
+            parent_version=parent,
+            checkpoint_fingerprint=fingerprint,
         )
         mv.metadata.name = mv_name
         mv.metadata.namespace = job.metadata.namespace

@@ -43,6 +43,16 @@ class ModelVersion(BaseObject):
     #: job.go:341-382).
     node_name: str = ""
     created_by: str = ""  # "<Kind>/<job-name>"
+    # -- lineage (recorded at registration, immutable afterwards) --
+    #: Name of the Model's latest version at registration time — the
+    #: version this one was trained from / supersedes ("" for the first).
+    parent_version: str = ""
+    #: Content fingerprint of the checkpoint artifact at registration
+    #: (training.checkpoint.checkpoint_fingerprint over the latest step:
+    #: manifest + shard digests). Serving and rollout tooling compare it
+    #: against what they actually loaded, so a swapped or truncated
+    #: artifact is detectable after the fact.
+    checkpoint_fingerprint: str = ""
     # -- status --
     phase: ModelVersionPhase = ModelVersionPhase.PENDING
     image: str = ""  # final image ref "repo:v<uid5>"

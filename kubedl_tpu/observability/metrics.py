@@ -682,6 +682,29 @@ class RouterMetrics:
             "Disagg-eligible requests that fell back to role-blind "
             "colocated dispatch (a leg failed or a pool was empty)",
         )
+        # model-version canary family (kubedl_tpu/serving/rollout.py):
+        # per-version routing outcomes plus the rollout controller's
+        # weight/burn/decision surfaces
+        self.version_requests = r.counter(
+            "kubedl_tpu_router_version_requests",
+            "Requests routed per model version (result=ok|error) — the "
+            "canary's request split observed, not configured",
+        )
+        self.rollout_weight = r.gauge(
+            "kubedl_tpu_router_rollout_weight",
+            "Configured canary traffic weight per model version (the "
+            "router's version WRR input, 0-100)",
+        )
+        self.version_burning = r.gauge(
+            "kubedl_tpu_router_version_burning",
+            "1 when a model version's own SLO partition has BOTH burn "
+            "windows above threshold, by version+severity, else 0",
+        )
+        self.rollout_events = r.counter(
+            "kubedl_tpu_router_rollout_events",
+            "Rollout controller decisions (event=advance|promote|"
+            "rollback|fence_cleared)",
+        )
 
 
 class SLOMetrics:

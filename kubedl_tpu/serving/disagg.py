@@ -66,6 +66,11 @@ class KVHandoff:
     #: the prefill request span) — lets an adopter with no HTTP header
     #: of its own still attach its spans to the caller's trace
     trace: str = ""
+    #: weight version the prefill ran on (docs/serving.md "Model
+    #: lifecycle"): the adopter decodes on exactly this version or
+    #: rejects the handoff — disagg legs never mix versions. "" keeps
+    #: pre-versioning artifacts adoptable (engine default).
+    model_version: str = ""
 
     @property
     def nbytes(self) -> int:
@@ -87,6 +92,7 @@ class KVHandoff:
             "cache_prefix": bool(self.cache_prefix),
             "ttft_ms": self.ttft_ms,
             "trace": self.trace,
+            "model_version": self.model_version,
             "dtype": str(self.k.dtype),
             "shape": list(self.k.shape),
         }).encode()
@@ -127,6 +133,7 @@ class KVHandoff:
             cache_prefix=bool(header.get("cache_prefix", False)),
             ttft_ms=header.get("ttft_ms"),
             trace=header.get("trace", ""),
+            model_version=header.get("model_version", ""),
         )
 
 
@@ -350,11 +357,12 @@ class DisaggCoordinator:
     def generate(self, prompt_ids, max_tokens: int = 16,
                  temperature: float = 0.0, timeout_s: float = 600.0,
                  cache_prefix: bool = False, request_id: str = "",
-                 trace=None) -> Dict:
+                 trace=None, model_version: str = "") -> Dict:
         h = self.prefill.prefill_handoff(
             prompt_ids, max_tokens=max_tokens, temperature=temperature,
             timeout_s=timeout_s, cache_prefix=cache_prefix,
             request_id=request_id, trace=trace,
+            model_version=model_version,
         )
         if self.serialize:
             h = KVHandoff.from_bytes(h.to_bytes())

@@ -1,0 +1,250 @@
+"""SLO-burn-gated canary rollout with auto-rollback.
+
+The model-lifecycle tentpole's control loop (docs/serving.md "Model
+lifecycle"): shifting traffic to a new model version is a *rollout*, not
+a weight edit. The controller walks the canary through a weight ladder
+(1 -> 10 -> 50 -> 100 by default), soaking at each step, and gates every
+advance on the canary's OWN error-budget burn — the router partitions
+its SLO tracking per model version (``ServingRouter.version_tracker``),
+so a canary melting down at 1% weight cannot hide inside a healthy
+aggregate. The decision rules:
+
+- **Advance** — the soak timer elapsed at the current step and no
+  gating burn alert fires on the canary partition.
+- **Promote** — the soak at the final step (100) elapsed clean: the
+  canary owns all traffic and the rollout is Complete.
+- **Rollback** — the canary partition's burn alert at the gating
+  severity fires (BOTH windows above threshold — the same SRE
+  multi-window rule the fleet pages on). Rollback is ONE weight flip
+  back to the baseline: in-flight canary requests finish (version
+  stickiness — a request never changes version mid-flight), new
+  requests route to the baseline, and the engines' drain-then-evict
+  hot-swap reclaims the canary weights once the last row drains.
+
+A rolled-back version is **fenced**: ``begin()`` refuses to promote it
+again until an operator calls ``clear_fence`` — an auto-rollback that
+could be auto-retried would flap the fleet against a genuinely bad
+model. The ``RolledBack`` condition carries the burning severity, the
+offending window pair with their burn rates, and the tracker's
+last-bad-trace-id exemplar, so the postmortem starts from the condition
+itself (``/v1/trace?trace_id=...``), not from log archaeology.
+
+Everything is clock-injectable; the verify drive
+(scripts/verify-drives/drive_rollout.py) runs the loop in real time over
+a real subprocess fleet with a seeded latency fault in the canary.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+log = logging.getLogger("kubedl_tpu.serving.rollout")
+
+#: The default canary weight ladder (percent of traffic).
+DEFAULT_STEPS: Tuple[int, ...] = (1, 10, 50, 100)
+
+#: RolloutController.phase values.
+PENDING = "Pending"
+PROGRESSING = "Progressing"
+COMPLETE = "Complete"
+ROLLED_BACK = "RolledBack"
+
+
+class RolloutFenced(Exception):
+    """begin() refused: the canary version was auto-rolled-back before
+    and its fence has not been manually cleared."""
+
+
+class RolloutController:
+    """Drives one canary rollout of ``canary_version`` against
+    ``baseline_version`` on a :class:`ServingRouter`.
+
+    ``tick()`` is the whole control loop — call it on any cadence (the
+    drive uses ~1s; a k8s controller would hang it off its resync). Each
+    tick refreshes the canary's SLO partition, publishes the per-version
+    burning gauges, and takes at most one action: rollback, advance, or
+    promote. ``severity`` picks which burn-alert pair gates the rollout
+    (default ``page`` — the 14.4x 5m+1h pair under default alerts).
+    """
+
+    def __init__(
+        self,
+        router,
+        canary_version: str,
+        baseline_version: str,
+        steps: Sequence[int] = DEFAULT_STEPS,
+        soak_s: float = 60.0,
+        severity: str = "page",
+        clock=time.monotonic,
+    ) -> None:
+        if not steps or list(steps) != sorted(set(int(s) for s in steps)):
+            raise ValueError(f"steps must be increasing, got {steps!r}")
+        if int(steps[-1]) != 100:
+            raise ValueError(f"final step must be 100, got {steps!r}")
+        if any(not 0 < int(s) <= 100 for s in steps):
+            raise ValueError(f"steps must be in (0,100], got {steps!r}")
+        if canary_version == baseline_version:
+            raise ValueError("canary and baseline must differ")
+        self.router = router
+        self.canary = str(canary_version)
+        self.baseline = str(baseline_version)
+        self.steps = tuple(int(s) for s in steps)
+        self.soak_s = float(soak_s)
+        self.severity = str(severity)
+        self.clock = clock
+        self.phase = PENDING
+        self.step_idx = -1
+        self.conditions: List[Dict] = []
+        self._step_started = 0.0
+        #: version -> the RolledBack condition that fenced it; survives
+        #: phase resets on this controller, cleared only by clear_fence()
+        self._fenced: Dict[str, Dict] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def begin(self) -> None:
+        """Start the rollout at the first ladder step. Raises
+        :class:`RolloutFenced` if the canary was rolled back before and
+        nobody cleared the fence."""
+        if self.canary in self._fenced:
+            raise RolloutFenced(
+                f"version {self.canary} was auto-rolled-back "
+                f"({self._fenced[self.canary].get('message', '')}); "
+                f"clear_fence() to re-promote"
+            )
+        if self.phase == PROGRESSING:
+            return
+        self.phase = PROGRESSING
+        self.step_idx = 0
+        self._step_started = self.clock()
+        self._apply_step()
+        self._condition("Progressing", "True", "RolloutStarted",
+                        f"canary {self.canary} at weight {self.steps[0]}")
+
+    def _apply_step(self) -> None:
+        w = self.steps[self.step_idx]
+        self.router.set_version_weights({
+            self.baseline: 100 - w, self.canary: w,
+        })
+        log.info("rollout: %s at weight %d (baseline %s at %d)",
+                 self.canary, w, self.baseline, 100 - w)
+
+    # -- the control loop --------------------------------------------------
+
+    def tick(self) -> str:
+        """One decision: returns ``rolled_back`` | ``advanced`` |
+        ``promoted`` | ``soaking`` | ``idle``."""
+        if self.phase != PROGRESSING:
+            return "idle"
+        tracker = self.router.version_tracker(self.canary)
+        tracker.refresh()
+        burning = self._publish_burning(tracker)
+        if burning is not None:
+            self._rollback(tracker, burning)
+            return "rolled_back"
+        if self.clock() - self._step_started < self.soak_s:
+            return "soaking"
+        if self.step_idx + 1 < len(self.steps):
+            self.step_idx += 1
+            self._step_started = self.clock()
+            self._apply_step()
+            self.router.metrics.rollout_events.inc(event="advance")
+            self._condition("Progressing", "True", "StepAdvanced",
+                            f"canary {self.canary} at weight "
+                            f"{self.steps[self.step_idx]}")
+            return "advanced"
+        # soaked clean at 100: the canary IS the fleet now
+        self.phase = COMPLETE
+        self.router.metrics.rollout_events.inc(event="promote")
+        self._condition("Complete", "True", "Promoted",
+                        f"{self.canary} serving 100% after clean soak")
+        log.info("rollout: promoted %s", self.canary)
+        return "promoted"
+
+    def _publish_burning(self, tracker):
+        """Export per-version burning gauges; return the gating alert if
+        it fires on the canary partition (both windows above threshold)."""
+        gating = None
+        m = self.router.metrics
+        base_tr = self.router.version_tracker(self.baseline)
+        for alert in tracker.alerts:
+            hot = tracker.burning(alert)
+            m.version_burning.set(1.0 if hot else 0.0,
+                                  version=self.canary,
+                                  severity=alert.severity)
+            m.version_burning.set(
+                1.0 if base_tr.burning(alert) else 0.0,
+                version=self.baseline, severity=alert.severity)
+            if hot and alert.severity == self.severity and gating is None:
+                gating = alert
+        return gating
+
+    def _rollback(self, tracker, alert) -> None:
+        """ONE weight flip back to the baseline, then fence the canary."""
+        short_rate = tracker.burn_rate(alert.short_s)
+        long_rate = tracker.burn_rate(alert.long_s)
+        self.router.set_version_weights({self.baseline: 100, self.canary: 0})
+        self.router.metrics.rollout_events.inc(event="rollback")
+        self.phase = ROLLED_BACK
+        cond = self._condition(
+            "RolledBack", "True", "SLOBurn",
+            f"canary {self.canary} burning at severity {alert.severity}: "
+            f"burn {short_rate:.1f}x over {int(alert.short_s)}s and "
+            f"{long_rate:.1f}x over {int(alert.long_s)}s "
+            f"(threshold {alert.threshold}x); "
+            f"exemplar trace_id={tracker.last_bad_trace_id or 'none'}",
+            severity=alert.severity,
+            short_s=alert.short_s, long_s=alert.long_s,
+            short_burn=round(short_rate, 2), long_burn=round(long_rate, 2),
+            threshold=alert.threshold,
+            trace_id=tracker.last_bad_trace_id,
+        )
+        self._fenced[self.canary] = cond
+        log.warning("rollout: rolled back %s (%s)",
+                    self.canary, cond["message"])
+
+    # -- fencing -----------------------------------------------------------
+
+    def fenced(self) -> Dict[str, Dict]:
+        """Version -> the RolledBack condition that fenced it."""
+        return dict(self._fenced)
+
+    def clear_fence(self, version: Optional[str] = None) -> bool:
+        """Manual operator action: allow a rolled-back version to be
+        promoted again. Returns whether a fence was cleared."""
+        version = str(version or self.canary)
+        if self._fenced.pop(version, None) is None:
+            return False
+        self.router.metrics.rollout_events.inc(event="fence_cleared")
+        if self.phase == ROLLED_BACK and version == self.canary:
+            self.phase = PENDING
+            self.step_idx = -1
+        log.info("rollout: fence cleared for %s", version)
+        return True
+
+    # -- introspection -----------------------------------------------------
+
+    def _condition(self, ctype: str, status: str, reason: str,
+                   message: str, **extra) -> Dict:
+        cond = {"type": ctype, "status": status, "reason": reason,
+                "message": message, "clock": self.clock(), **extra}
+        self.conditions.append(cond)
+        return cond
+
+    def status(self) -> Dict:
+        weight = (self.steps[self.step_idx]
+                  if 0 <= self.step_idx < len(self.steps) else 0)
+        return {
+            "phase": self.phase,
+            "canary": self.canary,
+            "baseline": self.baseline,
+            "step": self.step_idx,
+            "weight": weight if self.phase in (PROGRESSING, COMPLETE) else 0,
+            "steps": list(self.steps),
+            "soak_s": self.soak_s,
+            "severity": self.severity,
+            "fenced": sorted(self._fenced),
+            "conditions": list(self.conditions),
+        }

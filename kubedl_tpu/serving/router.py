@@ -45,6 +45,14 @@ severs in-flight streams. The router owns the tail-at-scale mechanics
   slots (smooth weighted round-robin) and sheds lowest-priority-first
   on overflow with a distinguishable 503 (``reason: qos_shed``),
   composing with the engines' own KV-watermark sheds.
+- **Model-version canary split** — ``set_version_weights`` declares a
+  per-version traffic split (the TrafficPolicy weight idea, one level
+  down); untagged requests get a version from a deterministic smooth
+  weighted round-robin, the tag rides ``body["model_version"]`` into
+  every retry/hedge/disagg leg (sticky: a request never flips version
+  mid-flight), and each version feeds its OWN SLOTracker partition so
+  the rollout controller (kubedl_tpu/serving/rollout.py) can gate
+  promotion on the canary's burn rate alone.
 
 Routing and hedging never change RESULTS: greedy outputs through the
 router are bit-identical to direct engine calls (tier-1 enforced), and
@@ -187,6 +195,7 @@ class ServingRouter:
         disagg_enabled: bool = True,
         qos_timeout_s: float = 30.0,
         slo: Optional[Dict] = None,
+        version_weights: Optional[Dict[str, int]] = None,
         metrics: Optional[RouterMetrics] = None,
         clock=time.monotonic,
     ) -> None:
@@ -224,6 +233,18 @@ class ServingRouter:
             clock=clock,
             metrics=SLOMetrics(self.metrics.registry),
         )
+        #: model-version canary split (rollout.py drives this): version ->
+        #: traffic weight; empty means version-blind routing (requests
+        #: carry whatever model_version the client set, or none)
+        self._slo_cfg = dict(slo_cfg)
+        self._version_weights: Dict[str, int] = {}
+        self._version_wrr: Dict[str, float] = {}  # smooth-WRR current
+        #: per-version SLO partition: each version gets its OWN tracker on
+        #: a private SLOMetrics registry (sharing the router registry
+        #: would need a version label on every kubedl_tpu_slo_* family —
+        #: a label-keyset change for every existing dashboard); the
+        #: aggregate self.slo keeps feeding the exported families
+        self._version_slo: Dict[str, SLOTracker] = {}
         self.retry_budget = policy.RetryBudget(ratio=retry_budget_ratio)
         self.latency = policy.LatencyTracker(default_ms=hedge_default_ms)
         self._lock = threading.Lock()
@@ -235,6 +256,71 @@ class ServingRouter:
         self._stop = threading.Event()
         self._prober: Optional[threading.Thread] = None
         self.set_replicas(replicas)
+        if version_weights:
+            self.set_version_weights(version_weights)
+
+    # -- model-version canary split ----------------------------------------
+
+    def set_version_weights(self, weights: Dict[str, int]) -> None:
+        """Declare the model-version traffic split (``{"v1": 90, "v2":
+        10}``). Reuses the TrafficPolicy weight idea one level down: the
+        router tags each untagged request with a version chosen by smooth
+        weighted round-robin, and the engines serve that version's weight
+        tree. An empty dict turns version tagging off. Weight changes are
+        atomic under the router lock — a request sees exactly one split."""
+        parsed = {str(v): int(w) for v, w in (weights or {}).items()}
+        if any(w < 0 for w in parsed.values()):
+            raise ValueError(f"negative version weight in {parsed}")
+        with self._lock:
+            self._version_weights = parsed
+            self._version_wrr = {v: 0.0 for v in parsed}
+            for v in parsed:
+                self._version_tracker_locked(v)
+        for v, w in parsed.items():
+            self.metrics.rollout_weight.set(float(w), version=v)
+
+    def version_weights(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._version_weights)
+
+    def _version_tracker_locked(self, version: str) -> SLOTracker:
+        tr = self._version_slo.get(version)
+        if tr is None:
+            cfg = self._slo_cfg
+            tr = SLOTracker(
+                objective=float(cfg.get("objective", 0.999)),
+                latency_objective_ms=cfg.get(
+                    "latency_objective_ms", self.default_deadline_ms
+                ),
+                alerts=alerts_from_config(cfg.get("alerts")),
+                clock=self.clock,
+                metrics=SLOMetrics(),  # private registry: see __init__
+            )
+            self._version_slo[version] = tr
+        return tr
+
+    def version_tracker(self, version: str) -> SLOTracker:
+        """The version's own SLO partition (rollout.py gates on its burn
+        rates; created on first use)."""
+        with self._lock:
+            return self._version_tracker_locked(str(version))
+
+    def _choose_version(self) -> str:
+        """Deterministic smooth weighted round-robin over the configured
+        split — the same interleave every run at the same weights, so
+        canary tests are reproducible without seeding."""
+        with self._lock:
+            weights = self._version_weights
+            total = sum(weights.values())
+            if total <= 0:
+                return ""
+            cur = self._version_wrr
+            for v, w in weights.items():
+                cur[v] = cur.get(v, 0.0) + w
+            # max by current, name-tiebreak for determinism across dicts
+            best = max(sorted(cur), key=lambda v: cur[v])
+            cur[best] -= total
+            return best
 
     # -- fleet membership --------------------------------------------------
 
@@ -325,9 +411,14 @@ class ServingRouter:
                 if (host, port) in seen_endpoints:
                     continue
                 seen_endpoints.add((host, port))
+            # with a TrafficPolicy armed, absence from its routes means
+            # weight 0 — NOT 100: a predictor the controller pulled from
+            # rotation (weight-0 canary, not-ready) must stay registered
+            # but unroutable through router restarts and breaker
+            # half-open readmissions alike
             specs.append({
                 "name": pod.metadata.name, "host": host, "port": port,
-                "weight": weights.get(pred, 100) if weights else 100,
+                "weight": weights.get(pred, 0) if tp is not None else 100,
                 "role": role, "model": model,
             })
         self.set_replicas(specs)
@@ -578,11 +669,24 @@ class ServingRouter:
         debug_trace = bool(
             isinstance(body.get("debug"), dict) and body["debug"].get("trace")
         )
+        # version tagging happens ONCE, here: a client-set model_version
+        # is sticky as-is; an untagged request under a canary split gets
+        # the WRR pick. Every retry/hedge/disagg leg below shares this
+        # body dict, so the version never flips mid-request — a hedge
+        # answering with different weights would be a silent model swap.
+        version = str(body.get("model_version", "") or "")
+        if not version and self._version_weights:
+            version = self._choose_version()
+            if version:
+                body = dict(body)
+                body["model_version"] = version
         root = TRACER.span("router.request", parent=trace)
         t0 = self.clock()
         code = 0
         try:
             with root as rattrs:
+                if version:
+                    rattrs["model_version"] = version
                 code, payload, extra = self._dispatch(
                     body, deadline_ms, tenant, root.ctx, t0)
                 rattrs["status"] = code
@@ -593,8 +697,13 @@ class ServingRouter:
         finally:
             lat_ms = (self.clock() - t0) * 1e3
             tid = root.ctx.trace_id if root.ctx is not None else ""
-            self.slo.observe(ok=(code == 200), latency_ms=lat_ms,
-                             trace_id=tid)
+            ok = code == 200
+            self.slo.observe(ok=ok, latency_ms=lat_ms, trace_id=tid)
+            if version:
+                m.version_requests.inc(version=version,
+                                       result="ok" if ok else "error")
+                self.version_tracker(version).observe(
+                    ok=ok, latency_ms=lat_ms, trace_id=tid)
             m.request_ms.observe(lat_ms, exemplar=tid or None)
 
     def _dispatch(self, body: Dict, deadline_ms: Optional[float],
@@ -874,7 +983,7 @@ class ServingRouter:
         leg1 = json.dumps({
             k: body[k] for k in
             ("prompt_ids", "max_tokens", "temperature", "cache_prefix",
-             "request_id") if k in body
+             "request_id", "model_version") if k in body
         }).encode()
         pre.begin()
         leg = TRACER.span("router.prefill_leg", parent=ctx,
@@ -1041,6 +1150,14 @@ class ServingRouter:
                 "admits": dict(self.qos.admits),
             }
         out["slo"] = self.slo.snapshot()
+        with self._lock:
+            vweights = dict(self._version_weights)
+            vslo = dict(self._version_slo)
+        if vweights or vslo:
+            out["versions"] = {
+                "weights": vweights,
+                "slo": {v: tr.snapshot() for v, tr in vslo.items()},
+            }
         return out
 
 
@@ -1094,6 +1211,16 @@ def make_router_handler(router: ServingRouter):
                 router.drain()
                 self._json(200, {"draining": True})
                 return
+            if self.path == "/admin/version_weights":
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    router.set_version_weights(req.get("weights") or {})
+                except (ValueError, TypeError) as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                self._json(200, {"weights": router.version_weights()})
+                return
             if self.path != "/v1/generate":
                 self._json(404, {"error": "not found"})
                 return
@@ -1137,6 +1264,10 @@ def router_kwargs(cfg: Dict) -> Dict:
         out["qos"] = cfg["qos"]
     if isinstance(cfg.get("slo"), dict):
         out["slo"] = cfg["slo"]
+    if isinstance(cfg.get("version_weights"), dict):
+        out["version_weights"] = {
+            str(v): int(w) for v, w in cfg["version_weights"].items()
+        }
     out["replicas"] = [
         {"name": r["name"], "host": r.get("host", "127.0.0.1"),
          "port": int(r["port"]), "weight": int(r.get("weight", 100)),

@@ -58,6 +58,13 @@ class EngineOverloaded(Exception):
         self.reason = reason
 
 
+class UnknownModelVersion(ValueError):
+    """A request named a model version this engine has not loaded (or
+    one already retiring) — a client/config error, not overload: the
+    handler answers 400, never 503, so the router does not fail it over
+    to a replica that cannot know the version either."""
+
+
 class _Slot:
     """One in-flight sequence occupying a batch row."""
 
@@ -87,6 +94,12 @@ class _Slot:
         #: prefill and resumes from imported blocks
         self.handoff: Optional[Dict] = None
         self.adopt = None
+        #: weight version serving this row (docs/serving.md "Model
+        #: lifecycle"): resolved at admission-gate time to a loaded
+        #: version id ("" until then = the engine default). Dispatch is
+        #: partitioned by version per tick, so one forward never mixes
+        #: parameter trees.
+        self.version = ""
         #: distributed tracing (docs/observability.md): ``trace`` is the
         #: caller's context (X-Trace-Context); ``span_id`` is this
         #: request's PRE-MINTED engine.request id, so scheduler-side
@@ -139,11 +152,11 @@ class LlamaEngine:
                  prefill_chunk_tokens: int = 0,
                  role: str = "colocated",
                  advertise_prefix_len: int = 8,
-                 handoff_ttl_s: float = 30.0) -> None:
+                 handoff_ttl_s: float = 30.0,
+                 model_version: str = "base") -> None:
         import jax
 
         from kubedl_tpu.models import llama
-        from kubedl_tpu.training import checkpoint
 
         if kv_layout not in ("paged", "contiguous"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}")
@@ -218,18 +231,7 @@ class LlamaEngine:
             self.prefill_chunk_tokens = pct
         else:
             self.prefill_chunk_tokens = 0
-        params = llama.llama_init(jax.random.PRNGKey(0), self.cfg)
-        if ckpt_dir and checkpoint.latest_step(ckpt_dir) is not None:
-            state = checkpoint.restore_checkpoint(ckpt_dir, {"params": params})
-            if state is not None:
-                params = state["params"]
-                log.info("restored checkpoint from %s", ckpt_dir)
-        if quantize == "int8":
-            # weight-only int8: decode is HBM-bound and weights dominate
-            # the bytes — halves the per-token floor (docs/serving.md)
-            params = llama.quantize_params(params, self.cfg)
-            log.info("serving with int8 weight-only quantization")
-        elif quantize:
+        if quantize and quantize != "int8":
             raise ValueError(f"unknown quantize mode {quantize!r}")
         self.quantize = quantize
         self.mesh = None
@@ -242,11 +244,24 @@ class LlamaEngine:
 
             spec = MeshSpec({k: int(v) for k, v in mesh_axes.items()})
             self.mesh = build_mesh(spec, jax.devices()[: spec.size()])
-            params = llama.shard_serving_params(params, self.cfg, self.mesh)
             log.info("serving over mesh %s", dict(mesh_axes))
-        self.params = params
         self._llama = llama
         self._jax = jax
+        #: versioned weights (docs/serving.md "Model lifecycle"): every
+        #: loaded parameter tree lives here under a version id; the
+        #: default version serves requests that name none. All jitted
+        #: entry points take params as an explicit argument, so a second
+        #: tree rides the SAME compiles — hot-swap is just passing a
+        #: different pytree.
+        self._default_version = str(model_version) or "base"
+        params = self._build_params(ckpt_dir)
+        self.params = params
+        self._versions: Dict[str, object] = {self._default_version: params}
+        #: versions drained-and-awaiting-eviction: unroutable for new
+        #: requests, evicted (tree dropped) once their last in-flight
+        #: row frees — never while a row still dispatches on them
+        self._retiring: set = set()
+        self._vers_rr = 0
         # the cache is DONATED: decode/prefill update it in place in HBM
         # instead of allocating a fresh copy every step
         if self._paged:
@@ -557,6 +572,187 @@ class LlamaEngine:
         )
         self._thread.start()
 
+    def _build_params(self, ckpt_dir: str, require_ckpt: bool = False):
+        """Build one servable parameter tree end to end: init → checkpoint
+        restore → optional int8 quantization → mesh sharding. The whole
+        pipeline runs OFF the dispatch path (init time or a hot-swap
+        load), and nothing is committed anywhere until it returns — a
+        failure at any stage leaves every already-serving version
+        untouched, never a torn tree. The ``serving.weight_swap`` chaos
+        site fires at the top so injected corrupt-artifact / mid-swap
+        crashes exercise exactly that contract.
+
+        ``require_ckpt`` (hot-swap loads): a version whose artifact is
+        missing or torn beyond recovery must FAIL the load — serving
+        freshly initialized random weights under a version id would be a
+        silent model swap. Init keeps the permissive behaviour (tests and
+        cold starts serve the preset without a checkpoint)."""
+        from kubedl_tpu.training import checkpoint
+
+        llama, jax = self._llama, self._jax
+        chaos.check("serving.weight_swap")
+        params = llama.llama_init(jax.random.PRNGKey(0), self.cfg)
+        step = checkpoint.latest_step(ckpt_dir) if ckpt_dir else None
+        if require_ckpt and step is None:
+            raise ValueError(f"no checkpoint found under {ckpt_dir!r}")
+        if ckpt_dir and step is not None:
+            state = checkpoint.restore_checkpoint(ckpt_dir, {"params": params})
+            if state is not None:
+                params = state["params"]
+                log.info("restored checkpoint from %s", ckpt_dir)
+            elif require_ckpt:
+                raise ValueError(
+                    f"no complete checkpoint step under {ckpt_dir!r} "
+                    "(every step torn/incomplete)"
+                )
+        if self.quantize == "int8":
+            # weight-only int8: decode is HBM-bound and weights dominate
+            # the bytes — halves the per-token floor (docs/serving.md)
+            params = llama.quantize_params(params, self.cfg)
+            log.info("serving with int8 weight-only quantization")
+        if self.mesh is not None:
+            params = llama.shard_serving_params(params, self.cfg, self.mesh)
+        return params
+
+    # -- versioned weights / hot swap (docs/serving.md "Model lifecycle") --
+
+    def load_version(self, version: str, ckpt_dir: str) -> None:
+        """Load a second (third, …) parameter tree alongside the serving
+        ones with ZERO downtime: the build runs entirely off to the side
+        on the caller's thread, and only a fully restored/quantized/
+        sharded tree is committed under the lock. A failed load (torn
+        artifact, injected ``serving.weight_swap`` crash) raises and the
+        already-loaded versions keep serving — there is no intermediate
+        state a request could observe. Idempotent for an already-loaded
+        version."""
+        if not self._paged:
+            raise ValueError(
+                "weight hot-swap requires kv_layout='paged' (per-row "
+                "block isolation is what lets rows of the other version "
+                "sit a dispatch out safely)"
+            )
+        version = str(version)
+        if not version:
+            raise ValueError("model version id must be non-empty")
+        with self._cv:
+            if version in self._retiring:
+                raise ValueError(
+                    f"version {version!r} is retiring; wait for eviction "
+                    "before reloading it"
+                )
+            if version in self._versions:
+                return
+        params = self._build_params(ckpt_dir, require_ckpt=True)
+        with self._cv:
+            self._versions[version] = params
+        log.info("hot-loaded model version %r from %s", version, ckpt_dir)
+
+    def activate_version(self, version: str) -> str:
+        """Make a loaded version the DEFAULT for requests that name no
+        version (the rollback/promotion flip is the router's weight
+        change; this is the engine-local equivalent). Returns the
+        previous default."""
+        version = str(version)
+        with self._cv:
+            if version not in self._versions or version in self._retiring:
+                raise UnknownModelVersion(
+                    f"cannot activate {version!r} "
+                    f"(loaded: {sorted(self._versions)})"
+                )
+            prev, self._default_version = self._default_version, version
+            self.params = self._versions[version]
+        log.info("activated model version %r (was %r)", version, prev)
+        return prev
+
+    def retire_version(self, version: str) -> bool:
+        """Fence a version from NEW requests and evict its tree once the
+        last in-flight row referencing it drains — never mid-flight: a
+        row dispatching on the tree keeps it alive. The default version
+        cannot retire (activate another first). Returns False for a
+        version that was never loaded."""
+        version = str(version)
+        with self._cv:
+            if version == self._default_version:
+                raise ValueError(
+                    f"cannot retire the default version {version!r}; "
+                    "activate another version first"
+                )
+            if version not in self._versions:
+                return False
+            self._retiring.add(version)
+            self._maybe_evict_versions_locked()
+        return True
+
+    def versions(self) -> Dict:
+        """Live version inventory (feeds /v1/models, stats(), and the
+        rollout drive's torn-state assertions)."""
+        with self._cv:
+            rows: Dict[str, int] = {}
+            for s in self._slots:
+                if s is not None:
+                    v = s.version or self._default_version
+                    rows[v] = rows.get(v, 0) + 1
+            return {
+                "default": self._default_version,
+                "loaded": sorted(self._versions),
+                "retiring": sorted(self._retiring),
+                "active_rows": rows,
+            }
+
+    def _resolve_version_locked(self, requested: str) -> str:
+        """Admission-gate resolution: "" → the default; anything else
+        must be a loaded, non-retiring version. Caller holds cv."""
+        v = str(requested or "") or self._default_version
+        if v not in self._versions or v in self._retiring:
+            raise UnknownModelVersion(
+                f"unknown or retiring model version {v!r} "
+                f"(loaded: {sorted(set(self._versions) - self._retiring)})"
+            )
+        return v
+
+    def _version_refs_locked(self, version: str) -> int:
+        n = sum(
+            1 for s in self._slots
+            if s is not None and (s.version or self._default_version) == version
+        )
+        n += sum(
+            1 for s in self._waiting
+            if (s.version or self._default_version) == version
+        )
+        return n
+
+    def _maybe_evict_versions_locked(self) -> None:
+        """Drop retiring trees whose last referencing row/queue entry is
+        gone (drain-then-evict). Hooked into _admit_locked so every
+        admission pass — which follows every row free — re-checks.
+        Caller holds cv."""
+        for v in list(self._retiring):
+            if v == self._default_version:
+                continue
+            if self._version_refs_locked(v) == 0:
+                self._versions.pop(v, None)
+                self._retiring.discard(v)
+                log.info("evicted retired model version %r", v)
+
+    def _pick_tick_version_locked(self, active) -> str:
+        """One version per scheduler tick: dispatch (prefill group,
+        decode segment, spec round) never mixes parameter trees. With
+        versions co-resident the tick alternates round-robin over those
+        with live rows — rows of the others sit the tick out, which is
+        safe in paged mode because the host pos/bt mirrors are
+        authoritative (re-uploaded before every dispatch, so the skipped
+        steps never happened for them). Caller holds cv."""
+        vers = sorted({
+            (s.version or self._default_version)
+            for s in active if s is not None
+        })
+        if not vers:
+            return self._default_version
+        if len(vers) == 1:
+            return vers[0]
+        self._vers_rr = (self._vers_rr + 1) % len(vers)
+        return vers[self._vers_rr]
+
     def _warmup(self) -> None:
         import jax.numpy as jnp
 
@@ -704,7 +900,8 @@ class LlamaEngine:
                  temperature: float = 0.0, timeout_s: float = 600.0,
                  cache_prefix: bool = False, request_id: str = "",
                  trace: Optional[TraceContext] = None,
-                 debug_trace: bool = False) -> Dict:
+                 debug_trace: bool = False,
+                 model_version: str = "") -> Dict:
         budget = self.max_seq - 1
         prompt = [int(t) for t in list(prompt_ids)[:budget]]
         if not prompt:
@@ -714,6 +911,7 @@ class LlamaEngine:
                      request_id=request_id)
         self._arm_trace(slot, trace, debug_trace)
         with self._cv:
+            slot.version = self._resolve_version_locked(model_version)
             if self._draining:
                 self._stats["drain_rejects"] += 1
                 raise EngineOverloaded(
@@ -852,6 +1050,7 @@ class LlamaEngine:
             )
             out["speculative"]["candidates"] = self.spec_candidates
         out["pipeline"] = self.pipeline_stats()
+        out["versions"] = self.versions()
         return out
 
     def pipeline_stats(self) -> Dict:
@@ -1159,6 +1358,10 @@ class LlamaEngine:
         return True
 
     def _admit_locked(self) -> None:
+        # retiring versions evict here: every row free is followed by an
+        # admission pass, so "last in-flight row drains" is observed at
+        # the next admission opportunity
+        self._maybe_evict_versions_locked()
         for i in range(self.max_batch):
             if self._slots[i] is None and self._waiting:
                 if self._paged:
@@ -1326,6 +1529,9 @@ class LlamaEngine:
                     len(s.out_ids) / (ms / 1e3), 2
                 ) if ms > 0 else 0.0,
                 "cached_prefix_len": s.cached_len,
+                # which weight version actually served the request — the
+                # rollout drive's no-version-mixing assertion reads this
+                "model_version": s.version or self._default_version,
             }
             if s.ttft_ms is not None:
                 s.result["ttft_ms"] = round(s.ttft_ms, 3)
@@ -1361,6 +1567,10 @@ class LlamaEngine:
             "ttft_ms": s.ttft_ms,
             "trace": s.trace,
             "span_id": s.span_id,
+            # the adopting decode engine must keep serving the SAME
+            # weight version the prefill ran on — rides the KVHandoff
+            # header so disagg legs never mix versions
+            "model_version": s.version or self._default_version,
             "t": time.time(),
         }
         ms = (time.perf_counter() - s.t0) * 1e3
@@ -1384,7 +1594,8 @@ class LlamaEngine:
     def prefill_handoff(self, prompt_ids, max_tokens: int = 16,
                         temperature: float = 0.0, timeout_s: float = 600.0,
                         cache_prefix: bool = False, request_id: str = "",
-                        trace: Optional[TraceContext] = None):
+                        trace: Optional[TraceContext] = None,
+                        model_version: str = ""):
         """Prefill-pool entry: run the whole-prompt prefill + on-device
         first-token sample exactly like generate(), then export the row's
         KV blocks instead of decoding. Returns a
@@ -1413,6 +1624,7 @@ class LlamaEngine:
         slot = _Slot(prompt, 1, float(temperature), cache_prefix,
                      request_id=request_id)
         slot.handoff = {"max_tokens": max_tokens}
+        slot.version = str(model_version or "")
         self._arm_trace(slot, trace)
         self._enqueue_slot_locked_checks(slot)
         if not slot.done.wait(timeout=timeout_s):
@@ -1439,8 +1651,12 @@ class LlamaEngine:
     def _enqueue_slot_locked_checks(self, slot: _Slot) -> None:
         """Admission gate shared by generate()'s disaggregated siblings:
         drain rejection, queue-depth/age shedding, KV watermark shedding
-        — identical budgets, identical 503 reasons."""
+        — identical budgets, identical 503 reasons. Also resolves the
+        slot's weight version (slot.version holds the REQUESTED id on
+        entry; unknown/retiring → UnknownModelVersion, a 400 not a
+        503)."""
         with self._cv:
+            slot.version = self._resolve_version_locked(slot.version)
             if self._draining:
                 self._stats["drain_rejects"] += 1
                 raise EngineOverloaded(
@@ -1560,6 +1776,7 @@ class LlamaEngine:
                     cache_prefix=rec["cache_prefix"],
                     ttft_ms=rec["ttft_ms"],
                     trace=th,
+                    model_version=rec.get("model_version", ""),
                 )
                 box["handoff"] = h
                 m = self.metrics
@@ -1621,6 +1838,10 @@ class LlamaEngine:
         slot = _Slot(prompt, max_tokens, float(h.temperature),
                      h.cache_prefix, request_id=request_id or h.request_id)
         slot.adopt = h
+        # version stickiness across the disagg seam: decode on exactly
+        # the version that prefilled (rides the handoff header); a decode
+        # replica that has not loaded it rejects the adopt cleanly
+        slot.version = str(getattr(h, "model_version", "") or "")
         # explicit context (HTTP header) wins; else the handoff's own
         # embedded trace keeps direct engine→engine adoption on-trace
         if trace is None:
@@ -1867,7 +2088,7 @@ class LlamaEngine:
             self._cv.notify_all()
         return (t1 - t0) * 1e3, (time.perf_counter() - t1) * 1e3
 
-    def _prefill_chunks(self, todo, acct: Dict):
+    def _prefill_chunks(self, todo, acct: Dict, params=None):
         """Chunked-admission prefill dispatch (docs/serving.md
         "Continuous batching"): spend at most ``prefill_chunk_tokens``
         prompt tokens this tick across the not-yet-prefilled rows, FIFO
@@ -1928,8 +2149,8 @@ class LlamaEngine:
         self._cache["bt"] = self._upload_mirror(self._bt_host)
         t0 = time.perf_counter()
         logits, self._cache = self._prefill_from(
-            self.params, self._cache, jnp.asarray(toks),
-            jnp.asarray(lens), jnp.asarray(starts),
+            self.params if params is None else params, self._cache,
+            jnp.asarray(toks), jnp.asarray(lens), jnp.asarray(starts),
         )
         if saved:
             if self._pcache is not None:
@@ -1985,7 +2206,7 @@ class LlamaEngine:
                 pre.append((i, s, budgeted))
         return pre, (prefill_ids if pre else None)
 
-    def _spec_tick(self, decoding, acct: Dict) -> None:
+    def _spec_tick(self, decoding, acct: Dict, params=None) -> None:
         """One draft-k/verify-1 round over every greedy decoding row.
 
         Per row: the pluggable draft proposes k tokens from the full host
@@ -2015,6 +2236,8 @@ class LlamaEngine:
 
         from kubedl_tpu.serving.speculative import accept_length, build_tree
 
+        if params is None:
+            params = self.params
         k = self.spec_k
         S = k + 1
         N = self.spec_candidates
@@ -2117,7 +2340,7 @@ class LlamaEngine:
                 mask_tr[i] = t_mask
                 lens_tr[i] = tr.size
             ids_tree = np.array(self._jax.device_get(self._verify_tree(
-                self.params, self._cache, jnp.asarray(toks_tr),
+                params, self._cache, jnp.asarray(toks_tr),
                 jnp.asarray(pos_tr), jnp.asarray(mask_tr),
                 jnp.asarray(lens_tr), jnp.asarray(starts),
             )))  # [B, M]
@@ -2134,7 +2357,7 @@ class LlamaEngine:
         elif multi:
             # read-only ranking pass (cache neither donated nor written)
             ids_multi = np.array(self._jax.device_get(self._verify_multi(
-                self.params, self._cache, jnp.asarray(cand_toks),
+                params, self._cache, jnp.asarray(cand_toks),
                 jnp.asarray(lens), jnp.asarray(starts),
             )))  # [B, N, S]
             for i, s, dl in rows:
@@ -2149,7 +2372,7 @@ class LlamaEngine:
                     dl[0] = dl[best]  # the accept loop reads dl[0]
                     toks[i, 1:] = dl[0]
         ids_dev, self._cache = self._verify(
-            self.params, self._cache, jnp.asarray(toks),
+            params, self._cache, jnp.asarray(toks),
             jnp.asarray(lens), jnp.asarray(starts),
         )
         acct["dispatch_ms"] += (time.perf_counter() - t0) * 1e3
@@ -2299,6 +2522,23 @@ class LlamaEngine:
         with self._cv:
             self._admit_locked()
             active = list(self._slots)
+            # one weight version per tick: every dispatch below (prefill
+            # group, decode segment, spec round) uses THIS tree only;
+            # rows of co-resident versions sit the tick out (round-robin
+            # alternation — a host-mirror no-op for them) so a forward
+            # never mixes parameter trees
+            tick_version = self._pick_tick_version_locked(active)
+            vp = self._versions[tick_version]
+
+        if tick_version != self._default_version:
+            # seeded canary degradation (``serving.canary_dispatch``):
+            # hits ONLY non-default-version ticks, so a drill can make
+            # a deliberately-degraded canary burn its own SLO partition
+            # while baseline traffic on the same replica stays healthy
+            chaos.check("serving.canary_dispatch")
+
+        def _mine(s: _Slot) -> bool:
+            return (s.version or self._default_version) == tick_version
 
         # ---- prefill DISPATCH: newly admitted rows consume their WHOLE
         # prompt in one batched forward (TTFT = one forward, not
@@ -2307,11 +2547,11 @@ class LlamaEngine:
         pre: list = []
         prefill_ids = None
         todo = [(i, s) for i, s in enumerate(active)
-                if s is not None and s.fed == 0]
+                if s is not None and s.fed == 0 and _mine(s)]
         if todo and self.prefill_chunk_tokens:
             # chunked admission: bounded prefill work per tick, rows
             # join the running decode batch chunk by chunk
-            pre, prefill_ids = self._prefill_chunks(todo, acct)
+            pre, prefill_ids = self._prefill_chunks(todo, acct, vp)
             with self._cv:
                 active = list(self._slots)
         elif todo:
@@ -2359,7 +2599,7 @@ class LlamaEngine:
             t0 = time.perf_counter()
             if np.any(starts > 0):
                 logits, self._cache = self._prefill_from(
-                    self.params, self._cache, jnp.asarray(toks),
+                    vp, self._cache, jnp.asarray(toks),
                     jnp.asarray(lens), jnp.asarray(starts),
                 )
                 saved = int(starts.sum())
@@ -2368,7 +2608,7 @@ class LlamaEngine:
                 self.metrics.prefix_tokens_saved.inc(saved)
             else:
                 logits, self._cache = self._prefill(
-                    self.params, self._cache, jnp.asarray(toks),
+                    vp, self._cache, jnp.asarray(toks),
                     jnp.asarray(lens),
                 )
             prefill_ids = self._sample_logits(
@@ -2437,6 +2677,7 @@ class LlamaEngine:
         decoding = [
             (i, s) for i, s in enumerate(active)
             if s is not None and s.fed >= len(s.prompt) and self._rem(s) > 0
+            and _mine(s)
         ]
 
         # ---- speculative verify (draft-k/verify-1): when every decoding
@@ -2461,7 +2702,7 @@ class LlamaEngine:
                         if self._slots[i] is s and self._rem(s) > 0
                     ]
             if decoding:
-                self._spec_tick(decoding, acct)
+                self._spec_tick(decoding, acct, vp)
             decoding = []
 
         new_pending = None
@@ -2526,7 +2767,7 @@ class LlamaEngine:
                 self._cache["bt"] = self._upload_mirror(self._bt_host)
             t0 = time.perf_counter()
             toks, last, self._key, self._cache = self._segment_fn(k, greedy)(
-                self.params, self._cache, tokens_dev,
+                vp, self._cache, tokens_dev,
                 self._temps_cache[1], self._key,
             )
             acct["dispatch_ms"] += (time.perf_counter() - t0) * 1e3
@@ -2622,6 +2863,7 @@ def make_handler(engine: LlamaEngine, model_name: str):
                         "name": model_name,
                         "max_seq": engine.max_seq,
                         "params": engine.cfg.num_params(),
+                        "versions": engine.versions(),
                     }]
                 })
             else:
@@ -2649,6 +2891,46 @@ def make_handler(engine: LlamaEngine, model_name: str):
                 engine.drain()
                 self._json(200, {"draining": True})
                 return
+            if self.path == "/admin/load_version":
+                # weight hot-swap: build v(N+1) off to the side, commit
+                # only a complete tree; a failed load leaves the serving
+                # versions untouched (never a torn state)
+                try:
+                    req = self._read_json()
+                    engine.load_version(
+                        str(req.get("version", "")),
+                        str(req.get("ckpt_dir", "")),
+                    )
+                    self._json(200, engine.versions())
+                except ValueError as e:
+                    self._json(400, {"error": str(e), "load_failed": True})
+                except Exception as e:
+                    self._json(500, {"error": str(e), "load_failed": True})
+                return
+            if self.path == "/admin/activate_version":
+                try:
+                    req = self._read_json()
+                    prev = engine.activate_version(
+                        str(req.get("version", ""))
+                    )
+                    out = engine.versions()
+                    out["previous"] = prev
+                    self._json(200, out)
+                except Exception as e:
+                    self._json(400, {"error": str(e)})
+                return
+            if self.path == "/admin/retire_version":
+                try:
+                    req = self._read_json()
+                    known = engine.retire_version(
+                        str(req.get("version", ""))
+                    )
+                    out = engine.versions()
+                    out["retired"] = known
+                    self._json(200, out)
+                except Exception as e:
+                    self._json(400, {"error": str(e)})
+                return
             if self.path == "/v1/prefill":
                 # prefill-pool leg of a disaggregated request: runs the
                 # whole-prompt prefill + first-token sample and answers
@@ -2674,6 +2956,7 @@ def make_handler(engine: LlamaEngine, model_name: str):
                         trace=parse_trace_header(
                             self.headers.get(TRACE_HEADER)
                         ),
+                        model_version=str(req.get("model_version", "")),
                     )
                     body = h.to_bytes()
                     self.send_response(200)
@@ -2766,11 +3049,14 @@ def make_handler(engine: LlamaEngine, model_name: str):
                     debug_trace=bool(
                         isinstance(dbg, dict) and dbg.get("trace")
                     ),
+                    model_version=str(req.get("model_version", "")),
                 )
                 if result.get("timed_out") and deadline_hdr is not None:
                     self._json(504, {"error": "deadline exceeded"})
                     return
                 self._json(200, result)
+            except UnknownModelVersion as e:
+                self._json(400, {"error": str(e), "unknown_version": True})
             except EngineOverloaded as e:
                 self._json(
                     503, {"error": str(e), "shed": True, "reason": e.reason},
@@ -2836,6 +3122,10 @@ def engine_kwargs(cfg: Dict, ckpt_dir: str) -> Dict:
             "role", os.environ.get("KUBEDL_SERVE_ROLE", "colocated")
         ),
         "advertise_prefix_len": int(cfg.get("advertise_prefix_len", 8)),
+        "model_version": cfg.get(
+            "model_version",
+            os.environ.get("KUBEDL_SERVE_MODEL_VERSION", "base"),
+        ),
     }
 
 
@@ -2880,6 +3170,14 @@ def serve_main(env: Optional[Dict[str, str]] = None) -> int:
     # cross-host deployments (round-2 weak #6: a hard-coded 127.0.0.1
     # contradicted the k8s deployment story)
     host = cfg.get("host") or os.environ.get("KUBEDL_SERVE_HOST", "127.0.0.1")
+    if cfg.get("chaos"):
+        # seeded fault schedule for THIS replica (chaos drills against
+        # subprocess fleets can't share an in-process context manager);
+        # same seed -> same fault trace, like every armed plan
+        plan = chaos.plan_from_config(cfg["chaos"])
+        chaos.arm(plan)
+        log.info("armed chaos plan seed=%d sites=%s", plan.seed,
+                 sorted(cfg["chaos"].get("sites") or {}))
     kwargs = engine_kwargs(cfg, ckpt)
     engine = LlamaEngine(**kwargs)
     model_name = cfg.get("model_name", kwargs["preset"])

@@ -144,6 +144,36 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
     return int(m.group(1)) if m else None
 
 
+def checkpoint_fingerprint(ckpt_dir: str, step: Optional[int] = None) -> str:
+    """Content fingerprint of one checkpoint step: sha256 over the
+    manifest bytes plus every shard file's (name, sha256), in sorted
+    order — the same fingerprint on two hosts means the same weights.
+    Defaults to the step the ``latest`` marker names. Returns "" when the
+    dir holds no complete step (missing manifest or no shard files): a
+    fingerprint must never vouch for an artifact restore would reject."""
+    import hashlib
+
+    if step is None:
+        step = latest_step(ckpt_dir)
+    if step is None:
+        return ""
+    d = Path(ckpt_dir) / f"step-{step:08d}"
+    meta = d / "meta.json"
+    shard_files = sorted(glob.glob(str(d / "shards-p*.npz")))
+    if not meta.exists() or not shard_files:
+        return ""
+    h = hashlib.sha256()
+    h.update(meta.read_bytes())
+    for f in shard_files:
+        h.update(Path(f).name.encode())
+        fh = hashlib.sha256()
+        with open(f, "rb") as fp:
+            for chunk in iter(lambda: fp.read(1 << 20), b""):
+                fh.update(chunk)
+        h.update(fh.digest())
+    return h.hexdigest()
+
+
 class _ShardStore:
     """Lazy view over every process's shard files for one step dir."""
 

@@ -814,3 +814,56 @@ class TestSiteRegistry:
         s = chaos.sites()
         s["bogus.site"] = "mutation"
         assert "bogus.site" not in chaos.sites()
+
+
+class TestPlanFromConfig:
+    """plan_from_config builds a FaultPlan from the JSON-shaped dict that
+    rides KUBEDL_SERVE_CONFIG["chaos"] into subprocess replicas (the
+    rollout drive arms the canary-dispatch latency fault this way)."""
+
+    def test_builds_latency_plan(self):
+        slept = []
+        plan = chaos.plan_from_config(
+            {"seed": 17, "sites": {"serving.canary_dispatch": [
+                {"mode": "latency", "latency_ms": 250.0, "every": 1}]}},
+            sleep=slept.append,
+        )
+        chaos.arm(plan)
+        try:
+            chaos.check("serving.canary_dispatch")
+            chaos.check("serving.canary_dispatch")
+        finally:
+            chaos.disarm()
+        assert slept == [0.25, 0.25]
+
+    def test_modes_map_to_fault_specs(self):
+        plan = chaos.plan_from_config({"sites": {
+            "serving.dispatch": [{"mode": "nth", "n": 3}],
+            "serving.kv_alloc": [{"mode": "first", "k": 2}],
+            "node.heartbeat": [{"mode": "prob", "p": 0.5, "k": 4}],
+            "store.update": [{"mode": "always"}],
+        }})
+        chaos.arm(plan)
+        try:
+            import pytest as _pt
+
+            chaos.check("serving.dispatch")
+            chaos.check("serving.dispatch")
+            with _pt.raises(chaos.FaultInjected):
+                chaos.check("serving.dispatch")
+            with _pt.raises(chaos.FaultInjected):
+                chaos.check("serving.kv_alloc")
+            with _pt.raises(chaos.FaultInjected):
+                chaos.check("store.update")
+        finally:
+            chaos.disarm()
+
+    def test_rejects_unknown_site_and_mode(self):
+        import pytest as _pt
+
+        with _pt.raises(ValueError):
+            chaos.plan_from_config({"sites": {"no.such.site": [
+                {"mode": "always"}]}})
+        with _pt.raises(ValueError):
+            chaos.plan_from_config({"sites": {"serving.dispatch": [
+                {"mode": "sideways"}]}})

@@ -407,7 +407,9 @@ def test_model_list_and_cluster_slices_endpoints(console):
     m.metadata.name = "m1"
     op.store.create(m)
     mv = ModelVersion(model_name="m1", image="repo:v1",
-                      phase=ModelVersionPhase.SUCCEEDED)
+                      phase=ModelVersionPhase.SUCCEEDED,
+                      parent_version="m1-v0",
+                      checkpoint_fingerprint="sha256:abc123")
     mv.metadata.name = "m1-v1"
     op.store.create(mv)
     status, resp = call(srv, "GET", "/api/v1/model/list")
@@ -415,6 +417,10 @@ def test_model_list_and_cluster_slices_endpoints(console):
     assert [x["name"] for x in models] == ["m1"]
     assert models[0]["versions"][0]["image"] == "repo:v1"
     assert models[0]["versions"][0]["phase"] == "Succeeded"
+    # rollout provenance rides the console view (PR 17 lineage fields)
+    assert models[0]["versions"][0]["parent_version"] == "m1-v0"
+    assert (models[0]["versions"][0]["checkpoint_fingerprint"]
+            == "sha256:abc123")
 
 
 def test_frontend_spa_served(console):

@@ -181,6 +181,8 @@ class TestGraftEntry:
         jax.block_until_ready(out)
         assert out.shape[0] == args[1].shape[0]
 
+    @pytest.mark.slow  # 8 fake XLA devices on a 1-core box: minutes of
+    # compile alone, reliably past the tier-1 wall-clock budget
     def test_dryrun_multichip(self):
         import __graft_entry__ as ge
 

@@ -50,7 +50,7 @@ def test_subprocess_job_lifecycle(tmp_path):
         op.submit(job)
         got = op.wait_for_phase(
             "TPUJob", "e2e", [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
-            timeout=30,
+            timeout=_phase_deadline(30),
         )
         assert got.status.phase == JobConditionType.SUCCEEDED, got.status.conditions
         # launch-delay metrics observed
@@ -90,7 +90,7 @@ def test_thread_job_builds_model_version(tmp_path):
         op.submit(job)
         got = op.wait_for_phase(
             "TPUJob", "train", [JobConditionType.SUCCEEDED, JobConditionType.FAILED],
-            timeout=30,
+            timeout=_phase_deadline(30),
         )
         assert got.status.phase == JobConditionType.SUCCEEDED
         # lineage: ModelVersion built into the artifact registry
@@ -121,7 +121,7 @@ def test_failed_process_marks_job_failed(tmp_path):
         op.submit(job)
         got = op.wait_for_phase(
             "TPUJob", "boom", [JobConditionType.FAILED, JobConditionType.SUCCEEDED],
-            timeout=30,
+            timeout=_phase_deadline(30),
         )
         assert got.status.phase == JobConditionType.FAILED
         assert op.metrics.failed.value(kind="TPUJob") == 1

@@ -1,0 +1,181 @@
+"""Rollout-controller tests (docs/serving.md "Model lifecycle"): the
+weight ladder, the SLO-burn rollback gate on the canary's OWN partition,
+the RolledBack condition's postmortem payload, and the re-promotion
+fence — all on a fake clock, no sockets."""
+
+import pytest
+
+from kubedl_tpu.serving.rollout import (
+    COMPLETE,
+    PENDING,
+    PROGRESSING,
+    ROLLED_BACK,
+    RolloutController,
+    RolloutFenced,
+)
+from kubedl_tpu.serving.router import ServingRouter
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+#: One tight alert pair so tests can burn it with a handful of events:
+#: objective 90%, page when both the 5s and 30s windows burn >= 2x.
+SLO = {
+    "objective": 0.9,
+    "latency_objective_ms": None,
+    "alerts": [{"severity": "page", "short_s": 5.0, "long_s": 30.0,
+                "threshold": 2.0}],
+}
+
+
+def _rig(soak_s=10.0):
+    clk = FakeClock()
+    router = ServingRouter(hedge_enabled=False, clock=clk, slo=SLO)
+    ctrl = RolloutController(router, canary_version="v2",
+                             baseline_version="v1",
+                             steps=(1, 10, 50, 100),
+                             soak_s=soak_s, clock=clk)
+    return clk, router, ctrl
+
+
+def _burn(router, version, n=20, trace_id="t-bad"):
+    """Feed the version's partition enough failures to fire both windows."""
+    tr = router.version_tracker(version)
+    for _ in range(n):
+        tr.observe(ok=False, latency_ms=1.0, trace_id=trace_id)
+
+
+class TestLadder:
+    def test_clean_soak_walks_ladder_then_promotes(self):
+        clk, router, ctrl = _rig(soak_s=10.0)
+        ctrl.begin()
+        assert ctrl.phase == PROGRESSING
+        assert router.version_weights() == {"v1": 99, "v2": 1}
+        assert ctrl.tick() == "soaking"  # soak not elapsed
+        for expect in ({"v1": 90, "v2": 10}, {"v1": 50, "v2": 50},
+                       {"v1": 0, "v2": 100}):
+            clk.t += 10.0
+            assert ctrl.tick() == "advanced"
+            assert router.version_weights() == expect
+        clk.t += 10.0
+        assert ctrl.tick() == "promoted"
+        assert ctrl.phase == COMPLETE
+        assert router.version_weights() == {"v1": 0, "v2": 100}
+        assert ctrl.tick() == "idle"  # terminal: no further action
+        m = router.metrics
+        assert m.rollout_events.value(event="advance") == 3.0
+        assert m.rollout_events.value(event="promote") == 1.0
+
+    def test_begin_is_idempotent_while_progressing(self):
+        clk, router, ctrl = _rig()
+        ctrl.begin()
+        clk.t += 10.0
+        ctrl.tick()
+        ctrl.begin()  # no-op: must not reset the ladder to step 0
+        assert router.version_weights() == {"v1": 90, "v2": 10}
+
+    def test_step_validation(self):
+        clk, router, _ = _rig()
+        for bad in ((), (10, 5, 100), (50,), (0, 100), (1, 10, 110)):
+            with pytest.raises(ValueError):
+                RolloutController(router, "v2", "v1", steps=bad, clock=clk)
+        with pytest.raises(ValueError):
+            RolloutController(router, "v1", "v1", clock=clk)
+
+
+class TestRollback:
+    def test_canary_burn_rolls_back_in_one_flip(self):
+        clk, router, ctrl = _rig(soak_s=10.0)
+        ctrl.begin()
+        _burn(router, "v2", trace_id="t-exemplar")
+        assert ctrl.tick() == "rolled_back"
+        assert ctrl.phase == ROLLED_BACK
+        # ONE weight flip: baseline owns everything, canary fenced at 0
+        assert router.version_weights() == {"v1": 100, "v2": 0}
+        assert router.metrics.rollout_events.value(event="rollback") == 1.0
+        assert router.metrics.version_burning.value(
+            version="v2", severity="page") == 1.0
+        assert ctrl.tick() == "idle"
+
+    def test_rollback_fires_mid_soak_not_just_on_advance(self):
+        clk, router, ctrl = _rig(soak_s=1000.0)
+        ctrl.begin()
+        _burn(router, "v2")
+        # the soak timer has NOT elapsed — burn still wins immediately
+        assert ctrl.tick() == "rolled_back"
+
+    def test_rolled_back_condition_carries_postmortem_payload(self):
+        clk, router, ctrl = _rig()
+        ctrl.begin()
+        _burn(router, "v2", trace_id="t-1234")
+        ctrl.tick()
+        cond = ctrl.conditions[-1]
+        assert cond["type"] == "RolledBack" and cond["reason"] == "SLOBurn"
+        assert cond["severity"] == "page"
+        assert cond["short_s"] == 5.0 and cond["long_s"] == 30.0
+        assert cond["short_burn"] >= 2.0 and cond["long_burn"] >= 2.0
+        assert cond["trace_id"] == "t-1234"  # the exemplar: /v1/trace entry
+        assert "t-1234" in cond["message"]
+
+    def test_baseline_burn_does_not_roll_back(self):
+        """The gate reads the canary's OWN partition: a baseline that is
+        also unhealthy must not blame (or mask) the canary."""
+        clk, router, ctrl = _rig(soak_s=10.0)
+        ctrl.begin()
+        clk.t += 10.0
+        _burn(router, "v1")  # fresh burn, inside both windows at tick
+        assert ctrl.tick() == "advanced"
+        assert router.metrics.version_burning.value(
+            version="v1", severity="page") == 1.0
+        assert router.metrics.version_burning.value(
+            version="v2", severity="page") == 0.0
+
+    def test_burn_clears_with_time_window_rule(self):
+        """Both windows must burn: once the short window ages out the
+        bad events, the alert clears and the ladder advances again."""
+        clk, router, ctrl = _rig(soak_s=10.0)
+        ctrl.begin()
+        tr = router.version_tracker("v2")
+        for _ in range(20):
+            tr.observe(ok=False, latency_ms=1.0)
+        clk.t += 6.0  # past short_s: the 5s window is clean now
+        for _ in range(5):
+            tr.observe(ok=True, latency_ms=1.0)
+        clk.t += 4.0  # soak elapsed; long window still dirty, short not
+        assert ctrl.tick() == "advanced"
+
+
+class TestFence:
+    def test_rolled_back_version_is_fenced_until_cleared(self):
+        clk, router, ctrl = _rig()
+        ctrl.begin()
+        _burn(router, "v2")
+        ctrl.tick()
+        assert "v2" in ctrl.fenced()
+        with pytest.raises(RolloutFenced):
+            ctrl.begin()
+        assert ctrl.clear_fence() is True
+        assert ctrl.clear_fence() is False  # idempotent
+        assert ctrl.phase == PENDING
+        assert router.metrics.rollout_events.value(
+            event="fence_cleared") == 1.0
+        ctrl.begin()  # manual clear re-opens promotion
+        assert ctrl.phase == PROGRESSING
+        assert router.version_weights() == {"v1": 99, "v2": 1}
+
+    def test_status_surfaces_fence_and_conditions(self):
+        clk, router, ctrl = _rig()
+        ctrl.begin()
+        _burn(router, "v2")
+        ctrl.tick()
+        st = ctrl.status()
+        assert st["phase"] == ROLLED_BACK
+        assert st["fenced"] == ["v2"]
+        assert st["weight"] == 0
+        assert any(c["type"] == "RolledBack" for c in st["conditions"])

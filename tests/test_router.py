@@ -425,14 +425,85 @@ class TestRouterPath:
     def test_router_kwargs_parses_config(self):
         kw = router_kwargs({
             "eject_threshold": "4", "hedge_floor_ms": "25",
+            "version_weights": {"v1": "90", "v2": 10},
             "replicas": [{"name": "r0", "port": 9000, "weight": 50}],
         })
         assert kw["eject_threshold"] == 4
         assert kw["hedge_floor_ms"] == 25.0
+        assert kw["version_weights"] == {"v1": 90, "v2": 10}
         assert kw["replicas"] == [
             {"name": "r0", "host": "127.0.0.1", "port": 9000,
              "weight": 50, "role": "", "model": ""}
         ]
+
+    def test_version_weights_tag_and_split_deterministically(self, fleet):
+        """The canary split: untagged requests get a model_version from
+        the smooth-WRR over the configured weights (deterministic — same
+        interleave every run), client-pinned versions pass through, and
+        every version feeds its own SLO partition."""
+        make, servers = fleet
+        a = make("a")
+        r = ServingRouter([("a", "127.0.0.1", a.server_port)],
+                          hedge_enabled=False,
+                          version_weights={"v1": 75, "v2": 25})
+        for _ in range(8):
+            code, _, _ = r.handle_generate({"prompt_ids": [1]}, 5000)
+            assert code == 200
+        tags = [c["req"]["model_version"] for c in a.calls]
+        assert tags.count("v1") == 6 and tags.count("v2") == 2
+        # a client-pinned version is never rewritten by the split
+        r.handle_generate(
+            {"prompt_ids": [1], "model_version": "v9"}, 5000)
+        assert a.calls[-1]["req"]["model_version"] == "v9"
+        st = r.stats()["versions"]
+        assert st["weights"] == {"v1": 75, "v2": 25}
+        assert st["slo"]["v1"]["requests"] == 6
+        assert st["slo"]["v2"]["requests"] == 2
+        assert r.metrics.version_requests.value(
+            version="v1", result="ok") == 6.0
+        assert r.metrics.rollout_weight.value(version="v2") == 25.0
+
+    def test_version_sticky_across_failover(self, fleet):
+        """A request keeps its model_version across retry legs: a hedge
+        or failover answering with a different version would be a silent
+        model swap."""
+        make, servers = fleet
+        a = make("a", shed=True)
+        b = make("b")
+        r = ServingRouter([("a", "127.0.0.1", a.server_port),
+                           ("b", "127.0.0.1", b.server_port)],
+                          hedge_enabled=False, affinity_prefix_len=0,
+                          version_weights={"v2": 100})
+        with r._lock:
+            r._replicas["b"].stats = {"queued": 50}  # a goes primary
+        code, payload, _ = r.handle_generate({"prompt_ids": [1]}, 5000)
+        assert code == 200 and payload["served_by"] == "b"
+        assert a.calls[0]["req"]["model_version"] == "v2"
+        assert b.calls[0]["req"]["model_version"] == "v2"
+
+    def test_version_slo_partition_isolates_failures(self, fleet):
+        """A failing version burns ITS tracker, not the other's — the
+        partition the rollout controller gates on."""
+        make, servers = fleet
+        a = make("a")
+        r = ServingRouter([("a", "127.0.0.1", a.server_port)],
+                          hedge_enabled=False,
+                          slo={"objective": 0.5},
+                          version_weights={"v1": 100})
+        r.handle_generate({"prompt_ids": [1]}, 5000)
+        # v2 requests fail (client-pinned, upstream 404s them here via
+        # a dead port after we kill the replica)
+        r.set_version_weights({"v1": 50, "v2": 50})
+        a.behavior["shed"] = True
+        code, _, _ = r.handle_generate(
+            {"prompt_ids": [1], "model_version": "v2"}, 5000)
+        assert code == 503
+        v1 = r.version_tracker("v1").snapshot()
+        v2 = r.version_tracker("v2").snapshot()
+        assert v1["requests"] == 1 and v1["bad"] == 0
+        assert v2["requests"] == 1 and v2["bad"] == 1
+        assert r.metrics.version_requests.value(
+            version="v2", result="error") == 1.0
 
 
 def test_sync_from_store_builds_fleet_from_control_plane():
@@ -471,6 +542,72 @@ def test_sync_from_store_builds_fleet_from_control_plane():
     for rep in st.values():
         assert rep["url"].endswith(f":{HTTP_PORT}")
         assert rep["weight"] == 100
+
+
+def test_sync_from_store_weight_zero_stays_unroutable():
+    """Regression: a predictor ABSENT from an armed TrafficPolicy's
+    routes (weight 0 — the controller pulled it from rotation) must stay
+    registered-but-unroutable. The old default resurrected it at weight
+    100 on every router restart and breaker half-open readmission."""
+    from kubedl_tpu.core.objects import PodPhase
+    from kubedl_tpu.core.store import ObjectStore
+    from kubedl_tpu.lineage.types import ModelVersion, ModelVersionPhase
+    from kubedl_tpu.serving.controller import InferenceController
+    from kubedl_tpu.serving.types import Inference, Predictor, TrafficRoute
+
+    store = ObjectStore()
+    mv = ModelVersion(model_name="m", phase=ModelVersionPhase.SUCCEEDED,
+                      image="m:v1", storage_root="/tmp/x")
+    mv.metadata.name = "m-v1"
+    store.create(mv)
+    inf = Inference(predictors=[
+        Predictor(name="main", model_version="m-v1", replicas=1),
+        Predictor(name="canary", model_version="m-v1", replicas=1),
+    ])
+    inf.metadata.name = "svc"
+    store.create(inf)
+    ctrl = InferenceController(store, local_addresses=True)
+    ctrl.reconcile("default", "svc")
+    for p in store.list("Pod"):
+        def mut(o):
+            o.status.phase = PodPhase.RUNNING
+        store.update_with_retry("Pod", p.metadata.name, "default", mut)
+    ctrl.reconcile("default", "svc")
+
+    # the operator takes the canary out of rotation: its route vanishes
+    def drop_canary(tp):
+        tp.routes = [TrafficRoute(predictor="main", weight=100,
+                                  service="svc-main")]
+    store.update_with_retry("TrafficPolicy", "svc", "default", drop_canary)
+
+    r = ServingRouter(hedge_enabled=False)
+    r.sync_from_store(store, "svc")
+    st = r.stats()["replicas"]
+    assert st["svc-main-0"]["weight"] == 100
+    assert st["svc-canary-0"]["weight"] == 0
+    # unroutable means unroutable: never selected for dispatch
+    sel = r._select({"prompt_ids": [1]}, set())
+    assert sel is not None and sel.name == "svc-main-0"
+    # a breaker half-open readmission touches health, never weight
+    rep = r._replicas["svc-canary-0"]
+    for _ in range(3):
+        rep.breaker.record_failure()
+    rep.breaker.record_success()
+    assert rep.weight == 0
+    assert r._select({"prompt_ids": [1]}, {"svc-main-0"}) is None
+    # a router restart re-syncs from the store: still weight 0
+    r2 = ServingRouter(hedge_enabled=False)
+    r2.sync_from_store(store, "svc")
+    assert r2.stats()["replicas"]["svc-canary-0"]["weight"] == 0
+    # routes=[] (nothing ready per the controller): EVERY pod unroutable
+    def clear_routes(tp):
+        tp.routes = []
+    store.update_with_retry("TrafficPolicy", "svc", "default", clear_routes)
+    r2.sync_from_store(store, "svc")
+    assert all(v["weight"] == 0
+               for v in r2.stats()["replicas"].values())
+    code, payload, _ = r2.handle_generate({"prompt_ids": [1]}, 1000)
+    assert code == 503 and payload["reason"] == "no_replica"
 
 
 # ---------------------------------------------------------------------------
