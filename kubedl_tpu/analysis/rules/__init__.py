@@ -10,6 +10,7 @@ from kubedl_tpu.analysis.rules import (
     ps_chaos_tests,
     schema_drift,
     span_names,
+    store_construction,
 )
 
 #: engine iterates this; order = report order
@@ -22,6 +23,7 @@ ALL_RULES = [
     schema_drift,    # KTL006
     span_names,      # KTL007
     ps_chaos_tests,  # KTL008
+    store_construction,  # KTL009
 ]
 
 RULE_IDS = {m.RULE_ID: m for m in ALL_RULES}

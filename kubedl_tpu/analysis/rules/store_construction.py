@@ -1,0 +1,65 @@
+"""KTL009 — unsharded store construction.
+
+PR 18 split the control plane into reconcile-domain shards behind one
+client-facing surface (:class:`kubedl_tpu.shards.store.ShardedObjectStore`).
+The failure mode this rule pins: a controller (or a future subsystem)
+quietly building its own bare ``ObjectStore`` — its objects then live
+outside every shard map, skip the per-shard WAL/lease fencing, and its
+watches never reach the sharded fan-out, which is exactly the
+split-brain-by-construction bug the fencing discipline exists to prevent.
+
+All object access must go through the sharded client API. Direct
+``ObjectStore(...)`` construction is allowed only in:
+
+- ``kubedl_tpu/shards/`` (the facade owns its shard-local stores), and
+- blessed entry points with their OWN partitioning/fencing discipline
+  (the parameter service mirrors PS-shard state in a private store).
+
+Everything else must take a store as a dependency or build a
+``ShardedObjectStore`` (``shards=1`` is behaviorally identical to the
+old bare store).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+RULE_ID = "KTL009"
+
+#: directories whose files may construct shard-local stores directly
+ALLOWED_PREFIXES = ("kubedl_tpu/shards/",)
+
+#: entry points with their own partitioning/fencing discipline
+BLESSED_FILES = {
+    # PS service keeps a private mirror store per PS shard (PR 15's
+    # lease-fenced discipline — the pattern this rule generalizes)
+    "kubedl_tpu/ps/service.py",
+}
+
+
+def _constructs_object_store(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id == "ObjectStore"
+    if isinstance(f, ast.Attribute):
+        return f.attr == "ObjectStore"
+    return False
+
+
+def check_file(ctx) -> List["Finding"]:  # noqa: F821 — engine's Finding
+    if ctx.relpath.startswith(ALLOWED_PREFIXES) \
+            or ctx.relpath in BLESSED_FILES:
+        return []
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _constructs_object_store(node):
+            findings.append(ctx.finding(
+                RULE_ID, node.lineno,
+                "direct ObjectStore construction outside kubedl_tpu/shards/ "
+                "— objects built here bypass the shard map, per-shard "
+                "WAL/lease fencing, and sharded watch fan-out; take a store "
+                "as a dependency or build shards.ShardedObjectStore "
+                "(shards=1 is the old behavior)",
+            ))
+    return findings

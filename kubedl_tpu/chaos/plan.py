@@ -35,6 +35,8 @@ Injection sites wired in this repo::
     ps.push                                      drop a parameter-service push
     ps.pull                                      drop a parameter-service pull
     ps.shard_failover                            kill a PS shard's owner mid-run
+    shard.lease_renew                            skip a control-plane shard lease renewal beat
+    shard.wal_append                             fail a fenced shard WAL append
 
 Schedules are per-site and deterministic: ``nth(n)`` fails exactly the
 n-th call (1-based), ``first(k)`` fails the first k calls, ``prob(p, k)``
@@ -87,6 +89,8 @@ SITES: Dict[str, str] = {
     "ps.push": "drop a parameter-service push",
     "ps.pull": "drop a parameter-service pull",
     "ps.shard_failover": "kill a PS shard's owner mid-run",
+    "shard.lease_renew": "skip a control-plane shard lease renewal beat",
+    "shard.wal_append": "fail a fenced shard WAL append",
 }
 
 

@@ -1,10 +1,18 @@
 """Controller manager: the ctrl.Manager analogue.
 
 Owns the object store, an event recorder, and a set of controllers; each
-controller gets a rate-limited workqueue fed by store watch events and a pool
+controller gets rate-limited workqueues fed by store watch events and a pool
 of worker threads calling ``reconcile(namespace, name)`` — mirroring the
 reference's wiring (main.go:76-118, SetupWithManager watch registration in
 each controller, e.g. tfjob_controller.go:183-219).
+
+Since the control plane sharded (kubedl_tpu/shards/), a registration owns
+ONE workqueue PER SHARD: watch events route each reconcile key to the queue
+of the shard that owns it (``store.shard_for_key``), and every shard gets
+its own worker pool — N reconcile domains that never contend on one queue
+lock. Against a plain :class:`~kubedl_tpu.core.store.ObjectStore` or a
+single-shard facade the manager collapses to exactly the old shape: one
+queue, one worker pool, identical thread names.
 """
 
 from __future__ import annotations
@@ -17,7 +25,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from kubedl_tpu.core.objects import BaseObject, Event
-from kubedl_tpu.core.store import AlreadyExists, ObjectStore
+from kubedl_tpu.core.store import AlreadyExists
 from kubedl_tpu.core.workqueue import WorkQueue
 
 log = logging.getLogger("kubedl_tpu.manager")
@@ -26,15 +34,20 @@ Key = Tuple[str, str]  # (namespace, name)
 #: maps a watch event to reconcile keys; None -> drop the event
 EventMapper = Callable[[str, BaseObject, Optional[BaseObject]], List[Key]]
 
+#: label stamped on Events recording which reconcile domain emitted them
+SHARD_LABEL = "kubedl-tpu/shard"
+
 
 class EventRecorder:
     """Writes Event objects into the store, deduplicating by
     (involved, reason) the way client-go's recorder aggregates: a repeat
     with the same message bumps the count; a repeat with a NEW message
     (e.g. a second Planned verdict after an elastic resize) bumps the
-    count and carries the latest message."""
+    count and carries the latest message. Against a sharded store each
+    Event is labeled with the shard of its involved object, so per-shard
+    hot spots are visible straight from ``kubectl get events``."""
 
-    def __init__(self, store: ObjectStore) -> None:
+    def __init__(self, store) -> None:
         self._store = store
         self._lock = threading.Lock()
 
@@ -67,6 +80,9 @@ class EventRecorder:
             )
             ev.metadata.name = name
             ev.metadata.namespace = obj.metadata.namespace
+            shard_of = getattr(self._store, "shard_for_object", None)
+            if shard_of is not None:
+                ev.metadata.labels[SHARD_LABEL] = str(shard_of(obj))
             try:
                 self._store.create(ev)
             except AlreadyExists:
@@ -94,7 +110,8 @@ def owner_mapper(primary_kind: str) -> EventMapper:
 class _Registration:
     name: str
     reconcile: Callable[[str, str], Optional[float]]
-    queue: WorkQueue
+    #: one workqueue per reconcile-domain shard
+    queues: List[WorkQueue]
     workers: int = 1
     threads: List[threading.Thread] = field(default_factory=list)
     #: list-then-watch: enqueue every current object's keys at start()
@@ -104,15 +121,45 @@ class _Registration:
 
 
 class ControllerManager:
-    def __init__(self, store: Optional[ObjectStore] = None) -> None:
-        self.store = store or ObjectStore()
+    def __init__(self, store=None, metrics=None) -> None:
+        if store is None:
+            from kubedl_tpu.shards.store import ShardedObjectStore
+
+            store = ShardedObjectStore(shards=1)
+        self.store = store
+        #: reconcile domains — 1 for a plain ObjectStore
+        self.shards: int = getattr(store, "num_shards", 1)
+        #: JobMetrics (or None): reconcile/workqueue families get per-shard
+        #: labels so hot domains show up in /metrics
+        self.metrics = metrics
         self.recorder = EventRecorder(self.store)
+        #: when set (bench probe), every reconcile appends its duration in
+        #: seconds — the controller-runtime reconcile-time definition
+        #: (queue wait is the workqueue's metric, not the reconciler's)
+        self.latency_samples: Optional[List[float]] = None
+        #: when set (bench probe), every reconcile appends the seconds its
+        #: key sat queued before this pass — the workqueue-wait metric
+        self.queue_wait_samples: Optional[List[float]] = None
         self._registrations: List[_Registration] = []
         self._cancels: List[Callable[[], None]] = []
         self._running = False
         self._gc_interval = 1.0
         self._gc_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+
+    # ---- key routing -----------------------------------------------------
+
+    def _shard_of(self, key: Key) -> int:
+        shard_for_key = getattr(self.store, "shard_for_key", None)
+        if shard_for_key is None:
+            return 0
+        return shard_for_key(key[0], key[1])
+
+    def _enqueue(self, reg: _Registration, key: Key) -> None:
+        owns_key = getattr(self.store, "owns_key", None)
+        if owns_key is not None and not owns_key(key[0], key[1]):
+            return  # another owner's reconcile domain
+        reg.queues[self._shard_of(key)].add(key)
 
     def register(
         self,
@@ -124,17 +171,20 @@ class ControllerManager:
         resync_on_start: bool = False,
     ) -> WorkQueue:
         """Wire a controller: watch ``watch_kinds``, map events to keys, feed
-        a dedicated workqueue drained by ``workers`` threads.
+        per-shard workqueues each drained by ``workers`` threads.
 
         ``resync_on_start=True`` gives the registration informer
         list-then-watch semantics: every :meth:`start` synthesizes ADDED
         events from current state through the mapper, so keys that existed
         before the watch (a rehydrated store, a leader takeover) are
         re-enqueued instead of waiting for their next mutation. A fresh
-        store makes it a no-op."""
-        queue: WorkQueue = WorkQueue()
+        store makes it a no-op.
+
+        Returns shard 0's queue (the only queue against an unsharded
+        store — kept for callers that introspect it in tests)."""
+        queues = [WorkQueue() for _ in range(self.shards)]
         reg = _Registration(
-            name=name, reconcile=reconcile, queue=queue, workers=workers,
+            name=name, reconcile=reconcile, queues=queues, workers=workers,
             resync_on_start=resync_on_start,
             watch_kinds=tuple(watch_kinds), mapper=mapper,
         )
@@ -142,34 +192,85 @@ class ControllerManager:
 
         def on_event(event: str, obj: BaseObject, old: Optional[BaseObject]) -> None:
             for key in mapper(event, obj, old):
-                queue.add(key)
+                self._enqueue(reg, key)
 
         self._cancels.append(self.store.watch(on_event, kinds=watch_kinds))
-        return queue
+        return queues[0]
 
     # ---- run loop --------------------------------------------------------
 
-    def _worker(self, reg: _Registration) -> None:
+    #: depth-balanced stealing hysteresis: a sibling queue must be this
+    #: many items deeper than the worker's own before it steals from it
+    STEAL_SLACK = 8
+
+    def _worker(self, reg: _Registration, shard: int) -> None:
+        queues = reg.queues
+        n = len(queues)
         while not self._stop.is_set():
-            key = reg.queue.get(timeout=0.2)
+            # Work-stealing keeps the sharded domains work-conserving: a
+            # key's backlog is pinned to its home shard's queue, so a
+            # worker whose sibling queue (same process — the facade owns
+            # both domains) is substantially deeper drains that backlog
+            # instead of letting the hot shard's tail grow, and an idle
+            # worker sweeps every sibling before blocking. The source
+            # queue's processing set still serializes each key, and
+            # latency/metric labels keep the key's HOME shard.
+            src, key = shard, None
+            if n > 1:
+                deepest = max(range(n), key=lambda i: len(queues[i]))
+                if (
+                    deepest != shard
+                    and len(queues[deepest])
+                    > len(queues[shard]) + self.STEAL_SLACK
+                ):
+                    src, key = deepest, queues[deepest].get(timeout=0)
+            if key is None:
+                src = shard
+                key = queues[shard].get(timeout=0.2 if n == 1 else 0.05)
+            if key is None and n > 1:
+                for off in range(1, n):
+                    j = (shard + off) % n
+                    key = queues[j].get(timeout=0)
+                    if key is not None:
+                        src = j
+                        break
             if key is None:
                 continue
+            queue = queues[src]
+            shard_label = str(src)
+            wait = queue.wait_seconds(key)
+            t0 = time.perf_counter()
             try:
                 requeue_after = reg.reconcile(*key)
             except Exception:
                 log.error(
-                    "controller %s: reconcile %s failed:\n%s",
+                    "controller %s[shard %d]: reconcile %s failed:\n%s",
                     reg.name,
+                    shard,
                     key,
                     traceback.format_exc(),
                 )
-                reg.queue.add_rate_limited(key)
+                queue.add_rate_limited(key)
             else:
-                reg.queue.forget(key)
+                queue.forget(key)
                 if requeue_after is not None:
-                    reg.queue.add_after(key, requeue_after)
+                    queue.add_after(key, requeue_after)
             finally:
-                reg.queue.done(key)
+                queue.done(key)
+                duration = time.perf_counter() - t0
+                samples = self.latency_samples
+                if samples is not None:
+                    samples.append(duration)
+                waits = self.queue_wait_samples
+                if waits is not None:
+                    waits.append(wait)
+                if self.metrics is not None:
+                    self.metrics.reconciles.inc(
+                        controller=reg.name, shard=shard_label
+                    )
+                    self.metrics.reconcile_latency.observe(
+                        duration, controller=reg.name, shard=shard_label
+                    )
 
     def _gc_loop(self) -> None:
         while not self._stop.wait(self._gc_interval):
@@ -188,21 +289,35 @@ class ControllerManager:
                 for kind in reg.watch_kinds:
                     for obj in self.store.list(kind, namespace=None):
                         for key in reg.mapper("ADDED", obj, None):
-                            reg.queue.add(key)
+                            self._enqueue(reg, key)
         for reg in self._registrations:
-            for i in range(reg.workers):
-                t = threading.Thread(
-                    target=self._worker, args=(reg,), name=f"{reg.name}-{i}", daemon=True
-                )
-                reg.threads.append(t)
-                t.start()
+            for shard in range(self.shards):
+                for i in range(reg.workers):
+                    # single-domain keeps the historical thread names
+                    tname = (
+                        f"{reg.name}-{i}" if self.shards == 1
+                        else f"{reg.name}-s{shard}-{i}"
+                    )
+                    t = threading.Thread(
+                        target=self._worker, args=(reg, shard),
+                        name=tname, daemon=True,
+                    )
+                    reg.threads.append(t)
+                    t.start()
+            if self.metrics is not None:
+                for shard, queue in enumerate(reg.queues):
+                    self.metrics.workqueue_depth.set_function(
+                        lambda q=queue: float(len(q)),
+                        controller=reg.name, shard=str(shard),
+                    )
         self._gc_thread = threading.Thread(target=self._gc_loop, daemon=True, name="gc")
         self._gc_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
         for reg in self._registrations:
-            reg.queue.shutdown()
+            for queue in reg.queues:
+                queue.shutdown()
         for reg in self._registrations:
             for t in reg.threads:
                 t.join(timeout=2.0)
@@ -216,10 +331,9 @@ class ControllerManager:
         self._running = False
 
     def kick_all(self) -> None:
-        """Enqueue every primary object once (startup resync)."""
-        for reg in self._registrations:
-            pass  # registrations enqueue via watches; initial objects:
-        # list every kind currently in the store and replay ADDED events
+        """Enqueue every primary object once (startup resync): list every
+        kind currently in the store and replay ADDED through the watch
+        path, which fans out to each registration's mapper."""
         for kind in self.store.kinds():
             for obj in self.store.list(kind, namespace=None):
                 self.store._notify("ADDED", obj, None)  # noqa: SLF001

@@ -59,6 +59,7 @@ class ObjectStore:
         wal_dir: Optional[str] = None,
         wal_fsync: str = "always",
         wal_snapshot_every: int = 1000,
+        wal_fsync_floor: float = 0.0,
     ) -> None:
         self._lock = threading.RLock()
         self._objects: Dict[str, Dict[Tuple[str, str], BaseObject]] = {}
@@ -75,7 +76,9 @@ class ObjectStore:
         self.replayed_records = 0
         self.recovery_seconds = 0.0
         if wal_dir:
-            self._open_wal(wal_dir, wal_fsync, wal_snapshot_every)
+            self._open_wal(
+                wal_dir, wal_fsync, wal_snapshot_every, wal_fsync_floor
+            )
 
     # ---- durability (WAL) ------------------------------------------------
 
@@ -84,7 +87,13 @@ class ObjectStore:
         with self._lock:
             return self._rv
 
-    def _open_wal(self, wal_dir: str, fsync: str, snapshot_every: int) -> None:
+    def _open_wal(
+        self,
+        wal_dir: str,
+        fsync: str,
+        snapshot_every: int,
+        fsync_floor: float = 0.0,
+    ) -> None:
         """Replay snapshot+log into memory, then arm the WAL on the write
         path. Runs in the constructor so every object is back before any
         watcher or controller exists."""
@@ -92,7 +101,12 @@ class ObjectStore:
         from kubedl_tpu.core.wal import WriteAheadLog
 
         t0 = time.perf_counter()
-        wal = WriteAheadLog(wal_dir, fsync=fsync, snapshot_every=snapshot_every)
+        wal = WriteAheadLog(
+            wal_dir,
+            fsync=fsync,
+            snapshot_every=snapshot_every,
+            fsync_floor=fsync_floor,
+        )
         snap_rev, snap_objs, records = wal.recover()
         max_uid = 0
         with self._lock:

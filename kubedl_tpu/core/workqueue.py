@@ -28,6 +28,9 @@ class WorkQueue(Generic[T]):
         self._delayed: List[Tuple[float, int, T]] = []  # heap by ready-time
         self._seq = 0
         self._failures: Dict[T, int] = {}
+        #: wall-clock of each item's FIRST pending enqueue, popped by
+        #: wait_seconds() — feeds the per-shard reconcile-latency metric
+        self._enqueued: Dict[T, float] = {}
         self._base_delay = base_delay
         self._max_delay = max_delay
         self._shutdown = False
@@ -37,6 +40,7 @@ class WorkQueue(Generic[T]):
             if self._shutdown or item in self._dirty:
                 return
             self._dirty.add(item)
+            self._enqueued.setdefault(item, time.time())
             if item not in self._processing:
                 self._queue.append(item)
                 self._cond.notify()
@@ -76,6 +80,7 @@ class WorkQueue(Generic[T]):
             _, _, item = heapq.heappop(self._delayed)
             if item not in self._dirty:
                 self._dirty.add(item)
+                self._enqueued.setdefault(item, now)
                 if item not in self._processing:
                     self._queue.append(item)
         return (self._delayed[0][0] - now) if self._delayed else None
@@ -100,6 +105,13 @@ class WorkQueue(Generic[T]):
                         return None
                     wait = remaining if wait is None else min(wait, remaining)
                 self._cond.wait(wait)
+
+    def wait_seconds(self, item: T) -> float:
+        """Seconds the just-``get``-ed item sat queued (first enqueue to
+        now); 0.0 when unknown. Pops the mark — call once per get."""
+        with self._cond:
+            ts = self._enqueued.pop(item, None)
+        return 0.0 if ts is None else max(0.0, time.time() - ts)
 
     def done(self, item: T) -> None:
         with self._cond:

@@ -13,6 +13,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
+from typing import Callable
 
 
 EXPECTATION_TIMEOUT = 5 * 60.0
@@ -116,3 +117,68 @@ class ControllerExpectations:
                 for k, exp in self._exps.items()
                 if k.startswith(prefix)
             )
+
+
+class ShardedExpectations:
+    """Per-reconcile-domain expectations: one ControllerExpectations per
+    shard, routed by the job key prefix of every expectation key (both
+    plain job keys ``ns/name`` and full ``ns/name/rtype/{pods,services}``
+    keys start with the routing prefix). Shard failover then clears ONE
+    domain's cache (:meth:`clear_shard`) instead of the whole world —
+    expectations recorded by a dead shard owner never suppress the new
+    owner's reconciles, while every other domain keeps its state."""
+
+    def __init__(self, route: "ShardRouter", shards: int) -> None:
+        self._route = route
+        self._shards = [ControllerExpectations() for _ in range(shards)]
+
+    def _for(self, key: str) -> ControllerExpectations:
+        parts = key.split("/")
+        namespace = parts[0]
+        name = parts[1] if len(parts) > 1 else ""
+        return self._shards[self._route(namespace, name)]
+
+    def shard(self, i: int) -> ControllerExpectations:
+        return self._shards[i]
+
+    def clear_shard(self, i: int) -> None:
+        """The failover-scoped analogue of :meth:`clear`."""
+        self._shards[i].clear()
+
+    # -- the ControllerExpectations surface, routed -----------------------
+
+    def expect_creations(self, key: str, count: int) -> None:
+        self._for(key).expect_creations(key, count)
+
+    def expect_deletions(self, key: str, count: int) -> None:
+        self._for(key).expect_deletions(key, count)
+
+    def creation_observed(self, key: str) -> None:
+        self._for(key).creation_observed(key)
+
+    def deletion_observed(self, key: str) -> None:
+        self._for(key).deletion_observed(key)
+
+    def satisfied(self, key: str) -> bool:
+        return self._for(key).satisfied(key)
+
+    def delete_expectations(self, key: str) -> None:
+        self._for(key).delete_expectations(key)
+
+    def clear(self) -> None:
+        for exp in self._shards:
+            exp.clear()
+
+    def collect_expired(self, job_key: str) -> list[str]:
+        return self._for(job_key).collect_expired(job_key)
+
+    def delete_job_expectations(self, job_key: str) -> None:
+        self._for(job_key).delete_job_expectations(job_key)
+
+    def all_satisfied(self, job_key: str) -> bool:
+        return self._for(job_key).all_satisfied(job_key)
+
+
+#: signature of the key router ShardedExpectations is built over —
+#: ``ShardedObjectStore.shard_for_key`` fits directly
+ShardRouter = Callable[[str, str], int]

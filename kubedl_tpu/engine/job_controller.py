@@ -60,7 +60,11 @@ from kubedl_tpu.core.objects import (
 from kubedl_tpu.core.store import AlreadyExists, Conflict, NotFound, ObjectStore
 from kubedl_tpu.engine import dag
 from kubedl_tpu.engine import status as status_machine
-from kubedl_tpu.engine.expectations import ControllerExpectations, expectation_key
+from kubedl_tpu.engine.expectations import (
+    ControllerExpectations,
+    ShardedExpectations,
+    expectation_key,
+)
 from kubedl_tpu.gang.interface import GangScheduler
 from kubedl_tpu.observability.metrics import DEFAULT_JOB_METRICS, JobMetrics
 from kubedl_tpu.utils.features import (
@@ -105,7 +109,15 @@ class JobEngine:
         self.cluster_domain = cluster_domain
         self.compile_cache_dir = compile_cache_dir
         self.beacon_dir = beacon_dir
-        self.expectations = ControllerExpectations()
+        # per-reconcile-domain expectation caches against a sharded store,
+        # so shard failover clears one domain instead of the whole world
+        num_shards = getattr(store, "num_shards", 1)
+        if num_shards > 1:
+            self.expectations = ShardedExpectations(
+                store.shard_for_key, num_shards
+            )
+        else:
+            self.expectations = ControllerExpectations()
         #: poison-pill protection: consecutive reconcile exceptions per job
         #: before it is parked with a Quarantined condition instead of
         #: hot-looping the workqueue forever (docs/robustness.md)

@@ -322,6 +322,29 @@ class JobMetrics:
             "Watchers registered with a since_revision older than "
             "replayable history (missed DELETED events)",
         )
+        # Sharded control plane (kubedl_tpu/shards/, docs/architecture.md
+        # "Sharded control plane"): per-reconcile-domain visibility. The
+        # WAL gauges above also carry per-shard series (shard=<i>) next to
+        # their unlabeled process totals.
+        self.reconciles = r.counter(
+            "kubedl_tpu_reconcile_total",
+            "Reconciles executed, by controller and reconcile-domain shard",
+        )
+        self.reconcile_latency = r.histogram(
+            "kubedl_tpu_reconcile_latency_seconds",
+            "Workqueue wait + reconcile duration, by controller and shard",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                     5.0, float("inf")),
+        )
+        self.workqueue_depth = r.gauge(
+            "kubedl_tpu_workqueue_depth",
+            "Items pending in each controller's per-shard workqueue",
+        )
+        self.shards_owned = r.gauge(
+            "kubedl_tpu_shards_owned",
+            "Reconcile-domain shards this operator currently owns (equals "
+            "the shard count unless a standby or deposed owner)",
+        )
         self.expectations_expired = r.counter(
             "kubedl_tpu_expectations_expired",
             "Reconciles that proceeded past timed-out controller "

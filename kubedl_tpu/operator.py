@@ -24,6 +24,7 @@ from kubedl_tpu.lineage.builder import ArtifactRegistry
 from kubedl_tpu.lineage.controller import ModelVersionController
 from kubedl_tpu.observability.metrics import JobMetrics, MetricsRegistry
 from kubedl_tpu.runtime.executor import ContainerRuntime, Kubelet, SubprocessRuntime
+from kubedl_tpu.shards.store import ShardedObjectStore
 from kubedl_tpu.utils.features import FeatureGates
 from kubedl_tpu.workloads.registry import WORKLOAD_REGISTRY, parse_workload_gate
 
@@ -131,6 +132,24 @@ class OperatorOptions:
     wal_fsync: str = "always"
     #: WAL records between snapshot+compaction passes
     wal_snapshot_every: int = 1000
+    #: sharded control plane (kubedl_tpu/shards/, docs/architecture.md
+    #: "Sharded control plane"): number of reconcile domains. 1 keeps
+    #: today's single-domain operator — and its on-disk WAL layout —
+    #: byte-for-byte; N>1 splits objects across N shard-local stores
+    #: (WAL segments under wal_dir/shard-<i>) with per-shard workqueues.
+    control_plane_shards: int = 1
+    #: directory of cross-process shard lease files
+    #: (shards.fencing.FileLeaseStore). "" runs unfenced: this process
+    #: owns every shard and no elector threads exist.
+    shard_lease_dir: str = ""
+    #: fenced mode: shard ids to acquire at startup (None -> all)
+    shard_own: Optional[List[int]] = None
+    #: fenced mode: shard ids to stand by for — campaign in the
+    #: background and take over (rehydrate-then-adopt) on lease expiry
+    shard_standby: List[int] = field(default_factory=list)
+    #: per-shard lease TTL: a standby takes a dead owner's shard within
+    #: about this many seconds
+    shard_lease_ttl: float = 2.0
 
 
 class ValidationError(ValueError):
@@ -152,15 +171,30 @@ class Operator:
         self.options = options or OperatorOptions()
         #: pass an existing store to run several operators against one
         #: object world (HA deployments — pair with leader_elect=True)
-        self.store = store or ObjectStore(
-            wal_dir=self.options.wal_dir or None,
-            wal_fsync=self.options.wal_fsync,
-            wal_snapshot_every=self.options.wal_snapshot_every,
-        )
+        if store is not None:
+            self.store = store
+        else:
+            lease_backend = None
+            if self.options.shard_lease_dir:
+                from kubedl_tpu.shards.fencing import FileLeaseStore
+
+                lease_backend = FileLeaseStore(self.options.shard_lease_dir)
+            self.store = ShardedObjectStore(
+                shards=self.options.control_plane_shards,
+                wal_dir=self.options.wal_dir or None,
+                wal_fsync=self.options.wal_fsync,
+                wal_snapshot_every=self.options.wal_snapshot_every,
+                lease_backend=lease_backend,
+                identity=self.options.leader_identity,
+                lease_ttl=self.options.shard_lease_ttl,
+                own=self.options.shard_own,
+                standby=self.options.shard_standby,
+                fence_verify_interval=0.05,
+            )
         self._owns_store = store is None
-        self.manager = ControllerManager(self.store)
         self.metrics_registry = MetricsRegistry()
         self.metrics = JobMetrics(self.metrics_registry)
+        self.manager = ControllerManager(self.store, metrics=self.metrics)
         self.features = FeatureGates()
         if self.options.feature_gates:
             self.features.set_from_string(self.options.feature_gates)
@@ -225,6 +259,31 @@ class Operator:
         self.metrics.watch_gaps.set_function(
             lambda: float(getattr(self.store, "watch_gaps", 0))
         )
+        # sharded control plane: per-domain WAL series beside the process
+        # totals above, ownership gauge, and the per-shard failover hook
+        num_shards = getattr(self.store, "num_shards", 1)
+        if num_shards > 1:
+            for i in range(num_shards):
+                self.metrics.wal_appends.set_function(
+                    lambda i=i: float(self.store.wal_appends_for(i)),
+                    shard=str(i),
+                )
+                self.metrics.wal_fsyncs.set_function(
+                    lambda i=i: float(self.store.wal_fsyncs_for(i)),
+                    shard=str(i),
+                )
+                self.metrics.watch_gaps.set_function(
+                    lambda i=i: float(self.store.watch_gaps_for(i)),
+                    shard=str(i),
+                )
+        if hasattr(self.store, "owned_shards"):
+            self.metrics.shards_owned.set_function(
+                lambda: float(len(self.store.owned_shards()))
+            )
+        else:
+            self.metrics.shards_owned.set_function(lambda: 1.0)
+        if hasattr(self.store, "on_shard_acquired"):
+            self.store.on_shard_acquired = self._on_shard_acquired
 
         # node lifecycle: heartbeat-driven failure detection (the k8s
         # node-controller analogue the reference delegates to the cluster)
@@ -402,6 +461,10 @@ class Operator:
         if not self.options.leader_elect:
             self._recover()
             self.manager.start()
+            # fenced sharding: begin renewing owned shard leases and
+            # campaigning for standby shards (unfenced stores: no-op)
+            if hasattr(self.store, "start_campaigns"):
+                self.store.start_campaigns()
             return
         # HA mode (reference: main.go:76-84): reconcile only while holding
         # the lease. The follower builds everything but starts nothing;
@@ -455,6 +518,30 @@ class Operator:
             "%d pods adoptable (takeover=%s)",
             getattr(self.store, "replayed_records", 0), adopted_gangs,
             adoptable_pods, takeover,
+        )
+
+    def _on_shard_acquired(self, shard: int, objs) -> None:
+        """Shard failover: the PR 5 rehydrate-then-adopt path scoped to
+        ONE reconcile domain. Runs on the standby's elector thread right
+        after the dead owner's WAL segment rehydrated, BEFORE the
+        rehydrated ADDED events reach the controllers: the dead owner's
+        expectations for this domain are dropped (sharded caches drop one
+        domain; flat caches drop everything — strictly safe), recorded
+        gang reservations re-pin, and the kubelet arms adoption so
+        surviving pods re-attach by (name, uid, pid) instead of being
+        double-launched."""
+        for engine in self.engines.values():
+            exps = engine.expectations
+            if hasattr(exps, "clear_shard"):
+                exps.clear_shard(shard)
+            else:
+                exps.clear()
+        adopted_gangs = self.gang.adopt_reservations()
+        adoptable_pods = self.kubelet.begin_recovery()
+        log.info(
+            "shard %d takeover: %d objects rehydrated, %d gangs "
+            "re-reserved, %d pods adoptable",
+            shard, len(objs), adopted_gangs, adoptable_pods,
         )
 
     def _on_deposed(self) -> None:

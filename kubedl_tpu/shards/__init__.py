@@ -1,0 +1,30 @@
+"""Horizontally sharded control plane: deterministic shard map, per-shard
+WAL/lease fencing, and the single client-facing store API controllers use.
+
+See docs/architecture.md ("Sharded control plane") for the shard map,
+fencing discipline, failover runbook, and how to pick N.
+"""
+
+from kubedl_tpu.shards.fencing import (
+    FencedOut,
+    FencedWal,
+    FileLeaseStore,
+    ShardElector,
+    ShardFence,
+    acquire_shard_lease,
+    shard_lease_name,
+)
+from kubedl_tpu.shards.shardmap import ShardMap
+from kubedl_tpu.shards.store import ShardedObjectStore
+
+__all__ = [
+    "FencedOut",
+    "FencedWal",
+    "FileLeaseStore",
+    "ShardElector",
+    "ShardFence",
+    "ShardMap",
+    "ShardedObjectStore",
+    "acquire_shard_lease",
+    "shard_lease_name",
+]

@@ -1,0 +1,320 @@
+"""Per-shard lease fencing: the PR 15 PS-shard discipline applied to the
+operator's own control plane.
+
+Every reconcile-domain shard is guarded by one :class:`~kubedl_tpu.core.
+leases.Lease` (``kubedl-shard-<i>`` in ``kubedl-system``), campaigned for
+with the stock :class:`~kubedl_tpu.core.leases.LeaderElector`. The lease's
+``transitions`` counter is the **fencing token**: it bumps on every change
+of holder, and the shard's WAL segment refuses appends from any writer
+whose captured token is no longer current (:class:`FencedWal`). A shard
+owner that pauses (GC stall, SIGSTOP) and resumes after its lease expired
+can therefore never apply stale writes — its next durable append raises
+:class:`FencedOut` and the shard domain is crash-only from there.
+
+Two lease surfaces:
+
+- any :class:`~kubedl_tpu.core.store.ObjectStore`-like store (in-process
+  default — two facades sharing one lease store contend for real);
+- :class:`FileLeaseStore` — flock-serialized JSON lease files, so shard
+  owners in DIFFERENT PROCESSES (scripts/verify-drives/drive_shards.py)
+  observe each other's leases without sharing memory.
+
+Chaos sites: ``shard.lease_renew`` (skip a renew beat -> lease expires ->
+standby takeover) and ``shard.wal_append`` (fail the fenced append).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from kubedl_tpu import chaos
+from kubedl_tpu.core.leases import LEASE_NAMESPACE, Lease, LeaderElector
+from kubedl_tpu.core.store import AlreadyExists, Conflict, NotFound
+
+SHARD_LEASE_NAMESPACE = LEASE_NAMESPACE
+
+
+def shard_lease_name(shard_id: int) -> str:
+    return f"kubedl-shard-{shard_id}"
+
+
+class FencedOut(Exception):
+    """A write carried a stale fencing token: the shard changed owners
+    since this writer acquired its lease. Crash-only — the deposed owner
+    must drop the shard, never retry the write."""
+
+
+class ShardElector(LeaderElector):
+    """LeaderElector with the ``shard.lease_renew`` chaos site on the
+    renew beat: a scheduled fault SKIPS the renewal (the renew loop keeps
+    running), so the lease goes stale exactly like a paused owner's and a
+    standby takes over after the TTL."""
+
+    def _renew(self) -> bool:
+        if chaos.should_fail("shard.lease_renew"):
+            return True  # beat skipped; renewed_at keeps aging
+        return super()._renew()
+
+
+class ShardFence:
+    """One owner's view of its shard lease: identity + captured token,
+    verified against the lease surface on demand.
+
+    ``verify_interval`` throttles backend reads on the append hot path
+    (file-backed leases cost a read syscall); 0 verifies every call.
+    A renewal failure or observed transition flips ``deposed`` sticky —
+    fencing never un-trips.
+    """
+
+    def __init__(
+        self,
+        lease_store,
+        shard_id: int,
+        identity: str,
+        token: int,
+        verify_interval: float = 0.0,
+        namespace: str = SHARD_LEASE_NAMESPACE,
+    ) -> None:
+        self.lease_store = lease_store
+        self.shard_id = shard_id
+        self.identity = identity
+        self.token = token
+        self.namespace = namespace
+        self.verify_interval = verify_interval
+        self.deposed = False
+        self._last_verify = 0.0
+        self._lock = threading.Lock()
+
+    def depose(self) -> None:
+        self.deposed = True
+
+    def assert_valid(self) -> None:
+        """Raise :class:`FencedOut` unless this owner still holds the
+        shard lease with the token it acquired."""
+        if self.deposed:
+            raise FencedOut(
+                f"shard {self.shard_id}: owner {self.identity} deposed "
+                f"(stale fencing token {self.token})"
+            )
+        with self._lock:
+            now = time.monotonic()
+            if self.verify_interval > 0.0 and (
+                now - self._last_verify < self.verify_interval
+            ):
+                return
+            self._last_verify = now
+        lease = self.lease_store.try_get(
+            "Lease", shard_lease_name(self.shard_id), self.namespace
+        )
+        if (
+            lease is None
+            or lease.holder != self.identity
+            or lease.transitions != self.token
+        ):
+            self.deposed = True
+            held = "gone" if lease is None else (
+                f"held by {lease.holder!r} at token {lease.transitions}"
+            )
+            raise FencedOut(
+                f"shard {self.shard_id}: fencing token {self.token} of "
+                f"{self.identity} is stale — lease {held}"
+            )
+
+
+class FencedWal:
+    """WriteAheadLog wrapper that checks the shard fence before every
+    durable append. Read-side recovery and snapshots pass through; only
+    the mutation path is fenced (a deposed owner may still READ its
+    abandoned memory image, it just can't make anything durable)."""
+
+    def __init__(self, wal, fence: Optional[ShardFence]) -> None:
+        self._wal = wal
+        self.fence = fence
+
+    def append(self, *args, **kwargs) -> None:
+        chaos.check("shard.wal_append")
+        if self.fence is not None:
+            self.fence.assert_valid()
+        self._wal.append(*args, **kwargs)
+
+    # -- pass-throughs the ObjectStore write path consults ---------------
+
+    def should_snapshot(self) -> bool:
+        return self._wal.should_snapshot()
+
+    def snapshot(self, revision, objects) -> None:
+        if self.fence is not None:
+            self.fence.assert_valid()
+        self._wal.snapshot(revision, objects)
+
+    def recover(self):
+        return self._wal.recover()
+
+    def close(self) -> None:
+        self._wal.close()
+
+    @property
+    def appends(self) -> int:
+        return self._wal.appends
+
+    @property
+    def fsyncs(self) -> int:
+        return self._wal.fsyncs
+
+    @property
+    def torn_tail_bytes(self) -> int:
+        return self._wal.torn_tail_bytes
+
+
+def acquire_shard_lease(
+    lease_store,
+    shard_id: int,
+    identity: str,
+    ttl: float = 2.0,
+    clock: Callable[[], float] = time.time,
+) -> Optional[int]:
+    """One synchronous campaign attempt for a shard lease. Returns the
+    fencing token on success (``transitions`` bumps iff the holder
+    changed), None while another live owner holds it — the caller waits
+    out the TTL, exactly like :mod:`kubedl_tpu.ps.shards`."""
+    elector = ShardElector(
+        lease_store,
+        identity=identity,
+        name=shard_lease_name(shard_id),
+        namespace=SHARD_LEASE_NAMESPACE,
+        ttl=ttl,
+        clock=clock,
+    )
+    if elector._try_acquire():  # noqa: SLF001 — synchronous single attempt
+        return elector.fence_token
+    return None
+
+
+class FileLeaseStore:
+    """Cross-process lease surface: one flock-serialized JSON file per
+    lease under ``lease_dir``. Implements exactly the store subset
+    :class:`~kubedl_tpu.core.leases.LeaderElector` touches (``try_get`` /
+    ``create`` / ``update_with_retry`` / ``get``), with optimistic
+    concurrency downgraded to a file lock — every read-modify-write runs
+    under ``flock(LOCK_EX)``, so two processes racing for an expired
+    lease serialize and exactly one sees it still expired."""
+
+    def __init__(self, lease_dir: str) -> None:
+        self.lease_dir = lease_dir
+        os.makedirs(lease_dir, exist_ok=True)
+
+    def _path(self, name: str, namespace: str) -> str:
+        return os.path.join(self.lease_dir, f"{namespace}__{name}.json")
+
+    @staticmethod
+    def _to_lease(data: dict, name: str, namespace: str) -> Lease:
+        lease = Lease(
+            holder=data["holder"],
+            acquired_at=data["acquired_at"],
+            renewed_at=data["renewed_at"],
+            lease_ttl=data["lease_ttl"],
+            transitions=data["transitions"],
+        )
+        lease.metadata.name = name
+        lease.metadata.namespace = namespace
+        lease.metadata.resource_version = data.get("rv", 0)
+        return lease
+
+    @staticmethod
+    def _to_dict(lease: Lease) -> dict:
+        return {
+            "holder": lease.holder,
+            "acquired_at": lease.acquired_at,
+            "renewed_at": lease.renewed_at,
+            "lease_ttl": lease.lease_ttl,
+            "transitions": lease.transitions,
+            "rv": lease.metadata.resource_version,
+        }
+
+    def _locked(self, path: str):
+        import fcntl
+
+        class _Guard:
+            def __enter__(self_inner):
+                self_inner.fh = open(path + ".lock", "a+")
+                fcntl.flock(self_inner.fh, fcntl.LOCK_EX)
+                return self_inner.fh
+
+            def __exit__(self_inner, *exc):
+                import fcntl as _f
+
+                _f.flock(self_inner.fh, _f.LOCK_UN)
+                self_inner.fh.close()
+
+        return _Guard()
+
+    def try_get(
+        self, kind: str, name: str, namespace: str = "default"
+    ) -> Optional[Lease]:
+        path = self._path(name, namespace)
+        with self._locked(path):
+            if not os.path.exists(path):
+                return None
+            data = json.loads(open(path).read())
+        return self._to_lease(data, name, namespace)
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Lease:
+        lease = self.try_get(kind, name, namespace)
+        if lease is None:
+            raise NotFound(f"Lease {namespace}/{name} not found")
+        return lease
+
+    def _write(self, path: str, lease: Lease) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(json.dumps(self._to_dict(lease)))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+
+    def create(self, lease: Lease) -> Lease:
+        path = self._path(lease.metadata.name, lease.metadata.namespace)
+        with self._locked(path):
+            if os.path.exists(path):
+                raise AlreadyExists(f"Lease {lease.metadata.name} exists")
+            lease.metadata.resource_version = 1
+            self._write(path, lease)
+        return lease
+
+    def update(self, lease: Lease) -> Lease:
+        path = self._path(lease.metadata.name, lease.metadata.namespace)
+        with self._locked(path):
+            if not os.path.exists(path):
+                raise NotFound(f"Lease {lease.metadata.name} not found")
+            cur = json.loads(open(path).read())
+            if cur.get("rv", 0) != lease.metadata.resource_version:
+                raise Conflict(
+                    f"Lease {lease.metadata.name}: stale rv "
+                    f"{lease.metadata.resource_version} != {cur.get('rv', 0)}"
+                )
+            lease.metadata.resource_version += 1
+            self._write(path, lease)
+        return lease
+
+    def update_with_retry(
+        self,
+        kind: str,
+        name: str,
+        namespace: str,
+        mutate: Callable[[Lease], None],
+        attempts: int = 5,
+    ) -> Lease:
+        last: Exception = NotFound(f"Lease {namespace}/{name} not found")
+        for _ in range(attempts):
+            try:
+                lease = self.get(kind, name, namespace)
+                mutate(lease)
+                return self.update(lease)
+            except Conflict as exc:  # raced another process: re-read
+                last = exc
+                time.sleep(0.001)
+        raise last

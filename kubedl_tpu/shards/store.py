@@ -1,0 +1,571 @@
+"""Sharded control-plane store: N reconcile domains behind one client API.
+
+:class:`ShardedObjectStore` splits the operator's object space into N
+shards, each backed by its own :class:`~kubedl_tpu.core.store.ObjectStore`
+with an independent lock and (when durable) an independent WAL segment
+under ``wal_dir/shard-<i>`` — so N reconcile domains fsync, snapshot, and
+fan out watch events in parallel instead of serializing on one store lock
+and one log file. Controllers keep talking to ONE client-facing surface:
+the facade replicates the full ObjectStore API (create/get/update/delete/
+list/watch/collect_orphans/compact/close + the WAL/rehydration counters),
+so every existing controller, test, and drive runs unmodified with
+``shards=1`` — same single store, same WAL layout, same event order.
+
+Routing is by **root key**: ``namespace/<controller-root name>``, where the
+root is the object's controlling owner if it has one, else itself. A job,
+its pods, its services, and its PodGroup therefore co-locate on one shard,
+which (a) matches the ``namespace/name`` reconcile keys the manager
+routes to per-shard workqueues, and (b) makes reconcile domains
+self-contained — the reconcile hot path never writes across a shard
+boundary, and per-shard GC can never mistake a co-located owner for a
+missing one. Cross-shard READS (point gets, lists, watches) go through
+the client layer: gets probe every shard, lists aggregate and re-sort,
+watches fan out to every shard-local store and deliver each object's
+events exactly once (each object lives in exactly one shard).
+
+Ownership and failover ride :mod:`kubedl_tpu.shards.fencing`: with a
+``lease_backend`` armed, each owned shard holds a per-shard lease whose
+``transitions`` count fences the shard's WAL; a standby that wins an
+expired lease mounts the dead owner's WAL segment, reruns the PR 5
+rehydrate-then-adopt path for that shard only (``on_shard_acquired``),
+and replays ADDED events to every facade watcher. Without a backend
+(the default, and all of single-process operation) every shard is owned,
+no elector threads run, and writes pay no fencing cost.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Tuple, Union
+
+from kubedl_tpu import chaos
+from kubedl_tpu.core.objects import BaseObject
+from kubedl_tpu.core.store import Conflict, NotFound, ObjectStore, WatchCallback
+from kubedl_tpu.shards.fencing import (
+    SHARD_LEASE_NAMESPACE,
+    FencedOut,
+    FencedWal,
+    ShardElector,
+    ShardFence,
+    acquire_shard_lease,
+    shard_lease_name,
+)
+from kubedl_tpu.shards.shardmap import ShardMap
+
+log = logging.getLogger("kubedl_tpu.shards.store")
+
+#: since_revision accepted by :meth:`ShardedObjectStore.watch` — a single
+#: int broadcasts to every shard (shard revisions are independent, so this
+#: over-replays; watchers are level-driven); a dict from :meth:`revisions`
+#: replays each shard from its exact revision.
+SinceRevision = Union[int, Dict[int, int], None]
+
+
+@dataclass
+class _WatchSpec:
+    callback: WatchCallback
+    kinds: Optional[Tuple[str, ...]]
+    cancels: Dict[int, Callable[[], None]] = field(default_factory=dict)
+
+
+class ShardedObjectStore:
+    """N shard-local ObjectStores behind the single-store client API."""
+
+    def __init__(
+        self,
+        shards: int = 1,
+        wal_dir: Optional[str] = None,
+        wal_fsync: str = "always",
+        wal_snapshot_every: int = 1000,
+        wal_fsync_floor: float = 0.0,
+        lease_backend=None,
+        identity: str = "",
+        lease_ttl: float = 2.0,
+        own: Optional[Iterable[int]] = None,
+        standby: Optional[Iterable[int]] = None,
+        fence_verify_interval: float = 0.0,
+    ) -> None:
+        self.num_shards = shards
+        self.shard_map = ShardMap(shards)
+        self.wal_dir = wal_dir
+        self._wal_fsync = wal_fsync
+        self._wal_snapshot_every = wal_snapshot_every
+        self._wal_fsync_floor = wal_fsync_floor
+        self._lease_backend = lease_backend
+        self._fenced = lease_backend is not None
+        self.identity = identity or f"sharded-store-{id(self):x}"
+        self.lease_ttl = lease_ttl
+        self._verify_interval = fence_verify_interval
+        self._lock = threading.RLock()
+        self._specs: List[_WatchSpec] = []
+        self._stores: List[ObjectStore] = [None] * shards  # type: ignore[list-item]
+        self._fences: List[Optional[ShardFence]] = [None] * shards
+        self._owned: List[bool] = [False] * shards
+        self._electors: Dict[int, ShardElector] = {}
+        #: shards this facade acquired by takeover (drive/test probe)
+        self.takeovers = 0
+        #: per-shard rehydrate-then-adopt hook, fired on every takeover
+        #: mount as ``on_shard_acquired(shard_id, rehydrated_objects)``
+        #: BEFORE the rehydrated ADDED events reach watchers
+        self.on_shard_acquired: Optional[
+            Callable[[int, List[BaseObject]], None]
+        ] = None
+
+        if not self._fenced:
+            for i in range(shards):
+                self._mount(i, None)
+            return
+        own_ids = list(own) if own is not None else list(range(shards))
+        self._standby_ids = [i for i in (standby or []) if i not in own_ids]
+        for i in own_ids:
+            token = self._campaign_sync(i)
+            fence = ShardFence(
+                lease_backend, i, self.identity, token,
+                verify_interval=self._verify_interval,
+            )
+            self._mount(i, fence)
+
+    # ---- shard topology --------------------------------------------------
+
+    def _shard_wal_dir(self, i: int) -> Optional[str]:
+        if self.wal_dir is None:
+            return None
+        if self.num_shards == 1:
+            # N=1 keeps today's on-disk layout byte-for-byte: a WAL written
+            # by the pre-shard operator replays into shard 0 unmoved
+            return self.wal_dir
+        import os
+
+        return os.path.join(self.wal_dir, f"shard-{i}")
+
+    @staticmethod
+    def _root_key(obj: BaseObject) -> str:
+        """Routing key: the object's controlling root, so a job and every
+        object it owns land on one shard. Events route by their involved
+        object (they carry no owner refs but belong to a domain)."""
+        involved = getattr(obj, "involved_name", "")
+        if obj.kind == "Event" and involved:
+            return f"{obj.metadata.namespace}/{involved}"
+        ref = obj.metadata.controller_ref()
+        name = ref.name if ref is not None else obj.metadata.name
+        return f"{obj.metadata.namespace}/{name}"
+
+    def shard_for_object(self, obj: BaseObject) -> int:
+        return self.shard_map.lookup(self._root_key(obj))
+
+    def shard_for_key(self, namespace: str, name: str) -> int:
+        """Shard owning reconcile key ``namespace/name`` — agrees with
+        :meth:`shard_for_object` for the root and everything it owns."""
+        return self.shard_map.lookup(f"{namespace}/{name}")
+
+    def owns_key(self, namespace: str, name: str) -> bool:
+        return self._owned[self.shard_for_key(namespace, name)]
+
+    def owned_shards(self) -> List[int]:
+        return [i for i, owned in enumerate(self._owned) if owned]
+
+    def shard_store(self, i: int) -> ObjectStore:
+        return self._stores[i]
+
+    def _mounted(self) -> List[Tuple[int, ObjectStore]]:
+        """Mounted shard-local stores — a standby facade's un-acquired
+        shards are None slots until takeover mounts them."""
+        return [(i, s) for i, s in enumerate(self._stores) if s is not None]
+
+    def fence_for(self, i: int) -> Optional[ShardFence]:
+        return self._fences[i]
+
+    # ---- mounting + leases -----------------------------------------------
+
+    def _mount(self, i: int, fence: Optional[ShardFence]) -> ObjectStore:
+        """Mount the real shard-local store (rehydrating its WAL segment),
+        arm the fence on its write path, re-attach facade watchers."""
+        path = self._shard_wal_dir(i)
+        if path is None:
+            store = ObjectStore()
+        else:
+            store = ObjectStore(
+                wal_dir=path,
+                wal_fsync=self._wal_fsync,
+                wal_snapshot_every=self._wal_snapshot_every,
+                wal_fsync_floor=self._wal_fsync_floor,
+            )
+        if store._wal is not None:  # noqa: SLF001 — arm the fenced write path
+            store._wal = FencedWal(store._wal, fence)  # noqa: SLF001
+        with self._lock:
+            self._stores[i] = store
+            self._fences[i] = fence
+            self._owned[i] = True
+            specs = list(self._specs)
+        for spec in specs:
+            spec.cancels[i] = store.watch(spec.callback, kinds=spec.kinds)
+        return store
+
+    def _campaign_sync(self, i: int) -> int:
+        """Acquire shard i's lease, waiting out a live holder's TTL."""
+        deadline = time.monotonic() + max(self.lease_ttl * 3.0, 1.0)
+        while True:
+            token = acquire_shard_lease(
+                self._lease_backend, i, self.identity, ttl=self.lease_ttl
+            )
+            if token is not None:
+                return token
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"{self.identity}: could not acquire lease for shard {i}"
+                )
+            time.sleep(max(self.lease_ttl / 4.0, 0.02))
+
+    def start_campaigns(self) -> None:
+        """Start the lease loops: renewal for owned shards, standby
+        campaigns (takeover on expiry) for ``standby`` shards. No-op
+        without a lease backend."""
+        if not self._fenced:
+            return
+        for i in self.owned_shards():
+            if i in self._electors:
+                continue
+            el = self._elector(i)
+            fence = self._fences[i]
+            # lease already held (acquired synchronously in __init__ or by
+            # takeover): seed the elector as leader so its loop renews
+            el._leader = True  # noqa: SLF001
+            el.fence_token = fence.token if fence is not None else -1
+            self._electors[i] = el
+            el.start(on_stopped=self._deposed_cb(i))
+        for i in self._standby_ids:
+            if i in self._electors or self._owned[i]:
+                continue
+            el = self._elector(i)
+            self._electors[i] = el
+            el.start(
+                on_started=self._takeover_cb(i, el),
+                on_stopped=self._deposed_cb(i),
+            )
+
+    def _elector(self, i: int) -> ShardElector:
+        return ShardElector(
+            self._lease_backend,
+            identity=self.identity,
+            name=shard_lease_name(i),
+            namespace=SHARD_LEASE_NAMESPACE,
+            ttl=self.lease_ttl,
+        )
+
+    def _takeover_cb(self, i: int, el: ShardElector) -> Callable[[], None]:
+        def on_started() -> None:
+            try:
+                self._takeover(i, el.fence_token)
+            except Exception:
+                log.exception("shard %d: takeover by %s failed", i, self.identity)
+
+        return on_started
+
+    def _deposed_cb(self, i: int) -> Callable[[], None]:
+        def on_stopped() -> None:
+            fence = self._fences[i]
+            if fence is not None:
+                fence.depose()
+            self._owned[i] = False
+            log.warning(
+                "shard %d: %s deposed — shard is crash-only from here",
+                i, self.identity,
+            )
+
+        return on_stopped
+
+    def _takeover(self, i: int, token: int) -> None:
+        """The PR 5 rehydrate-then-adopt path, scoped to one shard: mount
+        the dead owner's WAL segment under a fresh fencing token, let the
+        operator adopt what survived, then replay ADDED to watchers."""
+        fence = ShardFence(
+            self._lease_backend, i, self.identity, token,
+            verify_interval=self._verify_interval,
+        )
+        store = self._mount(i, fence)
+        objs: List[BaseObject] = []
+        for kind in store.kinds():
+            objs.extend(store.list(kind, namespace=None))
+        objs.sort(key=lambda o: o.metadata.resource_version)
+        log.info(
+            "shard %d: %s took over at fence token %d (%d objects rehydrated)",
+            i, self.identity, token, len(objs),
+        )
+        cb = self.on_shard_acquired
+        if cb is not None:
+            cb(i, objs)
+        for obj in objs:
+            self._notify("ADDED", obj, None)
+        self.takeovers += 1
+
+    def release_shards(self) -> None:
+        """Clean handoff: stop every elector and expire held leases so a
+        standby need not wait out the TTL (drives use this; crash paths
+        just die and let the lease age out)."""
+        for el in list(self._electors.values()):
+            el.stop()
+        self._electors.clear()
+
+    # ---- write routing ---------------------------------------------------
+
+    def _route_write(self, obj: BaseObject) -> int:
+        i = self.shard_for_object(obj)
+        if self._fenced and not self._owned[i]:
+            # events are observability droppings, not reconciled state —
+            # keep them on a shard this facade owns rather than fencing
+            # the recorder out of another domain's log
+            if obj.kind == "Event" and (owned := self.owned_shards()):
+                i = owned[0]
+            else:
+                raise FencedOut(
+                    f"shard {i}: {self.identity} does not own the shard for "
+                    f"{obj.kind} {obj.metadata.namespace}/{obj.metadata.name}"
+                )
+        # verify the fence on EVERY write, not just the durable append:
+        # an in-memory shard (no WAL) must reject a deposed owner too.
+        # verify_interval throttles the backend read on the hot path.
+        fence = self._fences[i]
+        if fence is not None:
+            fence.assert_valid()
+        return i
+
+    # ---- CRUD (the client-facing single-store surface) -------------------
+
+    def create(self, obj: BaseObject) -> BaseObject:
+        return self._stores[self._route_write(obj)].create(obj)
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> BaseObject:
+        for _, store in self._mounted():
+            found = store.try_get(kind, name, namespace)
+            if found is not None:
+                return found
+        raise NotFound(f"{kind} {namespace}/{name} not found")
+
+    def try_get(
+        self, kind: str, name: str, namespace: str = "default"
+    ) -> Optional[BaseObject]:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def update(self, obj: BaseObject) -> BaseObject:
+        return self._stores[self._route_write(obj)].update(obj)
+
+    def update_with_retry(
+        self,
+        kind: str,
+        name: str,
+        namespace: str,
+        mutate: Callable[[BaseObject], None],
+        attempts: int = 5,
+    ) -> BaseObject:
+        policy = chaos.RetryPolicy(
+            max_attempts=attempts, base_delay=0.001, max_delay=0.02
+        )
+
+        def attempt() -> BaseObject:
+            obj = self.get(kind, name, namespace)
+            mutate(obj)
+            return self.update(obj)
+
+        return policy.call(attempt, retry_on=(Conflict,))
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        for i, store in self._mounted():
+            with store._lock:  # noqa: SLF001 — existence probe, no copy
+                found = (namespace, name) in store._objects.get(kind, {})  # noqa: SLF001
+            if found:
+                if self._fenced and not self._owned[i]:
+                    raise FencedOut(
+                        f"shard {i}: {self.identity} does not own the shard "
+                        f"for {kind} {namespace}/{name}"
+                    )
+                store.delete(kind, name, namespace)
+                return
+        chaos.check("store.delete")  # not-found still consults the site once
+        raise NotFound(f"{kind} {namespace}/{name} not found")
+
+    def try_delete(self, kind: str, name: str, namespace: str = "default") -> bool:
+        try:
+            self.delete(kind, name, namespace)
+            return True
+        except NotFound:
+            return False
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = "default",
+        selector: Optional[Dict[str, str]] = None,
+    ) -> List[BaseObject]:
+        out: List[BaseObject] = []
+        for _, store in self._mounted():
+            out.extend(store.list(kind, namespace=namespace, selector=selector))
+        if self.num_shards > 1:
+            out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+        return out
+
+    def kinds(self) -> Iterable[str]:
+        seen: Dict[str, None] = {}
+        for _, store in self._mounted():
+            for kind in store.kinds():
+                seen[kind] = None
+        return list(seen)
+
+    # ---- watches (cross-shard fan-out) -----------------------------------
+
+    def watch(
+        self,
+        callback: WatchCallback,
+        kinds: Optional[Iterable[str]] = None,
+        since_revision: SinceRevision = None,
+    ) -> Callable[[], None]:
+        """Register a watcher across every shard-local store. Each object
+        lives in exactly one shard, so its ADDED/MODIFIED/DELETED events
+        reach the callback exactly once. ``since_revision`` as an int is
+        broadcast to every shard (over-replays — shard revisions advance
+        independently); a dict from :meth:`revisions` replays each shard
+        precisely. Returns an unsubscribe covering every shard."""
+        spec = _WatchSpec(callback, tuple(kinds) if kinds else None)
+        with self._lock:
+            self._specs.append(spec)
+            stores = self._mounted()
+        for i, store in stores:
+            if isinstance(since_revision, dict):
+                sr = since_revision.get(i)
+            else:
+                sr = since_revision
+            spec.cancels[i] = store.watch(callback, kinds=kinds, since_revision=sr)
+
+        def cancel() -> None:
+            with self._lock:
+                if spec in self._specs:
+                    self._specs.remove(spec)
+            for c in list(spec.cancels.values()):
+                c()
+
+        return cancel
+
+    def _notify(
+        self, event: str, obj: BaseObject, old: Optional[BaseObject]
+    ) -> None:
+        """Deliver a synthesized event to every facade watcher (resync /
+        kick_all path — mirrors ObjectStore._notify's contract)."""
+        with self._lock:
+            specs = list(self._specs)
+        for spec in specs:
+            if spec.kinds is None or obj.kind in spec.kinds:
+                spec.callback(event, obj, old)
+
+    # ---- GC (global owner set, per-shard deletes) ------------------------
+
+    def collect_orphans(self) -> int:
+        """Cross-shard-safe GC: the owner uid set is computed over ALL
+        shards before any shard deletes — an owner on shard j can never be
+        mistaken for missing while sweeping shard i (root-key routing
+        co-locates owners anyway; this keeps GC correct even for exotic
+        cross-domain owner refs)."""
+        if self.num_shards == 1:
+            only = self._stores[0]
+            return only.collect_orphans() if only is not None else 0
+        stores = self._mounted()
+        uids = set()
+        for _, store in stores:
+            with store._lock:  # noqa: SLF001 — counter scan, no copies
+                for bucket in store._objects.values():  # noqa: SLF001
+                    for obj in bucket.values():
+                        uids.add(obj.metadata.uid)
+        doomed: List[Tuple[ObjectStore, str, str, str]] = []
+        for i, store in stores:
+            if self._fenced and not self._owned[i]:
+                continue
+            with store._lock:  # noqa: SLF001
+                for bucket in store._objects.values():  # noqa: SLF001
+                    for obj in bucket.values():
+                        ref = obj.metadata.controller_ref()
+                        if ref is not None and ref.uid not in uids:
+                            doomed.append((
+                                store, obj.kind,
+                                obj.metadata.name, obj.metadata.namespace,
+                            ))
+        n = 0
+        for store, kind, name, ns in doomed:
+            if store.try_delete(kind, name, ns):
+                n += 1
+        return n
+
+    # ---- durability + counters (aggregated single-store surface) ---------
+
+    @property
+    def revision(self) -> int:
+        return sum(s.revision for _, s in self._mounted())
+
+    def revisions(self) -> Dict[int, int]:
+        """Per-shard revision map — the precise ``since_revision`` cursor
+        for :meth:`watch` across independent shard counters."""
+        return {i: s.revision for i, s in self._mounted()}
+
+    @property
+    def wal_appends(self) -> int:
+        return sum(s.wal_appends for _, s in self._mounted())
+
+    @property
+    def wal_fsyncs(self) -> int:
+        return sum(s.wal_fsyncs for _, s in self._mounted())
+
+    def wal_appends_for(self, i: int) -> int:
+        store = self._stores[i]
+        return store.wal_appends if store is not None else 0
+
+    def wal_fsyncs_for(self, i: int) -> int:
+        store = self._stores[i]
+        return store.wal_fsyncs if store is not None else 0
+
+    @property
+    def rehydrated(self) -> bool:
+        return any(s.rehydrated for _, s in self._mounted())
+
+    @property
+    def replayed_records(self) -> int:
+        return sum(s.replayed_records for _, s in self._mounted())
+
+    @property
+    def recovery_seconds(self) -> float:
+        return sum(s.recovery_seconds for _, s in self._mounted())
+
+    @property
+    def watch_gaps(self) -> int:
+        return sum(s.watch_gaps for _, s in self._mounted())
+
+    def watch_gaps_for(self, i: int) -> int:
+        store = self._stores[i]
+        return store.watch_gaps if store is not None else 0
+
+    @property
+    def _last_delete_rev(self) -> int:
+        return max(
+            (s._last_delete_rev for _, s in self._mounted()),  # noqa: SLF001
+            default=0,
+        )
+
+    def compact(self) -> None:
+        for _, store in self._mounted():
+            store.compact()
+
+    def close(self) -> None:
+        """Crash-style detach: halt elector loops WITHOUT releasing leases
+        (standbys must win by expiry, exactly as after a real death), then
+        detach every shard WAL. Use :meth:`release_shards` first for a
+        clean handoff."""
+        for el in self._electors.values():
+            el._stop.set()  # noqa: SLF001 — no release: crash-only semantics
+        for el in self._electors.values():
+            if el._thread is not None:  # noqa: SLF001
+                el._thread.join(timeout=2.0)  # noqa: SLF001
+        self._electors.clear()
+        for _, store in self._mounted():
+            store.close()

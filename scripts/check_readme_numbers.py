@@ -50,6 +50,9 @@ PS_ARTIFACT = "BENCH_r15_ps.json"
 #: model-lifecycle hot-swap/canary row (r17): separate artifact, same
 #: runs[] shape (CPU proxy — see docs/serving.md)
 ROLLOUT_ARTIFACT = "BENCH_r17_rollout.json"
+#: sharded control-plane churn-replay row (r18): separate artifact, same
+#: runs[] shape (CPU proxy — see docs/architecture.md)
+SHARDS_ARTIFACT = "BENCH_r18_shards.json"
 
 
 def _runs_median(runs, *path) -> float:
@@ -320,6 +323,26 @@ def expected_rollout_strings(artifact: dict) -> dict:
     }
 
 
+def expected_shards_strings(artifact: dict) -> dict:
+    """README sharded control-plane row strings from BENCH_r18_shards.json."""
+    runs = artifact["runs"]
+    tgt = ("targets", "shards")
+    one = _runs_median(runs, *tgt, "arms", "1_shard", "jobs_per_s")
+    four = _runs_median(runs, *tgt, "arms", "4_shard", "jobs_per_s")
+    thpt = _runs_median(runs, *tgt, "throughput_speedup")
+    p99 = _runs_median(runs, *tgt, "reconcile_p99_speedup")
+    launch = _runs_median(runs, *tgt, "median_launch_speedup")
+    return {
+        f"**{thpt:.2f}x** job throughput — {one:g} -> {four:g} jobs/s":
+            "medians of runs[].targets.shards.throughput_speedup and "
+            "arms.{1,4}_shard.jobs_per_s",
+        f"reconcile p99 **{p99:.2f}x**":
+            "median of runs[].targets.shards.reconcile_p99_speedup",
+        f"median time-to-launch **{launch:.2f}x**":
+            "median of runs[].targets.shards.median_launch_speedup",
+    }
+
+
 def check(repo: Path = REPO) -> list:
     """Returns a list of mismatch descriptions (empty = README is clean)."""
     artifact = json.loads((repo / ARTIFACT).read_text())
@@ -373,6 +396,11 @@ def check(repo: Path = REPO) -> list:
     expected.update(
         expected_rollout_strings(
             json.loads((repo / ROLLOUT_ARTIFACT).read_text())
+        )
+    )
+    expected.update(
+        expected_shards_strings(
+            json.loads((repo / SHARDS_ARTIFACT).read_text())
         )
     )
     problems = []

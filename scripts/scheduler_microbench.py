@@ -100,6 +100,16 @@ TRACING_DISARMED_US = 5.0
 #: per-tick scheduler overhead on every decode step.
 CHUNKED_BUDGET_MS = 5.0
 
+#: p95 per-key budget (µs) for the shard-map route (kubedl_tpu/shards/
+#: shardmap.py): every workqueue enqueue, store write, and watch
+#: delivery in the sharded control plane calls ``lookup(key)``, so HRW
+#: scoring must stay noise next to the reconcile it routes. One crc32
+#: per shard over a short string (memoized for hot keys); 5 µs leaves
+#: wide headroom on shared CI machines while catching an accidental
+#: per-call allocation storm, a busted memo cache, or a switch to a
+#: Python-level hash loop.
+SHARDMAP_LOOKUP_BUDGET_US = 5.0
+
 
 def build_stub_engine(max_batch: int = 4, max_seq: int = 128,
                       kv_layout: str = "contiguous",
@@ -538,6 +548,56 @@ def run_bucket_microbench(iters: int = 200) -> dict:
     }
 
 
+def run_shardmap_microbench(keys: int = 100_000, shards: int = 4) -> dict:
+    """Per-key cost of the HRW shard route over ``keys`` distinct
+    ``ns/name`` keys (every lookup a memo MISS — the worst case; hot
+    reconcile keys hit the memo and cost a dict probe), plus the memo-hit
+    path timed separately, against SHARDMAP_LOOKUP_BUDGET_US. Also
+    reports the balance spread so a degenerate hash (everything on one
+    shard) fails loudly here, not in a scale run."""
+    from kubedl_tpu.shards.shardmap import ShardMap
+
+    all_keys = [f"ns-{i % 7}/job-{i:06d}" for i in range(keys)]
+    sm = ShardMap(shards)
+    # per-key timing over cold keys (every one a memo miss): individual
+    # samples make the p95 robust to scheduler preemption on shared CI —
+    # a descheduling poisons only the keys it lands on, not a whole
+    # batch average. perf_counter_ns call-pair overhead (~0.1 µs) rides
+    # inside each sample; it is noise against the 5 µs budget.
+    ns = time.perf_counter_ns
+    lookup = sm.lookup
+    times = []
+    for k in all_keys:
+        t0 = ns()
+        lookup(k)
+        times.append((ns() - t0) / 1e3)
+    times.sort()
+    p50 = times[len(times) // 2]
+    p95 = times[int(len(times) * 0.95)]
+
+    hot = all_keys[-1]
+    iters = 100_000
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        sm.lookup(hot)
+    hit_us = (time.perf_counter() - t0) * 1e6 / iters
+
+    counts = sm.spread(all_keys)
+    lo, hi = min(counts.values()), max(counts.values())
+    return {
+        "keys": keys,
+        "shards": shards,
+        "lookup_us_p50": round(p50, 4),
+        "lookup_us_p95": round(p95, 4),
+        "memo_hit_us": round(hit_us, 4),
+        "spread_min": lo,
+        "spread_max": hi,
+        "spread_imbalance": round(hi / max(lo, 1), 3),
+        "budget_us": SHARDMAP_LOOKUP_BUDGET_US,
+        "within_budget": p95 <= SHARDMAP_LOOKUP_BUDGET_US,
+    }
+
+
 def run_tracing_microbench(calls: int = 200_000) -> dict:
     """Per-call cost of the DISARMED tracing fast path: a fresh local
     Tracer with ``enabled = False``, timing the three hot-path entry
@@ -586,6 +646,7 @@ def main() -> int:
     out["planner"] = run_planner_microbench()
     out["buckets"] = run_bucket_microbench()
     out["tracing"] = run_tracing_microbench()
+    out["shardmap"] = run_shardmap_microbench()
     print(json.dumps(out, indent=2))
     ok = (out["within_budget"] and out["prefix"]["within_budget"]
           and out["paged"]["within_budget"]
@@ -593,7 +654,8 @@ def main() -> int:
           and out["blocked_attention"]["within_budget"]
           and out["planner"]["within_budget"]
           and out["buckets"]["within_budget"]
-          and out["tracing"]["within_budget"])
+          and out["tracing"]["within_budget"]
+          and out["shardmap"]["within_budget"])
     return 0 if ok else 1
 
 

@@ -1,0 +1,270 @@
+"""Drive sharded-control-plane failover with a REAL SIGKILL across
+process boundaries (docs/architecture.md "Sharded control plane"):
+
+1. two child processes run the real multi-shard control plane —
+   :class:`ShardedObjectStore` (2 shards, shared WAL root, ``fsync=
+   always``), flock-backed :class:`FileLeaseStore`, the real
+   :class:`ControllerManager` with per-shard workqueues — churning jobs
+   through a create-pods/observe/tear-down reconcile loop. Owner A holds
+   shard 0; owner B holds shard 1 AND stands by for shard 0. Every pod
+   "launch" appends its name to a shared launches.log AFTER the create
+   landed in the WAL, so a duplicate create by any incarnation shows up
+   as a duplicate line;
+2. the driver SIGKILLs A mid-churn — no teardown, lease unreleased, WAL
+   handle dead — and asserts: B's standby campaign wins shard 0 within
+   ~the lease TTL, B drains every job A left behind (rehydrate-then-
+   adopt over A's WAL segment), launches.log holds ZERO duplicates, and
+   B's own shard 1 never stalls through the whole window.
+
+Run with `python scripts/verify-drives/drive_shards.py`
+(CPU only; control plane only — no jax needed).
+"""
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+LEASE_TTL = 1.0
+#: expiry (ttl) + standby campaign beat (ttl/3) + scheduling slop
+TAKEOVER_BUDGET_S = LEASE_TTL * 4 + 2.0
+PODS_PER_JOB = 3
+MAX_INFLIGHT = 12
+
+
+def _write_status(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(json.dumps(payload))
+    os.replace(tmp, path)
+
+
+def _read_status(path):
+    try:
+        with open(path) as fh:
+            return json.loads(fh.read())
+    except (OSError, ValueError):
+        return None
+
+
+class DriveReconciler:
+    """Job -> pods churn: create missing pods (fingerprinting each
+    launch AFTER its create is durable), then tear the job down."""
+
+    def __init__(self, store, launch_log):
+        import threading
+
+        self.store = store
+        self.launch_log = launch_log
+        self.completed = {0: 0, 1: 0}
+        self._done = set()
+        self._lock = threading.Lock()
+
+    def reconcile(self, namespace, name):
+        from kubedl_tpu.core.objects import OwnerRef, Pod
+        from kubedl_tpu.core.store import AlreadyExists
+
+        job = self.store.try_get("TPUJob", name, namespace)
+        if job is None:
+            return
+        missing = [
+            k for k in range(PODS_PER_JOB)
+            if self.store.try_get("Pod", f"{name}-p{k}", namespace) is None
+        ]
+        if missing:
+            for k in missing:
+                pod = Pod()
+                pod.metadata.name = f"{name}-p{k}"
+                pod.metadata.namespace = namespace
+                pod.metadata.owner_refs.append(OwnerRef(
+                    kind="TPUJob", name=name, uid=job.metadata.uid,
+                    controller=True,
+                ))
+                try:
+                    self.store.create(pod)
+                except AlreadyExists:
+                    continue
+                # fingerprint AFTER the create is durable in the WAL: a
+                # re-create by any incarnation duplicates the line
+                with open(self.launch_log, "a") as fh:
+                    fh.write(pod.metadata.name + "\n")
+            return  # pod ADDED events re-queue this key
+        # the JOB delete is the durable completion marker and goes first:
+        # a crash after it leaves orphan pods for the GC, never a pod-less
+        # job a successor would re-launch
+        self.store.try_delete("TPUJob", name, namespace)
+        for k in range(PODS_PER_JOB):
+            self.store.try_delete("Pod", f"{name}-p{k}", namespace)
+        uid = job.metadata.uid
+        with self._lock:
+            if uid not in self._done:
+                self._done.add(uid)
+                shard = self.store.shard_for_key(namespace, name)
+                self.completed[shard] += 1
+
+
+def child_main(role, wal_root, lease_dir, launch_log, status_path):
+    from kubedl_tpu.core.manager import ControllerManager, owner_mapper
+    from kubedl_tpu.shards import FileLeaseStore, ShardedObjectStore
+    from kubedl_tpu.workloads.tpujob import TPUJob
+
+    my_shard = 0 if role == "a" else 1
+    store = ShardedObjectStore(
+        shards=2, wal_dir=wal_root, wal_fsync="always",
+        wal_snapshot_every=1_000_000_000,
+        lease_backend=FileLeaseStore(lease_dir),
+        identity=f"owner-{role}", lease_ttl=LEASE_TTL,
+        own=[my_shard], standby=[0] if role == "b" else [],
+        fence_verify_interval=0.05,
+    )
+    reconciler = DriveReconciler(store, launch_log)
+    manager = ControllerManager(store=store)
+    manager.register(
+        "drive", reconciler.reconcile, watch_kinds=["TPUJob", "Pod"],
+        mapper=owner_mapper("TPUJob"), workers=2,
+    )
+    manager.start()
+    store.start_campaigns()
+
+    submitted = 0
+    i = 0
+    while True:  # churn forever; the driver owns this process's death
+        name = f"{role}-{i:05d}"
+        i += 1
+        if store.shard_for_key("default", name) != my_shard:
+            continue
+        job = TPUJob()
+        job.metadata.name = name
+        job.metadata.namespace = "default"
+        store.create(job)
+        submitted += 1
+        while submitted - sum(reconciler.completed.values()) > MAX_INFLIGHT:
+            time.sleep(0.005)
+        remaining0 = 0
+        if role == "b" and store.takeovers:
+            remaining0 = sum(
+                1 for j in store.list("TPUJob")
+                if store.shard_for_key("default", j.metadata.name) == 0
+            )
+        _write_status(status_path, {
+            "submitted": submitted,
+            "completed0": reconciler.completed[0],
+            "completed1": reconciler.completed[1],
+            "takeovers": store.takeovers,
+            "remaining0": remaining0,
+        })
+
+
+def parent_main():
+    ok = []
+
+    def check(name, cond, detail=""):
+        ok.append(bool(cond))
+        print(("PASS" if cond else "FAIL"), name, detail)
+
+    def poll(status_path, pred, timeout):
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            st = _read_status(status_path)
+            if st is not None and pred(st):
+                return st
+            time.sleep(0.05)
+        return _read_status(status_path)
+
+    tmp = tempfile.mkdtemp(prefix="kdl-shards-drive-")
+    wal_root = os.path.join(tmp, "wal")
+    lease_dir = os.path.join(tmp, "leases")
+    launch_log = os.path.join(tmp, "launches.log")
+    open(launch_log, "w").close()
+    status = {r: os.path.join(tmp, f"status_{r}.json") for r in ("a", "b")}
+    procs = {}
+    try:
+        for role in ("a", "b"):
+            procs[role] = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--child", role,
+                 wal_root, lease_dir, launch_log, status[role]],
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+            )
+        st_a = poll(status["a"], lambda s: s["completed0"] >= 15, 60.0)
+        st_b = poll(status["b"], lambda s: s["completed1"] >= 15, 60.0)
+        check("both owners churning through their shards",
+              st_a and st_b and st_a["completed0"] >= 15
+              and st_b["completed1"] >= 15, f"a={st_a} b={st_b}")
+        if not (st_a and st_b):
+            return finish(ok, tmp, procs)
+        check("owner A killed mid-churn (jobs in flight)",
+              st_a["submitted"] > st_a["completed0"], str(st_a))
+        b_before = st_b["completed1"]
+
+        t_kill = time.perf_counter()
+        procs["a"].send_signal(signal.SIGKILL)
+        procs["a"].wait(timeout=10)
+        check("A died by SIGKILL, lease unreleased",
+              procs["a"].returncode == -signal.SIGKILL)
+
+        st_b = poll(status["b"], lambda s: s["takeovers"] >= 1,
+                    TAKEOVER_BUDGET_S + 5.0)
+        elapsed = time.perf_counter() - t_kill
+        check("standby B took over shard 0", st_b and st_b["takeovers"] == 1,
+              str(st_b))
+        check(f"takeover within ~lease TTL (<{TAKEOVER_BUDGET_S:.0f}s)",
+              elapsed < TAKEOVER_BUDGET_S, f"{elapsed:.2f}s")
+
+        # a third campaigner cannot steal the shard from live owner B
+        from kubedl_tpu.shards import FileLeaseStore, acquire_shard_lease
+
+        check("live takeover lease is not stealable",
+              acquire_shard_lease(FileLeaseStore(lease_dir), 0, "driver",
+                                  ttl=LEASE_TTL) is None)
+
+        st_b = poll(
+            status["b"],
+            lambda s: s["takeovers"] >= 1 and s["remaining0"] == 0
+            and s["completed0"] > 0,
+            60.0,
+        )
+        check("B drained every job A left behind",
+              st_b and st_b["remaining0"] == 0 and st_b["completed0"] > 0,
+              str(st_b))
+        check("surviving shard 1 never stalled",
+              st_b and st_b["completed1"] > b_before,
+              f"{b_before} -> {st_b and st_b['completed1']}")
+
+        lines = [l for l in open(launch_log).read().splitlines() if l]
+        check("zero duplicate launches across both owners",
+              len(lines) == len(set(lines)),
+              f"{len(lines)} launches, "
+              f"{len(lines) - len(set(lines))} duplicates")
+        check("launch volume sane for the churn",
+              len(lines) >= (st_a["completed0"] + st_b["completed1"])
+              * PODS_PER_JOB, str(len(lines)))
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+    return finish(ok, tmp, procs)
+
+
+def finish(ok, tmp, procs):
+    for role, p in procs.items():
+        if p.stderr is not None and p.returncode not in (None, -signal.SIGKILL):
+            err = p.stderr.read()[-400:]
+            if err:
+                print(f"--- child {role} stderr ---\n{err}")
+    shutil.rmtree(tmp, ignore_errors=True)
+    print(f"\n{sum(ok)}/{len(ok)} checks passed")
+    return 0 if all(ok) and ok else 1
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child_main(*sys.argv[2:7])
+    else:
+        sys.exit(parent_main())
