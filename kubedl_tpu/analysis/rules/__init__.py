@@ -5,6 +5,7 @@ from kubedl_tpu.analysis.rules import (
     chaos_sites,
     donation,
     envmut,
+    fsync_loop,
     locks,
     metrics_drift,
     ps_chaos_tests,
@@ -24,6 +25,7 @@ ALL_RULES = [
     span_names,      # KTL007
     ps_chaos_tests,  # KTL008
     store_construction,  # KTL009
+    fsync_loop,      # KTL010
 ]
 
 RULE_IDS = {m.RULE_ID: m for m in ALL_RULES}

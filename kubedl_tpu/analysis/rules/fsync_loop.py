@@ -1,0 +1,96 @@
+"""KTL010 — per-iteration durability barrier inside a loop.
+
+PR 19's group-commit work exists because the control plane was paying one
+fsync per WAL append: at 10k jobs / 100k pods the log issued 220,000
+fsyncs for 220,000 appends and every arm of BENCH_r18 flatlined at the
+same throughput regardless of shard count. The bug class this rule pins:
+a loop that re-pays the durability barrier every iteration —
+
+    for rec in records:
+        f.write(rec)
+        os.fsync(f.fileno())        # N barriers for one logical batch
+
+    for obj in batch:
+        ticket = wal.append(...)
+        wal.wait_durable(ticket)    # re-serializes the group commit
+
+The batched shape costs the same durability and O(batches) barriers:
+write/stage everything, then fsync (or ``wait_durable``) ONCE on the
+last ticket. ``kubedl_tpu/core/wal.py`` is exempt — its committer loop
+IS the amortized fsync (one per batch window, by construction).
+
+A loop writing N *distinct* files can legitimately fsync each one; that
+is still usually better written as write-all-then-fsync-all, but when the
+per-file barrier is required, say so with
+``# ktl: disable=KTL010 -- <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+RULE_ID = "KTL010"
+
+#: the committer loop in here is the group-commit mechanism itself
+ALLOWED_FILES = {"kubedl_tpu/core/wal.py"}
+
+#: terminal callable names that are durability barriers
+_BARRIERS = {"fsync", "fdatasync", "_fsync", "wait_durable", "_wait_durable"}
+
+_LOOPS = (ast.For, ast.While, ast.AsyncFor)
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def _barrier_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name) and f.id in _BARRIERS:
+        return f.id
+    if isinstance(f, ast.Attribute) and f.attr in _BARRIERS:
+        return f.attr
+    return ""
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.loop_depth = 0
+        self.hits: List[ast.Call] = []
+
+    def generic_visit(self, node: ast.AST) -> None:
+        if isinstance(node, _SCOPES):
+            # a def/lambda inside a loop body doesn't RUN per iteration;
+            # its own loops are visited with a fresh depth
+            depth, self.loop_depth = self.loop_depth, 0
+            super().generic_visit(node)
+            self.loop_depth = depth
+            return
+        if isinstance(node, _LOOPS):
+            self.loop_depth += 1
+            super().generic_visit(node)
+            self.loop_depth -= 1
+            return
+        if (
+            self.loop_depth > 0
+            and isinstance(node, ast.Call)
+            and _barrier_name(node)
+        ):
+            self.hits.append(node)
+        super().generic_visit(node)
+
+
+def check_file(ctx) -> List["Finding"]:  # noqa: F821 — engine's Finding
+    if ctx.relpath in ALLOWED_FILES:
+        return []
+    v = _Visitor()
+    v.visit(ctx.tree)
+    return [
+        ctx.finding(
+            RULE_ID, node.lineno,
+            f"durability barrier '{_barrier_name(node)}' inside a loop "
+            "pays one commit per iteration — batch it: write/stage the "
+            "whole set, then fsync (or wait_durable on the LAST ticket) "
+            "once; BENCH_r18's 220k fsyncs for 220k appends is this shape "
+            "at scale",
+        )
+        for node in v.hits
+    ]

@@ -27,6 +27,7 @@ Injection sites wired in this repo::
     checkpoint.torn                              die between shard + manifest
     store.wal_append                             torn WAL record (half-write)
     store.wal_fsync                              fail the WAL fsync syscall
+    store.wal_group_commit                       fail the batched group-commit fsync
     watchdog.beacon                              freeze a node's beacon publish
     trainer.step_stall                           wedge the training step loop
     router.forward                               replica forward transport failure
@@ -81,6 +82,7 @@ SITES: Dict[str, str] = {
     "checkpoint.torn": "die between shard + manifest",
     "store.wal_append": "torn WAL record (half-write)",
     "store.wal_fsync": "fail the WAL fsync syscall",
+    "store.wal_group_commit": "fail the batched group-commit fsync",
     "watchdog.beacon": "freeze a node's beacon publish",
     "trainer.step_stall": "wedge the training step loop",
     "router.forward": "replica forward transport failure",

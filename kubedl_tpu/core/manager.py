@@ -169,6 +169,7 @@ class ControllerManager:
         mapper: EventMapper,
         workers: int = 1,
         resync_on_start: bool = False,
+        coalesce_window: float = 0.0,
     ) -> WorkQueue:
         """Wire a controller: watch ``watch_kinds``, map events to keys, feed
         per-shard workqueues each drained by ``workers`` threads.
@@ -180,9 +181,18 @@ class ControllerManager:
         re-enqueued instead of waiting for their next mutation. A fresh
         store makes it a no-op.
 
+        ``coalesce_window`` (seconds) turns on burst coalescing in every
+        queue: a key re-enqueued within the window of its last pickup is
+        delivered once at the window edge instead of once per event (see
+        :class:`~kubedl_tpu.core.workqueue.WorkQueue`). Level-driven
+        reconcilers only — the reconcile sees final state, not each event.
+
         Returns shard 0's queue (the only queue against an unsharded
         store — kept for callers that introspect it in tests)."""
-        queues = [WorkQueue() for _ in range(self.shards)]
+        queues = [
+            WorkQueue(coalesce_window=coalesce_window)
+            for _ in range(self.shards)
+        ]
         reg = _Registration(
             name=name, reconcile=reconcile, queues=queues, workers=workers,
             resync_on_start=resync_on_start,
@@ -203,6 +213,27 @@ class ControllerManager:
     #: many items deeper than the worker's own before it steals from it
     STEAL_SLACK = 8
 
+    #: max keys a worker drains from its HOME queue per pass — a deep
+    #: backlog costs one queue-lock round-trip per GET_BATCH reconciles
+    #: instead of one per reconcile (stolen work stays single-key: a
+    #: thief should relieve pressure, not bulk-claim a sibling's backlog).
+    #: The effective batch is further capped to the worker's fair share
+    #: of the current depth (depth // pool size, min 1): bulk-claiming a
+    #: shallow backlog would serialize keys that idle siblings could run
+    #: in parallel — e.g. a gang's pod launches must not queue behind
+    #: each other on one kubelet worker.
+    GET_BATCH = 8
+
+    @classmethod
+    def fair_batch(cls, depth: int, workers: int) -> int:
+        """Batch size for one drain pass: the worker's fair share of the
+        current backlog, capped at :data:`GET_BATCH`, floor 1. A shallow
+        queue yields single-key pickups so idle siblings run the rest in
+        parallel (a gang's pod launches must not serialize behind one
+        worker); only a backlog deeper than the pool amortizes the queue
+        lock across full batches."""
+        return max(1, min(cls.GET_BATCH, depth // max(workers, 1)))
+
     def _worker(self, reg: _Registration, shard: int) -> None:
         queues = reg.queues
         n = len(queues)
@@ -215,7 +246,8 @@ class ControllerManager:
             # worker sweeps every sibling before blocking. The source
             # queue's processing set still serializes each key, and
             # latency/metric labels keep the key's HOME shard.
-            src, key = shard, None
+            src = shard
+            batch: List[Key] = []
             if n > 1:
                 deepest = max(range(n), key=lambda i: len(queues[i]))
                 if (
@@ -223,54 +255,78 @@ class ControllerManager:
                     and len(queues[deepest])
                     > len(queues[shard]) + self.STEAL_SLACK
                 ):
-                    src, key = deepest, queues[deepest].get(timeout=0)
-            if key is None:
+                    stolen = queues[deepest].get(timeout=0)
+                    if stolen is not None:
+                        src, batch = deepest, [stolen]
+            if not batch:
                 src = shard
-                key = queues[shard].get(timeout=0.2 if n == 1 else 0.05)
-            if key is None and n > 1:
+                batch = queues[shard].get_batch(
+                    max_items=self.fair_batch(len(queues[shard]), reg.workers),
+                    timeout=0.2 if n == 1 else 0.05,
+                )
+            if not batch and n > 1:
                 for off in range(1, n):
                     j = (shard + off) % n
-                    key = queues[j].get(timeout=0)
-                    if key is not None:
-                        src = j
+                    stolen = queues[j].get(timeout=0)
+                    if stolen is not None:
+                        src, batch = j, [stolen]
                         break
-            if key is None:
+            if not batch:
                 continue
             queue = queues[src]
             shard_label = str(src)
-            wait = queue.wait_seconds(key)
-            t0 = time.perf_counter()
-            try:
-                requeue_after = reg.reconcile(*key)
-            except Exception:
-                log.error(
-                    "controller %s[shard %d]: reconcile %s failed:\n%s",
-                    reg.name,
-                    shard,
-                    key,
-                    traceback.format_exc(),
+            for key in batch:
+                self._process_key(reg, queue, shard, shard_label, key)
+
+    def _process_key(
+        self,
+        reg: _Registration,
+        queue: WorkQueue,
+        shard: int,
+        shard_label: str,
+        key: Key,
+    ) -> None:
+        wait = queue.wait_seconds(key)
+        t0 = time.perf_counter()
+        try:
+            requeue_after = reg.reconcile(*key)
+        except Exception:
+            log.error(
+                "controller %s[shard %d]: reconcile %s failed:\n%s",
+                reg.name,
+                shard,
+                key,
+                traceback.format_exc(),
+            )
+            queue.add_rate_limited(key)
+        else:
+            queue.forget(key)
+            if requeue_after is not None:
+                queue.add_after(key, requeue_after)
+        finally:
+            queue.done(key)
+            duration = time.perf_counter() - t0
+            samples = self.latency_samples
+            if samples is not None:
+                samples.append(duration)
+            waits = self.queue_wait_samples
+            if waits is not None:
+                waits.append(wait)
+            if self.metrics is not None:
+                self.metrics.reconciles.inc(
+                    controller=reg.name, shard=shard_label
                 )
-                queue.add_rate_limited(key)
-            else:
-                queue.forget(key)
-                if requeue_after is not None:
-                    queue.add_after(key, requeue_after)
-            finally:
-                queue.done(key)
-                duration = time.perf_counter() - t0
-                samples = self.latency_samples
-                if samples is not None:
-                    samples.append(duration)
-                waits = self.queue_wait_samples
-                if waits is not None:
-                    waits.append(wait)
-                if self.metrics is not None:
-                    self.metrics.reconciles.inc(
-                        controller=reg.name, shard=shard_label
-                    )
-                    self.metrics.reconcile_latency.observe(
-                        duration, controller=reg.name, shard=shard_label
-                    )
+                self.metrics.reconcile_latency.observe(
+                    duration, controller=reg.name, shard=shard_label
+                )
+
+    @property
+    def coalesced_reconciles(self) -> int:
+        """Events absorbed by workqueue coalescing across every
+        registration — reconcile passes the control plane did NOT run."""
+        return sum(
+            q.coalesced for reg in self._registrations for q in reg.queues
+        )
 
     def _gc_loop(self) -> None:
         while not self._stop.wait(self._gc_interval):
@@ -310,6 +366,10 @@ class ControllerManager:
                         lambda q=queue: float(len(q)),
                         controller=reg.name, shard=str(shard),
                     )
+                self.metrics.coalesced_reconciles.set_function(
+                    lambda r=reg: float(sum(q.coalesced for q in r.queues)),
+                    controller=reg.name,
+                )
         self._gc_thread = threading.Thread(target=self._gc_loop, daemon=True, name="gc")
         self._gc_thread.start()
 

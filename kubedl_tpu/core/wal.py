@@ -32,13 +32,30 @@ Recovery semantics (the acceptance contract):
   O(live objects + log tail), not O(total writes ever).
 
 fsync policy knob: ``"always"`` fsyncs each append (durability to the
-record), ``"batch"`` fsyncs only at snapshot/close (a crash may lose the
-un-synced tail — torn-tail tolerance makes that a clean rollback), ``"off"``
-never fsyncs (tests/benchmarks).
+record), ``"group"`` group-commits — appends stage their bytes and a
+per-segment committer thread fsyncs once per batch window, acknowledging
+every staged writer after the ONE fsync that covers it (fsync-before-ack:
+an acknowledged record is exactly as durable as under ``"always"``, the
+log just pays O(batches) fsyncs instead of O(appends)) — ``"batch"``
+fsyncs only at snapshot/close (a crash may lose the un-synced tail —
+torn-tail tolerance makes that a clean rollback), ``"off"`` never fsyncs
+(tests/benchmarks).
+
+Group-commit contract: :meth:`WriteAheadLog.append` returns a ticket
+(monotonic sequence number); the caller applies the record to memory and
+then blocks in :meth:`wait_durable` OUTSIDE its store lock — so N writers
+overlap one commit window — and must not acknowledge its client until
+that returns. A crash (or injected fault) between append and the batched
+fsync loses only records whose ``wait_durable`` never returned: replay
+after the crash keeps every acknowledged record (it was fsynced before
+its ack) and may or may not keep unacknowledged ones. A failed group
+fsync poisons the log (crash-only, like a torn append): every waiter
+past the last durable seq raises.
 
 Chaos sites: ``store.wal_append`` tears an append in half (simulating
-death mid-write; the log is then dead, crash-only) and ``store.wal_fsync``
-fails the fsync call.
+death mid-write; the log is then dead, crash-only), ``store.wal_fsync``
+fails the fsync call, and ``store.wal_group_commit`` fails the batched
+group-commit fsync between staged appends and their acknowledgement.
 """
 
 from __future__ import annotations
@@ -46,9 +63,10 @@ from __future__ import annotations
 import json
 import os
 import struct
+import threading
 import time
 import zlib
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubedl_tpu import chaos
 
@@ -57,7 +75,14 @@ _HEADER = struct.Struct("<II")
 WAL_FILE = "wal.log"
 SNAPSHOT_FILE = "snapshot.json"
 
-VALID_FSYNC = ("always", "batch", "off")
+VALID_FSYNC = ("always", "group", "batch", "off")
+
+#: default group-commit accumulation window (seconds): how long the
+#: committer lets appends pile up after waking before the one fsync that
+#: acknowledges them all. The window + the fsync itself bound a writer's
+#: ack latency; everything staged while a previous batch was fsyncing
+#: rides the next batch for free.
+DEFAULT_GROUP_WINDOW = 0.005
 
 
 class WalCorruption(Exception):
@@ -74,6 +99,7 @@ class WriteAheadLog:
         fsync: str = "always",
         snapshot_every: int = 1000,
         fsync_floor: float = 0.0,
+        group_window: float = DEFAULT_GROUP_WINDOW,
     ) -> None:
         if fsync not in VALID_FSYNC:
             raise ValueError(f"fsync policy {fsync!r} not in {VALID_FSYNC}")
@@ -101,6 +127,24 @@ class WriteAheadLog:
         #: would corrupt interior history. Crash-only — reopen to recover.
         self._dead = False
         self._closed = False
+        # ---- group commit (fsync="group") --------------------------------
+        self.group_window = group_window
+        #: batched fsyncs executed and records they covered — avg batch =
+        #: batch_records / batches; per-batch sizes also flow through
+        #: :attr:`on_batch` for the kubedl_tpu_wal_batch_size histogram
+        self.batches = 0
+        self.batch_records = 0
+        self.on_batch: Optional[Callable[[int], None]] = None
+        self._commit_cv = threading.Condition()
+        self._staged_seq = 0  # last record staged (bytes flushed to OS)
+        self._acked_seq = 0  # last record covered by a durable fsync
+        self._commit_error: Optional[BaseException] = None
+        self._committer: Optional[threading.Thread] = None
+        #: excludes the committer's fsync from racing the log rotation in
+        #: snapshot()/close() (append vs those is already serialized by
+        #: the store lock; fsync-concurrent-with-append is fine at the OS
+        #: level, fsync of a just-closed fd is not)
+        self._rotate_lock = threading.Lock()
 
     # ---- recovery --------------------------------------------------------
 
@@ -147,6 +191,13 @@ class WriteAheadLog:
                     f.truncate(good_end)
         self._f = open(self.log_path, "ab")  # noqa: SIM115 — held for appends
         self._since_snapshot = len(records)
+        if self.fsync_policy == "group" and self._committer is None:
+            self._committer = threading.Thread(
+                target=self._commit_loop,
+                name=f"wal-commit-{os.path.basename(self.dir)}",
+                daemon=True,
+            )
+            self._committer.start()
         return snap_rev, snap_objs, records
 
     # ---- append ----------------------------------------------------------
@@ -159,11 +210,18 @@ class WriteAheadLog:
         namespace: str,
         name: str,
         obj: Optional[Dict[str, Any]] = None,
-    ) -> None:
-        """Durably record one mutation. Raises before the caller applies it
-        to memory; on success the record is on disk (fsync per policy)."""
+    ) -> Optional[int]:
+        """Record one mutation. Raises before the caller applies it to
+        memory; on success the record is on disk (fsync per policy).
+
+        Under ``fsync="group"`` the record is only *staged* (bytes flushed
+        to the OS, not yet fsynced) and a ticket is returned: the caller
+        must pass it to :meth:`wait_durable` — outside its own lock, so
+        concurrent writers share one commit — before acknowledging the
+        write to anyone. Every other policy returns ``None`` with the
+        historical inline semantics unchanged."""
         if self._closed:
-            return  # detached (clean shutdown raced a late writer): drop
+            return None  # detached (clean shutdown raced a late writer): drop
         if self._dead or self._f is None:
             raise WalCorruption(f"{self.log_path}: log is dead after torn append")
         record: Dict[str, Any] = {
@@ -179,7 +237,7 @@ class WriteAheadLog:
             # disk, the rest never will — replay must roll the tail back
             self._f.write(data[: max(1, len(data) // 2)])
             self._f.flush()
-            self._dead = True
+            self._poison(WalCorruption(f"{self.log_path}: torn append"))
             raise chaos.FaultInjected(
                 f"chaos: torn WAL append at store.wal_append (rev {rev})"
             )
@@ -187,10 +245,92 @@ class WriteAheadLog:
         self._f.flush()
         self.appends += 1
         self._since_snapshot += 1
+        if self.fsync_policy == "group":
+            with self._commit_cv:
+                self._staged_seq += 1
+                seq = self._staged_seq
+                self._commit_cv.notify_all()
+            return seq
         if self.fsync_policy == "always":
             self._fsync()
+        return None
+
+    # ---- group commit ----------------------------------------------------
+
+    def wait_durable(self, ticket: Optional[int]) -> None:
+        """Block until the batched fsync covering ``ticket`` completed —
+        the fsync-before-ack barrier. ``None`` (non-group policies, where
+        append itself was the barrier) returns immediately. Call WITHOUT
+        holding the store lock: the whole point is that N writers wait on
+        one commit concurrently. Raises if the log died before the ticket
+        became durable (the write is unacknowledged — after a restart it
+        may or may not replay)."""
+        if ticket is None:
+            return
+        with self._commit_cv:
+            while self._acked_seq < ticket:
+                if self._commit_error is not None:
+                    raise WalCorruption(
+                        f"{self.log_path}: group commit failed before seq "
+                        f"{ticket} became durable"
+                    ) from self._commit_error
+                if self._closed and self._committer is None:
+                    return  # detached post-close: close() already fsynced
+                self._commit_cv.wait(0.5)
+
+    def _poison(self, err: BaseException) -> None:
+        """Kill the log (torn append / failed commit): wake every waiter
+        with the error; the store is crash-only from here."""
+        self._dead = True
+        with self._commit_cv:
+            if self._commit_error is None:
+                self._commit_error = err
+            self._commit_cv.notify_all()
+
+    def _commit_loop(self) -> None:
+        """The per-segment group committer: sleep until something is
+        staged, let the batch window accumulate a burst, then fsync ONCE
+        and acknowledge everything staged before the fsync."""
+        while True:
+            with self._commit_cv:
+                while (
+                    self._staged_seq == self._acked_seq
+                    and not self._closed
+                    and self._commit_error is None
+                ):
+                    self._commit_cv.wait(0.2)
+                if self._commit_error is not None:
+                    return
+                if self._closed and self._staged_seq == self._acked_seq:
+                    return
+            if self.group_window > 0.0 and not self._closed:
+                time.sleep(self.group_window)  # accumulate the burst
+            with self._commit_cv:
+                seq = self._staged_seq
+            try:
+                # the crash seam this site models: records staged (bytes on
+                # disk) but the batch fsync never happens — on replay only
+                # unacknowledged records may be lost
+                chaos.check("store.wal_group_commit")
+                with self._rotate_lock:
+                    self._fsync()
+            except BaseException as e:  # noqa: BLE001 — poison + stop
+                self._poison(e)
+                return
+            with self._commit_cv:
+                batch = seq - self._acked_seq
+                self._acked_seq = seq
+                self._commit_cv.notify_all()
+            if batch > 0:
+                self.batches += 1
+                self.batch_records += batch
+                cb = self.on_batch
+                if cb is not None:
+                    cb(batch)
 
     def _fsync(self) -> None:
+        if self._f is None:
+            return
         chaos.check("store.wal_fsync")
         t0 = time.perf_counter()
         os.fsync(self._f.fileno())
@@ -222,11 +362,21 @@ class WriteAheadLog:
                 os.fsync(f.fileno())
         os.replace(tmp, self.snapshot_path)
         # every logged record <= revision is now in the snapshot: truncate
-        if self._f is not None:
-            self._f.close()
-        open(self.log_path, "wb").close()
-        self._f = open(self.log_path, "ab")  # noqa: SIM115
+        with self._rotate_lock:
+            if self._f is not None:
+                self._f.close()
+            open(self.log_path, "wb").close()
+            self._f = open(self.log_path, "ab")  # noqa: SIM115
         self._since_snapshot = 0
+        if self.fsync_policy == "group":
+            # the fsynced snapshot covers every staged record (snapshot is
+            # called under the store lock, so nothing stages concurrently):
+            # they are durable now — ack them so waiters don't stall on a
+            # batch whose bytes just got truncated away
+            with self._commit_cv:
+                if self._acked_seq < self._staged_seq:
+                    self._acked_seq = self._staged_seq
+                self._commit_cv.notify_all()
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -238,13 +388,28 @@ class WriteAheadLog:
         if self._closed:
             return
         self._closed = True
-        if self._f is not None:
-            try:
-                self._f.flush()
-                if self.fsync_policy != "off" and not self._dead:
-                    os.fsync(self._f.fileno())
-                    self.fsyncs += 1
-            except (OSError, ValueError):
-                pass
-            self._f.close()
-            self._f = None
+        committer = self._committer
+        if committer is not None:
+            # wake the committer; it drains any staged-but-unacked batch
+            # with one final fsync, then exits on the _closed flag
+            with self._commit_cv:
+                self._commit_cv.notify_all()
+            committer.join(timeout=5.0)
+            self._committer = None
+        with self._rotate_lock:
+            if self._f is not None:
+                try:
+                    self._f.flush()
+                    if self.fsync_policy != "off" and not self._dead:
+                        os.fsync(self._f.fileno())
+                        self.fsyncs += 1
+                except (OSError, ValueError):
+                    pass
+                self._f.close()
+                self._f = None
+        # the final fsync above covered anything still staged (e.g. the
+        # committer died or timed out): release any last waiters
+        with self._commit_cv:
+            if not self._dead and self._acked_seq < self._staged_seq:
+                self._acked_seq = self._staged_seq
+            self._commit_cv.notify_all()

@@ -1,10 +1,20 @@
-"""Rate-limited work queue with deduplication and exponential backoff.
+"""Rate-limited work queue with deduplication, coalescing and backoff.
 
 The controller-runtime workqueue analogue the reference's engine relies on
 (BackoffStatesQueue, pkg/job_controller/job_controller.go:71 and requeue
 semantics in job.go:87-97). Guarantees: an item queued multiple times before
 being processed is handed out once; an item re-added while being processed is
 re-queued afterwards; failures back off exponentially per item.
+
+Event coalescing (``coalesce_window > 0``) extends dedupe-while-queued to
+dedupe-across-a-burst: after an item is handed out, re-adds within the
+window don't go straight back on the queue — the first one schedules a
+single delayed re-add at the window edge and the rest are absorbed into it
+(counted in :attr:`coalesced`). A job whose 10 pods churn in a burst is
+reconciled once per window instead of once per event, and because the
+re-add always fires AFTER the last absorbed event, the final state is
+never dropped — workers just see it once, level-driven. ``coalesce_window
+= 0`` (default) is the exact historical behavior.
 """
 
 from __future__ import annotations
@@ -19,7 +29,10 @@ T = TypeVar("T", bound=Hashable)
 
 class WorkQueue(Generic[T]):
     def __init__(
-        self, base_delay: float = 0.005, max_delay: float = 30.0
+        self,
+        base_delay: float = 0.005,
+        max_delay: float = 30.0,
+        coalesce_window: float = 0.0,
     ) -> None:
         self._cond = threading.Condition()
         self._queue: List[T] = []
@@ -34,11 +47,35 @@ class WorkQueue(Generic[T]):
         self._base_delay = base_delay
         self._max_delay = max_delay
         self._shutdown = False
+        # ---- coalescing ------------------------------------------------
+        self._coalesce_window = coalesce_window
+        #: events absorbed into an already-pending pickup (dedupe) or an
+        #: already-scheduled coalesced re-add — each one is a reconcile
+        #: the controller did NOT run; exported as a metric
+        self.coalesced = 0
+        self._last_get: Dict[T, float] = {}  # item -> wall time of last get
+        self._cooling: set = set()  # items with a coalesced re-add scheduled
+        self._last_prune = 0.0
 
     def add(self, item: T) -> None:
         with self._cond:
-            if self._shutdown or item in self._dirty:
+            if self._shutdown:
                 return
+            if item in self._dirty or item in self._cooling:
+                self.coalesced += 1  # absorbed: a pickup is already pending
+                return
+            w = self._coalesce_window
+            if w > 0.0 and item not in self._processing:
+                last = self._last_get.get(item)
+                if last is not None and time.time() - last < w:
+                    # just handed out: defer to the window edge so the rest
+                    # of the burst rides this one scheduled re-add
+                    self._cooling.add(item)
+                    self._seq += 1
+                    heapq.heappush(self._delayed, (last + w, self._seq, item))
+                    self._enqueued.setdefault(item, time.time())
+                    self._cond.notify()
+                    return
             self._dirty.add(item)
             self._enqueued.setdefault(item, time.time())
             if item not in self._processing:
@@ -78,6 +115,7 @@ class WorkQueue(Generic[T]):
         now = time.time()
         while self._delayed and self._delayed[0][0] <= now:
             _, _, item = heapq.heappop(self._delayed)
+            self._cooling.discard(item)
             if item not in self._dirty:
                 self._dirty.add(item)
                 self._enqueued.setdefault(item, now)
@@ -85,24 +123,57 @@ class WorkQueue(Generic[T]):
                     self._queue.append(item)
         return (self._delayed[0][0] - now) if self._delayed else None
 
+    def _prune_last_get_locked(self, now: float) -> None:
+        """Bound the last-get map: entries older than the window can't
+        coalesce anything, so drop them once the map is big and at most
+        once per window (10k churned jobs must not pin 10k stamps)."""
+        if (
+            len(self._last_get) < 1024
+            or now - self._last_prune < self._coalesce_window
+        ):
+            return
+        cutoff = now - self._coalesce_window
+        self._last_get = {
+            k: t for k, t in self._last_get.items() if t > cutoff
+        }
+        self._last_prune = now
+
     def get(self, timeout: Optional[float] = None) -> Optional[T]:
         """Block until an item is available; None on shutdown/timeout."""
+        batch = self.get_batch(max_items=1, timeout=timeout)
+        return batch[0] if batch else None
+
+    def get_batch(
+        self, max_items: int = 8, timeout: Optional[float] = None
+    ) -> List[T]:
+        """Drain up to ``max_items`` ready items in ONE lock acquisition —
+        a worker behind a deep backlog stops paying a lock round-trip (and
+        a cond wakeup) per key. Empty list on shutdown/timeout. Each item
+        still gets its own ``wait_seconds``/``done`` calls."""
         deadline = None if timeout is None else time.time() + timeout
         with self._cond:
             while True:
                 next_due = self._drain_delayed_locked()
                 if self._queue:
-                    item = self._queue.pop(0)
-                    self._dirty.discard(item)
-                    self._processing.add(item)
-                    return item
+                    now = time.time()
+                    out: List[T] = []
+                    while self._queue and len(out) < max_items:
+                        item = self._queue.pop(0)
+                        self._dirty.discard(item)
+                        self._processing.add(item)
+                        if self._coalesce_window > 0.0:
+                            self._last_get[item] = now
+                        out.append(item)
+                    if self._coalesce_window > 0.0:
+                        self._prune_last_get_locked(now)
+                    return out
                 if self._shutdown:
-                    return None
+                    return []
                 wait: Optional[float] = next_due
                 if deadline is not None:
                     remaining = deadline - time.time()
                     if remaining <= 0:
-                        return None
+                        return []
                     wait = remaining if wait is None else min(wait, remaining)
                 self._cond.wait(wait)
 
@@ -116,9 +187,25 @@ class WorkQueue(Generic[T]):
     def done(self, item: T) -> None:
         with self._cond:
             self._processing.discard(item)
-            if item in self._dirty:
+            if item not in self._dirty:
+                return
+            w = self._coalesce_window
+            last = self._last_get.get(item) if w > 0.0 else None
+            if (
+                last is not None
+                and time.time() - last < w
+                and item not in self._cooling
+            ):
+                # events landed while we processed: apply the same cooldown
+                # instead of an immediate re-queue, so a burst costs one
+                # follow-up reconcile, not N
+                self._dirty.discard(item)
+                self._cooling.add(item)
+                self._seq += 1
+                heapq.heappush(self._delayed, (last + w, self._seq, item))
+            else:
                 self._queue.append(item)
-                self._cond.notify()
+            self._cond.notify()
 
     def shutdown(self) -> None:
         with self._cond:

@@ -607,13 +607,30 @@ class JobEngine:
 
         if to_create:
             self.expectations.expect_creations(exp_key, len(to_create))
-            for index in to_create:
-                pod = self._new_pod(job, ctx, rtype, spec, index)
-                try:
-                    created = self.store.create(pod)
-                    ctx.pods.append(created)  # type: ignore[arg-type]
-                except AlreadyExists:
-                    self.expectations.creation_observed(exp_key)
+            pods = [
+                self._new_pod(job, ctx, rtype, spec, index)
+                for index in to_create
+            ]
+            try:
+                # one store round-trip for the whole gang: under group
+                # commit a batch pays ONE fsync wait instead of one commit
+                # window per pod
+                ctx.pods.extend(self.store.create_many(pods))  # type: ignore[arg-type]
+            except AlreadyExists:
+                # someone raced us on at least one name (create_many is
+                # all-or-nothing per shard): fall back to per-pod creates
+                # so the rest of the gang still comes up
+                for pod in pods:
+                    if self.store.try_get(
+                        "Pod", pod.metadata.name, pod.metadata.namespace
+                    ) is not None:
+                        self.expectations.creation_observed(exp_key)
+                        continue
+                    try:
+                        created = self.store.create(pod)
+                        ctx.pods.append(created)  # type: ignore[arg-type]
+                    except AlreadyExists:
+                        self.expectations.creation_observed(exp_key)
         return restarted
 
     def reconcile_services(
@@ -1012,8 +1029,18 @@ class JobEngine:
         if policy == CleanPodPolicy.NONE:
             return
         for pod in pods:
-            if policy == CleanPodPolicy.RUNNING and pod.is_terminal():
-                continue
+            if policy == CleanPodPolicy.RUNNING:
+                if pod.is_terminal():
+                    continue
+                # ctx.pods is a reconcile-start snapshot: a pod that
+                # reached a terminal phase since then (its final update
+                # racing the job's success transition) must be spared,
+                # or its exit state is lost to the reap
+                cur = self.store.try_get(
+                    "Pod", pod.metadata.name, pod.metadata.namespace
+                )
+                if cur is None or cur.is_terminal():
+                    continue
             self._delete_pod(pod)
 
     def _delete_pod(self, pod: Pod) -> None:

@@ -317,6 +317,19 @@ class JobMetrics:
             "kubedl_tpu_wal_fsyncs",
             "fsync calls issued by the write-ahead log",
         )
+        self.wal_batch_size = r.histogram(
+            "kubedl_tpu_wal_batch_size",
+            "Records covered by each group-commit fsync (fsync='group'): "
+            "batch size 1 means no writers overlapped the window, the "
+            "right tail is the amortization collapsing fsyncs-per-append",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                     float("inf")),
+        )
+        self.coalesced_reconciles = r.gauge(
+            "kubedl_tpu_coalesced_reconciles",
+            "Watch events absorbed by workqueue burst coalescing, by "
+            "controller — reconcile passes the control plane did not run",
+        )
         self.watch_gaps = r.gauge(
             "kubedl_tpu_store_watch_gaps",
             "Watchers registered with a since_revision older than "

@@ -90,6 +90,7 @@ class ChurnReconciler:
             if self.store.try_get("Pod", f"{name}-p{k}", namespace) is None
         ]
         if missing:
+            pods = []
             for k in missing:
                 pod = Pod()
                 pod.metadata.name = f"{name}-p{k}"
@@ -99,15 +100,24 @@ class ChurnReconciler:
                     kind=KIND, name=name, uid=job.metadata.uid,
                     controller=True,
                 ))
-                try:
-                    self.store.create(pod)
-                except AlreadyExists:
-                    pass
+                pods.append(pod)
+            try:
+                # the production gang-create shape: one batch, one
+                # group-commit wait for the whole pod set
+                self.store.create_many(pods)
+            except AlreadyExists:
+                for pod in pods:
+                    try:
+                        self.store.create(pod)
+                    except AlreadyExists:
+                        pass
             return None  # pod ADDED events re-queue this key
         self._milestone(job, "job.pod_launch")
-        for k in range(self.pods_per_job):
-            self.store.try_delete("Pod", f"{name}-p{k}", namespace)
-        self.store.try_delete(KIND, name, namespace)
+        self.store.delete_many(
+            [("Pod", f"{name}-p{k}", namespace)
+             for k in range(self.pods_per_job)]
+            + [(KIND, name, namespace)]
+        )
         uid = job.metadata.uid
         with self._lock:
             if uid not in self._done:
@@ -126,6 +136,9 @@ def run_churn(
     wave: int = 500,
     stall_timeout: float = 120.0,
     fsync_floor_ms: float = 0.0,
+    wal_fsync: str = "always",
+    group_window_ms: float = 5.0,
+    coalesce_ms: float = 0.0,
 ) -> Dict[str, object]:
     """One churn-replay arm. Returns latency/TTL percentiles + throughput.
 
@@ -138,11 +151,18 @@ def run_churn(
     commit cost is exactly what a sharded log parallelizes — with one
     WAL every write in the process serializes behind it, with N WALs up
     to N commits overlap. 0 benchmarks the raw local device.
+
+    ``wal_fsync``/``group_window_ms`` pick the commit discipline:
+    ``"always"`` is the pre-PR-19 fsync-per-append shape, ``"group"``
+    group-commits with the given batch window (identical ack-durability —
+    writers still block until their record is fsynced). ``coalesce_ms``
+    turns on workqueue burst coalescing for the reconcile keys.
     """
     tracer = Tracer(capacity=2 * jobs + 1024)
     store = ShardedObjectStore(
-        shards=shards, wal_dir=wal_dir, wal_fsync="always",
+        shards=shards, wal_dir=wal_dir, wal_fsync=wal_fsync,
         wal_fsync_floor=fsync_floor_ms / 1e3,
+        wal_group_window=group_window_ms / 1e3,
         # churn must measure the append/fsync path, not O(live-set)
         # snapshot dumps every 1000 records
         wal_snapshot_every=1_000_000_000,
@@ -154,6 +174,7 @@ def run_churn(
     manager.register(
         "churn", reconciler.reconcile, watch_kinds=[KIND, "Pod"],
         mapper=owner_mapper(KIND), workers=workers_per_shard,
+        coalesce_window=coalesce_ms / 1e3,
     )
     manager.start()
     t0 = time.perf_counter()
@@ -162,11 +183,13 @@ def run_churn(
         submitted = 0
         while submitted < jobs:
             batch = min(wave, jobs - submitted)
+            wave_jobs = []
             for i in range(submitted, submitted + batch):
                 job = TPUJob()
                 job.metadata.name = f"churn-{i:05d}"
                 job.metadata.namespace = "default"
-                store.create(job)
+                wave_jobs.append(job)
+            store.create_many(wave_jobs)
             submitted += batch
             _wait_completed(
                 reconciler, max(0, submitted - 2 * wave), stall_timeout
@@ -185,6 +208,9 @@ def run_churn(
         elapsed = time.perf_counter() - t0
         wal_appends = store.wal_appends
         wal_fsyncs = store.wal_fsyncs
+        wal_batches = store.wal_batches
+        wal_batch_records = store.wal_batch_records
+        coalesced = manager.coalesced_reconciles
         manager.stop()
         store.close()
     # index i of both sample lists is the same reconcile pass (both are
@@ -204,6 +230,9 @@ def run_churn(
         "shards": shards,
         "workers_per_shard": workers_per_shard,
         "fsync_floor_ms": fsync_floor_ms,
+        "wal_fsync": wal_fsync,
+        "group_window_ms": group_window_ms if wal_fsync == "group" else 0.0,
+        "coalesce_ms": coalesce_ms,
         "jobs": jobs,
         "pods_per_job": pods_per_job,
         "pod_churn": jobs * pods_per_job,
@@ -224,6 +253,9 @@ def run_churn(
         "launch_p99_ms": round(percentile(launches, 0.99) * 1e3, 3),
         "wal_appends": wal_appends,
         "wal_fsyncs": wal_fsyncs,
+        "wal_batches": wal_batches,
+        "wal_batch_records": wal_batch_records,
+        "coalesced_reconciles": coalesced,
     }
 
 

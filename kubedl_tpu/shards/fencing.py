@@ -135,11 +135,17 @@ class FencedWal:
         self._wal = wal
         self.fence = fence
 
-    def append(self, *args, **kwargs) -> None:
+    def append(self, *args, **kwargs) -> Optional[int]:
         chaos.check("shard.wal_append")
         if self.fence is not None:
             self.fence.assert_valid()
-        self._wal.append(*args, **kwargs)
+        return self._wal.append(*args, **kwargs)
+
+    def wait_durable(self, ticket: Optional[int]) -> None:
+        # fsync-before-ack barrier of group commit: durability is decided
+        # by the fsync that already happened (or will); fencing was
+        # checked when the record was staged
+        self._wal.wait_durable(ticket)
 
     # -- pass-throughs the ObjectStore write path consults ---------------
 
@@ -168,6 +174,22 @@ class FencedWal:
     @property
     def torn_tail_bytes(self) -> int:
         return self._wal.torn_tail_bytes
+
+    @property
+    def batches(self) -> int:
+        return self._wal.batches
+
+    @property
+    def batch_records(self) -> int:
+        return self._wal.batch_records
+
+    @property
+    def on_batch(self):
+        return self._wal.on_batch
+
+    @on_batch.setter
+    def on_batch(self, cb) -> None:
+        self._wal.on_batch = cb
 
 
 def acquire_shard_lease(

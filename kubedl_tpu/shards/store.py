@@ -81,6 +81,7 @@ class ShardedObjectStore:
         wal_fsync: str = "always",
         wal_snapshot_every: int = 1000,
         wal_fsync_floor: float = 0.0,
+        wal_group_window: Optional[float] = None,
         lease_backend=None,
         identity: str = "",
         lease_ttl: float = 2.0,
@@ -94,6 +95,7 @@ class ShardedObjectStore:
         self._wal_fsync = wal_fsync
         self._wal_snapshot_every = wal_snapshot_every
         self._wal_fsync_floor = wal_fsync_floor
+        self._wal_group_window = wal_group_window
         self._lease_backend = lease_backend
         self._fenced = lease_backend is not None
         self.identity = identity or f"sharded-store-{id(self):x}"
@@ -192,6 +194,7 @@ class ShardedObjectStore:
                 wal_fsync=self._wal_fsync,
                 wal_snapshot_every=self._wal_snapshot_every,
                 wal_fsync_floor=self._wal_fsync_floor,
+                wal_group_window=self._wal_group_window,
             )
         if store._wal is not None:  # noqa: SLF001 — arm the fenced write path
             store._wal = FencedWal(store._wal, fence)  # noqa: SLF001
@@ -337,6 +340,23 @@ class ShardedObjectStore:
     def create(self, obj: BaseObject) -> BaseObject:
         return self._stores[self._route_write(obj)].create(obj)
 
+    def create_many(self, objs: List[BaseObject]) -> List[BaseObject]:
+        """Batched create, grouped by owning shard: each shard batch pays
+        ONE lock hold and (under group commit) ONE durability wait. Raises
+        :class:`~kubedl_tpu.core.store.AlreadyExists` before the failing
+        shard's batch applies; earlier shards' batches stay applied —
+        callers fall back to the per-object path on collision. Results
+        come back in input order."""
+        slots: List[Optional[BaseObject]] = [None] * len(objs)
+        groups: Dict[int, List[int]] = {}
+        for idx, obj in enumerate(objs):
+            groups.setdefault(self._route_write(obj), []).append(idx)
+        for i, idxs in groups.items():
+            created = self._stores[i].create_many([objs[k] for k in idxs])
+            for k, snap in zip(idxs, created):
+                slots[k] = snap
+        return [s for s in slots if s is not None]
+
     def get(self, kind: str, name: str, namespace: str = "default") -> BaseObject:
         for _, store in self._mounted():
             found = store.try_get(kind, name, namespace)
@@ -374,18 +394,32 @@ class ShardedObjectStore:
 
         return policy.call(attempt, retry_on=(Conflict,))
 
-    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+    def _holding_shard(
+        self, kind: str, name: str, namespace: str
+    ) -> Optional[int]:
+        """Which mounted shard holds ``kind namespace/name`` — a LOCK-FREE
+        existence probe (GIL-atomic dict reads over replace-on-write
+        buckets, same legality argument as ``ObjectStore.peek``; unlike
+        peek it sees terminating objects, since deletes must find them).
+        This is what un-serialized the delete path: the old probe took
+        every shard's WRITE lock, which is where the 4-shard
+        reconcile_exec_p99 regression came from."""
         for i, store in self._mounted():
-            with store._lock:  # noqa: SLF001 — existence probe, no copy
-                found = (namespace, name) in store._objects.get(kind, {})  # noqa: SLF001
-            if found:
-                if self._fenced and not self._owned[i]:
-                    raise FencedOut(
-                        f"shard {i}: {self.identity} does not own the shard "
-                        f"for {kind} {namespace}/{name}"
-                    )
-                store.delete(kind, name, namespace)
-                return
+            bucket = store._objects.get(kind)  # noqa: SLF001 — lock-free probe
+            if bucket is not None and (namespace, name) in bucket:
+                return i
+        return None
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        i = self._holding_shard(kind, name, namespace)
+        if i is not None:
+            if self._fenced and not self._owned[i]:
+                raise FencedOut(
+                    f"shard {i}: {self.identity} does not own the shard "
+                    f"for {kind} {namespace}/{name}"
+                )
+            self._stores[i].delete(kind, name, namespace)
+            return
         chaos.check("store.delete")  # not-found still consults the site once
         raise NotFound(f"{kind} {namespace}/{name} not found")
 
@@ -395,6 +429,30 @@ class ShardedObjectStore:
             return True
         except NotFound:
             return False
+
+    def delete_many(self, keys: List[Tuple[str, str, str]]) -> int:
+        """Batched try-delete of ``(kind, name, namespace)`` keys, grouped
+        by the shard that actually holds each object (lock-free probe):
+        one lock hold + one durability wait per shard batch. Missing keys
+        are skipped; returns the count deleted."""
+        groups: Dict[int, List[Tuple[str, str, str]]] = {}
+        for kind, name, namespace in keys:
+            i = self._holding_shard(kind, name, namespace)
+            if i is None:
+                continue
+            if self._fenced and not self._owned[i]:
+                raise FencedOut(
+                    f"shard {i}: {self.identity} does not own the shard "
+                    f"for {kind} {namespace}/{name}"
+                )
+            groups.setdefault(i, []).append((kind, name, namespace))
+        n = 0
+        for i, ks in groups.items():
+            fence = self._fences[i]
+            if fence is not None:
+                fence.assert_valid()
+            n += self._stores[i].delete_many(ks)
+        return n
 
     def list(
         self,
@@ -473,25 +531,31 @@ class ShardedObjectStore:
             only = self._stores[0]
             return only.collect_orphans() if only is not None else 0
         stores = self._mounted()
+        # RCU snapshot views: the global uid scan and the orphan scan no
+        # longer take ANY shard's write lock (this was the other half of
+        # the 4-shard exec-p99 regression — GC beats serialized writers
+        # on every shard once a second)
+        views: List[Tuple[int, ObjectStore, List[Tuple[BaseObject, ...]]]] = [
+            (i, store, [store.snapshot_view(kind) for kind in store.kinds()])
+            for i, store in stores
+        ]
         uids = set()
-        for _, store in stores:
-            with store._lock:  # noqa: SLF001 — counter scan, no copies
-                for bucket in store._objects.values():  # noqa: SLF001
-                    for obj in bucket.values():
-                        uids.add(obj.metadata.uid)
+        for _, _, kind_views in views:
+            for view in kind_views:
+                for obj in view:
+                    uids.add(obj.metadata.uid)
         doomed: List[Tuple[ObjectStore, str, str, str]] = []
-        for i, store in stores:
+        for i, store, kind_views in views:
             if self._fenced and not self._owned[i]:
                 continue
-            with store._lock:  # noqa: SLF001
-                for bucket in store._objects.values():  # noqa: SLF001
-                    for obj in bucket.values():
-                        ref = obj.metadata.controller_ref()
-                        if ref is not None and ref.uid not in uids:
-                            doomed.append((
-                                store, obj.kind,
-                                obj.metadata.name, obj.metadata.namespace,
-                            ))
+            for view in kind_views:
+                for obj in view:
+                    ref = obj.metadata.controller_ref()
+                    if ref is not None and ref.uid not in uids:
+                        doomed.append((
+                            store, obj.kind,
+                            obj.metadata.name, obj.metadata.namespace,
+                        ))
         n = 0
         for store, kind, name, ns in doomed:
             if store.try_delete(kind, name, ns):
@@ -516,6 +580,21 @@ class ShardedObjectStore:
     @property
     def wal_fsyncs(self) -> int:
         return sum(s.wal_fsyncs for _, s in self._mounted())
+
+    @property
+    def wal_batches(self) -> int:
+        return sum(s.wal_batches for _, s in self._mounted())
+
+    @property
+    def wal_batch_records(self) -> int:
+        return sum(s.wal_batch_records for _, s in self._mounted())
+
+    def set_wal_batch_observer(self, cb: Callable[[int], None]) -> None:
+        """Fan the per-batch group-commit size callback out to every
+        mounted shard WAL (the committer threads call it concurrently —
+        the metrics histogram is already thread-safe)."""
+        for _, store in self._mounted():
+            store.set_wal_batch_observer(cb)
 
     def wal_appends_for(self, i: int) -> int:
         store = self._stores[i]

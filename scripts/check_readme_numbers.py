@@ -53,6 +53,9 @@ ROLLOUT_ARTIFACT = "BENCH_r17_rollout.json"
 #: sharded control-plane churn-replay row (r18): separate artifact, same
 #: runs[] shape (CPU proxy — see docs/architecture.md)
 SHARDS_ARTIFACT = "BENCH_r18_shards.json"
+#: control-plane scaling-efficiency row (r19): separate artifact, same
+#: runs[] shape (group commit + coalescing — see docs/architecture.md)
+CP_SCALE_ARTIFACT = "BENCH_r19_cp_scale.json"
 
 
 def _runs_median(runs, *path) -> float:
@@ -343,6 +346,30 @@ def expected_shards_strings(artifact: dict) -> dict:
     }
 
 
+def expected_cp_scale_strings(artifact: dict) -> dict:
+    """README control-plane scaling row strings from BENCH_r19_cp_scale.json."""
+    runs = artifact["runs"]
+    tgt = ("targets", "cp_scale")
+    speedup = _runs_median(runs, *tgt, "throughput_speedup_4x1")
+    one = _runs_median(runs, *tgt, "arms", "1_shard", "jobs_per_s")
+    four = _runs_median(runs, *tgt, "arms", "4_shard", "jobs_per_s")
+    r18_qw = _runs_median(runs, *tgt, "r18_queue_wait_p99_ms")
+    qw = _runs_median(runs, *tgt, "arms", "4_shard", "queue_wait_p99_ms")
+    amort = _runs_median(runs, *tgt, "fsync_amortization_4_shard")
+    return {
+        f"**{speedup:.2f}x** job throughput at 4 shards — "
+        f"{one:g} -> {four:g} jobs/s":
+            "medians of runs[].targets.cp_scale.throughput_speedup_4x1 and "
+            "arms.{1,4}_shard.jobs_per_s",
+        f"queue wait p99 **{r18_qw / qw:.1f}x** lower than r18 "
+        f"({r18_qw:,.0f} -> {qw:,.0f} ms)":
+            "medians of runs[].targets.cp_scale.r18_queue_wait_p99_ms and "
+            "arms.4_shard.queue_wait_p99_ms",
+        f"**{amort:.0f}** appends per fsync (r18: 1)":
+            "median of runs[].targets.cp_scale.fsync_amortization_4_shard",
+    }
+
+
 def check(repo: Path = REPO) -> list:
     """Returns a list of mismatch descriptions (empty = README is clean)."""
     artifact = json.loads((repo / ARTIFACT).read_text())
@@ -401,6 +428,11 @@ def check(repo: Path = REPO) -> list:
     expected.update(
         expected_shards_strings(
             json.loads((repo / SHARDS_ARTIFACT).read_text())
+        )
+    )
+    expected.update(
+        expected_cp_scale_strings(
+            json.loads((repo / CP_SCALE_ARTIFACT).read_text())
         )
     )
     problems = []

@@ -110,6 +110,20 @@ CHUNKED_BUDGET_MS = 5.0
 #: Python-level hash loop.
 SHARDMAP_LOOKUP_BUDGET_US = 5.0
 
+#: per-event budget (µs) for a COALESCED workqueue add — the absorbed
+#: path (item already dirty/cooling) every event storm rides: one lock
+#: round-trip, two set probes, a counter bump. 10 µs leaves headroom on
+#: shared CI machines while catching an accidental heap push, dict
+#: rebuild, or timestamp scan sneaking onto the hot absorb path.
+WORKQUEUE_ADD_BUDGET_US = 10.0
+
+#: pickups-per-key ceiling for an event storm under coalescing: a burst
+#: of N events on an already-reconciled key must cost ~1 follow-up
+#: pickup (the window-edge re-add), not N. 3 allows the window to roll
+#: over once on a slow machine while still failing the
+#: reconcile-per-event shape this guards against.
+WORKQUEUE_STORM_PICKUPS_PER_KEY = 3.0
+
 
 def build_stub_engine(max_batch: int = 4, max_seq: int = 128,
                       kv_layout: str = "contiguous",
@@ -598,6 +612,65 @@ def run_shardmap_microbench(keys: int = 100_000, shards: int = 4) -> dict:
     }
 
 
+def run_workqueue_microbench(keys: int = 200,
+                             events_per_key: int = 50) -> dict:
+    """Workqueue burst coalescing under an enqueue storm: ``keys``
+    already-reconciled keys each take ``events_per_key`` rapid-fire
+    re-adds (the 10-pods-churn-per-job shape), then the queue drains.
+    Reports dequeue count vs event count — the whole point of coalescing
+    is that the storm costs ~1 follow-up pickup per key, not one per
+    event — plus the per-event cost of the absorbed-add hot path."""
+    from kubedl_tpu.core.workqueue import WorkQueue
+
+    window = 0.02
+    q = WorkQueue(coalesce_window=window)
+    # phase 1: every key reconciled once (stamps its last-get time)
+    for i in range(keys):
+        q.add(i)
+    while True:
+        batch = q.get_batch(max_items=64, timeout=0.01)
+        if not batch:
+            break
+        for item in batch:
+            q.done(item)
+    # phase 2: the storm, timed — every add lands within the window of
+    # its key's pickup, so adds 2..N ride the absorbed fast path
+    events = keys * events_per_key
+    t0 = time.perf_counter()
+    for i in range(keys):
+        for _ in range(events_per_key):
+            q.add(i)
+    add_us = (time.perf_counter() - t0) * 1e6 / events
+    # phase 3: drain — count how many pickups the storm actually cost
+    pickups = 0
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        batch = q.get_batch(max_items=64, timeout=window)
+        if batch:
+            pickups += len(batch)
+            for item in batch:
+                q.done(item)
+        elif len(q) == 0:
+            break
+    per_key = pickups / max(keys, 1)
+    return {
+        "keys": keys,
+        "events": events,
+        "coalesce_window_ms": window * 1e3,
+        "storm_pickups": pickups,
+        "pickups_per_key": round(per_key, 3),
+        "coalesced": q.coalesced,
+        "add_us": round(add_us, 4),
+        "add_budget_us": WORKQUEUE_ADD_BUDGET_US,
+        "pickups_per_key_budget": WORKQUEUE_STORM_PICKUPS_PER_KEY,
+        "within_budget": (
+            per_key <= WORKQUEUE_STORM_PICKUPS_PER_KEY
+            and pickups >= keys  # final state never dropped
+            and add_us <= WORKQUEUE_ADD_BUDGET_US
+        ),
+    }
+
+
 def run_tracing_microbench(calls: int = 200_000) -> dict:
     """Per-call cost of the DISARMED tracing fast path: a fresh local
     Tracer with ``enabled = False``, timing the three hot-path entry
@@ -647,6 +720,7 @@ def main() -> int:
     out["buckets"] = run_bucket_microbench()
     out["tracing"] = run_tracing_microbench()
     out["shardmap"] = run_shardmap_microbench()
+    out["workqueue"] = run_workqueue_microbench()
     print(json.dumps(out, indent=2))
     ok = (out["within_budget"] and out["prefix"]["within_budget"]
           and out["paged"]["within_budget"]
@@ -655,7 +729,8 @@ def main() -> int:
           and out["planner"]["within_budget"]
           and out["buckets"]["within_budget"]
           and out["tracing"]["within_budget"]
-          and out["shardmap"]["within_budget"])
+          and out["shardmap"]["within_budget"]
+          and out["workqueue"]["within_budget"])
     return 0 if ok else 1
 
 

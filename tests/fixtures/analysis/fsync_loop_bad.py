@@ -1,0 +1,19 @@
+"""Seed fixture: the per-iteration durability barrier BENCH_r18 measured
+at scale — one fsync per appended record (220k fsyncs for 220k appends),
+and one wait_durable per staged ticket, each of which re-serializes the
+whole batch behind a commit it could have shared."""
+
+import os
+
+
+def append_all(f, records):
+    for rec in records:
+        f.write(rec)
+        f.flush()
+        os.fsync(f.fileno())  # one commit per record
+
+
+def stage_all(wal, batch):
+    for op in batch:
+        ticket = wal.append(op)
+        wal.wait_durable(ticket)  # re-serializes the group commit

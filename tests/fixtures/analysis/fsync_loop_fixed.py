@@ -1,0 +1,18 @@
+"""Fixed form: write/stage the whole set, then pay the durability
+barrier once — same guarantee, O(batches) commits."""
+
+import os
+
+
+def append_all(f, records):
+    for rec in records:
+        f.write(rec)
+    f.flush()
+    os.fsync(f.fileno())  # one commit for the batch
+
+
+def stage_all(wal, batch):
+    ticket = None
+    for op in batch:
+        ticket = wal.append(op)
+    wal.wait_durable(ticket)  # the last ticket covers every earlier one

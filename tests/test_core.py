@@ -124,6 +124,106 @@ class TestWorkQueue:
         q.forget("x")
         assert q.num_requeues("x") == 0
 
+    def test_get_batch_drains_in_order(self):
+        q = WorkQueue()
+        for item in ("a", "b", "c", "d"):
+            q.add(item)
+        assert q.get_batch(max_items=3, timeout=0.1) == ["a", "b", "c"]
+        assert q.get_batch(max_items=3, timeout=0.1) == ["d"]
+        assert q.get_batch(max_items=3, timeout=0.02) == []
+
+
+class TestCoalescing:
+    """Burst coalescing (``coalesce_window > 0``): a storm of N events on
+    one key costs at most ceil(N-ish / window) reconciles, at least 1, and
+    the final state is never dropped — the coalesced re-add always fires
+    AFTER the last absorbed event."""
+
+    def test_burst_collapses_to_bounded_pickups(self):
+        win = 0.05
+        q = WorkQueue(coalesce_window=win)
+        n = 50
+        q.add("job")
+        assert q.get(0.1) == "job"
+        q.done("job")
+        # the burst: N rapid-fire events right after the pickup
+        for _ in range(n):
+            q.add("job")
+        pickups = 0
+        deadline = time.time() + 2.0
+        while time.time() < deadline:
+            item = q.get(timeout=win)
+            if item is None:
+                if len(q) == 0:
+                    break
+                continue
+            pickups += 1
+            q.done(item)
+        # >= 1 (never dropped), and nowhere near one pickup per event:
+        # the whole sub-window burst rides one scheduled re-add
+        assert 1 <= pickups <= 3, pickups
+        assert q.coalesced >= n - pickups
+
+    def test_final_state_never_dropped(self):
+        """An event that lands while the item is mid-processing (or mid-
+        cooldown) must still produce a later pickup — coalescing absorbs
+        duplicates, never the last level."""
+        q = WorkQueue(coalesce_window=0.03)
+        q.add("k")
+        assert q.get(0.1) == "k"
+        q.add("k")  # lands while processing -> dirty
+        q.done("k")  # -> coalesced cooldown, not a drop
+        assert q.get(1.0) == "k"  # fires at the window edge
+        q.done("k")
+        assert q.get(0.05) is None  # and exactly once
+
+    def test_readd_after_window_is_immediate(self):
+        win = 0.03
+        q = WorkQueue(coalesce_window=win)
+        q.add("k")
+        assert q.get(0.1) == "k"
+        q.done("k")
+        time.sleep(win * 2)  # quiet period: the window has passed
+        t0 = time.time()
+        q.add("k")
+        assert q.get(0.5) == "k"
+        assert time.time() - t0 < win  # no cooldown applied
+
+    def test_zero_window_is_exact_historical_behavior(self):
+        q = WorkQueue(coalesce_window=0.0)
+        q.add("k")
+        assert q.get(0.1) == "k"
+        q.done("k")
+        q.add("k")
+        assert q.get(0.05) == "k"  # immediate, no cooling
+        assert q.coalesced == 0
+
+
+class TestFairBatch:
+    """A drain pass claims only the worker's fair share of the backlog:
+    a shallow queue must stay single-key pickups — bulk-claiming it would
+    serialize keys (a gang's pod launches) that idle sibling workers
+    could have run in parallel."""
+
+    def test_shallow_backlog_is_single_key(self):
+        assert ControllerManager.fair_batch(depth=2, workers=4) == 1
+        assert ControllerManager.fair_batch(depth=0, workers=4) == 1
+        assert ControllerManager.fair_batch(depth=3, workers=4) == 1
+
+    def test_deep_backlog_amortizes_to_full_batches(self):
+        assert ControllerManager.fair_batch(depth=100, workers=4) == (
+            ControllerManager.GET_BATCH
+        )
+        assert ControllerManager.fair_batch(depth=9, workers=3) == 3
+
+    def test_single_worker_takes_whole_shallow_queue(self):
+        assert ControllerManager.fair_batch(depth=5, workers=1) == 5
+
+    def test_degenerate_worker_count(self):
+        assert ControllerManager.fair_batch(depth=10, workers=0) == (
+            ControllerManager.GET_BATCH
+        )
+
 
 class TestManager:
     def test_reconcile_driven_by_watch(self):
@@ -145,5 +245,103 @@ class TestManager:
             cm.metadata.name = "c1"
             mgr.store.create(cm)
             assert mgr.wait(lambda: ("default", "c1") in seen, timeout=5)
+        finally:
+            mgr.stop()
+
+
+class TestWorkQueueBudget:
+    def test_coalescing_storm_budget(self):
+        """scripts/scheduler_microbench.py's workqueue arm as a tier-1
+        gate: an enqueue storm on reconciled keys must cost ~1 pickup per
+        key (never one per event), never drop the final state, and keep
+        the absorbed-add hot path at dict-probe cost."""
+        import sys
+        from pathlib import Path
+
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+        from scripts.scheduler_microbench import run_workqueue_microbench
+
+        out = run_workqueue_microbench(keys=100, events_per_key=30)
+        assert out["within_budget"], out
+        assert out["coalesced"] >= out["events"] - out["storm_pickups"], out
+
+
+class TestExpectationsUnderCoalescing:
+    def test_accounting_exact_when_reconciles_coalesce(self):
+        """Coalescing absorbs RECONCILES, never watch events: every pod
+        ADDED still decrements the expectation cache exactly once, so the
+        gang is created exactly once and the counter lands on exactly
+        zero — not negative (over-observation), not positive (a dropped
+        event would wedge the job until expiry)."""
+        from kubedl_tpu.core.manager import owner_mapper
+        from kubedl_tpu.engine.expectations import (
+            ControllerExpectations, expectation_key,
+        )
+
+        gang = 8
+        store = ObjectStore()
+        mgr = ControllerManager(store=store)
+        exps = ControllerExpectations()
+        created_batches = []
+        lock = threading.Lock()
+
+        def exp_key(ns, name):
+            return expectation_key(f"{ns}/{name}", "worker", "pods")
+
+        # the engine's watch-side accounting: one observed() per event
+        def on_event(event, obj, _old):
+            if obj.kind != "Pod" or event != "ADDED":
+                return
+            owner = obj.metadata.owner_refs[0]
+            exps.creation_observed(
+                exp_key(obj.metadata.namespace, owner.name))
+
+        store.watch(on_event, ["Pod"])
+
+        def reconcile(ns, name):
+            if store.try_get("ConfigMap", name, ns) is None:
+                return None
+            key = exp_key(ns, name)
+            if not exps.satisfied(key):
+                return None  # cache behind: creating again = duplicates
+            owner = store.get("ConfigMap", name, ns)
+            missing = [
+                k for k in range(gang)
+                if store.try_get("Pod", f"{name}-p{k}", ns) is None
+            ]
+            if not missing:
+                return None
+            exps.expect_creations(key, len(missing))
+            pods = []
+            for k in missing:
+                p = Pod()
+                p.metadata.name = f"{name}-p{k}"
+                p.metadata.namespace = ns
+                p.metadata.owner_refs.append(OwnerRef(
+                    kind="ConfigMap", name=name,
+                    uid=owner.metadata.uid, controller=True,
+                ))
+                pods.append(p)
+            with lock:
+                created_batches.append(len(missing))
+            store.create_many(pods)
+            return None
+
+        mgr.register("gang", reconcile, ["ConfigMap", "Pod"],
+                     owner_mapper("ConfigMap"), coalesce_window=0.02)
+        mgr.start()
+        try:
+            cm = ConfigMap()
+            cm.metadata.name = "job"
+            store.create(cm)
+            assert mgr.wait(
+                lambda: len(store.list("Pod")) == gang, timeout=5)
+            time.sleep(0.1)  # let the coalesced follow-up reconcile land
+            key = exp_key("default", "job")
+            assert exps.satisfied(key)
+            # exact: all 8 ADDED events observed, none double-counted
+            assert exps._exps[key].adds == 0
+            # and the gang was created exactly once, in one batch
+            assert created_batches == [gang]
         finally:
             mgr.stop()

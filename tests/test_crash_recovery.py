@@ -186,6 +186,13 @@ class TestWalStore:
             with pytest.raises(FaultInjected):
                 s.create(_pod("p1"))
 
+    def test_group_policy_round_trips(self, tmp_path):
+        d = str(tmp_path / "wal-group")
+        s = ObjectStore(wal_dir=d, wal_fsync="group", wal_group_window=0.001)
+        s.create(_pod("p1"))
+        s.close()
+        assert ObjectStore(wal_dir=d).try_get("Pod", "p1") is not None
+
     def test_wal_off_store_has_zero_overhead_path(self):
         s = ObjectStore()
         assert s._wal is None and s.wal_appends == 0
@@ -198,6 +205,102 @@ class TestWalStore:
         # generous guard (scheduler_microbench owns the tight budget):
         # 3000 ops of pure-memory store work must stay fast
         assert elapsed < 5.0, f"WAL-off store slowed down: {elapsed:.2f}s"
+
+
+class TestGroupCommit:
+    """WAL group commit (``fsync="group"``): fsync-before-ack durability,
+    O(batches) fsyncs instead of O(appends), per-batch fsync floor, and
+    the crash/chaos contract — acknowledged records always replay,
+    unacknowledged ones may be lost, never the reverse."""
+
+    def test_batch_amortizes_fsyncs(self, tmp_path):
+        s = ObjectStore(wal_dir=str(tmp_path / "wal"), wal_fsync="group",
+                        wal_group_window=0.005)
+        s.create_many([_pod(f"p{i}") for i in range(32)])
+        # one staged burst, one (maybe two) covering fsyncs — never 32
+        assert s.wal_appends == 32
+        assert s.wal_fsyncs < 32 and s.wal_batches >= 1
+        assert s.wal_batch_records == 32
+        s.close()
+        s2 = ObjectStore(wal_dir=str(tmp_path / "wal"))
+        assert len(s2.list("Pod")) == 32
+
+    def test_acked_records_survive_crash_without_close(self, tmp_path):
+        """fsync-before-ack: once create() returned, the record is exactly
+        as durable as under fsync="always" — a hard crash (no close(), no
+        final fsync) must replay it."""
+        wal = str(tmp_path / "wal")
+        s1 = ObjectStore(wal_dir=wal, wal_fsync="group",
+                         wal_group_window=0.001)
+        s1.create(_pod("acked"))  # returned => batched fsync covered it
+        # simulate the hard crash: drop the store without close()
+        s2 = ObjectStore(wal_dir=wal)
+        assert s2.try_get("Pod", "acked") is not None
+
+    def test_failed_group_commit_poisons_log(self, tmp_path):
+        """A failed batch fsync is the crash seam: the waiting writer gets
+        WalCorruption (its write is UNacknowledged), the log goes
+        crash-only, and replay still holds every earlier acked record."""
+        wal = str(tmp_path / "wal")
+        s1 = ObjectStore(wal_dir=wal, wal_fsync="group",
+                         wal_group_window=0.001)
+        s1.create(_pod("acked"))
+        with FaultPlan(1, sites={"store.wal_group_commit":
+                                 [FaultSpec.always()]}):
+            with pytest.raises(WalCorruption):
+                s1.create(_pod("unacked"))
+        # crash-only from here: later writes refuse loudly
+        with pytest.raises(WalCorruption):
+            s1.create(_pod("after"))
+        s2 = ObjectStore(wal_dir=wal)
+        # the contract is one-sided: acked always replays; the unacked
+        # record's bytes were staged so it MAY replay — never assert on it
+        assert s2.try_get("Pod", "acked") is not None
+        assert s2.try_get("Pod", "after") is None
+
+    def test_fsync_floor_applies_per_batch(self, tmp_path):
+        """The commit floor (modeling etcd-class disks) is paid once per
+        batched fsync, not once per record — the whole point of group
+        commit. 16 records at a 30ms floor must cost ~1 floor, nowhere
+        near 16."""
+        floor = 0.03
+        s = ObjectStore(wal_dir=str(tmp_path / "wal"), wal_fsync="group",
+                        wal_group_window=0.0, wal_fsync_floor=floor)
+        t0 = time.perf_counter()
+        s.create_many([_pod(f"p{i}") for i in range(16)])
+        elapsed = time.perf_counter() - t0
+        assert elapsed >= floor  # the ack really waited for a commit
+        assert elapsed < 16 * floor / 2  # and not one commit per record
+        assert s.wal_fsyncs <= 4
+        s.close()
+
+    def test_concurrent_writers_share_one_commit_window(self, tmp_path):
+        """N threads creating concurrently must overlap their ack waits:
+        total fsyncs stays O(batches) and every write is durable."""
+        s = ObjectStore(wal_dir=str(tmp_path / "wal"), wal_fsync="group",
+                        wal_group_window=0.01)
+        errs = []
+
+        def writer(base):
+            try:
+                for i in range(10):
+                    s.create(_pod(f"w{base}-{i}"))
+            except Exception as e:  # pragma: no cover - surfaced below
+                errs.append(e)
+
+        import threading
+        threads = [threading.Thread(target=writer, args=(b,))
+                   for b in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert s.wal_appends == 80
+        assert s.wal_fsyncs < 80  # batches shared across writers
+        s.close()
+        s2 = ObjectStore(wal_dir=str(tmp_path / "wal"))
+        assert len(s2.list("Pod")) == 80
 
 
 class TestWatchGapRobustness:

@@ -231,6 +231,24 @@ class TestCleanPodPolicy:
         # services always cleaned on terminal
         assert store.list("Service") == []
 
+    def test_reap_rechecks_store_not_stale_snapshot(self):
+        """A worker whose terminal update lands between the reconcile's
+        pod read and the reap must be spared: deleting from the stale
+        snapshot would destroy its exit state (the pod looked Running
+        when ctx.pods was captured, but is Succeeded by delete time)."""
+        engine, store, _ = make_engine()
+        driver = PodDriver(store)
+        job = make_tpujob(workers=2)
+        job.spec.run_policy.clean_pod_policy = CleanPodPolicy.RUNNING
+        submit_and_reconcile(engine, store, job)
+        driver.run("job1-worker-1")
+        stale = store.list("Pod")  # snapshot with worker-1 Running
+        driver.succeed("job1-worker-0")
+        driver.succeed("job1-worker-1")  # lands after the snapshot
+        stored = store.get("TPUJob", "job1")
+        engine._delete_pods(stored, stale, CleanPodPolicy.RUNNING)
+        assert pod_names(store) == ["job1-worker-0", "job1-worker-1"]
+
     def test_ttl_deletes_job(self):
         engine, store, _ = make_engine()
         driver = PodDriver(store)
