@@ -286,6 +286,290 @@ def bench_cp_scale() -> dict:
     }
 
 
+def bench_federation() -> dict:
+    """Multi-operator federation round (BENCH_r20_federation.json): the
+    cp_scale churn replay with the shards spread across real operator
+    PROCESSES instead of one GIL. Two arms:
+
+    - ``fed_4proc``: 4 member processes share one 8-shard WAL/lease root
+      (2 shards each, disjoint static plan, per-shard file leases +
+      fenced WAL writers); each submits only the jobs out of the same
+      global 10k-job sequence that route to its shards, with cp_scale's
+      offered load and worker pool held fixed fleet-wide (wave 80 -> 20
+      per process, 2 workers/shard, 2ms commit floor, 18ms group window,
+      20ms coalesce). Gate: aggregate jobs/s beats BENCH_r19's 8-shard
+      in-process arm (128.9 — the measured GIL ceiling cp_scale's
+      docstring promised federation would remove), and every member
+      completes every one of its jobs.
+    - ``member_kill``: 3 full FederationMember processes (heartbeats,
+      staggered standby campaigns, WAL tails) over a 6-shard root churn
+      a smaller job set; once the seeded victim has made progress the
+      parent SIGKILLs it mid-churn. Gates: every shard lease lands on a
+      survivor within the takeover budget (ttl + rank-staggered standby
+      delay + retry beat, with slop), the survivors drain the ENTIRE
+      churn including the victim's orphaned jobs (remaining==0 across
+      owned shards), and the shared launch ledger — a line per pod
+      appended only after the durable create — contains zero duplicate
+      pod names: rehydrate-then-adopt meant takeover never relaunched a
+      durably-created pod, and fencing meant the dead member's half-sent
+      wave could not land after its lease expired.
+    """
+    import shutil
+    import subprocess
+    import tempfile
+
+    from kubedl_tpu.federation.rebalance import plan_assignment
+    from kubedl_tpu.shards.fencing import (
+        SHARD_LEASE_NAMESPACE,
+        FileLeaseStore,
+        shard_lease_name,
+    )
+
+    jobs = int(os.environ.get("KUBEDL_BENCH_FED_JOBS", "10000"))
+    pods_per_job = 10
+    r19_8shard_jobs_per_s = 128.9  # BENCH_r19_cp_scale.json, 8_shard arm
+
+    def _spawn(cfg: dict) -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, "-m", "kubedl_tpu.federation.bench_worker",
+             json.dumps(cfg)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+
+    # --- arm 1: 4-process federated churn over one 8-shard root -------
+    shards = 8
+    members = [f"fed-{c}" for c in "abcd"]
+    plan = plan_assignment(shards, members)
+    root = tempfile.mkdtemp(prefix="kubedl-bench-fed4-")
+    procs = []
+    try:
+        procs = [
+            _spawn({
+                "mode": "churn",
+                "churn": {
+                    "shards": shards, "jobs": jobs,
+                    "pods_per_job": pods_per_job,
+                    "wal_dir": os.path.join(root, "wal"),
+                    "workers_per_shard": 2, "wave": 20,
+                    "fsync_floor_ms": 2.0, "stall_timeout": 300.0,
+                    "wal_fsync": "group", "group_window_ms": 18.0,
+                    "coalesce_ms": 20.0,
+                    "lease_dir": os.path.join(root, "leases"),
+                    "identity": m, "own": plan[m], "standby": [],
+                    "lease_ttl": 5.0, "only_owned_jobs": True,
+                },
+            })
+            for m in members
+        ]
+        outs = [p.communicate(timeout=600)[0] for p in procs]
+        rcs = [p.returncode for p in procs]
+        member_results = [json.loads(o.strip().splitlines()[-1]) for o in outs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(root, ignore_errors=True)
+    fed_completed = sum(r["completed"] for r in member_results)
+    fed_elapsed = max(r["elapsed_s"] for r in member_results)
+    fed_jobs_per_s = round(fed_completed / max(fed_elapsed, 1e-9), 1)
+    fed = {
+        "processes": len(members),
+        "shards": shards,
+        "plan": plan,
+        "jobs": jobs,
+        "pod_churn": jobs * pods_per_job,
+        "completed": fed_completed,
+        "elapsed_s": round(fed_elapsed, 3),
+        "jobs_per_s": fed_jobs_per_s,
+        "reconcile_p99_ms": max(
+            r["reconcile_p99_ms"] for r in member_results
+        ),
+        "queue_wait_p99_ms": max(
+            r["queue_wait_p99_ms"] for r in member_results
+        ),
+        "members": member_results,
+        "worker_exit_codes": rcs,
+    }
+
+    # --- arm 2: seeded member SIGKILL under churn ----------------------
+    kill_jobs = int(os.environ.get(
+        "KUBEDL_BENCH_FED_KILL_JOBS", str(max(300, jobs // 10))
+    ))
+    kshards = 6
+    lease_ttl = 1.0
+    kill_members = ["fed-ka", "fed-kb", "fed-kc"]
+    seed = 20
+    victim = kill_members[seed % len(kill_members)]
+    # replicate each member's static share of the global job sequence so
+    # the drain gate knows how many jobs SHOULD exist: survivors submit
+    # their full planned shares; the victim's share is frozen at the
+    # kill point (nobody resubmits for the dead — takeover only drains
+    # what the victim durably created)
+    from kubedl_tpu.shards.shardmap import ShardMap
+
+    kplan = plan_assignment(kshards, kill_members)
+    shard_owner = {i: m for m, ss in kplan.items() for i in ss}
+    smap = ShardMap(kshards)
+    share = {m: 0 for m in kill_members}
+    for i in range(kill_jobs):
+        share[shard_owner[smap.lookup(f"default/fed-{i:05d}")]] += 1
+    takeover_budget_s = lease_ttl * 4 + 2.0
+    root = tempfile.mkdtemp(prefix="kubedl-bench-fedkill-")
+    kprocs = {}
+    try:
+        lease_dir = os.path.join(root, "leases")
+        launch_log = os.path.join(root, "launches.log")
+        stop_path = os.path.join(root, "stop")
+        status = {m: os.path.join(root, f"status-{m}.json") for m in kill_members}
+        for m in kill_members:
+            kprocs[m] = _spawn({
+                "mode": "member", "identity": m, "peers": kill_members,
+                "shards": kshards, "lease_ttl": lease_ttl,
+                "jobs": kill_jobs, "pods_per_job": pods_per_job,
+                "wal_dir": os.path.join(root, "wal"),
+                "lease_dir": lease_dir, "launch_log": launch_log,
+                "status_path": status[m], "stop_path": stop_path,
+                "wave": 25, "group_window_ms": 5.0, "coalesce_ms": 10.0,
+            })
+
+        def _read_status(m):
+            try:
+                with open(status[m]) as fh:
+                    return json.loads(fh.read())
+            except (OSError, ValueError):
+                return None
+
+        def _holders():
+            backend = FileLeaseStore(lease_dir)
+            out = {}
+            for i in range(kshards):
+                lease = backend.try_get(
+                    "Lease", shard_lease_name(i), SHARD_LEASE_NAMESPACE
+                )
+                out[i] = lease.holder if lease is not None else None
+            return out
+
+        # wait for the victim to own its planned shards and make real
+        # progress — the seeded kill point is mid-churn, not at startup
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            st = _read_status(victim)
+            if st and st["completed"] >= max(10, kill_jobs // 20):
+                break
+            time.sleep(0.05)
+        victim_frozen = _read_status(victim) or {}
+        kprocs[victim].kill()  # SIGKILL: no release, leases must EXPIRE
+        t_kill = time.monotonic()
+        kprocs[victim].wait()
+
+        survivors = [m for m in kill_members if m != victim]
+        reconverge_s = None
+        while time.monotonic() - t_kill < 60.0:
+            h = _holders()
+            if all(h[i] in survivors for i in range(kshards)):
+                reconverge_s = round(time.monotonic() - t_kill, 3)
+                break
+            time.sleep(0.02)
+
+        # survivors must drain the whole churn, the victim's durably
+        # created orphans included: full planned shares submitted, every
+        # shard owned by a survivor, zero live jobs left anywhere
+        drained = False
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline:
+            sts = {m: _read_status(m) for m in survivors}
+            if all(st is not None for st in sts.values()):
+                owned = set()
+                for st in sts.values():
+                    owned.update(st["owned"])
+                if (all(sts[m]["submitted"] >= share[m] for m in survivors)
+                        and owned == set(range(kshards))
+                        and sum(st["remaining_jobs"]
+                                for st in sts.values()) == 0):
+                    drained = True
+                    break
+            time.sleep(0.1)
+        final = {m: _read_status(m) for m in survivors}
+
+        with open(stop_path, "w") as fh:
+            fh.write("stop\n")
+        for m in survivors:
+            try:
+                kprocs[m].wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                kprocs[m].kill()
+
+        launched = []
+        try:
+            with open(launch_log) as fh:
+                launched = [ln.split()[0] for ln in fh if ln.strip()]
+        except OSError:
+            pass
+        # the name ledger over-counts: a member SIGKILLed with a
+        # half-durable teardown batch makes the successor's relaunch of
+        # a durably-DELETED pod look like a double launch. The WAL is
+        # ground truth — a true duplicate is a create of a still-live
+        # name (different uid, no durable delete between)
+        from kubedl_tpu.federation.tail import duplicate_creates
+
+        dup_launches = len(
+            duplicate_creates(os.path.join(root, "wal"), kshards)
+        )
+        ledger_relaunches = len(launched) - len(set(launched))
+        survivor_completed = sum(
+            (final[m] or {}).get("completed", 0) for m in survivors
+        )
+        kill = {
+            "members": kill_members,
+            "victim": victim,
+            "shards": kshards,
+            "lease_ttl_s": lease_ttl,
+            "jobs": kill_jobs,
+            "victim_completed_at_kill": victim_frozen.get("completed", 0),
+            "victim_submitted_at_kill": victim_frozen.get("submitted", 0),
+            "reconverge_s": reconverge_s,
+            "takeover_budget_s": takeover_budget_s,
+            "survivor_completed": survivor_completed,
+            "survivor_takeovers": {
+                m: (final[m] or {}).get("takeovers", 0) for m in survivors
+            },
+            "pods_launched": len(set(launched)),
+            "duplicate_launches": dup_launches,
+            "ledger_relaunches_after_durable_delete": ledger_relaunches,
+            "drained": drained,
+        }
+    finally:
+        for p in kprocs.values():
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(root, ignore_errors=True)
+
+    gates = {
+        "fed_all_jobs_complete": (
+            fed_completed == jobs and all(rc == 0 for rc in rcs)
+        ),
+        "fed_beats_r19_8shard_inprocess": (
+            fed_jobs_per_s > r19_8shard_jobs_per_s
+        ),
+        "kill_reconverged_within_budget": (
+            reconverge_s is not None and reconverge_s <= takeover_budget_s
+        ),
+        "kill_survivors_drained_all_jobs": drained,
+        "kill_zero_duplicate_launches": dup_launches == 0,
+    }
+    return {
+        "jobs": jobs,
+        "r19_8shard_jobs_per_s": r19_8shard_jobs_per_s,
+        "fed_speedup_vs_inprocess_8shard": round(
+            fed_jobs_per_s / r19_8shard_jobs_per_s, 2
+        ),
+        "fed_4proc": fed,
+        "member_kill": kill,
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+
+
 def bench_serving(on_tpu: bool) -> dict:
     """BASELINE.md target 5: Gemma-2B decode on the chip (tiny on CPU
     smoke). Measures the jitted continuous-batching decode step under the
@@ -2578,6 +2862,19 @@ def main() -> int:
         d = bench_cp_scale()
         print(json.dumps({
             "runs": [{"detail": {"targets": {"cp_scale": d}}}],
+        }, indent=2))
+        return 0 if d["ok"] else 1
+    if "--federation" in sys.argv[1:]:
+        # standalone federation round (BENCH_r20_federation.json): the
+        # churn replay spread across 4 real operator processes over one
+        # 8-shard WAL/lease root, plus the seeded member-SIGKILL arm
+        # (lease reconvergence, orphan drain, zero duplicate launches in
+        # the shared ledger), in the same runs[] shape
+        # check_readme_numbers reads; gates decide the exit code. Pure
+        # control plane — no accelerator in the loop.
+        d = bench_federation()
+        print(json.dumps({
+            "runs": [{"detail": {"targets": {"federation": d}}}],
         }, indent=2))
         return 0 if d["ok"] else 1
     if "--disagg" in sys.argv[1:]:

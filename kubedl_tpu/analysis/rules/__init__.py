@@ -5,6 +5,7 @@ from kubedl_tpu.analysis.rules import (
     chaos_sites,
     donation,
     envmut,
+    fenced_actuation,
     fsync_loop,
     locks,
     metrics_drift,
@@ -26,6 +27,7 @@ ALL_RULES = [
     ps_chaos_tests,  # KTL008
     store_construction,  # KTL009
     fsync_loop,      # KTL010
+    fenced_actuation,  # KTL011
 ]
 
 RULE_IDS = {m.RULE_ID: m for m in ALL_RULES}

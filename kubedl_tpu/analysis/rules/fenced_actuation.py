@@ -1,0 +1,127 @@
+"""KTL011 — externally-visible actuation without a fencing-token check.
+
+PR 20's federation runs N operator processes over one lease/WAL root.
+The store's own write router fences every shard-local mutation, but a
+reconcile's side effects are wider than store writes: reserving slice
+capacity in the in-memory inventory, launching a pod batch, reaping a
+pod the kubelet will SIGKILL. A SIGSTOP'd owner that resumes after its
+lease expired still holds those calls queued mid-reconcile — each one
+must be gated by :func:`kubedl_tpu.federation.actuation.
+assert_fenced_actuation` BEFORE it fires, or the stale owner acts on a
+shard a live member now owns (docs/robustness.md "Federation demotion
+and takeover"). The bug class this rule pins::
+
+    def try_admit(self, gang):
+        assigned = self.inventory.try_reserve(...)   # memory — unfenced
+        self.store.update_with_retry(...)            # fenced, but SECOND
+
+The fixed shape calls ``assert_fenced_actuation(...)`` in the same
+function, before (or on the same line as) the actuation.
+
+Matched actuations: slice reservations (``.try_reserve(...)``,
+``.reserve_exact(...)``), batched pod launches (``.create_many(...)``),
+and pod reaps (``.try_delete("Pod", ...)``). Bench/driver harnesses
+that own every shard by construction are exempt via ``ALLOWED_FILES``;
+anything else that must act unfenced says why with
+``# ktl: disable=KTL011 -- <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+RULE_ID = "KTL011"
+
+ALLOWED_FILES = {
+    # the facade's create_many IS the fenced write path (each shard-local
+    # batch goes through the FencedWal it mounted)
+    "kubedl_tpu/shards/store.py",
+    # single-process churn harness: constructed owning every shard; its
+    # create_many calls are the workload generator, not a reconcile
+    "kubedl_tpu/shards/churn.py",
+}
+
+#: attribute calls that ARE externally-visible actuations
+_ACTUATIONS = {"try_reserve", "reserve_exact", "create_many"}
+
+#: the gate — seeing a call to it anywhere earlier in the same function
+#: satisfies the rule for that function's actuations
+_GUARD = "assert_fenced_actuation"
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _actuation_name(node: ast.Call) -> str:
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return ""
+    if f.attr in _ACTUATIONS:
+        return f.attr
+    if f.attr == "try_delete" and node.args:
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and first.value == "Pod":
+            return 'try_delete("Pod", ...)'
+    return ""
+
+
+def _is_guard(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id == _GUARD
+    if isinstance(f, ast.Attribute):
+        return f.attr == _GUARD
+    return False
+
+
+def _scope_calls(fn: ast.AST) -> List[ast.Call]:
+    """Calls lexically in ``fn``'s own body, pruning nested defs — they
+    are walked as their own scope, and a guard in the outer body does
+    not cover a closure that may run later."""
+    calls: List[ast.Call] = []
+
+    def walk(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNCS):
+                continue
+            if isinstance(child, ast.Call):
+                calls.append(child)
+            walk(child)
+
+    walk(fn)
+    return calls
+
+
+def _check_function(fn: ast.AST, ctx, out: List) -> None:
+    guard_line = None
+    hits: List[ast.Call] = []
+    for node in _scope_calls(fn):
+        if _is_guard(node):
+            if guard_line is None or node.lineno < guard_line:
+                guard_line = node.lineno
+        elif _actuation_name(node):
+            hits.append(node)
+    for node in hits:
+        if guard_line is not None and guard_line <= node.lineno:
+            continue
+        out.append(
+            ctx.finding(
+                RULE_ID, node.lineno,
+                f"externally-visible actuation '{_actuation_name(node)}' "
+                "without a fencing-token check: call "
+                "assert_fenced_actuation(store, namespace, root_name) "
+                "earlier in this function so a deposed/stale owner "
+                "(SIGSTOP resumed past its lease TTL, partitioned member) "
+                "rejects the side effect instead of racing the live owner",
+            )
+        )
+
+
+def check_file(ctx) -> List["Finding"]:  # noqa: F821 — engine's Finding
+    if ctx.relpath in ALLOWED_FILES:
+        return []
+    out: List = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, _FUNCS):
+            _check_function(node, ctx, out)
+    return out

@@ -38,6 +38,8 @@ Injection sites wired in this repo::
     ps.shard_failover                            kill a PS shard's owner mid-run
     shard.lease_renew                            skip a control-plane shard lease renewal beat
     shard.wal_append                             fail a fenced shard WAL append
+    federation.heartbeat                         skip a federation member heartbeat beat
+    federation.lease_io                          fail a federation member's lease-root IO
 
 Schedules are per-site and deterministic: ``nth(n)`` fails exactly the
 n-th call (1-based), ``first(k)`` fails the first k calls, ``prob(p, k)``
@@ -93,6 +95,8 @@ SITES: Dict[str, str] = {
     "ps.shard_failover": "kill a PS shard's owner mid-run",
     "shard.lease_renew": "skip a control-plane shard lease renewal beat",
     "shard.wal_append": "fail a fenced shard WAL append",
+    "federation.heartbeat": "skip a federation member heartbeat beat",
+    "federation.lease_io": "fail a federation member's lease-root IO",
 }
 
 

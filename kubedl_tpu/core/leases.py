@@ -74,6 +74,7 @@ class LeaderElector:
         namespace: str = LEASE_NAMESPACE,
         ttl: float = 5.0,
         clock: Callable[[], float] = time.time,
+        initial_delay: float = 0.0,
     ) -> None:
         self.store = store
         self.identity = identity or default_identity()
@@ -81,6 +82,13 @@ class LeaderElector:
         self.namespace = namespace
         self.ttl = ttl
         self.clock = clock
+        #: seconds to hold back the FIRST acquire attempt while not the
+        #: leader. The federation rebalancer staggers standby campaigns by
+        #: successor rank with this, so N standbys don't thundering-herd
+        #: one orphaned lease: the designated successor campaigns at 0,
+        #: the next rank waits one step, and so on — any earlier rank that
+        #: is alive wins before a later rank even tries.
+        self.initial_delay = initial_delay
         self._leader = False
         #: `transitions` value captured when this elector acquired the
         #: lease — see check_fence()
@@ -209,6 +217,8 @@ class LeaderElector:
 
     def _loop(self) -> None:
         interval = max(self.ttl / 3.0, 0.05)
+        if self.initial_delay > 0.0 and not self._leader:
+            self._stop.wait(self.initial_delay)
         while not self._stop.is_set():
             if not self._leader:
                 if self._try_acquire():

@@ -114,6 +114,9 @@ class _Registration:
     queues: List[WorkQueue]
     workers: int = 1
     threads: List[threading.Thread] = field(default_factory=list)
+    #: shards whose worker pool is running (federated standbys start with
+    #: workers only for OWNED shards; takeover spawns the rest on demand)
+    worker_shards: set = field(default_factory=set)
     #: list-then-watch: enqueue every current object's keys at start()
     resync_on_start: bool = False
     watch_kinds: Tuple[str, ...] = ()
@@ -146,6 +149,7 @@ class ControllerManager:
         self._gc_interval = 1.0
         self._gc_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._spawn_lock = threading.Lock()
 
     # ---- key routing -----------------------------------------------------
 
@@ -346,20 +350,16 @@ class ControllerManager:
                     for obj in self.store.list(kind, namespace=None):
                         for key in reg.mapper("ADDED", obj, None):
                             self._enqueue(reg, key)
+        # workers run only for shards this process OWNS: a federated
+        # standby spawns nothing for remote shards (their keys are dropped
+        # at enqueue anyway) and gets its pools on takeover via
+        # ensure_shard_workers — wired through the store's mount hook so
+        # it fires for every takeover path, not just operator-managed ones
+        owned = getattr(self.store, "owned_shards", None)
+        shard_ids = list(owned()) if owned is not None else list(range(self.shards))
         for reg in self._registrations:
-            for shard in range(self.shards):
-                for i in range(reg.workers):
-                    # single-domain keeps the historical thread names
-                    tname = (
-                        f"{reg.name}-{i}" if self.shards == 1
-                        else f"{reg.name}-s{shard}-{i}"
-                    )
-                    t = threading.Thread(
-                        target=self._worker, args=(reg, shard),
-                        name=tname, daemon=True,
-                    )
-                    reg.threads.append(t)
-                    t.start()
+            for shard in shard_ids:
+                self._spawn_workers(reg, shard)
             if self.metrics is not None:
                 for shard, queue in enumerate(reg.queues):
                     self.metrics.workqueue_depth.set_function(
@@ -370,8 +370,38 @@ class ControllerManager:
                     lambda r=reg: float(sum(q.coalesced for q in r.queues)),
                     controller=reg.name,
                 )
+        mount_hooks = getattr(self.store, "on_shard_mounted", None)
+        if mount_hooks is not None and self.ensure_shard_workers not in mount_hooks:
+            mount_hooks.append(self.ensure_shard_workers)
         self._gc_thread = threading.Thread(target=self._gc_loop, daemon=True, name="gc")
         self._gc_thread.start()
+
+    def _spawn_workers(self, reg: _Registration, shard: int) -> None:
+        with self._spawn_lock:
+            if shard in reg.worker_shards:
+                return
+            reg.worker_shards.add(shard)
+            for i in range(reg.workers):
+                # single-domain keeps the historical thread names
+                tname = (
+                    f"{reg.name}-{i}" if self.shards == 1
+                    else f"{reg.name}-s{shard}-{i}"
+                )
+                t = threading.Thread(
+                    target=self._worker, args=(reg, shard),
+                    name=tname, daemon=True,
+                )
+                reg.threads.append(t)
+                t.start()
+
+    def ensure_shard_workers(self, shard: int) -> None:
+        """Spawn the worker pools for a shard acquired AFTER start() — the
+        takeover path of a federated standby. Idempotent; no-op before
+        start or after stop."""
+        if not self._running:
+            return
+        for reg in self._registrations:
+            self._spawn_workers(reg, shard)
 
     def stop(self) -> None:
         self._stop.set()
@@ -382,6 +412,7 @@ class ControllerManager:
             for t in reg.threads:
                 t.join(timeout=2.0)
             reg.threads.clear()
+            reg.worker_shards.clear()
         if self._gc_thread is not None:
             self._gc_thread.join(timeout=2.0)
             self._gc_thread = None

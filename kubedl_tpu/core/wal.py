@@ -89,6 +89,75 @@ class WalCorruption(Exception):
     """A fully-present record failed its CRC (or carried unparseable JSON)."""
 
 
+def read_snapshot(wal_dir: str) -> Tuple[int, List[dict]]:
+    """Read-only load of a segment's snapshot file: ``(revision,
+    objects)``, ``(0, [])`` when absent. Safe against a concurrent
+    owner — the snapshot is written atomically (tmp + rename), so a
+    reader sees either the old or the new image, never a torn one."""
+    path = os.path.join(wal_dir, SNAPSHOT_FILE)
+    try:
+        with open(path, "r") as f:
+            snap = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return 0, []
+    return int(snap.get("revision", 0)), list(snap.get("objects", []))
+
+
+def read_records(wal_dir: str, offset: int = 0) -> Tuple[List[dict], int]:
+    """Read-only replay of a segment's log from byte ``offset``: parse
+    every COMPLETE record and return ``(records, next_offset)``.
+
+    This is :meth:`WriteAheadLog.recover`'s parse without its ownership
+    side effects: a torn or still-being-written trailing record simply
+    stops the scan (``next_offset`` points at its first byte, so the next
+    call resumes there once the owner finishes the append) — the file is
+    never truncated and no append handle is taken. A CRC mismatch on a
+    fully-present record is still :class:`WalCorruption`: non-owner
+    readers must not paper over interior damage either. Used by
+    :mod:`kubedl_tpu.federation.tail` to serve cross-shard reads by
+    tailing a remote owner's segment."""
+    path = os.path.join(wal_dir, WAL_FILE)
+    try:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            buf = f.read()
+    except OSError:
+        return [], offset
+    records: List[dict] = []
+    pos = 0
+    while pos < len(buf):
+        if pos + _HEADER.size > len(buf):
+            break  # torn/in-flight header
+        length, crc = _HEADER.unpack_from(buf, pos)
+        start = pos + _HEADER.size
+        if start + length > len(buf):
+            break  # torn/in-flight payload
+        payload = buf[start : start + length]
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            raise WalCorruption(
+                f"{path}: CRC mismatch at offset {offset + pos}"
+            )
+        try:
+            records.append(json.loads(payload.decode("utf-8")))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise WalCorruption(
+                f"{path}: bad payload at offset {offset + pos}: {e}"
+            ) from e
+        pos = start + length
+    return records, offset + pos
+
+
+def log_size(wal_dir: str) -> int:
+    """Current byte length of a segment's log file (0 when absent) —
+    the tail reader's compaction probe: a log SHORTER than the reader's
+    cursor means the owner snapshotted + truncated, so the reader must
+    restart from the (new) snapshot."""
+    try:
+        return os.path.getsize(os.path.join(wal_dir, WAL_FILE))
+    except OSError:
+        return 0
+
+
 class WriteAheadLog:
     """Append/replay engine. Not thread-safe by itself — the owning
     ObjectStore serializes calls under its own lock."""

@@ -60,6 +60,7 @@ from kubedl_tpu.core.objects import (
 from kubedl_tpu.core.store import AlreadyExists, Conflict, NotFound, ObjectStore
 from kubedl_tpu.engine import dag
 from kubedl_tpu.engine import status as status_machine
+from kubedl_tpu.federation.actuation import assert_fenced_actuation
 from kubedl_tpu.engine.expectations import (
     ControllerExpectations,
     ShardedExpectations,
@@ -606,6 +607,14 @@ class JobEngine:
                         ctx.pods.remove(p)
 
         if to_create:
+            # fenced actuation (KTL011): a pod launch is externally
+            # visible — reject the whole batch up front if this process
+            # lost the job's shard (create_many would also fence, but
+            # expectations must not be armed for launches that never land)
+            assert_fenced_actuation(
+                self.store, job.metadata.namespace, job.metadata.name,
+                action="pod launch",
+            )
             self.expectations.expect_creations(exp_key, len(to_create))
             pods = [
                 self._new_pod(job, ctx, rtype, spec, index)
@@ -1044,6 +1053,14 @@ class JobEngine:
             self._delete_pod(pod)
 
     def _delete_pod(self, pod: Pod) -> None:
+        # fenced actuation (KTL011): the kubelet SIGKILLs the process on
+        # the DELETED event — a stale owner must not reap a pod a live
+        # owner may have just adopted
+        ref = pod.metadata.controller_ref()
+        root = ref.name if ref is not None else pod.metadata.name
+        assert_fenced_actuation(
+            self.store, pod.metadata.namespace, root, action="pod delete",
+        )
         self.store.try_delete("Pod", pod.metadata.name, pod.metadata.namespace)
 
     def _model_version_name(self, job: JobObject) -> str:

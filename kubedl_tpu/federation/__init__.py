@@ -1,0 +1,54 @@
+"""Multi-operator federation: N operator replica processes share one
+lease/WAL root, each owning a subset of the control-plane shards.
+
+PR 18/19 sharded the store, leases, and WAL but kept every shard in one
+process — BENCH_r19 shows the 8-shard arm flattening on the shared GIL.
+This package moves shards OUT of the process: ownership is arbitrated by
+the same per-shard fenced leases (:mod:`kubedl_tpu.shards.fencing`) over
+a shared :class:`~kubedl_tpu.shards.fencing.FileLeaseStore`, failover
+reuses the PR 5 rehydrate-then-adopt takeover, and four properties make
+it safe (docs/architecture.md "Multi-operator federation"):
+
+- failover: standbys absorb a dead member's shards with zero duplicate
+  pod launches (acked-create replay is exact);
+- fenced actuation: every externally-visible side effect threads the
+  shard fencing token (:func:`assert_fenced_actuation`, analyzer rule
+  KTL011) — a resumed SIGSTOP'd owner observes but never acts;
+- partition tolerance: a member that loses the lease root demotes to
+  read-only before its leases can be re-acquired elsewhere
+  (:class:`FederationMember`), and succession is deterministic and
+  staggered (:mod:`~kubedl_tpu.federation.rebalance`);
+- cross-shard visibility: non-owners serve reads/watches for remote
+  shards by tailing their WAL segments
+  (:mod:`~kubedl_tpu.federation.tail`).
+"""
+
+from kubedl_tpu.federation.actuation import (
+    actuation_root,
+    assert_fenced_actuation,
+)
+from kubedl_tpu.federation.member import FederationMember
+from kubedl_tpu.federation.rebalance import (
+    campaign_delay,
+    plan_assignment,
+    rank_of,
+    successors,
+)
+from kubedl_tpu.federation.tail import (
+    ShardWalTail,
+    TailSet,
+    duplicate_creates,
+)
+
+__all__ = [
+    "FederationMember",
+    "ShardWalTail",
+    "TailSet",
+    "actuation_root",
+    "assert_fenced_actuation",
+    "campaign_delay",
+    "duplicate_creates",
+    "plan_assignment",
+    "rank_of",
+    "successors",
+]

@@ -1,0 +1,76 @@
+"""Fencing gate for externally-visible side effects.
+
+The sharded store already fences its OWN write path (`_route_write`
+verifies the fence before any shard-local mutation), but a reconcile
+produces side effects that are not store writes: reserving slice
+capacity in the in-memory :class:`~kubedl_tpu.gang.slice_scheduler.
+SliceInventory`, binding a gang, launching pods, deleting pods. In
+federated mode each of those must thread the shard's fencing token
+explicitly — a SIGSTOP'd owner that resumes after its lease expired may
+still be holding a reconcile mid-flight, and the first thing that
+reconcile does next might be an inventory reservation (pure memory — no
+store write to fence it) followed by a pod create. Gating the ACTUATION
+itself, before any of its parts, rejects the whole stale side effect
+up front instead of relying on the store write that happens to come
+second.
+
+:func:`assert_fenced_actuation` is that gate, and analyzer rule KTL011
+(docs/static-analysis.md) statically requires it on every call path
+under ``kubedl_tpu/{gang,engine}/`` that launches pods or binds gangs.
+On an unsharded/unfenced store it is a no-op — single-owner by
+construction — so non-federated deployments pay one hash lookup and
+nothing else.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kubedl_tpu.core.objects import BaseObject
+from kubedl_tpu.shards.fencing import FencedOut
+
+
+def actuation_root(obj: BaseObject) -> str:
+    """The routing root an actuation fences on: the object's controlling
+    owner's name (a gang/pod actuates within its job's shard), falling
+    back to its own name — the same root-key rule the shard map routes
+    by, so the fence consulted is the fence of the shard the subsequent
+    store writes will hit."""
+    ref = obj.metadata.controller_ref()
+    return ref.name if ref is not None else obj.metadata.name
+
+
+def assert_fenced_actuation(
+    store,
+    namespace: str,
+    name: str,
+    action: str = "actuate",
+) -> None:
+    """Raise :class:`FencedOut` unless this process currently owns the
+    shard of root key ``namespace/name`` with a live fencing token.
+
+    The check is the same two-step the store's write router performs —
+    ownership flag, then a fence verification against the lease surface
+    (throttled by the store's ``fence_verify_interval``) — but runs
+    BEFORE the externally-visible side effect instead of inside whichever
+    store write happens to be its second half. Stores without sharding
+    (plain :class:`~kubedl_tpu.core.store.ObjectStore`) have no fence to
+    check and pass trivially."""
+    shard_for_key = getattr(store, "shard_for_key", None)
+    if shard_for_key is None:
+        return  # unsharded store: single-owner by construction
+    i = shard_for_key(namespace, name)
+    fence = _fence_of(store, i)
+    if fence is not None:
+        fence.assert_valid()  # sticky FencedOut on a stale token
+    owned = getattr(store, "_owned", None)
+    if owned is not None and not owned[i]:
+        raise FencedOut(
+            f"shard {i}: {getattr(store, 'identity', '?')} does not own "
+            f"the shard for {action} of {namespace}/{name}"
+        )
+
+
+def _fence_of(store, shard_id: int) -> Optional[object]:
+    fence_for = getattr(store, "fence_for", None)
+    return fence_for(shard_id) if fence_for is not None else None

@@ -1,0 +1,214 @@
+"""Subprocess entry for the federated arms of ``bench.py --federation``.
+
+Two modes, both taking one JSON config blob as ``argv[1]``:
+
+- ``churn``: one federated member of the throughput arm — runs the
+  standard :func:`kubedl_tpu.shards.churn.run_churn` replay over the
+  SHARED wal/lease root, fenced to this member's planned shards and
+  submitting only the jobs (out of the global ``churn-00000..`` name
+  sequence) that route to them; prints the churn result dict as JSON on
+  stdout. N such processes partition the identical total workload the
+  in-process arms of ``bench.py --cp-scale`` ran, so jobs/s aggregates
+  by ``sum(completed) / max(elapsed)``.
+- ``member``: one federated member of the SIGKILL failover arm — a full
+  :class:`~kubedl_tpu.federation.FederationMember` (staggered standby
+  campaigns, heartbeats, WAL tails) plus a ControllerManager running
+  the churn reconciler with the shared duplicate-launch ledger; submits
+  its planned shards' jobs, then serves until killed or told to stop.
+  Progress is published to an atomically-replaced status JSON the bench
+  parent polls; a member SIGKILLed mid-churn leaves its WAL segments
+  and unreleased leases for the survivors' rank-staggered takeovers —
+  exactly the contract ``scripts/verify-drives/drive_federation.py``
+  drills with trace assertions on top.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import traceback
+
+
+def churn_main(cfg: dict) -> int:
+    from kubedl_tpu.shards.churn import run_churn
+
+    result = run_churn(**cfg["churn"])
+    print(json.dumps(result))
+    return 0 if result["completed"] == result["jobs"] else 1
+
+
+def _write_status(path: str, payload: dict) -> None:
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as fh:
+        fh.write(json.dumps(payload))
+    os.replace(tmp, path)
+
+
+def member_main(cfg: dict) -> int:
+    from kubedl_tpu.core.manager import ControllerManager, owner_mapper
+    from kubedl_tpu.federation import FederationMember, assert_fenced_actuation
+    from kubedl_tpu.observability.tracing import Tracer
+    from kubedl_tpu.shards.churn import KIND, ChurnReconciler
+    from kubedl_tpu.shards.fencing import FencedOut, FileLeaseStore
+    from kubedl_tpu.shards.store import ShardedObjectStore
+    from kubedl_tpu.workloads.tpujob import TPUJob
+
+    identity = cfg["identity"]
+    peers = cfg["peers"]
+    shards = cfg["shards"]
+    lease_ttl = cfg.get("lease_ttl", 1.0)
+    jobs = cfg["jobs"]
+    pods_per_job = cfg.get("pods_per_job", 10)
+    backend = FileLeaseStore(cfg["lease_dir"])
+    store = ShardedObjectStore(
+        shards=shards,
+        wal_dir=cfg["wal_dir"],
+        wal_fsync="group",
+        wal_group_window=cfg.get("group_window_ms", 5.0) / 1e3,
+        wal_snapshot_every=1_000_000_000,
+        lease_backend=FileLeaseStore(cfg["lease_dir"]),
+        identity=identity,
+        lease_ttl=lease_ttl,
+        own=[],
+        standby=list(range(shards)),
+        fence_verify_interval=0.05,
+    )
+    member = FederationMember(
+        store, backend, identity, peers, lease_ttl=lease_ttl,
+        heartbeat_interval=max(lease_ttl / 8.0, 0.05),
+    )
+    tracer = Tracer(capacity=2 * jobs + 1024)
+    reconciler = ChurnReconciler(
+        store, pods_per_job, tracer,
+        launch_log=cfg["launch_log"], identity=identity,
+    )
+    manager = ControllerManager(store=store)
+    manager.register(
+        "churn", reconciler.reconcile, watch_kinds=[KIND, "Pod"],
+        mapper=owner_mapper(KIND), workers=2,
+        coalesce_window=cfg.get("coalesce_ms", 10.0) / 1e3,
+    )
+    manager.start()
+    member.start()
+
+    planned = set(member.planned_shards())
+    deadline = time.monotonic() + lease_ttl * 4 + 5.0
+    while time.monotonic() < deadline:
+        if planned <= set(store.owned_shards()):
+            break
+        time.sleep(0.02)
+
+    # submit only the jobs whose root key routes to a PLANNED shard —
+    # the static plan, not live ownership, so every member's submission
+    # set is disjoint and their union is exactly jobs 0..N-1
+    mine = [
+        f"fed-{i:05d}" for i in range(jobs)
+        if store.shard_for_key("default", f"fed-{i:05d}") in planned
+    ]
+    submitted = 0
+    wave = cfg.get("wave", 50)
+    # backpressure for the drive arms: keep the submit loop a bounded
+    # distance ahead of completion so time-to-launch measures reconcile
+    # latency, not queue depth (the bench arms submit unthrottled —
+    # queue-wait under saturation is their point)
+    max_inflight = cfg.get("max_inflight")
+    telemetry = bool(cfg.get("launch_telemetry"))
+    status_path = cfg["status_path"]
+    stop_path = cfg["stop_path"]
+
+    def _launch_stats() -> dict:
+        # job.pod_launch milestones: span.ts is the job's creation wall
+        # time and duration its time-to-launch, so ts + duration is when
+        # the launch actually happened
+        spans = tracer.spans("job.pod_launch")
+        if not spans:
+            return {"launches": 0, "last_launch_at": 0.0,
+                    "recent_launch_ms": 0.0}
+        recent = sorted(s.duration for s in spans[-25:])
+        return {
+            "launches": len(spans),
+            "last_launch_at": max(s.ts + s.duration for s in spans[-25:]),
+            "recent_launch_ms": recent[len(recent) // 2] * 1e3,
+        }
+
+    def remaining_jobs() -> int:
+        # owned shards only (no tails): between them the members count
+        # every live job exactly once
+        n = 0
+        for i in store.owned_shards():
+            s = store.shard_store(i)
+            if s is not None:
+                n += len(s.list(KIND, None))
+        return n
+
+    while True:
+        if os.path.exists(stop_path):
+            break
+        if submitted < len(mine) and not member.read_only and (
+            max_inflight is None
+            or submitted - reconciler.completed <= max_inflight
+        ):
+            batch = []
+            for name in mine[submitted:submitted + wave]:
+                job = TPUJob()
+                job.metadata.name = name
+                job.metadata.namespace = "default"
+                batch.append(job)
+            try:
+                # KTL011: thread the fencing token through the submit —
+                # a member whose shards were taken while it stalled must
+                # reject the batch here, not race the live owner
+                assert_fenced_actuation(
+                    store, "default", batch[0].metadata.name,
+                    action="job submit",
+                )
+                store.create_many(batch)
+                submitted += len(batch)
+            except FencedOut:
+                # a member frozen mid-submission and resumed past its
+                # TTL lands here — loud on stderr, the drive greps it
+                traceback.print_exc()
+                time.sleep(0.25)
+            except Exception:
+                time.sleep(0.05)
+        _write_status(status_path, {
+            "identity": identity,
+            "submitted": submitted,
+            "completed": reconciler.completed,
+            "owned": store.owned_shards(),
+            "takeovers": store.takeovers,
+            "remaining_jobs": remaining_jobs(),
+            "read_only": member.read_only,
+            "heartbeat_misses": member.heartbeat_misses,
+            "ts": time.time(),
+            **(_launch_stats() if telemetry else {}),
+        })
+        time.sleep(0.05)
+    member.stop()
+    manager.stop()
+    store.close()
+    _write_status(status_path, {
+        "identity": identity,
+        "submitted": submitted,
+        "completed": reconciler.completed,
+        "owned": store.owned_shards(),
+        "takeovers": store.takeovers,
+        "read_only": member.read_only,
+        "heartbeat_misses": member.heartbeat_misses,
+        "stopped": True,
+        "ts": time.time(),
+    })
+    return 0
+
+
+def main() -> int:
+    cfg = json.loads(sys.argv[1])
+    if cfg["mode"] == "churn":
+        return churn_main(cfg)
+    return member_main(cfg)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

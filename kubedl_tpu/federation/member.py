@@ -1,0 +1,237 @@
+"""One operator replica's seat in the federation.
+
+N operator processes share one lease/WAL root. Each process wraps its
+:class:`~kubedl_tpu.shards.store.ShardedObjectStore` in a
+:class:`FederationMember`, which owns the three loops that make
+cross-process sharding safe:
+
+- **Heartbeat / partition detector.** Every beat does one REAL round
+  trip against the lease root (:meth:`FileLeaseStore.probe` — write,
+  fsync, read back) and refreshes this member's presence file. A beat
+  is skipped by the ``federation.heartbeat`` chaos site (a wedged
+  publisher) and fails via ``federation.lease_io`` (the root itself
+  gone). When the last successful beat is older than the **demotion
+  deadline**, the member demotes itself to read-only: every shard fence
+  is deposed, campaigns stop, and all subsequent actuations raise
+  :class:`~kubedl_tpu.shards.fencing.FencedOut`. The deadline is
+  validated ``< lease TTL``: a partitioned member goes read-only BEFORE
+  any standby can have won its expired leases, so there is never a
+  moment with two acting owners on opposite sides of a partition.
+- **Staggered standby campaigns.** Campaigns for non-owned shards are
+  delayed by the member's deterministic succession rank
+  (:mod:`kubedl_tpu.federation.rebalance`), so a dead member's shards
+  spread across survivors without a thundering herd on the lease files.
+- **Tail refresh.** Remote shards are served read-only from
+  :class:`~kubedl_tpu.federation.tail.ShardWalTail` replicas
+  (WAL-segment replay); this loop refreshes them and fans the resulting
+  watch events into the facade, so router/console reads and watches
+  keep working through a partial outage.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from kubedl_tpu import chaos
+from kubedl_tpu.federation.rebalance import campaign_delay, plan_assignment
+
+log = logging.getLogger("kubedl_tpu.federation.member")
+
+_MEMBERS_DIR = "members"
+
+
+class FederationMember:
+    """Heartbeat + demotion + staggered campaigns + tail refresh for one
+    operator replica. ``store`` must be a fenced
+    :class:`~kubedl_tpu.shards.store.ShardedObjectStore` (lease backend
+    armed); ``peers`` is the full configured membership (including this
+    member) that the deterministic rebalancer ranks over."""
+
+    def __init__(
+        self,
+        store,
+        lease_backend,
+        identity: str,
+        peers: Sequence[str],
+        lease_ttl: float,
+        heartbeat_interval: float = 0.25,
+        demotion_deadline: Optional[float] = None,
+        tail_interval: float = 0.25,
+        on_demoted: Optional[Callable[[], None]] = None,
+    ) -> None:
+        if demotion_deadline is None:
+            demotion_deadline = lease_ttl * 0.5
+        if demotion_deadline >= lease_ttl:
+            raise ValueError(
+                f"demotion deadline {demotion_deadline}s must be < lease "
+                f"TTL {lease_ttl}s — a partitioned member must demote "
+                "BEFORE its leases can be re-acquired elsewhere"
+            )
+        self.store = store
+        self.lease_backend = lease_backend
+        self.identity = identity
+        self.peers = list(dict.fromkeys(peers))
+        if identity not in self.peers:
+            self.peers.append(identity)
+        self.lease_ttl = lease_ttl
+        self.heartbeat_interval = heartbeat_interval
+        self.demotion_deadline = demotion_deadline
+        self.tail_interval = tail_interval
+        self.on_demoted = on_demoted
+        #: counters the operator exports as gauges
+        self.heartbeats = 0
+        self.heartbeat_misses = 0
+        self.demotions = 0
+        self.read_only = False
+        self._last_ok = time.monotonic()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # ---- planning --------------------------------------------------------
+
+    def planned_shards(self) -> List[int]:
+        """Shards this member owns under the full-membership plan."""
+        return plan_assignment(self.store.num_shards, self.peers).get(
+            self.identity, []
+        )
+
+    def standby_delays(self) -> Dict[int, float]:
+        """Per-shard campaign hold-back, staggered by succession rank:
+        0 for planned shards (the member campaigns for its own shards
+        immediately), one stagger step per successor rank for the rest.
+        Every shard is campaigned as a standby — ownership is whatever
+        the lease says, so a member restarting into a fleet where a
+        survivor took its shards simply queues behind the live holder
+        instead of failing startup."""
+        return {
+            i: campaign_delay(i, self.identity, self.peers, self.lease_ttl)
+            for i in range(self.store.num_shards)
+        }
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        """Start campaigns (owned renewals + rank-staggered standbys) and
+        the heartbeat/tail loops."""
+        self.store.start_campaigns(standby_delays=self.standby_delays())
+        self.store.enable_tail_reads()
+        self._stop.clear()
+        for name, target, interval in (
+            ("fed-heartbeat", self._heartbeat_once, self.heartbeat_interval),
+            ("fed-tail", self._tail_once, self.tail_interval),
+        ):
+            t = threading.Thread(
+                target=self._loop, args=(target, interval),
+                name=f"{name}-{self.identity}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads.clear()
+
+    def _loop(self, tick: Callable[[], None], interval: float) -> None:
+        while not self._stop.is_set():
+            try:
+                tick()
+            except Exception:
+                log.exception("%s: federation loop tick failed", self.identity)
+            self._stop.wait(interval)
+
+    # ---- heartbeat / demotion --------------------------------------------
+
+    def _heartbeat_once(self) -> None:
+        if not chaos.should_fail("federation.heartbeat"):
+            try:
+                chaos.check("federation.lease_io")
+                self.lease_backend.probe(self.identity)
+                self._publish_presence()
+            except (OSError, chaos.FaultInjected):
+                self.heartbeat_misses += 1
+            else:
+                self.heartbeats += 1
+                self._last_ok = time.monotonic()
+        else:
+            self.heartbeat_misses += 1
+        if (
+            not self.read_only
+            and time.monotonic() - self._last_ok >= self.demotion_deadline
+        ):
+            self.demote()
+
+    def demote(self) -> None:
+        """Go read-only NOW: depose every fence first (instant, lock-free
+        — actuations start raising FencedOut before anything else
+        happens), then halt campaign threads so a transiently healed root
+        cannot flap this member back into ownership it may have lost."""
+        self.read_only = True
+        self.demotions += 1
+        log.warning(
+            "%s: lease root unreachable for >= %.2fs (< TTL %.2fs): "
+            "demoting to read-only",
+            self.identity, self.demotion_deadline, self.lease_ttl,
+        )
+        demote = getattr(self.store, "demote", None)
+        if demote is not None:
+            demote()
+        if self.on_demoted is not None:
+            try:
+                self.on_demoted()
+            except Exception:
+                log.exception("%s: on_demoted callback failed", self.identity)
+
+    # ---- membership presence ---------------------------------------------
+
+    def _members_dir(self) -> str:
+        path = os.path.join(self.lease_backend.lease_dir, _MEMBERS_DIR)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def _publish_presence(self) -> None:
+        path = os.path.join(self._members_dir(), f"{self.identity}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(json.dumps({
+                "identity": self.identity, "beat": time.time(),
+                "read_only": self.read_only,
+            }))
+        os.replace(tmp, path)
+
+    def live_members(self, staleness: Optional[float] = None) -> List[str]:
+        """Members whose presence file beat within ``staleness`` seconds
+        (default: the lease TTL) — observability surface; the rebalancer
+        ranks over the CONFIGURED membership, not this, so a flapping
+        reader can never skew succession order."""
+        if staleness is None:
+            staleness = self.lease_ttl
+        out = []
+        now = time.time()
+        try:
+            names = os.listdir(self._members_dir())
+        except OSError:
+            return []
+        for fname in names:
+            if not fname.endswith(".json"):
+                continue
+            try:
+                data = json.loads(
+                    open(os.path.join(self._members_dir(), fname)).read()
+                )
+            except (OSError, ValueError):
+                continue
+            if now - float(data.get("beat", 0.0)) <= staleness:
+                out.append(data["identity"])
+        return sorted(out)
+
+    # ---- tails -----------------------------------------------------------
+
+    def _tail_once(self) -> None:
+        self.store.refresh_tails()

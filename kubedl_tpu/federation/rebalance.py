@@ -1,0 +1,90 @@
+"""Deterministic shard placement + orphan succession for the federation.
+
+Every member must answer two questions with ZERO coordination:
+
+- "which shards should I own when the full membership is healthy?"
+- "when member X dies, who campaigns for each of its shards, and when?"
+
+Both come from the same rendezvous (highest-random-weight) ranking the
+shard map itself uses (:mod:`kubedl_tpu.shards.shardmap`): for each shard,
+every member is scored with a salt-free ``crc32(member + "@" + shard)``
+and sorted descending — rank 0 is the planned owner, rank 1 the first
+successor, and so on. Because the hash is deterministic and salt-free,
+every member (and every drive, and a standby started a minute later)
+computes the SAME ranking from the same membership list, so there is no
+assignment to distribute and no leader needed to rebalance.
+
+Succession is staggered by rank to kill the thundering herd: the planned
+owner (rank 0) campaigns immediately, the rank-1 successor holds back one
+stagger step, rank 2 two steps (:func:`campaign_delay`). Any earlier rank
+that is alive wins the flock-serialized lease before a later rank's first
+attempt even fires — including at COLD START, where the whole fleet boots
+at once and the planned owner must win its own unclaimed leases; if the
+earlier ranks are dead, the later rank is only a step behind. Orphans
+also SPREAD: the ranking is independent per shard, so a dead member's
+shards land across the survivors instead of dogpiling whichever standby
+woke first.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Sequence
+
+#: stagger step between successor ranks, as a fraction of the lease TTL.
+#: One elector campaign beat is ttl/3 — half a TTL per rank keeps rank
+#: r+1's first attempt comfortably behind rank r's win + renewal.
+RANK_STAGGER_TTL_FRACTION = 0.5
+
+
+def _weight(member: str, shard_id: int) -> int:
+    return zlib.crc32(f"{member}@{shard_id}".encode("utf-8")) & 0xFFFFFFFF
+
+
+def successors(shard_id: int, members: Sequence[str]) -> List[str]:
+    """Members ranked by rendezvous weight for ``shard_id`` — index 0 is
+    the planned owner, index 1 the first failover successor. Ties break
+    on the identity string so the order is total and identical
+    everywhere."""
+    return sorted(
+        dict.fromkeys(members),
+        key=lambda m: (-_weight(m, shard_id), m),
+    )
+
+
+def rank_of(shard_id: int, identity: str, members: Sequence[str]) -> int:
+    """``identity``'s position in ``shard_id``'s succession order (0 =
+    planned owner); ``len(members)`` when not a member at all."""
+    order = successors(shard_id, members)
+    try:
+        return order.index(identity)
+    except ValueError:
+        return len(order)
+
+
+def plan_assignment(
+    shards: int, members: Sequence[str]
+) -> Dict[str, List[int]]:
+    """Full-membership ownership plan: shard i belongs to its rank-0
+    member. Every member computes the identical plan from the identical
+    membership list — campaigning only for your planned shards means no
+    two healthy members ever contend for a lease."""
+    plan: Dict[str, List[int]] = {m: [] for m in dict.fromkeys(members)}
+    for i in range(shards):
+        plan[successors(i, members)[0]].append(i)
+    return plan
+
+
+def campaign_delay(
+    shard_id: int,
+    identity: str,
+    members: Sequence[str],
+    lease_ttl: float,
+) -> float:
+    """Seconds ``identity`` holds back its campaign for ``shard_id``:
+    0 for the planned owner (rank 0), one stagger step per rank after
+    that — so a cold-starting fleet resolves every unclaimed lease to
+    its planned owner, and a dead owner's first live successor is only
+    one step behind its expired lease."""
+    r = rank_of(shard_id, identity, members)
+    return r * lease_ttl * RANK_STAGGER_TTL_FRACTION

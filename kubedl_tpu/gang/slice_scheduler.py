@@ -21,7 +21,12 @@ from kubedl_tpu.api.interface import JobObject
 from kubedl_tpu.api.topology import SliceTopology, get_slice
 from kubedl_tpu.core.objects import Pod, PodGroup
 from kubedl_tpu.core.store import AlreadyExists, NotFound, ObjectStore
+from kubedl_tpu.federation.actuation import (
+    actuation_root,
+    assert_fenced_actuation,
+)
 from kubedl_tpu.gang.interface import GangScheduler
+from kubedl_tpu.shards.fencing import FencedOut
 
 log = logging.getLogger("kubedl_tpu.gang")
 
@@ -292,6 +297,16 @@ class SliceGangScheduler(GangScheduler):
         for gang in self.store.list("PodGroup", namespace=None):
             if gang.phase != "Running" or not gang.assigned_slices:
                 continue
+            try:
+                # federation: the rehydrated list can include REMOTE-shard
+                # gangs served by WAL tails — their owners adopt them;
+                # reserving them here would pollute this inventory
+                assert_fenced_actuation(
+                    self.store, gang.metadata.namespace,
+                    actuation_root(gang), action="slice adoption",
+                )
+            except FencedOut:
+                continue
             owner = f"{gang.metadata.namespace}/{gang.metadata.name}"
             if self.inventory.reserve_exact(gang.assigned_slices, owner):
                 adopted += 1
@@ -304,6 +319,14 @@ class SliceGangScheduler(GangScheduler):
         return adopted
 
     def try_admit(self, gang: PodGroup) -> bool:
+        # fenced actuation (KTL011): a gang bind reserves slice capacity
+        # in pure memory BEFORE the fenced store write — gate the whole
+        # side effect up front so a deposed/stale owner rejects here,
+        # leaving the inventory untouched
+        assert_fenced_actuation(
+            self.store, gang.metadata.namespace, actuation_root(gang),
+            action="gang bind",
+        )
         if gang.phase == "Running" and (gang.assigned_slices or not gang.slice_type):
             if gang.assigned_slices:
                 owner = f"{gang.metadata.namespace}/{gang.metadata.name}"
@@ -376,6 +399,12 @@ class SliceGangScheduler(GangScheduler):
         the caller falls back to the coarse release-everything path."""
         if count < 1 or not gang.slice_type:
             return False
+        # fenced actuation (KTL011): resize re-reserves or releases slice
+        # capacity — same memory-before-store-write shape as try_admit
+        assert_fenced_actuation(
+            self.store, gang.metadata.namespace, actuation_root(gang),
+            action="gang resize",
+        )
         owner = f"{gang.metadata.namespace}/{gang.metadata.name}"
         held = self.inventory.owned_slices(owner)
         if count >= len(held):
@@ -403,5 +432,11 @@ class SliceGangScheduler(GangScheduler):
         return True
 
     def delete_gang(self, job: JobObject) -> None:
+        # fenced actuation (KTL011): releasing capacity a live owner may
+        # have re-reserved is as unsafe as reserving it
+        assert_fenced_actuation(
+            self.store, job.metadata.namespace, job.metadata.name,
+            action="gang delete",
+        )
         self.inventory.release(_owner_key(job))
         self.store.try_delete("PodGroup", _gang_name(job), job.metadata.namespace)

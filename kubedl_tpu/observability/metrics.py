@@ -358,6 +358,30 @@ class JobMetrics:
             "Reconcile-domain shards this operator currently owns (equals "
             "the shard count unless a standby or deposed owner)",
         )
+        # Multi-operator federation (kubedl_tpu/federation/,
+        # docs/architecture.md "Multi-operator federation"): one series
+        # per member process; the operator wires these as set_function
+        # gauges over the FederationMember counters.
+        self.federation_heartbeats = r.gauge(
+            "kubedl_tpu_federation_heartbeats",
+            "Successful lease-root heartbeat round trips (probe write + "
+            "fsync + readback) by this federation member",
+        )
+        self.federation_heartbeat_misses = r.gauge(
+            "kubedl_tpu_federation_heartbeat_misses",
+            "Failed or chaos-skipped federation heartbeats — the "
+            "partition-detector input that drives demotion",
+        )
+        self.federation_demotions = r.gauge(
+            "kubedl_tpu_federation_demotions",
+            "Times this member demoted itself to read-only after losing "
+            "the lease root for longer than the demotion deadline",
+        )
+        self.federation_read_only = r.gauge(
+            "kubedl_tpu_federation_read_only",
+            "1 while this member is demoted to read-only (serving tails, "
+            "rejecting actuations), 0 while it may own shards",
+        )
         self.expectations_expired = r.counter(
             "kubedl_tpu_expectations_expired",
             "Reconciles that proceeded past timed-out controller "

@@ -134,6 +134,13 @@ class TensorBoardReconciler:
 
     def delete(self, job: JobObject) -> None:
         """Tear down pod + service (reference: tensorboard.go:382-447)."""
+        from kubedl_tpu.federation.actuation import assert_fenced_actuation
+
+        # fenced actuation (KTL011): the tb pod reap kills a process
+        assert_fenced_actuation(
+            self.store, job.metadata.namespace, job.metadata.name,
+            action="pod delete",
+        )
         name = tb_name(job)
         self.store.try_delete("Pod", name, job.metadata.namespace)
         self.store.try_delete("Service", name, job.metadata.namespace)
@@ -182,6 +189,13 @@ class TensorBoardReconciler:
         return []
 
     def _sync_pod(self, job: JobObject, spec: TensorBoardSpec) -> None:
+        from kubedl_tpu.federation.actuation import assert_fenced_actuation
+
+        # fenced actuation (KTL011): may recreate the tb pod below
+        assert_fenced_actuation(
+            self.store, job.metadata.namespace, job.metadata.name,
+            action="pod launch",
+        )
         name = tb_name(job)
         existing = self.store.try_get("Pod", name, job.metadata.namespace)
         if existing is not None:

@@ -170,6 +170,24 @@ class OperatorOptions:
     #: per-shard lease TTL: a standby takes a dead owner's shard within
     #: about this many seconds
     shard_lease_ttl: float = 2.0
+    #: multi-operator federation (kubedl_tpu/federation/,
+    #: docs/architecture.md "Multi-operator federation"): N operator
+    #: PROCESSES share one lease/WAL root, each owning the shards the
+    #: deterministic rebalancer assigns it and standing by — with
+    #: rank-staggered campaigns — for everything else. Requires
+    #: shard_lease_dir + wal_dir + control_plane_shards > 1 and a unique
+    #: leader_identity per process. Overrides shard_own/shard_standby.
+    federation: bool = False
+    #: full configured membership (identities, including this process);
+    #: succession order ranks over THIS list, so every member must agree
+    federation_peers: List[str] = field(default_factory=list)
+    #: seconds between lease-root heartbeat probes
+    federation_heartbeat_interval: float = 0.25
+    #: lease-root unreachable this long -> demote to read-only. 0 picks
+    #: the default (half the shard lease TTL); must stay < the TTL.
+    federation_demotion_deadline: float = 0.0
+    #: seconds between WAL-tail refreshes for remote-shard reads
+    federation_tail_interval: float = 0.25
 
 
 class ValidationError(ValueError):
@@ -191,14 +209,31 @@ class Operator:
         self.options = options or OperatorOptions()
         #: pass an existing store to run several operators against one
         #: object world (HA deployments — pair with leader_elect=True)
+        lease_backend = None
         if store is not None:
             self.store = store
         else:
-            lease_backend = None
             if self.options.shard_lease_dir:
                 from kubedl_tpu.shards.fencing import FileLeaseStore
 
                 lease_backend = FileLeaseStore(self.options.shard_lease_dir)
+            own = self.options.shard_own
+            standby = list(self.options.shard_standby)
+            if self.options.federation:
+                # federation: EVERY shard is a standby campaign — the
+                # member's rank-staggered delays (FederationMember.
+                # standby_delays, delay 0 for planned shards) resolve each
+                # lease to its planned owner without a synchronous ctor
+                # acquisition, so a member restarting into a fleet where a
+                # survivor already took its shards queues behind the live
+                # holder instead of failing startup
+                if lease_backend is None:
+                    raise ValueError(
+                        "federation=True requires shard_lease_dir (the "
+                        "shared lease root is the arbitration surface)"
+                    )
+                own = []
+                standby = list(range(self.options.control_plane_shards))
             self.store = ShardedObjectStore(
                 shards=self.options.control_plane_shards,
                 wal_dir=self.options.wal_dir or None,
@@ -208,11 +243,27 @@ class Operator:
                 lease_backend=lease_backend,
                 identity=self.options.leader_identity,
                 lease_ttl=self.options.shard_lease_ttl,
-                own=self.options.shard_own,
-                standby=self.options.shard_standby,
+                own=own,
+                standby=standby,
                 fence_verify_interval=0.05,
             )
         self._owns_store = store is None
+        self.federation = None
+        if self.options.federation and lease_backend is not None:
+            from kubedl_tpu.federation import FederationMember
+
+            self.federation = FederationMember(
+                self.store,
+                lease_backend,
+                identity=self.store.identity,
+                peers=self.options.federation_peers,
+                lease_ttl=self.options.shard_lease_ttl,
+                heartbeat_interval=self.options.federation_heartbeat_interval,
+                demotion_deadline=(
+                    self.options.federation_demotion_deadline or None
+                ),
+                tail_interval=self.options.federation_tail_interval,
+            )
         self.metrics_registry = MetricsRegistry()
         self.metrics = JobMetrics(self.metrics_registry)
         self.manager = ControllerManager(self.store, metrics=self.metrics)
@@ -312,6 +363,20 @@ class Operator:
             self.metrics.shards_owned.set_function(lambda: 1.0)
         if hasattr(self.store, "on_shard_acquired"):
             self.store.on_shard_acquired = self._on_shard_acquired
+        if self.federation is not None:
+            member = self.federation
+            self.metrics.federation_heartbeats.set_function(
+                lambda: float(member.heartbeats)
+            )
+            self.metrics.federation_heartbeat_misses.set_function(
+                lambda: float(member.heartbeat_misses)
+            )
+            self.metrics.federation_demotions.set_function(
+                lambda: float(member.demotions)
+            )
+            self.metrics.federation_read_only.set_function(
+                lambda: 1.0 if member.read_only else 0.0
+            )
 
         # node lifecycle: heartbeat-driven failure detection (the k8s
         # node-controller analogue the reference delegates to the cluster)
@@ -489,9 +554,14 @@ class Operator:
         if not self.options.leader_elect:
             self._recover()
             self.manager.start()
-            # fenced sharding: begin renewing owned shard leases and
-            # campaigning for standby shards (unfenced stores: no-op)
-            if hasattr(self.store, "start_campaigns"):
+            if self.federation is not None:
+                # federation: the member starts campaigns (with rank-
+                # staggered standby delays), tails remote shards, and
+                # runs the heartbeat/demotion loop
+                self.federation.start()
+            elif hasattr(self.store, "start_campaigns"):
+                # fenced sharding: begin renewing owned shard leases and
+                # campaigning for standby shards (unfenced stores: no-op)
                 self.store.start_campaigns()
             return
         # HA mode (reference: main.go:76-84): reconcile only while holding
@@ -577,6 +647,18 @@ class Operator:
         self.manager.stop()
 
     def stop(self) -> None:
+        # The order is load-bearing (pinned by tests/test_federation.py::
+        # TestStopOrdering): federation loops and shard campaigns halt
+        # FIRST, so no standby takeover can mount a shard — and no lease
+        # renewal can extend ownership — into a process that is already
+        # tearing down workers; the store (and its group-commit committer
+        # threads) closes LAST, after the manager has drained reconciles,
+        # so an in-flight commit window is fsynced, never appended to a
+        # closed WAL.
+        if self.federation is not None:
+            self.federation.stop()
+        if hasattr(self.store, "stop_campaigns"):
+            self.store.stop_campaigns()
         elector = getattr(self, "elector", None)
         if elector is not None:
             elector.stop()

@@ -312,6 +312,13 @@ class InferenceController:
         but finishes in-flight decodes); the pod is deleted only once its
         stats report idle, or the grace expires. Returns True once the
         pod is actually deleted."""
+        from kubedl_tpu.federation.actuation import assert_fenced_actuation
+
+        # fenced actuation (KTL011): scale-down/GC reaps kill processes
+        assert_fenced_actuation(
+            self.store, inf.metadata.namespace, inf.metadata.name,
+            action="pod delete",
+        )
         if (self.drain_grace_s <= 0
                 or pod.status.phase != PodPhase.RUNNING):
             self.store.try_delete(

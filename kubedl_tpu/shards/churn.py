@@ -55,14 +55,28 @@ class ChurnReconciler:
     down. Level-driven and re-entrant — watch events on the pods re-queue
     the job key until it completes."""
 
-    def __init__(self, store, pods_per_job: int, tracer: Tracer) -> None:
+    def __init__(self, store, pods_per_job: int, tracer: Tracer,
+                 launch_log: Optional[str] = None,
+                 identity: str = "") -> None:
         self.store = store
         self.pods_per_job = pods_per_job
         self.tracer = tracer
         self.completed = 0
+        #: shared duplicate-launch ledger (federation kill arms): one
+        #: line per pod appended strictly AFTER its durable create, so a
+        #: pod name appearing twice means two processes both launched it
+        self.launch_log = launch_log
+        self.identity = identity
         self._done: set = set()
         self._marks: Dict[str, set] = {}
         self._lock = threading.Lock()
+
+    def _log_launches(self, pods: List[Pod]) -> None:
+        if self.launch_log is None:
+            return
+        with open(self.launch_log, "a") as fh:
+            for pod in pods:
+                fh.write(f"{pod.metadata.name} {self.identity}\n")
 
     def _milestone(self, job, name: str) -> None:
         uid = job.metadata.uid
@@ -105,10 +119,12 @@ class ChurnReconciler:
                 # the production gang-create shape: one batch, one
                 # group-commit wait for the whole pod set
                 self.store.create_many(pods)
+                self._log_launches(pods)
             except AlreadyExists:
                 for pod in pods:
                     try:
                         self.store.create(pod)
+                        self._log_launches([pod])
                     except AlreadyExists:
                         pass
             return None  # pod ADDED events re-queue this key
@@ -139,6 +155,12 @@ def run_churn(
     wal_fsync: str = "always",
     group_window_ms: float = 5.0,
     coalesce_ms: float = 0.0,
+    lease_dir: Optional[str] = None,
+    identity: str = "",
+    own: Optional[List[int]] = None,
+    standby: Optional[List[int]] = None,
+    lease_ttl: float = 2.0,
+    only_owned_jobs: bool = False,
 ) -> Dict[str, object]:
     """One churn-replay arm. Returns latency/TTL percentiles + throughput.
 
@@ -157,8 +179,21 @@ def run_churn(
     group-commits with the given batch window (identical ack-durability —
     writers still block until their record is fsynced). ``coalesce_ms``
     turns on workqueue burst coalescing for the reconcile keys.
+
+    Federated mode (``lease_dir`` set): this process mounts only the
+    ``own`` shards, fenced by real file leases under ``lease_dir``, and
+    — with ``only_owned_jobs=True`` — submits only the jobs out of the
+    GLOBAL ``churn-00000..`` name sequence whose root key routes to an
+    owned shard, so N such processes over one WAL/lease root partition
+    the same total workload with zero cross-process contention (the
+    federated arm of ``bench.py --federation``).
     """
     tracer = Tracer(capacity=2 * jobs + 1024)
+    lease_backend = None
+    if lease_dir:
+        from kubedl_tpu.shards.fencing import FileLeaseStore
+
+        lease_backend = FileLeaseStore(lease_dir)
     store = ShardedObjectStore(
         shards=shards, wal_dir=wal_dir, wal_fsync=wal_fsync,
         wal_fsync_floor=fsync_floor_ms / 1e3,
@@ -166,7 +201,18 @@ def run_churn(
         # churn must measure the append/fsync path, not O(live-set)
         # snapshot dumps every 1000 records
         wal_snapshot_every=1_000_000_000,
+        lease_backend=lease_backend,
+        identity=identity,
+        lease_ttl=lease_ttl,
+        own=own,
+        standby=standby,
+        fence_verify_interval=0.05,
     )
+    names = [f"churn-{i:05d}" for i in range(jobs)]
+    if only_owned_jobs:
+        names = [
+            n for n in names if store.owns_key("default", n)
+        ]
     manager = ControllerManager(store=store)
     manager.latency_samples = []
     manager.queue_wait_samples = []
@@ -177,16 +223,19 @@ def run_churn(
         coalesce_window=coalesce_ms / 1e3,
     )
     manager.start()
+    if lease_backend is not None:
+        store.start_campaigns()  # renew owned-shard leases for the run
     t0 = time.perf_counter()
     steady_n = 0
+    total = len(names)
     try:
         submitted = 0
-        while submitted < jobs:
-            batch = min(wave, jobs - submitted)
+        while submitted < total:
+            batch = min(wave, total - submitted)
             wave_jobs = []
-            for i in range(submitted, submitted + batch):
+            for n in names[submitted:submitted + batch]:
                 job = TPUJob()
-                job.metadata.name = f"churn-{i:05d}"
+                job.metadata.name = n
                 job.metadata.namespace = "default"
                 wave_jobs.append(job)
             store.create_many(wave_jobs)
@@ -203,7 +252,7 @@ def run_churn(
         steady_n = min(
             len(manager.latency_samples), len(manager.queue_wait_samples)
         )
-        _wait_completed(reconciler, jobs, stall_timeout)
+        _wait_completed(reconciler, total, stall_timeout)
     finally:
         elapsed = time.perf_counter() - t0
         wal_appends = store.wal_appends
@@ -233,9 +282,11 @@ def run_churn(
         "wal_fsync": wal_fsync,
         "group_window_ms": group_window_ms if wal_fsync == "group" else 0.0,
         "coalesce_ms": coalesce_ms,
-        "jobs": jobs,
+        "identity": identity,
+        "owned_shards": own if own is not None else list(range(shards)),
+        "jobs": total,
         "pods_per_job": pods_per_job,
-        "pod_churn": jobs * pods_per_job,
+        "pod_churn": total * pods_per_job,
         "completed": reconciler.completed,
         "elapsed_s": round(elapsed, 3),
         "jobs_per_s": round(reconciler.completed / max(elapsed, 1e-9), 1),

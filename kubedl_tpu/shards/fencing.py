@@ -232,6 +232,27 @@ class FileLeaseStore:
     def _path(self, name: str, namespace: str) -> str:
         return os.path.join(self.lease_dir, f"{namespace}__{name}.json")
 
+    def probe(self, identity: str = "probe") -> float:
+        """One REAL round trip against the lease root: write a probe file,
+        fsync it, read it back, and return the elapsed seconds. Raises
+        ``OSError`` when the root is unreachable (unmounted NFS, revoked
+        credentials, full disk) — this is the federation member's
+        partition detector: a member whose probes fail for longer than its
+        demotion deadline must assume its leases are expiring on a root it
+        can no longer see, and demote itself to read-only BEFORE a standby
+        can have re-acquired them."""
+        t0 = time.monotonic()
+        path = os.path.join(self.lease_dir, f"__probe__{identity}.json")
+        payload = json.dumps({"identity": identity, "nonce": t0})
+        with open(path, "w") as fh:
+            fh.write(payload)
+            fh.flush()
+            os.fsync(fh.fileno())
+        with open(path) as fh:
+            if fh.read() != payload:
+                raise OSError(f"lease root probe readback mismatch at {path}")
+        return time.monotonic() - t0
+
     @staticmethod
     def _to_lease(data: dict, name: str, namespace: str) -> Lease:
         lease = Lease(

@@ -109,12 +109,20 @@ class ShardedObjectStore:
         self._electors: Dict[int, ShardElector] = {}
         #: shards this facade acquired by takeover (drive/test probe)
         self.takeovers = 0
+        #: read-only WAL-tail replicas of shards this facade does NOT
+        #: own (federation cross-shard visibility) — None until
+        #: :meth:`enable_tail_reads`
+        self._tailset = None
         #: per-shard rehydrate-then-adopt hook, fired on every takeover
         #: mount as ``on_shard_acquired(shard_id, rehydrated_objects)``
         #: BEFORE the rehydrated ADDED events reach watchers
         self.on_shard_acquired: Optional[
             Callable[[int, List[BaseObject]], None]
         ] = None
+        #: fired with the shard id every time a shard-local store is
+        #: mounted (init + takeover) — the manager hooks this to spawn
+        #: worker pools for shards acquired after start()
+        self.on_shard_mounted: List[Callable[[int], None]] = []
 
         if not self._fenced:
             for i in range(shards):
@@ -180,6 +188,61 @@ class ShardedObjectStore:
     def fence_for(self, i: int) -> Optional[ShardFence]:
         return self._fences[i]
 
+    def shard_wal_path(self, i: int) -> Optional[str]:
+        """On-disk WAL segment directory for shard ``i`` (None when the
+        facade is memory-only) — what a non-owner tails."""
+        return self._shard_wal_dir(i)
+
+    # ---- cross-shard read tails (federation) -----------------------------
+
+    def enable_tail_reads(self):
+        """Serve reads/watches for UN-mounted shards from read-only
+        WAL-tail replicas (:mod:`kubedl_tpu.federation.tail`). Tail state
+        flows into the same facade surfaces — ``get``/``list``/``kinds``
+        consult tails after mounted shards, and :meth:`refresh_tails`
+        fans tail deltas to facade watchers — but never into actuation:
+        writes still route through :meth:`_route_write`'s ownership
+        fence, and the manager drops un-owned reconcile keys. Requires a
+        durable facade (``wal_dir``); no-op otherwise. Returns the
+        :class:`~kubedl_tpu.federation.tail.TailSet`."""
+        from kubedl_tpu.federation.tail import TailSet
+
+        if self.wal_dir is None:
+            return None
+        if self._tailset is None:
+            self._tailset = TailSet(self._notify)
+            self._sync_tails()
+        return self._tailset
+
+    def _sync_tails(self) -> None:
+        """Tail every shard without a mounted store; drop tails for
+        shards that got mounted (ownership supersedes tailing)."""
+        from kubedl_tpu.federation.tail import ShardWalTail
+
+        if self._tailset is None:
+            return
+        current = self._tailset.tails()
+        for i in range(self.num_shards):
+            if self._stores[i] is not None:
+                if i in current:
+                    self._tailset.set_tail(i, None)
+            elif i not in current:
+                path = self._shard_wal_dir(i)
+                if path is not None:
+                    self._tailset.set_tail(i, ShardWalTail(path, shard_id=i))
+
+    def refresh_tails(self) -> int:
+        """Incrementally replay every remote tail and fan the deltas to
+        facade watchers; returns events delivered. 0 when tails are not
+        enabled."""
+        if self._tailset is None:
+            return 0
+        self._sync_tails()
+        return self._tailset.refresh()
+
+    def _tails(self):
+        return self._tailset.tails().values() if self._tailset else ()
+
     # ---- mounting + leases -----------------------------------------------
 
     def _mount(self, i: int, fence: Optional[ShardFence]) -> ObjectStore:
@@ -203,8 +266,14 @@ class ShardedObjectStore:
             self._fences[i] = fence
             self._owned[i] = True
             specs = list(self._specs)
+        if self._tailset is not None:
+            # ownership supersedes tailing: the mounted store IS this
+            # shard now; the tail's stale replica must not double-serve
+            self._tailset.set_tail(i, None)
         for spec in specs:
             spec.cancels[i] = store.watch(spec.callback, kinds=spec.kinds)
+        for hook in list(self.on_shard_mounted):
+            hook(i)
         return store
 
     def _campaign_sync(self, i: int) -> int:
@@ -222,10 +291,15 @@ class ShardedObjectStore:
                 )
             time.sleep(max(self.lease_ttl / 4.0, 0.02))
 
-    def start_campaigns(self) -> None:
+    def start_campaigns(
+        self, standby_delays: Optional[Dict[int, float]] = None
+    ) -> None:
         """Start the lease loops: renewal for owned shards, standby
         campaigns (takeover on expiry) for ``standby`` shards. No-op
-        without a lease backend."""
+        without a lease backend. ``standby_delays`` holds back a standby
+        shard's FIRST acquire attempt by that many seconds — the
+        federation rebalancer staggers campaigns by succession rank with
+        it, so N standbys don't thundering-herd one orphaned lease."""
         if not self._fenced:
             return
         for i in self.owned_shards():
@@ -242,20 +316,49 @@ class ShardedObjectStore:
         for i in self._standby_ids:
             if i in self._electors or self._owned[i]:
                 continue
-            el = self._elector(i)
+            el = self._elector(
+                i, delay=(standby_delays or {}).get(i, 0.0)
+            )
             self._electors[i] = el
             el.start(
                 on_started=self._takeover_cb(i, el),
                 on_stopped=self._deposed_cb(i),
             )
 
-    def _elector(self, i: int) -> ShardElector:
+    def stop_campaigns(self) -> None:
+        """Crash-style campaign halt: stop every elector thread WITHOUT
+        releasing leases or touching the WALs. This is the first step of
+        orderly shutdown (and of partition demotion): once it returns, no
+        renewal can extend a lease and — critically — no standby takeover
+        can fire and mount a shard into a process that is already tearing
+        down its workers and closing its logs."""
+        for el in self._electors.values():
+            el._stop.set()  # noqa: SLF001 — no release: crash-only semantics
+        for el in self._electors.values():
+            if el._thread is not None:  # noqa: SLF001
+                el._thread.join(timeout=2.0)  # noqa: SLF001
+        self._electors.clear()
+
+    def demote(self) -> None:
+        """Partition demotion: this facade keeps serving READS from its
+        mounted shards (and its tails) but can never act again — every
+        fence is deposed (sticky: actuations raise FencedOut immediately)
+        and campaigns halt so a healed lease root can't flap it back."""
+        for i, fence in enumerate(self._fences):
+            if fence is not None:
+                fence.depose()
+            if self._fenced:
+                self._owned[i] = False
+        self.stop_campaigns()
+
+    def _elector(self, i: int, delay: float = 0.0) -> ShardElector:
         return ShardElector(
             self._lease_backend,
             identity=self.identity,
             name=shard_lease_name(i),
             namespace=SHARD_LEASE_NAMESPACE,
             ttl=self.lease_ttl,
+            initial_delay=delay,
         )
 
     def _takeover_cb(self, i: int, el: ShardElector) -> Callable[[], None]:
@@ -362,6 +465,10 @@ class ShardedObjectStore:
             found = store.try_get(kind, name, namespace)
             if found is not None:
                 return found
+        for tail in self._tails():
+            found = tail.try_get(kind, name, namespace)
+            if found is not None:
+                return found
         raise NotFound(f"{kind} {namespace}/{name} not found")
 
     def try_get(
@@ -463,6 +570,8 @@ class ShardedObjectStore:
         out: List[BaseObject] = []
         for _, store in self._mounted():
             out.extend(store.list(kind, namespace=namespace, selector=selector))
+        for tail in self._tails():
+            out.extend(tail.list(kind, namespace=namespace, selector=selector))
         if self.num_shards > 1:
             out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
         return out
@@ -471,6 +580,9 @@ class ShardedObjectStore:
         seen: Dict[str, None] = {}
         for _, store in self._mounted():
             for kind in store.kinds():
+                seen[kind] = None
+        for tail in self._tails():
+            for kind in tail.kinds():
                 seen[kind] = None
         return list(seen)
 
@@ -639,12 +751,8 @@ class ShardedObjectStore:
         """Crash-style detach: halt elector loops WITHOUT releasing leases
         (standbys must win by expiry, exactly as after a real death), then
         detach every shard WAL. Use :meth:`release_shards` first for a
-        clean handoff."""
-        for el in self._electors.values():
-            el._stop.set()  # noqa: SLF001 — no release: crash-only semantics
-        for el in self._electors.values():
-            if el._thread is not None:  # noqa: SLF001
-                el._thread.join(timeout=2.0)  # noqa: SLF001
-        self._electors.clear()
+        clean handoff. Campaigns halt FIRST (:meth:`stop_campaigns`) so a
+        takeover can never fire after a shard WAL is already closed."""
+        self.stop_campaigns()
         for _, store in self._mounted():
             store.close()

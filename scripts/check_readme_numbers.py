@@ -56,6 +56,9 @@ SHARDS_ARTIFACT = "BENCH_r18_shards.json"
 #: control-plane scaling-efficiency row (r19): separate artifact, same
 #: runs[] shape (group commit + coalescing — see docs/architecture.md)
 CP_SCALE_ARTIFACT = "BENCH_r19_cp_scale.json"
+#: multi-operator federation row (r20): separate artifact, same runs[]
+#: shape (cross-process failover — see docs/architecture.md)
+FEDERATION_ARTIFACT = "BENCH_r20_federation.json"
 
 
 def _runs_median(runs, *path) -> float:
@@ -370,6 +373,29 @@ def expected_cp_scale_strings(artifact: dict) -> dict:
     }
 
 
+def expected_federation_strings(artifact: dict) -> dict:
+    """README federation row strings from BENCH_r20_federation.json."""
+    runs = artifact["runs"]
+    tgt = ("targets", "federation")
+    speedup = _runs_median(runs, *tgt, "fed_speedup_vs_inprocess_8shard")
+    r19 = _runs_median(runs, *tgt, "r19_8shard_jobs_per_s")
+    fed = _runs_median(runs, *tgt, "fed_4proc", "jobs_per_s")
+    reconverge = _runs_median(runs, *tgt, "member_kill", "reconverge_s")
+    dups = _runs_median(runs, *tgt, "member_kill", "duplicate_launches")
+    return {
+        f"**{speedup:.2f}x** the in-process 8-shard arm — "
+        f"{r19:g} -> {fed:g} jobs/s":
+            "medians of runs[].targets.federation."
+            "fed_speedup_vs_inprocess_8shard, r19_8shard_jobs_per_s and "
+            "fed_4proc.jobs_per_s",
+        f"member SIGKILL reconverges in **{reconverge:.2f} s**":
+            "median of runs[].targets.federation.member_kill.reconverge_s",
+        f"**{dups:.0f}** duplicate pod launches":
+            "median of runs[].targets.federation.member_kill."
+            "duplicate_launches",
+    }
+
+
 def check(repo: Path = REPO) -> list:
     """Returns a list of mismatch descriptions (empty = README is clean)."""
     artifact = json.loads((repo / ARTIFACT).read_text())
@@ -433,6 +459,11 @@ def check(repo: Path = REPO) -> list:
     expected.update(
         expected_cp_scale_strings(
             json.loads((repo / CP_SCALE_ARTIFACT).read_text())
+        )
+    )
+    expected.update(
+        expected_federation_strings(
+            json.loads((repo / FEDERATION_ARTIFACT).read_text())
         )
     )
     problems = []

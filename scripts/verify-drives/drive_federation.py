@@ -1,0 +1,291 @@
+"""Drive multi-operator federation with REAL process faults
+(docs/architecture.md "Multi-operator federation", docs/robustness.md
+federation runbook):
+
+1. three child processes each run one full federation member over a
+   SHARED lease/WAL root — fenced :class:`ShardedObjectStore` (6 shards,
+   group commit), flock-backed :class:`FileLeaseStore`,
+   :class:`FederationMember` (rank-staggered standby campaigns,
+   lease-root heartbeats, WAL-tail reads) and a ControllerManager
+   churning jobs through the create-pods/observe/tear-down reconcile
+   loop. Each member submits only the jobs the deterministic plan routes
+   to its shards, gated to a bounded in-flight window so the
+   ``job.pod_launch`` trace milestone measures reconcile latency;
+2. the driver SIGKILLs the seeded victim mid-churn and asserts: the
+   survivors' staggered campaigns absorb the victim's shards within ~the
+   lease TTL, launch milestones resume and time-to-launch recovers,
+   and the victim's orphaned jobs drain;
+3. then the nastiest fencing schedule, cross-process (the in-process
+   twin is tests/test_federation.py::TestFencedTakeoverSchedule): a
+   survivor is SIGSTOP'd past its lease TTL, the last member takes over
+   ALL shards and keeps launching, the stopped member is SIGCONT'd —
+   every actuation it had queued must be rejected with FencedOut (its
+   fences verify against leases now held elsewhere and depose sticky),
+   it ends up owning nothing, and it keeps running — observing, never
+   acting. (Read-only DEMOTION is the lease-root-partition response,
+   driven by the ``federation.lease_io``/``federation.heartbeat`` chaos
+   sites in tests/test_federation.py::TestPartitionDemotion — a
+   SIGSTOP'd member resumes to a healthy root, so fencing, not the
+   heartbeat deadline, is what stops it);
+4. ground truth at the end: a full WAL replay
+   (:func:`kubedl_tpu.federation.duplicate_creates`) proves no pod was
+   ever launched twice while live — across a SIGKILL, a SIGSTOP/CONT,
+   and every takeover — and the shared launches.log ledger agrees.
+
+Job volume is env-tunable: KUBEDL_DRIVE_FED_JOBS (default 720 — sized
+so an idle 1-core box cannot drain the churn before the SIGSTOP lands;
+the committed BENCH_r20_federation.json kill arm runs the same harness
+at 10k), KUBEDL_DRIVE_FED_SEED picks the SIGKILL victim.
+
+Run with `python scripts/verify-drives/drive_federation.py`
+(CPU only; control plane only — no jax needed).
+"""
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+LEASE_TTL = 1.0
+#: expiry (ttl) + staggered standby campaign + scheduling slop
+TAKEOVER_BUDGET_S = LEASE_TTL * 4 + 2.0
+SHARDS = 6
+MEMBERS = ["fed-a", "fed-b", "fed-c"]
+JOBS = int(os.environ.get("KUBEDL_DRIVE_FED_JOBS", "720"))
+SEED = int(os.environ.get("KUBEDL_DRIVE_FED_SEED", "20"))
+PODS_PER_JOB = 3
+
+
+def _read_status(path):
+    try:
+        with open(path) as fh:
+            return json.loads(fh.read())
+    except (OSError, ValueError):
+        return None
+
+
+def parent_main():
+    from kubedl_tpu.federation import duplicate_creates, plan_assignment
+    from kubedl_tpu.shards import ShardMap
+    from kubedl_tpu.shards.fencing import (
+        SHARD_LEASE_NAMESPACE, FileLeaseStore, shard_lease_name,
+    )
+
+    ok = []
+
+    def check(name, cond, detail=""):
+        ok.append(bool(cond))
+        print(("PASS" if cond else "FAIL"), name, detail)
+
+    def poll(path, pred, timeout):
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            st = _read_status(path)
+            if st is not None and pred(st):
+                return st
+            time.sleep(0.05)
+        return _read_status(path)
+
+    tmp = tempfile.mkdtemp(prefix="kdl-fed-drive-")
+    wal_root = os.path.join(tmp, "wal")
+    lease_dir = os.path.join(tmp, "leases")
+    launch_log = os.path.join(tmp, "launches.log")
+    stop_path = os.path.join(tmp, "stop")
+    open(launch_log, "w").close()
+    status = {m: os.path.join(tmp, f"status_{m}.json") for m in MEMBERS}
+    backend = FileLeaseStore(lease_dir)
+
+    # the same static math every member derives: which jobs are whose
+    plan = plan_assignment(SHARDS, MEMBERS)
+    shard_owner = {i: m for m, shards in plan.items() for i in shards}
+    smap = ShardMap(SHARDS)
+    share = {m: 0 for m in MEMBERS}
+    for i in range(JOBS):
+        share[shard_owner[smap.lookup(f"default/fed-{i:05d}")]] += 1
+
+    victim = MEMBERS[SEED % len(MEMBERS)]
+    survivors = [m for m in MEMBERS if m != victim]
+    stopped, last = survivors[0], survivors[1]
+    print(f"jobs={JOBS} seed={SEED}: SIGKILL {victim}, "
+          f"SIGSTOP {stopped}, {last} inherits everything")
+
+    def holders():
+        out = {}
+        for i in range(SHARDS):
+            lease = backend.try_get(
+                "Lease", shard_lease_name(i), SHARD_LEASE_NAMESPACE)
+            out[i] = lease.holder if lease is not None else None
+        return out
+
+    procs = {}
+    try:
+        for m in MEMBERS:
+            cfg = {
+                "mode": "member", "identity": m, "peers": MEMBERS,
+                "shards": SHARDS, "jobs": JOBS,
+                "pods_per_job": PODS_PER_JOB,
+                "lease_dir": lease_dir, "wal_dir": wal_root,
+                "lease_ttl": LEASE_TTL, "group_window_ms": 5.0,
+                "coalesce_ms": 20.0, "wave": 8, "max_inflight": 24,
+                "launch_telemetry": True, "launch_log": launch_log,
+                "status_path": status[m], "stop_path": stop_path,
+            }
+            procs[m] = subprocess.Popen(
+                [sys.executable, "-m", "kubedl_tpu.federation.bench_worker",
+                 json.dumps(cfg)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+                cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))),
+            )
+
+        # --- phase 1: healthy federation churning -------------------------
+        sts = {m: poll(status[m], lambda s: s["completed"] >= 10, 90.0)
+               for m in MEMBERS}
+        check("all three members own their planned shards and churn",
+              all(sts[m] and sts[m]["completed"] >= 10
+                  and sorted(sts[m]["owned"]) == sorted(plan[m])
+                  for m in MEMBERS),
+              " ".join(f"{m}:{sts[m] and sts[m]['completed']}"
+                       for m in MEMBERS))
+        if not all(sts.values()):
+            return finish(ok, tmp, procs)
+        baseline_ms = max(sts[m]["recent_launch_ms"] for m in survivors)
+
+        # --- phase 2: seeded SIGKILL mid-churn ----------------------------
+        check("victim killed with jobs in flight",
+              sts[victim]["submitted"] > sts[victim]["completed"],
+              str({k: sts[victim][k] for k in ("submitted", "completed")}))
+        t_kill = time.perf_counter()
+        t_kill_wall = time.time()
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait(timeout=10)
+
+        deadline = time.perf_counter() + TAKEOVER_BUDGET_S + 10.0
+        reconverge_s = None
+        while time.perf_counter() < deadline:
+            h = holders()
+            if all(h[i] in survivors for i in plan[victim]):
+                reconverge_s = time.perf_counter() - t_kill
+                break
+            time.sleep(0.05)
+        check(f"survivors absorbed the victim's shards "
+              f"(<{TAKEOVER_BUDGET_S:.0f}s)",
+              reconverge_s is not None
+              and reconverge_s < TAKEOVER_BUDGET_S,
+              f"{reconverge_s and f'{reconverge_s:.2f}'}s "
+              f"holders={holders()}")
+
+        # --- phase 3: SIGSTOP a survivor past its TTL ---------------------
+        # freeze RIGHT after reconvergence, while the survivor is still
+        # MID-SUBMISSION with a live in-flight window — the queued
+        # reconciles must get fenced on resume, and the next submit wave
+        # it attempts must be rejected at assert_fenced_actuation (both
+        # print FencedOut to its stderr). Poll for a fresh status showing
+        # both conditions rather than trusting one stale read: on an idle
+        # box the churn drains fast enough to close the window.
+        st = poll(status[stopped],
+                  lambda s: s["submitted"] < share[stopped]
+                  and s["submitted"] - s["completed"] >= 4,
+                  30.0)
+        os.kill(procs[stopped].pid, signal.SIGSTOP)
+        check("survivor frozen mid-submission with jobs in flight",
+              st and st["submitted"] < share[stopped]
+              and st["submitted"] - st["completed"] >= 4,
+              str(st and {k: st[k] for k in
+                          ("submitted", "completed")})
+              + f" share={share[stopped]}")
+        t_stop = time.perf_counter()
+        st = poll(status[last],
+                  lambda s: sorted(s["owned"]) == list(range(SHARDS)),
+                  TAKEOVER_BUDGET_S * 2 + 10.0)
+        check("last member took over ALL shards from the stopped one",
+              st and sorted(st["owned"]) == list(range(SHARDS)),
+              f"{time.perf_counter() - t_stop:.2f}s "
+              f"owned={st and st['owned']}")
+        # hold the freeze past the TTL so the resume is unambiguously
+        # stale, then let the old owner's queued actuations fire
+        time.sleep(max(0.0, LEASE_TTL * 1.5 - (time.perf_counter() - t_stop)))
+        os.kill(procs[stopped].pid, signal.SIGCONT)
+        st = poll(status[stopped], lambda s: s.get("owned") == [], 30.0)
+        check("resumed member observes but owns nothing",
+              st and st.get("owned") == [], str(st))
+
+        st = poll(status[last],
+                  lambda s: s["last_launch_at"] > t_kill_wall, 60.0)
+        check("launch milestones resumed after the faults",
+              st and st["last_launch_at"] > t_kill_wall, str(st))
+
+        # --- phase 4: drain + ground truth --------------------------------
+        st = poll(
+            status[last],
+            lambda s: s["submitted"] >= share[last]
+            and s["remaining_jobs"] == 0,
+            240.0,
+        )
+        check("last member drained every live job on all shards",
+              st and st["remaining_jobs"] == 0
+              and st["submitted"] >= share[last], str(st))
+        check("time-to-launch recovered after the takeovers",
+              st and st["recent_launch_ms"] < TAKEOVER_BUDGET_S * 1e3,
+              f"baseline={baseline_ms:.0f}ms "
+              f"final={st and st['recent_launch_ms']:.0f}ms")
+
+        open(stop_path, "w").write("x")
+        for m in (stopped, last):
+            try:
+                procs[m].wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+        check("surviving members exited cleanly on the stop signal",
+              all(procs[m].returncode == 0 for m in (stopped, last)),
+              str({m: procs[m].returncode for m in (stopped, last)}))
+
+        stopped_err = (procs[stopped].stderr.read()
+                       if procs[stopped].stderr else "")
+        check("resumed member's queued actuations were fenced",
+              "FencedOut" in stopped_err,
+              f"{stopped_err.count('FencedOut')} FencedOut rejections "
+              "in its log")
+
+        dups = duplicate_creates(wal_root, SHARDS)
+        check("WAL replay: zero duplicate pod launches", dups == [],
+              f"dups={dups[:5]}")
+        lines = [l for l in open(launch_log).read().splitlines() if l]
+        relaunches = len(lines) - len(set(lines))
+        # the ledger may legitimately re-list a pod whose delete was
+        # durable before the SIGKILL (see duplicate_creates docstring) —
+        # the WAL audit above is the gate; the ledger must stay close
+        check("launch ledger consistent with the WAL audit",
+              relaunches <= PODS_PER_JOB,
+              f"{len(lines)} launches, {relaunches} ledger re-lists")
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                try:
+                    os.kill(p.pid, signal.SIGCONT)
+                except OSError:
+                    pass
+                p.kill()
+                p.wait(timeout=10)
+    return finish(ok, tmp, procs)
+
+
+def finish(ok, tmp, procs):
+    for m, p in procs.items():
+        if p.stderr is not None and p.returncode not in (None, -signal.SIGKILL):
+            err = p.stderr.read()[-400:]
+            if err:
+                print(f"--- member {m} stderr ---\n{err}")
+    shutil.rmtree(tmp, ignore_errors=True)
+    print(f"\n{sum(ok)}/{len(ok)} checks passed")
+    return 0 if all(ok) and ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(parent_main())

@@ -1,0 +1,25 @@
+"""Seed fixture: the unfenced-actuation shape PR 20's federation forbids
+— a reconcile reserves slice capacity in pure memory and launches a pod
+batch without ever consulting the shard's fencing token, so a SIGSTOP'd
+owner resumed past its lease TTL replays both against a shard a live
+member now owns."""
+
+
+def admit_gang(scheduler, gang, owner):
+    assigned = scheduler.inventory.try_reserve(
+        gang.slice_type, gang.num_slices, owner
+    )  # memory-only reservation, no fence consulted
+    if not assigned:
+        return False
+    scheduler.store.update_with_retry(
+        "PodGroup", gang.metadata.name, gang.metadata.namespace, lambda o: o
+    )
+    return True
+
+
+def launch_pods(store, pods):
+    return store.create_many(pods)  # externally visible, unfenced
+
+
+def reap_pod(store, pod):
+    store.try_delete("Pod", pod.metadata.name, pod.metadata.namespace)

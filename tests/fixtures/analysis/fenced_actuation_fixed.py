@@ -1,0 +1,36 @@
+"""Fixed shape: every externally-visible actuation is gated by
+assert_fenced_actuation earlier in the same function, so a deposed or
+stale owner rejects the whole side effect (FencedOut) before any part
+of it — including the memory-only inventory reservation — fires."""
+
+from kubedl_tpu.federation.actuation import assert_fenced_actuation
+
+
+def admit_gang(scheduler, gang, owner):
+    assert_fenced_actuation(
+        scheduler.store, gang.metadata.namespace, gang.metadata.name,
+        action="gang bind",
+    )
+    assigned = scheduler.inventory.try_reserve(
+        gang.slice_type, gang.num_slices, owner
+    )
+    if not assigned:
+        return False
+    scheduler.store.update_with_retry(
+        "PodGroup", gang.metadata.name, gang.metadata.namespace, lambda o: o
+    )
+    return True
+
+
+def launch_pods(store, job, pods):
+    assert_fenced_actuation(
+        store, job.metadata.namespace, job.metadata.name, action="pod launch"
+    )
+    return store.create_many(pods)
+
+
+def reap_pod(store, root, pod):
+    assert_fenced_actuation(
+        store, pod.metadata.namespace, root, action="pod delete"
+    )
+    store.try_delete("Pod", pod.metadata.name, pod.metadata.namespace)

@@ -55,7 +55,8 @@ class TestSeedRegressions:
         ("asarray_mirror", "KTL001"),   # PR 8: self._bt_host borrow
         ("env_race", "KTL003"),         # PR 6: environ rewrite on re-entry
         ("lock_blocking", "KTL002"),    # PR 11: harvest under the cv
-        ("fsync_loop", "KTL010"),       # PR 19: fsync-per-append at scale
+        ("fsync_loop", "KTL010"),
+        ("fenced_actuation", "KTL011"),       # PR 19: fsync-per-append at scale
     ]
 
     @pytest.mark.parametrize("name,rule", CASES)

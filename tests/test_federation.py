@@ -1,0 +1,669 @@
+"""Multi-operator federation: deterministic placement + staggered
+succession, WAL-tail read replicas, fenced actuation under the nastiest
+SIGSTOP-past-TTL schedule, partition demotion, real-subprocess lease
+takeover timing, and the Operator.stop() ordering pin."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from kubedl_tpu import chaos
+from kubedl_tpu.chaos import FaultPlan, FaultSpec
+from kubedl_tpu.core.manager import ControllerManager, owner_mapper
+from kubedl_tpu.core.objects import OwnerRef, Pod
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.core.wal import WriteAheadLog
+from kubedl_tpu.federation import (
+    FederationMember,
+    ShardWalTail,
+    actuation_root,
+    assert_fenced_actuation,
+    campaign_delay,
+    duplicate_creates,
+    plan_assignment,
+    rank_of,
+    successors,
+)
+from kubedl_tpu.shards import (
+    FencedOut,
+    FileLeaseStore,
+    ShardedObjectStore,
+    acquire_shard_lease,
+)
+from kubedl_tpu.workloads.tpujob import TPUJob
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MEMBERS = ["op-a", "op-b", "op-c"]
+
+
+def _job(name, namespace="default"):
+    job = TPUJob()
+    job.metadata.name = name
+    job.metadata.namespace = namespace
+    return job
+
+
+def _pod(name, owner=None, namespace="default"):
+    pod = Pod()
+    pod.metadata.name = name
+    pod.metadata.namespace = namespace
+    if owner is not None:
+        pod.metadata.owner_refs.append(OwnerRef(
+            kind=owner.kind, name=owner.metadata.name,
+            uid=owner.metadata.uid, controller=True,
+        ))
+    return pod
+
+
+def _wait(pred, timeout, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class TestRebalance:
+    def test_succession_is_total_deterministic_and_identical(self):
+        for shard in range(16):
+            order = successors(shard, MEMBERS)
+            assert sorted(order) == sorted(MEMBERS)
+            # every member computes the identical order from the list
+            assert order == successors(shard, list(MEMBERS))
+            assert [rank_of(shard, m, MEMBERS) for m in order] == [0, 1, 2]
+
+    def test_plan_covers_every_shard_exactly_once(self):
+        plan = plan_assignment(8, MEMBERS)
+        owned = sorted(i for shards in plan.values() for i in shards)
+        assert owned == list(range(8))
+
+    def test_orphans_spread_across_survivors(self):
+        """Per-shard independent ranking: a dead member's shards must not
+        all dogpile one successor (checked over enough shards that a
+        constant-successor bug cannot hide)."""
+        members = [f"m{i}" for i in range(4)]
+        heirs = {
+            successors(shard, members)[1]
+            for shard in range(32)
+            if successors(shard, members)[0] == members[0]
+        }
+        assert len(heirs) > 1, heirs
+
+    def test_campaign_delay_staggers_by_rank(self):
+        ttl = 2.0
+        for shard in range(8):
+            delays = sorted(
+                campaign_delay(shard, m, MEMBERS, ttl) for m in MEMBERS
+            )
+            # planned owner campaigns immediately; each later rank holds
+            # back one more stagger step, all strictly below 2 TTLs
+            assert delays[0] == 0.0
+            assert delays == [0.0, ttl * 0.5, ttl * 1.0]
+
+
+class TestShardWalTail:
+    def test_incremental_refresh_serves_owner_writes(self, tmp_path):
+        owner = ObjectStore(wal_dir=str(tmp_path), wal_snapshot_every=10**6)
+        tail = ShardWalTail(str(tmp_path))
+        job = _job("t1")
+        owner.create(job)
+        events = tail.refresh()
+        assert [e[0] for e in events] == ["ADDED"]
+        assert tail.try_get("TPUJob", "t1") is not None
+        cursor = tail._cursor
+        owner.create(_pod("t1-p0", owner=job))
+        tail.refresh()
+        # incremental: the cursor advanced instead of re-reading from 0
+        assert tail._cursor > cursor
+        assert {o.metadata.name for o in tail.list("Pod")} == {"t1-p0"}
+        owner.delete("Pod", "t1-p0", "default")
+        events = tail.refresh()
+        assert [e[0] for e in events] == ["DELETED"]
+        assert tail.list("Pod") == []
+        owner.close()
+
+    def test_torn_tail_tolerated_without_truncation(self, tmp_path):
+        owner = ObjectStore(wal_dir=str(tmp_path), wal_snapshot_every=10**6)
+        owner.create(_job("t1"))
+        tail = ShardWalTail(str(tmp_path))
+        tail.refresh()
+        # simulate the owner mid-append: a record header promising more
+        # payload bytes than exist yet
+        log_path = os.path.join(str(tmp_path), "wal.log")
+        size = os.path.getsize(log_path)
+        with open(log_path, "ab") as fh:
+            fh.write(b"\xff\x00\x00\x00\x12\x34\x56\x78half")
+        assert tail.refresh() == []  # scan stops at the torn record
+        assert tail.try_get("TPUJob", "t1") is not None
+        # read-only contract: the tail never truncated the owner's log
+        assert os.path.getsize(log_path) > size
+        owner.close()
+
+    def test_compaction_triggers_rebuild_from_snapshot(self, tmp_path):
+        owner = ObjectStore(wal_dir=str(tmp_path), wal_snapshot_every=4)
+        tail = ShardWalTail(str(tmp_path))
+        for i in range(3):
+            owner.create(_job(f"t{i}"))
+        tail.refresh()
+        assert tail.object_count() == 3
+        # crossing snapshot_every compacts: snapshot written, log
+        # truncated -> the tail sees the segment shrink below its cursor
+        # and rebuilds, converging on the same objects
+        for i in range(3, 8):
+            owner.create(_job(f"t{i}"))
+        tail.refresh()
+        assert {o.metadata.name for o in tail.list("TPUJob")} == {
+            f"t{i}" for i in range(8)
+        }
+        owner.close()
+
+    def test_facade_serves_unowned_shards_from_tails(self, tmp_path):
+        """Cross-shard visibility: a member that owns NOTHING still
+        answers get/list for every shard by tailing the owners' WAL
+        segments — and still cannot actuate."""
+        lease_dir = str(tmp_path / "leases")
+        wal_dir = str(tmp_path / "wal")
+        owner = ShardedObjectStore(
+            shards=4, wal_dir=wal_dir,
+            lease_backend=FileLeaseStore(lease_dir), identity="owner",
+            lease_ttl=5.0, own=list(range(4)),
+        )
+        names = [f"vis-{i}" for i in range(12)]
+        for n in names:
+            owner.create(_job(n))
+        reader = ShardedObjectStore(
+            shards=4, wal_dir=wal_dir,
+            lease_backend=FileLeaseStore(lease_dir), identity="reader",
+            lease_ttl=5.0, own=[], standby=[],
+        )
+        reader.enable_tail_reads()
+        reader.refresh_tails()
+        assert {
+            o.metadata.name for o in reader.list("TPUJob", None)
+        } == set(names)
+        assert reader.get("TPUJob", names[0]).metadata.name == names[0]
+        with pytest.raises(FencedOut):
+            reader.create(_job("vis-write"))
+        with pytest.raises(FencedOut):
+            assert_fenced_actuation(reader, "default", names[0],
+                                    action="pod launch")
+        reader.close()
+        owner.close()
+
+
+class TestDuplicateCreatesAudit:
+    def _append(self, wal, rev, op, name, uid):
+        wal.append(rev, op, "Pod", "default", name, obj={
+            "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default", "uid": uid},
+        } if op == "PUT" else None)
+
+    def test_recreate_after_durable_delete_is_not_a_duplicate(self, tmp_path):
+        seg = tmp_path / "shard-0"
+        seg.mkdir()
+        wal = WriteAheadLog(str(seg))
+        wal.recover()
+        self._append(wal, 1, "PUT", "p0", "uid-1")
+        self._append(wal, 2, "PUT", "p0", "uid-1")  # status update: same uid
+        self._append(wal, 3, "DELETE", "p0", "")
+        self._append(wal, 4, "PUT", "p0", "uid-2")  # fresh generation
+        wal.close()
+        assert duplicate_creates(str(tmp_path), 1) == []
+
+    def test_second_create_of_live_name_is_flagged(self, tmp_path):
+        seg = tmp_path / "shard-0"
+        seg.mkdir()
+        wal = WriteAheadLog(str(seg))
+        wal.recover()
+        self._append(wal, 1, "PUT", "p0", "uid-1")
+        self._append(wal, 2, "PUT", "p0", "uid-2")  # live name, new uid
+        wal.close()
+        assert duplicate_creates(str(tmp_path), 1) == ["p0"]
+
+
+class TestFencedTakeoverSchedule:
+    def test_sigstop_past_ttl_old_owner_observes_but_never_acts(
+        self, tmp_path
+    ):
+        """The nastiest schedule (also drilled cross-process by
+        scripts/verify-drives/drive_federation.py): the owner stalls past
+        its lease TTL without renewing (the in-process equivalent of
+        SIGSTOP), a standby takes its shards over and launches pods, the
+        old owner resumes — every queued actuation must be rejected with
+        FencedOut, its reads must keep working, and the WAL audit must
+        show zero duplicate pod launches."""
+        ttl = 0.5
+        lease_dir = str(tmp_path / "leases")
+        wal_dir = str(tmp_path / "wal")
+        old = ShardedObjectStore(
+            shards=2, wal_dir=wal_dir,
+            lease_backend=FileLeaseStore(lease_dir), identity="old",
+            lease_ttl=ttl, own=[0, 1],
+        )
+        job = _job("g1")
+        old.create(job)
+        old.create_many([_pod(f"g1-p{k}", owner=job) for k in range(3)])
+        # the owner stalls: no campaigns running, so nothing renews and
+        # both leases expire on the shared root
+        time.sleep(ttl * 1.3)
+        new = ShardedObjectStore(
+            shards=2, wal_dir=wal_dir,
+            lease_backend=FileLeaseStore(lease_dir), identity="new",
+            lease_ttl=ttl, own=[], standby=[0, 1],
+        )
+        try:
+            new.start_campaigns()
+            assert _wait(lambda: new.owned_shards() == [0, 1], ttl * 8)
+            # rehydrate-then-adopt: the standby sees the old owner's world
+            assert new.get("TPUJob", "g1") is not None
+            assert len(new.list("Pod", "default")) == 3
+            # ...and acts on it: launch the rest of the gang
+            new.create_many([_pod(f"g1-p{k}", owner=job) for k in (3, 4)])
+
+            # the old owner resumes. It may observe...
+            assert old.get("TPUJob", "g1") is not None
+            # ...but every externally-visible actuation it had queued is
+            # rejected: the fencing gate first,
+            for action in ("pod launch", "gang bind", "slice reservation",
+                           "pod delete"):
+                with pytest.raises(FencedOut):
+                    assert_fenced_actuation(old, "default", "g1",
+                                            action=action)
+            # and the store write paths behind it
+            with pytest.raises(FencedOut):
+                old.create_many([_pod("g1-p9", owner=job)])
+            with pytest.raises(FencedOut):
+                old.try_delete("Pod", "g1-p0", "default")
+            # fencing is sticky: still fenced after the first rejection
+            with pytest.raises(FencedOut):
+                old.create(_job("g2"))
+        finally:
+            new.close()
+            old.close()
+        # ground truth: nothing was ever launched twice
+        assert duplicate_creates(wal_dir, 2) == []
+
+    def test_actuation_root_follows_controller_ref(self):
+        job = _job("g1")
+        pod = _pod("g1-p0", owner=job)
+        assert actuation_root(pod) == "g1"
+        assert actuation_root(job) == "g1"
+
+
+class TestPartitionDemotion:
+    def test_lost_lease_root_demotes_before_ttl(self, tmp_path):
+        """federation.lease_io: a member that cannot reach the lease root
+        demotes to read-only in < demotion_deadline + one beat — strictly
+        before its leases can have been re-acquired elsewhere — and keeps
+        serving reads from its mounted shards."""
+        ttl = 1.5
+        store = ShardedObjectStore(
+            shards=2, wal_dir=str(tmp_path / "wal"),
+            lease_backend=FileLeaseStore(str(tmp_path / "leases")),
+            identity="op-a", lease_ttl=ttl, own=[0, 1],
+        )
+        store.create(_job("d1"))
+        member = FederationMember(
+            store, store._lease_backend, "op-a", ["op-a"],
+            lease_ttl=ttl, heartbeat_interval=0.05,
+            demotion_deadline=0.3,
+        )
+        chaos.arm(FaultPlan(seed=20, sites={
+            "federation.lease_io": [FaultSpec.always()],
+        }))
+        try:
+            t0 = time.monotonic()
+            member.start()
+            assert _wait(lambda: member.read_only, ttl * 2)
+            demoted_after = time.monotonic() - t0
+            assert demoted_after < ttl, demoted_after
+            assert member.heartbeat_misses > 0
+            assert member.demotions == 1
+            # demoted: observes (mounted shards still answer reads)...
+            assert store.get("TPUJob", "d1") is not None
+            # ...but can never act again
+            with pytest.raises(FencedOut):
+                store.create(_job("d2"))
+        finally:
+            member.stop()
+            chaos.disarm()
+            store.close()
+
+    def test_wedged_heartbeat_site_counts_misses(self, tmp_path):
+        store = ShardedObjectStore(
+            shards=1, wal_dir=str(tmp_path / "wal"),
+            lease_backend=FileLeaseStore(str(tmp_path / "leases")),
+            identity="op-a", lease_ttl=2.0, own=[0],
+        )
+        member = FederationMember(
+            store, store._lease_backend, "op-a", ["op-a"], lease_ttl=2.0,
+            heartbeat_interval=0.05, demotion_deadline=0.5,
+        )
+        chaos.arm(FaultPlan(seed=20, sites={
+            "federation.heartbeat": [FaultSpec.nth(1)],
+        }))
+        try:
+            member._heartbeat_once()  # beat 1: wedged publisher
+            member._heartbeat_once()  # beat 2: healthy
+            assert member.heartbeat_misses == 1
+            assert member.heartbeats == 1
+            assert not member.read_only
+        finally:
+            chaos.disarm()
+            store.close()
+
+    def test_presence_and_live_members(self, tmp_path):
+        store = ShardedObjectStore(
+            shards=1, wal_dir=str(tmp_path / "wal"),
+            lease_backend=FileLeaseStore(str(tmp_path / "leases")),
+            identity="op-a", lease_ttl=2.0, own=[0],
+        )
+        member = FederationMember(
+            store, store._lease_backend, "op-a", MEMBERS, lease_ttl=2.0,
+        )
+        member._heartbeat_once()
+        assert member.live_members() == ["op-a"]
+        store.close()
+
+
+class TestManagerShardWorkers:
+    def test_takeover_mount_spawns_worker_pool(self, tmp_path):
+        """A federated standby starts with worker pools only for owned
+        shards; a takeover AFTER start() must spawn the new shard's pool
+        via the store's on_shard_mounted hook — otherwise adopted keys
+        sit in a queue nothing drains."""
+        lease_dir = str(tmp_path / "leases")
+        wal_dir = str(tmp_path / "wal")
+        seeded = ShardedObjectStore(
+            shards=2, wal_dir=wal_dir,
+            lease_backend=FileLeaseStore(lease_dir), identity="seed",
+            lease_ttl=0.5, own=[0, 1],
+        )
+        for i in range(8):
+            seeded.create(_job(f"tk-{i}"))
+        seeded.stop_campaigns()  # crash-style: leases expire, WAL stays
+        seeded.close()
+        time.sleep(0.7)
+
+        standby = ShardedObjectStore(
+            shards=2, wal_dir=wal_dir,
+            lease_backend=FileLeaseStore(lease_dir), identity="standby",
+            lease_ttl=0.5, own=[], standby=[0, 1],
+        )
+        manager = ControllerManager(store=standby)
+        done = set()
+        lock = threading.Lock()
+
+        def reconcile(namespace, name):
+            with lock:
+                done.add(name)
+            return None
+
+        manager.register(
+            "tk", reconcile, watch_kinds=["TPUJob"],
+            mapper=owner_mapper("TPUJob"), workers=1, resync_on_start=True,
+        )
+        reg = manager._registrations[0]
+        manager.start()
+        assert reg.worker_shards == set()  # nothing owned yet
+        standby.start_campaigns()
+        try:
+            assert _wait(lambda: standby.owned_shards() == [0, 1], 5.0)
+            # the takeover mounts fired the hook: pools exist and the
+            # rehydrated jobs' ADDED events were reconciled
+            assert _wait(lambda: reg.worker_shards == {0, 1}, 2.0)
+            assert _wait(
+                lambda: done == {f"tk-{i}" for i in range(8)}, 5.0
+            ), done
+        finally:
+            manager.stop()
+            standby.close()
+
+
+@pytest.mark.slow
+class TestFileLeaseTakeoverTiming:
+    """Satellite: FileLeaseStore takeover timing across REAL processes —
+    the cross-process twin of test_leader.py::TestFailoverTiming."""
+
+    TTL = 1.5
+
+    HOLDER = textwrap.dedent("""
+        import os, sys, time
+        from kubedl_tpu.shards.fencing import (
+            SHARD_LEASE_NAMESPACE, FileLeaseStore, ShardElector,
+            shard_lease_name,
+        )
+        root, ttl = sys.argv[1], float(sys.argv[2])
+        backend = FileLeaseStore(os.path.join(root, "leases"))
+        el = ShardElector(
+            backend, identity="child", name=shard_lease_name(0),
+            namespace=SHARD_LEASE_NAMESPACE, ttl=ttl,
+        )
+        el.start()
+        while not el.is_leader:
+            time.sleep(0.01)
+        open(os.path.join(root, "acquired"), "w").write("ok")
+        while not os.path.exists(os.path.join(root, "stop")):
+            time.sleep(0.01)
+        el.stop()  # clean: releases the lease
+    """)
+
+    def _spawn(self, script, *args):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        return subprocess.Popen(
+            [sys.executable, "-c", script, *args],
+            env=env, cwd=REPO_ROOT,
+        )
+
+    def _acquire_delay(self, root, timeout):
+        backend = FileLeaseStore(os.path.join(root, "leases"))
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        while time.monotonic() < deadline:
+            if acquire_shard_lease(backend, 0, "parent", ttl=self.TTL) is not None:
+                return time.monotonic() - t0
+            time.sleep(0.02)
+        pytest.fail(f"parent could not take over within {timeout}s")
+
+    def test_clean_release_hands_over_within_a_renew_interval(self, tmp_path):
+        child = self._spawn(self.HOLDER, str(tmp_path), str(self.TTL))
+        try:
+            assert _wait(
+                lambda: os.path.exists(str(tmp_path / "acquired")), 20.0
+            )
+            open(str(tmp_path / "stop"), "w").write("x")
+            delay = self._acquire_delay(str(tmp_path), self.TTL * 4)
+            assert delay < self.TTL * 0.6, delay
+            assert child.wait(timeout=10) == 0
+        finally:
+            if child.poll() is None:
+                child.kill()
+
+    def test_sigkilled_holder_waits_out_the_ttl(self, tmp_path):
+        child = self._spawn(self.HOLDER, str(tmp_path), str(self.TTL))
+        try:
+            assert _wait(
+                lambda: os.path.exists(str(tmp_path / "acquired")), 20.0
+            )
+            child.kill()  # SIGKILL: no release — the lease must EXPIRE
+            child.wait()
+            delay = self._acquire_delay(str(tmp_path), self.TTL * 4)
+            assert delay > self.TTL * 0.55, delay
+        finally:
+            if child.poll() is None:
+                child.kill()
+
+    STOPPED = textwrap.dedent("""
+        import os, sys, time
+        from kubedl_tpu.core.wal import WriteAheadLog
+        from kubedl_tpu.shards.fencing import (
+            SHARD_LEASE_NAMESPACE, FencedOut, FencedWal, FileLeaseStore,
+            ShardElector, ShardFence, shard_lease_name,
+        )
+        root, ttl = sys.argv[1], float(sys.argv[2])
+        backend = FileLeaseStore(os.path.join(root, "leases"))
+        el = ShardElector(
+            backend, identity="child", name=shard_lease_name(0),
+            namespace=SHARD_LEASE_NAMESPACE, ttl=ttl,
+        )
+        el.start()
+        while not el.is_leader:
+            time.sleep(0.01)
+        fence = ShardFence(
+            backend, 0, "child", el.fence_token, verify_interval=0.0,
+        )
+        raw = WriteAheadLog(os.path.join(root, "wal"))
+        os.makedirs(raw.dir, exist_ok=True)
+        raw.recover()
+        wal = FencedWal(raw, fence)
+        wal.append(1, "PUT", "Pod", "default", "p0",
+                   obj={"kind": "Pod", "metadata": {"name": "p0"}})
+        open(os.path.join(root, "acquired"), "w").write("ok")
+        # parent SIGSTOPs us here, waits out the TTL, takes the lease,
+        # then SIGCONTs and drops the go file
+        while not os.path.exists(os.path.join(root, "go")):
+            time.sleep(0.01)
+        try:
+            wal.append(2, "PUT", "Pod", "default", "p1",
+                       obj={"kind": "Pod", "metadata": {"name": "p1"}})
+        except FencedOut:
+            open(os.path.join(root, "fenced"), "w").write("ok")
+            sys.exit(0)
+        sys.exit(3)  # durable append went through with a stale token
+    """)
+
+    def test_resumed_sigstopped_holder_is_fenced_on_next_append(
+        self, tmp_path
+    ):
+        child = self._spawn(self.STOPPED, str(tmp_path), str(self.TTL))
+        try:
+            assert _wait(
+                lambda: os.path.exists(str(tmp_path / "acquired")), 20.0
+            )
+            os.kill(child.pid, signal.SIGSTOP)  # freeze renewals mid-hold
+            self._acquire_delay(str(tmp_path), self.TTL * 4)
+            os.kill(child.pid, signal.SIGCONT)
+            open(str(tmp_path / "go"), "w").write("x")
+            assert child.wait(timeout=20) == 0
+            assert os.path.exists(str(tmp_path / "fenced"))
+        finally:
+            if child.poll() is None:
+                os.kill(child.pid, signal.SIGCONT)
+                child.kill()
+
+
+class TestStopOrdering:
+    def test_stop_during_commit_window_loses_no_acked_record(self, tmp_path):
+        """The Operator.stop() ordering pin (named in operator.py): the
+        federation member and shard campaigns stop first, then workers,
+        and the WAL closes LAST — so a stop() racing an in-flight
+        group-commit window surfaces no append-after-close and every
+        record acked before stop() was called is durable."""
+        from kubedl_tpu.operator import Operator, OperatorOptions
+
+        opts = OperatorOptions(
+            local_addresses=True,
+            pod_log_dir=str(tmp_path / "logs"),
+            artifact_registry_root=str(tmp_path / "registry"),
+            control_plane_shards=2,
+            wal_dir=str(tmp_path / "wal"),
+            wal_fsync="group",
+            wal_group_window_ms=25.0,
+            wal_snapshot_every=10**6,
+            shard_lease_dir=str(tmp_path / "leases"),
+            shard_lease_ttl=2.0,
+            federation=True,
+            federation_peers=["solo"],
+            leader_identity="solo",
+        )
+        op = Operator(opts)
+        op.start()
+        assert op.federation is not None
+        assert _wait(lambda: op.store.owned_shards() == [0, 1], 10.0)
+
+        acked = []
+        failure = []
+        quit_evt = threading.Event()
+
+        def writer():
+            i = 0
+            while not quit_evt.is_set():
+                job = _job(f"sw-{i:04d}")
+                try:
+                    op.store.create(job)  # returns only once durable
+                except FencedOut:
+                    return  # acceptable: fenced after demotion/close
+                except Exception as exc:  # noqa: BLE001 — the pin
+                    failure.append(exc)
+                    return
+                acked.append(job.metadata.name)
+                i += 1
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        assert _wait(lambda: len(acked) >= 5, 10.0)
+        # stop mid-commit-window: records are staged and unacked RIGHT NOW
+        acked_before_stop = list(acked)
+        op.stop()
+        quit_evt.set()
+        t.join(timeout=10)
+        assert not failure, failure
+
+        rehydrated = ShardedObjectStore(
+            shards=2, wal_dir=str(tmp_path / "wal"),
+        )
+        names = {o.metadata.name for o in rehydrated.list("TPUJob", None)}
+        missing = set(acked_before_stop) - names
+        assert not missing, f"acked records lost across stop(): {missing}"
+        rehydrated.close()
+
+    def test_federation_member_stops_before_store_closes(self, tmp_path):
+        """Order probe: by the time the store closes, the federation
+        loops and campaign electors must already be down — a takeover
+        firing into a closing process is the bug class this pins."""
+        from kubedl_tpu.operator import Operator, OperatorOptions
+
+        opts = OperatorOptions(
+            local_addresses=True,
+            pod_log_dir=str(tmp_path / "logs"),
+            artifact_registry_root=str(tmp_path / "registry"),
+            control_plane_shards=2,
+            wal_dir=str(tmp_path / "wal"),
+            shard_lease_dir=str(tmp_path / "leases"),
+            shard_lease_ttl=2.0,
+            federation=True,
+            federation_peers=["solo"],
+            leader_identity="solo",
+        )
+        op = Operator(opts)
+        op.start()
+        assert _wait(lambda: op.store.owned_shards() == [0, 1], 10.0)
+        order = []
+        member_stop = op.federation.stop
+        store_close = op.store.close
+
+        def spying_member_stop():
+            order.append("member")
+            member_stop()
+
+        def spying_store_close():
+            order.append("close")
+            assert not op.store._electors, (
+                "campaign electors still running at store close"
+            )
+            store_close()
+
+        op.federation.stop = spying_member_stop
+        op.store.close = spying_store_close
+        op.stop()
+        assert order == ["member", "close"]
